@@ -1,0 +1,38 @@
+"""lightgbm_tpu — a TPU-native gradient boosting framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capability surface of
+LightGBM v2.2.3 (reference: ``/root/reference``): histogram-based leaf-wise
+GBDT/DART/GOSS/RF/MVS boosting, the full objective/metric zoo, quantile
+binning with categorical and missing-value handling, distributed
+data/feature/voting-parallel learning over a ``jax.sharding.Mesh``, and a
+Python ``train/cv/Dataset/Booster`` + sklearn + CLI API.
+"""
+from .config import Config
+from .utils.log import Log, LightGBMError
+
+__version__ = "0.1.0"
+
+__all__ = ["Config", "Log", "LightGBMError", "__version__"]
+
+
+def __getattr__(name):
+    # heavier API surface is imported lazily so `import lightgbm_tpu`
+    # stays cheap and jax-free until needed
+    if name in ("Dataset", "Booster"):
+        from . import basic
+        return getattr(basic, name)
+    if name in ("train", "cv", "CVBooster"):
+        from . import engine
+        return getattr(engine, name)
+    if name in ("LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker"):
+        from . import sklearn
+        return getattr(sklearn, name)
+    if name in ("early_stopping", "print_evaluation", "record_evaluation",
+                "reset_parameter"):
+        from . import callback
+        return getattr(callback, name)
+    if name in ("plot_importance", "plot_metric", "plot_tree",
+                "create_tree_digraph"):
+        from . import plotting
+        return getattr(plotting, name)
+    raise AttributeError(f"module 'lightgbm_tpu' has no attribute {name!r}")

@@ -1,0 +1,475 @@
+"""Single-sourced parameter registry.
+
+The reference keeps all 258 parameters as structured comments in
+``include/LightGBM/config.h`` which a generator compiles into an alias map +
+setters (``src/io/config_auto.cpp``) and docs.  Here the registry is a list of
+:class:`Param` descriptors from which the :class:`Config` dataclass, the alias
+table and the docs are all derived — same single-source pattern, Python-first.
+
+Parameter names, defaults and alias sets follow the reference
+(``config.h:126-770``, ``config_auto.cpp:4``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from .utils.log import Log
+
+__all__ = ["Param", "PARAMS", "ALIAS_TABLE", "Config", "param_docs"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Param:
+    name: str
+    default: Any
+    type: type
+    aliases: Tuple[str, ...] = ()
+    desc: str = ""
+    group: str = "core"
+    check: Optional[str] = None  # human-readable constraint, validated loosely
+
+
+def _p(name, default, type_, aliases=(), desc="", group="core", check=None):
+    return Param(name, default, type_, tuple(aliases), desc, group, check)
+
+
+# ---------------------------------------------------------------------------
+# The registry.  Grouping mirrors config.h: core / learning / io / objective /
+# metric / network / device.
+# ---------------------------------------------------------------------------
+PARAMS: List[Param] = [
+    # ---- core ----
+    _p("config", "", str, ("config_file",), "path to config file"),
+    _p("task", "train", str, ("task_type",),
+       "train, predict, convert_model, refit"),
+    _p("objective", "regression", str,
+       ("objective_type", "app", "application", "loss"),
+       "regression, regression_l1, huber, fair, poisson, quantile, mape, "
+       "gamma, tweedie, binary, multiclass, multiclassova, cross_entropy, "
+       "cross_entropy_lambda, lambdarank, rank_xendcg"),
+    _p("boosting", "gbdt", str, ("boosting_type", "boost"),
+       "gbdt, rf, dart, goss, mvs"),
+    _p("data", "", str, ("train", "train_data", "train_data_file", "data_filename"),
+       "path of training data"),
+    _p("valid", "", str, ("test", "valid_data", "valid_data_file", "test_data",
+                          "test_data_file", "valid_filenames"),
+       "comma-separated validation data paths"),
+    _p("num_iterations", 100, int,
+       ("num_iteration", "n_iter", "num_tree", "num_trees", "num_round",
+        "num_rounds", "num_boost_round", "n_estimators", "max_iter"),
+       "number of boosting iterations", check=">=0"),
+    _p("learning_rate", 0.1, float, ("shrinkage_rate", "eta"),
+       "shrinkage rate", check=">0"),
+    _p("num_leaves", 31, int, ("num_leaf", "max_leaves", "max_leaf",
+                               "max_leaf_nodes"),
+       "max number of leaves in one tree", check=">1"),
+    _p("tree_learner", "serial", str,
+       ("tree", "tree_type", "tree_learner_type"),
+       "serial, feature, data, voting"),
+    _p("num_threads", 0, int, ("num_thread", "nthread", "nthreads", "n_jobs"),
+       "number of host threads (0 = default)"),
+    _p("device_type", "tpu", str, ("device",), "tpu, cpu (XLA backend)",
+       group="device"),
+    _p("seed", None, object, ("random_seed", "random_state"),
+       "master seed, overridden by specific seeds"),
+    # ---- learning control ----
+    _p("max_depth", -1, int, (), "max tree depth, <=0 means no limit",
+       group="learning"),
+    _p("min_data_in_leaf", 20, int,
+       ("min_data_per_leaf", "min_data", "min_child_samples", "min_samples_leaf"),
+       "minimal data in one leaf", group="learning", check=">=0"),
+    _p("min_sum_hessian_in_leaf", 1e-3, float,
+       ("min_sum_hessian_per_leaf", "min_sum_hessian", "min_hessian",
+        "min_child_weight"),
+       "minimal sum of hessians in one leaf", group="learning", check=">=0"),
+    _p("bagging_fraction", 1.0, float, ("sub_row", "subsample", "bagging"),
+       "row subsample fraction, used when bagging_freq>0", group="learning",
+       check="0<x<=1"),
+    _p("pos_bagging_fraction", 1.0, float,
+       ("pos_sub_row", "pos_subsample", "pos_bagging"),
+       "positive-class bagging fraction (binary)", group="learning"),
+    _p("neg_bagging_fraction", 1.0, float,
+       ("neg_sub_row", "neg_subsample", "neg_bagging"),
+       "negative-class bagging fraction (binary)", group="learning"),
+    _p("bagging_freq", 0, int, ("subsample_freq",),
+       "perform bagging every k iterations", group="learning"),
+    _p("bagging_seed", 3, int, ("bagging_fraction_seed",),
+       "bagging random seed", group="learning"),
+    _p("feature_fraction", 1.0, float,
+       ("sub_feature", "colsample_bytree"),
+       "per-tree feature subsample fraction", group="learning", check="0<x<=1"),
+    _p("feature_fraction_bynode", 1.0, float,
+       ("sub_feature_bynode", "colsample_bynode"),
+       "per-node feature subsample fraction", group="learning"),
+    _p("feature_fraction_seed", 2, int, (), "feature_fraction seed",
+       group="learning"),
+    _p("early_stopping_round", 0, int,
+       ("early_stopping_rounds", "early_stopping", "n_iter_no_change"),
+       "stop if one validation metric does not improve in this many rounds",
+       group="learning"),
+    _p("first_metric_only", False, bool, (),
+       "only use the first metric for early stopping", group="learning"),
+    _p("max_delta_step", 0.0, float, ("max_tree_output", "max_leaf_output"),
+       "limit of leaf output, <=0 means no constraint", group="learning"),
+    _p("lambda_l1", 0.0, float, ("reg_alpha",), "L1 regularization",
+       group="learning", check=">=0"),
+    _p("lambda_l2", 0.0, float, ("reg_lambda", "lambda"),
+       "L2 regularization", group="learning", check=">=0"),
+    _p("min_gain_to_split", 0.0, float, ("min_split_gain",),
+       "minimal gain to perform split", group="learning", check=">=0"),
+    _p("drop_rate", 0.1, float, ("rate_drop",), "DART dropout rate",
+       group="learning"),
+    _p("max_drop", 50, int, (), "DART max dropped trees per iteration",
+       group="learning"),
+    _p("skip_drop", 0.5, float, (), "DART probability of skipping drop",
+       group="learning"),
+    _p("xgboost_dart_mode", False, bool, (), "use xgboost dart normalization",
+       group="learning"),
+    _p("uniform_drop", False, bool, (), "DART uniform drop", group="learning"),
+    _p("drop_seed", 4, int, (), "DART drop seed", group="learning"),
+    _p("top_rate", 0.2, float, (), "GOSS large-gradient retain ratio",
+       group="learning"),
+    _p("other_rate", 0.1, float, (), "GOSS small-gradient sample ratio",
+       group="learning"),
+    _p("min_data_per_group", 100, int, (),
+       "minimal data per categorical group", group="learning"),
+    _p("max_cat_threshold", 32, int, (),
+       "max categories in many-vs-many split set", group="learning"),
+    _p("cat_l2", 10.0, float, (), "L2 in categorical split", group="learning"),
+    _p("cat_smooth", 10.0, float, (),
+       "smoothing for categorical bin sort", group="learning"),
+    _p("max_cat_to_onehot", 4, int, (),
+       "use one-vs-other when #categories <= this", group="learning"),
+    _p("top_k", 20, int, ("topk",),
+       "top-k features in voting parallel", group="learning"),
+    _p("monotone_constraints", [], list,
+       ("mc", "monotone_constraint"),
+       "per-feature monotone constraints (-1,0,1)", group="learning"),
+    _p("feature_contri", [], list, ("feature_contrib", "fc", "fp",
+                                    "feature_penalty"),
+       "per-feature split-gain multipliers", group="learning"),
+    _p("forcedsplits_filename", "", str,
+       ("fs", "forced_splits_filename", "forced_splits_file", "forced_splits"),
+       "path to forced-splits JSON", group="learning"),
+    _p("refit_decay_rate", 0.9, float, (),
+       "leaf decay rate in refit task", group="learning"),
+    _p("verbosity", 1, int, ("verbose",), "<0 fatal, 0 warn, 1 info, >1 debug"),
+    # ---- io / dataset ----
+    _p("max_bin", 255, int, (), "max number of bins per feature", group="io",
+       check=">1"),
+    _p("min_data_in_bin", 3, int, (), "minimal data inside one bin",
+       group="io", check=">0"),
+    _p("bin_construct_sample_cnt", 200000, int, ("subsample_for_bin",),
+       "number of rows sampled to construct bins", group="io"),
+    _p("histogram_pool_size", -1.0, float, ("hist_pool_size",),
+       "max cache size (MB) for historical histograms, <0 = no limit",
+       group="io"),
+    _p("data_random_seed", 1, int, ("data_seed",),
+       "seed for data partition in parallel learning", group="io"),
+    _p("output_model", "LightGBM_model.txt", str,
+       ("model_output", "model_out"), "output model filename", group="io"),
+    _p("snapshot_freq", -1, int, ("save_period",),
+       "save model snapshot every k iterations", group="io"),
+    _p("input_model", "", str, ("model_input", "model_in"),
+       "input model path (continue train / predict)", group="io"),
+    _p("output_result", "LightGBM_predict_result.txt", str,
+       ("predict_result", "prediction_result", "predict_name",
+        "prediction_name", "pred_name", "name_pred"),
+       "prediction output file", group="io"),
+    _p("initscore_filename", "", str,
+       ("init_score_filename", "init_score_file", "init_score",
+        "input_init_score"),
+       "initial score file path", group="io"),
+    _p("valid_data_initscores", "", str,
+       ("valid_data_init_scores", "valid_init_score_file", "valid_init_score"),
+       "comma-separated init score files for validation data", group="io"),
+    _p("pre_partition", False, bool, ("is_pre_partition",),
+       "data is pre-partitioned across machines", group="io"),
+    _p("enable_bundle", True, bool, ("is_enable_bundle", "bundle"),
+       "enable exclusive feature bundling", group="io"),
+    _p("max_conflict_rate", 0.0, float, (),
+       "max conflict rate in EFB", group="io"),
+    _p("is_enable_sparse", True, bool, ("is_sparse", "enable_sparse", "sparse"),
+       "enable sparse optimization", group="io"),
+    _p("sparse_threshold", 0.8, float, (),
+       "sparsity threshold for sparse bin storage", group="io"),
+    _p("use_missing", True, bool, (), "enable missing value handling",
+       group="io"),
+    _p("zero_as_missing", False, bool, (),
+       "treat zero as missing", group="io"),
+    _p("two_round", False, bool,
+       ("two_round_loading", "use_two_round_loading"),
+       "two-round data loading (low memory)", group="io"),
+    _p("save_binary", False, bool, ("is_save_binary", "is_save_binary_file"),
+       "save dataset to binary file", group="io"),
+    _p("header", False, bool, ("has_header",), "input data has header",
+       group="io"),
+    _p("label_column", "", str, ("label",), "label column (index or name:)",
+       group="io"),
+    _p("weight_column", "", str, ("weight",), "weight column", group="io"),
+    _p("group_column", "", str,
+       ("group", "group_id", "query_column", "query", "query_id"),
+       "query/group column for ranking", group="io"),
+    _p("ignore_column", "", str, ("ignore_feature", "blacklist"),
+       "columns to ignore", group="io"),
+    _p("categorical_feature", "", object,
+       ("cat_feature", "categorical_column", "cat_column"),
+       "categorical features (indices or name: list)", group="io"),
+    _p("predict_raw_score", False, bool,
+       ("is_predict_raw_score", "predict_rawscore", "raw_score"),
+       "predict raw scores", group="io"),
+    _p("predict_leaf_index", False, bool,
+       ("is_predict_leaf_index", "leaf_index"),
+       "predict leaf indices", group="io"),
+    _p("predict_contrib", False, bool, ("is_predict_contrib", "contrib"),
+       "predict SHAP feature contributions", group="io"),
+    _p("num_iteration_predict", -1, int, (),
+       "number of iterations used in prediction", group="io"),
+    _p("pred_early_stop", False, bool, (), "use early stopping in prediction",
+       group="io"),
+    _p("pred_early_stop_freq", 10, int, (), "prediction early stop frequency",
+       group="io"),
+    _p("pred_early_stop_margin", 10.0, float, (),
+       "prediction early stop margin", group="io"),
+    _p("convert_model_language", "", str, (),
+       "language of converted model (cpp)", group="io"),
+    _p("convert_model", "gbdt_prediction.cpp", str,
+       ("convert_model_file",), "converted model output", group="io"),
+    # ---- objective ----
+    _p("num_class", 1, int, ("num_classes",), "number of classes (multiclass)",
+       group="objective", check=">0"),
+    _p("is_unbalance", False, bool, ("unbalance", "unbalanced_sets"),
+       "unbalanced binary training data", group="objective"),
+    _p("scale_pos_weight", 1.0, float, (), "weight of positive class",
+       group="objective", check=">0"),
+    _p("sigmoid", 1.0, float, (), "sigmoid scaling parameter",
+       group="objective", check=">0"),
+    _p("boost_from_average", True, bool, (),
+       "initialize score from average label", group="objective"),
+    _p("reg_sqrt", False, bool, (), "fit sqrt(label) for regression_l2",
+       group="objective"),
+    _p("alpha", 0.9, float, (), "huber/quantile alpha", group="objective",
+       check=">0"),
+    _p("fair_c", 1.0, float, (), "fair loss parameter", group="objective",
+       check=">0"),
+    _p("poisson_max_delta_step", 0.7, float, (),
+       "poisson safeguard parameter", group="objective", check=">0"),
+    _p("tweedie_variance_power", 1.5, float, (),
+       "tweedie variance power in [1,2)", group="objective"),
+    _p("max_position", 20, int, (), "NDCG optimization position (lambdarank)",
+       group="objective", check=">0"),
+    _p("lambdamart_norm", True, bool, ("lambdarank_norm",),
+       "normalize lambdas in lambdarank", group="objective"),
+    _p("label_gain", [], list, (), "gain per label level in lambdarank",
+       group="objective"),
+    _p("mvs_adaptive", True, bool, (),
+       "adaptive threshold in MVS sampling", group="objective"),
+    # ---- metric ----
+    _p("metric", "", object,
+       ("metrics", "metric_types"),
+       "metric names, comma-separated; '' = from objective, 'None' = none",
+       group="metric"),
+    _p("metric_freq", 1, int, ("output_freq",), "metric output frequency",
+       group="metric", check=">0"),
+    _p("is_provide_training_metric", False, bool,
+       ("training_metric", "is_training_metric", "train_metric"),
+       "output metrics on training data", group="metric"),
+    _p("eval_at", [1, 2, 3, 4, 5], list,
+       ("ndcg_eval_at", "ndcg_at", "map_eval_at", "map_at"),
+       "positions for ndcg/map evaluation", group="metric"),
+    _p("multi_error_top_k", 1, int, (), "top-k threshold for multi_error",
+       group="metric"),
+    # ---- network ----
+    _p("num_machines", 1, int, ("num_machine",),
+       "number of machines in distributed training", group="network",
+       check=">0"),
+    _p("local_listen_port", 12400, int, ("local_port",),
+       "listening port (socket backend analog)", group="network"),
+    _p("time_out", 120, int, (), "socket timeout in minutes", group="network"),
+    _p("machine_list_filename", "", str,
+       ("machine_list_file", "machine_list", "mlist"),
+       "machine list file", group="network"),
+    _p("machines", "", str, ("workers", "nodes"),
+       "comma-separated machine list", group="network"),
+    # ---- device ----
+    _p("gpu_platform_id", -1, int, (), "(compat) OpenCL platform id",
+       group="device"),
+    _p("gpu_device_id", -1, int, (), "(compat) device id", group="device"),
+    _p("gpu_use_dp", False, bool, (),
+       "use float64 accumulation in device histograms", group="device"),
+    _p("tpu_hist_dtype", "float32", str, (),
+       "accumulator dtype for histogram kernel", group="device"),
+    _p("tpu_rows_per_block", 1024, int, (),
+       "rows per Pallas histogram block", group="device"),
+]
+
+_PARAM_BY_NAME: Dict[str, Param] = {p.name: p for p in PARAMS}
+
+# alias -> canonical name (aliases AND canonical names both resolve)
+ALIAS_TABLE: Dict[str, str] = {}
+for _param in PARAMS:
+    ALIAS_TABLE[_param.name] = _param.name
+    for _a in _param.aliases:
+        ALIAS_TABLE[_a] = _param.name
+
+
+def param_docs() -> str:
+    """Render parameter docs (the reference generates Parameters.rst)."""
+    lines = []
+    group = None
+    for p in PARAMS:
+        if p.group != group:
+            group = p.group
+            lines.append(f"\n## {group}\n")
+        alias = f" (aliases: {', '.join(p.aliases)})" if p.aliases else ""
+        lines.append(f"- `{p.name}` = `{p.default!r}`{alias}: {p.desc}")
+    return "\n".join(lines)
+
+
+_TRUE = {"true", "1", "yes", "on", "+", "t", "y"}
+_FALSE = {"false", "0", "no", "off", "-", "f", "n"}
+
+
+def _coerce(param: Param, value: Any) -> Any:
+    if value is None:
+        return None
+    if param.type is bool:
+        if isinstance(value, bool):
+            return value
+        if isinstance(value, (int, float)):
+            return bool(value)
+        s = str(value).strip().lower()
+        if s in _TRUE:
+            return True
+        if s in _FALSE:
+            return False
+        raise ValueError(f"cannot parse bool parameter {param.name}={value!r}")
+    if param.type is int:
+        return int(float(value))
+    if param.type is float:
+        return float(value)
+    if param.type is list:
+        if isinstance(value, (list, tuple)):
+            return list(value)
+        if isinstance(value, str):
+            if not value.strip():
+                return []
+            return [_num(tok) for tok in value.replace(";", ",").split(",")]
+        return [value]
+    if param.type is str:
+        return str(value)
+    return value
+
+
+def _num(tok: str) -> Any:
+    tok = tok.strip()
+    try:
+        f = float(tok)
+        return int(f) if f == int(f) and "." not in tok and "e" not in tok.lower() else f
+    except ValueError:
+        return tok
+
+
+class Config:
+    """Resolved configuration.
+
+    ``Config(params)`` resolves aliases (later aliases never override an
+    explicitly-set canonical name, mirroring ``Config::KV2Map``), coerces
+    types, applies the master ``seed`` to the specific seeds
+    (``config.cpp GetAliasAndSeed`` behavior) and keeps unknown keys in
+    ``raw`` for forward-compat.
+    """
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None):
+        for p in PARAMS:
+            object.__setattr__(self, p.name,
+                               list(p.default) if isinstance(p.default, list)
+                               else p.default)
+        self.raw: Dict[str, Any] = {}
+        self._user_set: set = set()
+        if params:
+            self.update(params)
+
+    def update(self, params: Dict[str, Any]) -> None:
+        resolved: Dict[str, Any] = {}
+        explicit: set = set()
+        for key, value in params.items():
+            canon = ALIAS_TABLE.get(key)
+            if canon is None:
+                self.raw[key] = value
+                continue
+            if canon in resolved and (canon in explicit or key != canon):
+                # canonical name wins over aliases; first alias wins otherwise
+                if key == canon:
+                    resolved[canon] = value
+                    explicit.add(canon)
+                else:
+                    Log.warning("%s is set with %s=%r, %s=%r will be ignored. "
+                                "Current value: %s=%r", canon, canon,
+                                resolved[canon], key, value, canon,
+                                resolved[canon])
+                continue
+            resolved[canon] = value
+            if key == canon:
+                explicit.add(canon)
+        for canon, value in resolved.items():
+            try:
+                setattr(self, canon, _coerce(_PARAM_BY_NAME[canon], value))
+            except (TypeError, ValueError) as e:
+                Log.fatal("bad value for parameter %s: %s", canon, e)
+            self._user_set.add(canon)
+        # master seed fans out to seeds never explicitly set by the user
+        # (in this or any earlier update)
+        if self.seed is not None:
+            seed = int(self.seed)
+            for name, offset in (("bagging_seed", 3),
+                                 ("feature_fraction_seed", 2),
+                                 ("drop_seed", 4), ("data_random_seed", 1)):
+                if name not in self._user_set:
+                    setattr(self, name, seed + offset)
+        self._validate()
+
+    def _validate(self) -> None:
+        if self.num_leaves < 2:
+            Log.fatal("num_leaves must be >= 2, got %d", self.num_leaves)
+        if not (0.0 < self.bagging_fraction <= 1.0):
+            Log.fatal("bagging_fraction must be in (0, 1], got %g",
+                      self.bagging_fraction)
+        if not (0.0 < self.feature_fraction <= 1.0):
+            Log.fatal("feature_fraction must be in (0, 1], got %g",
+                      self.feature_fraction)
+        if self.max_bin <= 1:
+            Log.fatal("max_bin must be > 1, got %d", self.max_bin)
+        if self.boosting == "goss" and self.top_rate + self.other_rate > 1.0:
+            Log.fatal("goss: top_rate + other_rate must be <= 1")
+        if self.boosting == "rf" and not (self.bagging_freq > 0 and
+                                          0 < self.bagging_fraction < 1):
+            Log.fatal("random forest requires bagging "
+                      "(bagging_freq > 0, 0 < bagging_fraction < 1)")
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {p.name: getattr(self, p.name) for p in PARAMS}
+        d.update(self.raw)
+        return d
+
+    def copy(self) -> "Config":
+        c = Config()
+        for p in PARAMS:
+            v = getattr(self, p.name)
+            setattr(c, p.name, list(v) if isinstance(v, list) else v)
+        c.raw = dict(self.raw)
+        c._user_set = set(self._user_set)
+        return c
+
+    @staticmethod
+    def str2dict(text: str) -> Dict[str, Any]:
+        """Parse CLI/conf ``key=value`` lines (``Config::KV2Map``)."""
+        out: Dict[str, Any] = {}
+        for line in text.splitlines():
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            out[k.strip()] = v.strip()
+        return out

@@ -1,0 +1,209 @@
+"""Constructed (binned) dataset + metadata, device-resident.
+
+Capability parity with the reference's ``Dataset`` / ``Metadata``
+(``include/LightGBM/dataset.h:36-625``, ``src/io/dataset.cpp``,
+``src/io/metadata.cpp``): owns per-feature bin mappers and the binned
+feature matrix, label / weight / query-boundary / init-score metadata,
+train/valid alignment (``CheckAlign``), and a binary cache file
+(``SaveBinaryFile``).
+
+TPU-first design: instead of per-feature-group ``Bin`` columns with
+sparse/dense/4-bit variants, the whole dataset is ONE dense
+``(num_data, num_features)`` integer matrix pushed to HBM, padded so the
+Pallas histogram kernel reads aligned tiles.  Sparse data is kept narrow
+via EFB-style bundling upstream (``binning.py``); trivial features are
+dropped from the device matrix and re-inserted at the model layer.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.log import Log
+from .binning import (BIN_CATEGORICAL, BinMapper, find_bin_mappers)
+
+_BINARY_MAGIC = b"LGBTPU_DATASET_V1\n"
+
+
+class Metadata:
+    """label / weight / query / init_score container
+    (``dataset.h:36-248``)."""
+
+    def __init__(self, num_data: int):
+        self.num_data = int(num_data)
+        self.label = np.zeros(num_data, dtype=np.float32)
+        self.weight: Optional[np.ndarray] = None
+        self.query_boundaries: Optional[np.ndarray] = None
+        self.init_score: Optional[np.ndarray] = None
+
+    def set_label(self, label) -> None:
+        label = np.asarray(label, dtype=np.float32).reshape(-1)
+        if len(label) != self.num_data:
+            Log.fatal("label length %d != num_data %d", len(label),
+                      self.num_data)
+        self.label = label
+
+    def set_weight(self, weight) -> None:
+        if weight is None:
+            self.weight = None
+            return
+        weight = np.asarray(weight, dtype=np.float32).reshape(-1)
+        if len(weight) != self.num_data:
+            Log.fatal("weight length %d != num_data %d", len(weight),
+                      self.num_data)
+        self.weight = weight
+
+    def set_query(self, group) -> None:
+        """``group`` is per-query counts; stored as boundaries
+        (``Metadata::SetQuery``)."""
+        if group is None:
+            self.query_boundaries = None
+            return
+        group = np.asarray(group, dtype=np.int64).reshape(-1)
+        if group.sum() != self.num_data:
+            Log.fatal("sum of query counts (%d) != num_data (%d)",
+                      int(group.sum()), self.num_data)
+        self.query_boundaries = np.concatenate(
+            [[0], np.cumsum(group)]).astype(np.int64)
+
+    def set_init_score(self, init_score) -> None:
+        if init_score is None:
+            self.init_score = None
+            return
+        self.init_score = np.asarray(init_score, dtype=np.float64)
+
+    @property
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None else \
+            len(self.query_boundaries) - 1
+
+
+class TpuDataset:
+    """Binned dataset ready for training."""
+
+    def __init__(self, mappers: List[BinMapper], binned: np.ndarray,
+                 metadata: Metadata,
+                 feature_names: Optional[Sequence[str]] = None):
+        self.mappers = mappers
+        self.num_total_features = len(mappers)
+        # features that actually carry information (>=2 bins)
+        self.used_features = [i for i, m in enumerate(mappers)
+                              if not m.is_trivial]
+        if not self.used_features:
+            Log.warning("dataset has no informative features")
+        self.binned = binned  # (num_data, num_used_features) small ints
+        self.metadata = metadata
+        self.num_data = metadata.num_data
+        self.feature_names = (list(feature_names) if feature_names else
+                              [f"Column_{i}" for i in
+                               range(self.num_total_features)])
+        self.num_bins = np.array(
+            [mappers[i].num_bin for i in self.used_features], dtype=np.int32)
+        self.max_bin_count = int(self.num_bins.max()) if len(self.num_bins) \
+            else 1
+        self._device_binned = None
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_raw(cls, X: np.ndarray, label, config,
+                 weight=None, group=None, init_score=None,
+                 feature_names=None, categorical_features: Sequence[int] = (),
+                 mappers: Optional[List[BinMapper]] = None) -> "TpuDataset":
+        """Bin a raw dense matrix.  Passing ``mappers`` aligns this dataset
+        with a reference (train) dataset — the valid-set path
+        (``DatasetLoader::LoadFromFileAlignWithOtherDataset``)."""
+        X = np.ascontiguousarray(X)
+        num_data = X.shape[0]
+        if mappers is None:
+            mappers = find_bin_mappers(
+                X, max_bin=config.max_bin,
+                min_data_in_bin=config.min_data_in_bin,
+                sample_cnt=config.bin_construct_sample_cnt,
+                seed=config.data_random_seed,
+                categorical_features=categorical_features,
+                use_missing=config.use_missing,
+                zero_as_missing=config.zero_as_missing)
+        used = [i for i, m in enumerate(mappers) if not m.is_trivial]
+        dtype = np.uint8 if all(mappers[i].num_bin <= 256 for i in used) \
+            else np.uint16
+        binned = np.zeros((num_data, len(used)), dtype=dtype)
+        for j, f in enumerate(used):
+            binned[:, j] = mappers[f].value_to_bin(X[:, f]).astype(dtype)
+        meta = Metadata(num_data)
+        meta.set_label(label if label is not None else np.zeros(num_data))
+        meta.set_weight(weight)
+        meta.set_query(group)
+        meta.set_init_score(init_score)
+        return cls(mappers, binned, meta, feature_names)
+
+    # ------------------------------------------------------------------
+    def device_binned(self):
+        """The binned matrix as a device array (cached)."""
+        import jax.numpy as jnp
+        if self._device_binned is None:
+            self._device_binned = jnp.asarray(self.binned)
+        return self._device_binned
+
+    def check_align(self, other: "TpuDataset") -> bool:
+        """Train/valid bin compatibility (``Dataset::CheckAlign``)."""
+        if self.num_total_features != other.num_total_features:
+            return False
+        for a, b in zip(self.mappers, other.mappers):
+            if a.num_bin != b.num_bin or a.bin_type != b.bin_type:
+                return False
+        return True
+
+    def real_feature_index(self, inner: int) -> int:
+        return self.used_features[inner]
+
+    def inner_feature_index(self, real: int) -> int:
+        """-1 if the feature is trivial/unused
+        (``Dataset::InnerFeatureIndex``)."""
+        try:
+            return self.used_features.index(real)
+        except ValueError:
+            return -1
+
+    def feature_infos(self) -> List[str]:
+        return [m.feature_info() for m in self.mappers]
+
+    # ------------------------------------------------------------------
+    def save_binary(self, path: str) -> None:
+        """Binary dataset cache (``Dataset::SaveBinaryFile``)."""
+        with open(path, "wb") as f:
+            f.write(_BINARY_MAGIC)
+            pickle.dump({
+                "mappers": [m.to_bytes() for m in self.mappers],
+                "binned": self.binned,
+                "label": self.metadata.label,
+                "weight": self.metadata.weight,
+                "query_boundaries": self.metadata.query_boundaries,
+                "init_score": self.metadata.init_score,
+                "feature_names": self.feature_names,
+            }, f, protocol=4)
+        Log.info("saved binary dataset to %s", path)
+
+    @classmethod
+    def load_binary(cls, path: str) -> "TpuDataset":
+        with open(path, "rb") as f:
+            magic = f.read(len(_BINARY_MAGIC))
+            if magic != _BINARY_MAGIC:
+                Log.fatal("%s is not a lightgbm_tpu binary dataset", path)
+            d = pickle.load(f)
+        mappers = [BinMapper.from_bytes(b) for b in d["mappers"]]
+        meta = Metadata(d["binned"].shape[0])
+        meta.set_label(d["label"])
+        meta.weight = d["weight"]
+        meta.query_boundaries = d["query_boundaries"]
+        meta.init_score = d["init_score"]
+        return cls(mappers, d["binned"], meta, d["feature_names"])
+
+    @staticmethod
+    def is_binary_file(path: str) -> bool:
+        if not os.path.exists(path):
+            return False
+        with open(path, "rb") as f:
+            return f.read(len(_BINARY_MAGIC)) == _BINARY_MAGIC

@@ -1,0 +1,225 @@
+"""Text data parsing: CSV / TSV / LibSVM with auto-detection.
+
+Capability parity with the reference's ``Parser`` (``src/io/parser.cpp``,
+``include/LightGBM/dataset.h:252-277``): probes sample lines to pick the
+format, supports a header row, label column by index or ``name:`` prefix,
+ignore/weight/group columns.  A native C++ fast path lives in
+``cpp/ltpu_io.cpp`` (loaded via ctypes when built); this module is the
+always-available fallback and the single source of parsing semantics.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.log import Log
+
+__all__ = ["detect_format", "parse_file", "load_query_file", "load_float_file"]
+
+
+def _tokenize(line: str, sep: str) -> List[str]:
+    return [t for t in line.strip().split(sep)]
+
+
+def detect_format(sample_lines: Sequence[str]) -> Tuple[str, str]:
+    """Return (kind, sep) with kind in {csv, tsv, libsvm}.
+
+    Mirrors the reference's line-probing: a token containing ':' with an
+    integer prefix means LibSVM; otherwise the separator with the most
+    consistent count across lines wins.
+    """
+    for line in sample_lines:
+        toks = line.strip().split()
+        for tok in toks[1:3]:
+            if ":" in tok:
+                head = tok.split(":", 1)[0]
+                try:
+                    int(head)
+                    return "libsvm", " "
+                except ValueError:
+                    break
+    counts = {}
+    for sep in ("\t", ",", " "):
+        c = [line.count(sep) for line in sample_lines if line.strip()]
+        if c and min(c) > 0 and len(set(c)) == 1:
+            counts[sep] = c[0]
+    for sep in ("\t", ",", " "):
+        if sep in counts:
+            return ("tsv" if sep == "\t" else
+                    "csv" if sep == "," else "space"), sep
+    return "space", None  # whitespace split
+
+
+def _resolve_columns(spec: str, header_names: Optional[List[str]]) -> List[int]:
+    """Resolve a column spec ('0,3' or 'name:a,b') to indices."""
+    if not spec:
+        return []
+    if spec.startswith("name:"):
+        if header_names is None:
+            Log.fatal("column spec %r requires header", spec)
+        return [header_names.index(n) for n in spec[5:].split(",")]
+    return [int(t) for t in spec.split(",") if t.strip() != ""]
+
+
+def parse_file(path: str, header: bool = False,
+               label_column: str = "", ignore_columns: str = "",
+               weight_column: str = "", group_column: str = "",
+               max_probe_lines: int = 32,
+               ) -> Tuple[np.ndarray, np.ndarray, Optional[List[str]]]:
+    """Parse a data file into (features, labels, feature_names).
+
+    Dense output (TPU-first: the binned matrix is dense anyway); LibSVM
+    columns missing from a row become 0.0 like the reference's sparse
+    semantics.  ``ignore_columns`` / ``weight_column`` / ``group_column``
+    are dropped from the feature matrix; weight/group values are returned
+    via :func:`parse_file_full`.
+    """
+    X, y, names, _, _ = parse_file_full(path, header, label_column,
+                                        ignore_columns, weight_column,
+                                        group_column, max_probe_lines)
+    return X, y, names
+
+
+def parse_file_full(path: str, header: bool = False,
+                    label_column: str = "", ignore_columns: str = "",
+                    weight_column: str = "", group_column: str = "",
+                    max_probe_lines: int = 32):
+    """parse_file + extracted (weight, group) columns."""
+    if not os.path.exists(path):
+        Log.fatal("data file %s does not exist", path)
+    with open(path, "r") as f:
+        first_lines = []
+        for _ in range(max_probe_lines):
+            line = f.readline()
+            if not line:
+                break
+            first_lines.append(line)
+    probe = first_lines[1:] if header and len(first_lines) > 1 else first_lines
+    kind, sep = detect_format(probe)
+
+    names: Optional[List[str]] = None
+    label_idx = 0
+    if label_column != "":
+        if label_column.startswith("name:"):
+            if not header:
+                Log.fatal("label_column name:%s requires header",
+                          label_column[5:])
+            label_idx = -1  # resolved after header read
+        else:
+            label_idx = int(label_column)
+
+    if kind == "libsvm":
+        X, y, names = _parse_libsvm(path, header)
+        return X, y, names, None, None
+
+    rows: List[np.ndarray] = []
+    labels: List[float] = []
+    hdr: Optional[List[str]] = None
+    with open(path, "r") as f:
+        if header:
+            hdr = _split(f.readline(), sep)
+            if label_column.startswith("name:"):
+                label_idx = hdr.index(label_column[5:])
+        drop = {label_idx}
+        ignore = _resolve_columns(ignore_columns, hdr)
+        w_cols = _resolve_columns(weight_column, hdr)
+        g_cols = _resolve_columns(group_column, hdr)
+        drop.update(ignore)
+        drop.update(w_cols)
+        drop.update(g_cols)
+        if hdr is not None:
+            names = [h for i, h in enumerate(hdr) if i not in drop]
+        keep: Optional[np.ndarray] = None
+        weights: List[float] = []
+        groups: List[float] = []
+        for line in f:
+            if not line.strip():
+                continue
+            toks = _split(line, sep)
+            vals = np.array([_safe_float(t) for t in toks], dtype=np.float64)
+            labels.append(vals[label_idx])
+            if w_cols:
+                weights.append(vals[w_cols[0]])
+            if g_cols:
+                groups.append(vals[g_cols[0]])
+            if keep is None:
+                keep = np.array([i for i in range(len(vals))
+                                 if i not in drop], dtype=np.int64)
+            rows.append(vals[keep])
+    X = np.vstack(rows) if rows else np.zeros((0, 0))
+    y = np.asarray(labels, dtype=np.float64)
+    w = np.asarray(weights, dtype=np.float64) if w_cols else None
+    g = np.asarray(groups, dtype=np.float64) if g_cols else None
+    return X, y, names, w, g
+
+
+def _split(line: str, sep: Optional[str]) -> List[str]:
+    line = line.rstrip("\r\n")
+    return line.split(sep) if sep else line.split()
+
+
+def _safe_float(tok: str) -> float:
+    tok = tok.strip()
+    if tok == "" or tok.lower() in ("na", "nan", "null", "none", "?"):
+        return np.nan
+    try:
+        return float(tok)
+    except ValueError:
+        return np.nan
+
+
+def _parse_libsvm(path: str, header: bool):
+    rows: List[List[Tuple[int, float]]] = []
+    labels: List[float] = []
+    max_idx = -1
+    with open(path, "r") as f:
+        if header:
+            f.readline()
+        for line in f:
+            toks = line.split()
+            if not toks:
+                continue
+            labels.append(_safe_float(toks[0]))
+            pairs = []
+            for tok in toks[1:]:
+                if ":" not in tok:
+                    continue
+                k, v = tok.split(":", 1)
+                idx = int(k)
+                pairs.append((idx, _safe_float(v)))
+                max_idx = max(max_idx, idx)
+            rows.append(pairs)
+    X = np.zeros((len(rows), max_idx + 1), dtype=np.float64)
+    for i, pairs in enumerate(rows):
+        for idx, v in pairs:
+            X[i, idx] = v
+    return X, np.asarray(labels, dtype=np.float64), None
+
+
+def load_float_file(path: str) -> Optional[np.ndarray]:
+    """Load a one-or-more-column numeric sidecar file (.weight / .init).
+
+    Multi-column rows (multiclass init score) come back 2-D.
+    """
+    if not os.path.exists(path):
+        return None
+    rows = []
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                rows.append([float(t) for t in line.split()])
+    arr = np.asarray(rows, dtype=np.float64)
+    if arr.ndim == 2 and arr.shape[1] == 1:
+        arr = arr[:, 0]
+    return arr
+
+
+def load_query_file(path: str) -> Optional[np.ndarray]:
+    """Load per-query counts (.query sidecar, one count per line)."""
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        counts = [int(float(line)) for line in f if line.strip()]
+    return np.asarray(counts, dtype=np.int64)

@@ -1,0 +1,62 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip sharding paths (data/feature/voting parallel) are exercised in
+CI on a virtual device mesh; real-TPU runs come from bench.py and the
+driver's dryrun.  Must run before jax is imported anywhere.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+REFERENCE_EXAMPLES = "/root/reference/examples"
+
+
+@pytest.fixture(scope="session")
+def binary_example():
+    """The reference's binary_classification example data as arrays."""
+    from lightgbm_tpu.io.parser import parse_file, load_float_file
+    base = os.path.join(REFERENCE_EXAMPLES, "binary_classification")
+    X, y, _ = parse_file(os.path.join(base, "binary.train"))
+    Xt, yt, _ = parse_file(os.path.join(base, "binary.test"))
+    return X, y, Xt, yt
+
+
+@pytest.fixture(scope="session")
+def regression_example():
+    from lightgbm_tpu.io.parser import parse_file
+    base = os.path.join(REFERENCE_EXAMPLES, "regression")
+    X, y, _ = parse_file(os.path.join(base, "regression.train"))
+    Xt, yt, _ = parse_file(os.path.join(base, "regression.test"))
+    return X, y, Xt, yt
+
+
+@pytest.fixture(scope="session")
+def rank_example():
+    from lightgbm_tpu.io.parser import parse_file, load_query_file
+    base = os.path.join(REFERENCE_EXAMPLES, "lambdarank")
+    X, y, _ = parse_file(os.path.join(base, "rank.train"))
+    Xt, yt, _ = parse_file(os.path.join(base, "rank.test"))
+    q = load_query_file(os.path.join(base, "rank.train.query"))
+    qt = load_query_file(os.path.join(base, "rank.test.query"))
+    return X, y, q, Xt, yt, qt
+
+
+@pytest.fixture(scope="session")
+def multiclass_example():
+    from lightgbm_tpu.io.parser import parse_file
+    base = os.path.join(REFERENCE_EXAMPLES, "multiclass_classification")
+    X, y, _ = parse_file(os.path.join(base, "multiclass.train"))
+    Xt, yt, _ = parse_file(os.path.join(base, "multiclass.test"))
+    return X, y, Xt, yt
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

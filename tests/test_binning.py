@@ -1,0 +1,132 @@
+import numpy as np
+import pytest
+
+from lightgbm_tpu.io.binning import (BIN_CATEGORICAL, MISSING_NAN,
+                                     MISSING_NONE, MISSING_ZERO, BinMapper)
+from lightgbm_tpu.io.dataset import Metadata, TpuDataset
+from lightgbm_tpu.config import Config
+
+
+def test_few_distinct_values_get_own_bins():
+    m = BinMapper()
+    vals = np.array([1.0, 1.0, 2.0, 2.0, 3.0, 3.0, 3.0])
+    m.find_bin(vals, len(vals), max_bin=255, min_data_in_bin=1)
+    assert m.num_bin == 3
+    bins = m.value_to_bin(np.array([1.0, 2.0, 3.0, 0.5, 10.0]))
+    assert bins[0] != bins[1] != bins[2]
+    assert bins[3] == bins[0]      # below range joins lowest bin
+    assert bins[4] == bins[2]      # above range joins highest bin
+
+
+def test_many_distinct_equal_frequency():
+    rng = np.random.RandomState(0)
+    vals = rng.normal(size=100000)
+    m = BinMapper()
+    m.find_bin(vals, len(vals), max_bin=255, min_data_in_bin=3)
+    assert 2 <= m.num_bin <= 255
+    bins = m.value_to_bin(vals)
+    counts = np.bincount(bins, minlength=m.num_bin)
+    # equal-frequency: no bin wildly over-represented
+    assert counts.max() < len(vals) / m.num_bin * 3
+
+
+def test_monotone_mapping():
+    rng = np.random.RandomState(1)
+    vals = rng.uniform(-5, 5, size=10000)
+    m = BinMapper()
+    m.find_bin(vals, len(vals), max_bin=63, min_data_in_bin=3)
+    x = np.sort(rng.uniform(-5, 5, size=100))
+    b = m.value_to_bin(x)
+    assert np.all(np.diff(b) >= 0)
+
+
+def test_nan_missing_gets_last_bin():
+    vals = np.array([1.0, 2.0, 3.0, np.nan, np.nan, 4.0] * 10)
+    m = BinMapper()
+    m.find_bin(vals, len(vals), max_bin=255, min_data_in_bin=1)
+    assert m.missing_type == MISSING_NAN
+    assert m.missing_bin == m.num_bin - 1
+    bins = m.value_to_bin(np.array([np.nan, 1.0]))
+    assert bins[0] == m.num_bin - 1
+    assert bins[1] != m.num_bin - 1
+
+
+def test_no_use_missing_maps_nan_to_zero_bin():
+    vals = np.array([-1.0, 0.0, 1.0, np.nan] * 10)
+    m = BinMapper()
+    m.find_bin(vals, len(vals), max_bin=255, min_data_in_bin=1,
+               use_missing=False)
+    assert m.missing_type == MISSING_NONE
+    bins = m.value_to_bin(np.array([np.nan, 0.0]))
+    assert bins[0] == bins[1]
+
+
+def test_zero_as_missing():
+    vals = np.array([-1.0, 0.0, 0.0, 1.0, 2.0] * 10)
+    m = BinMapper()
+    m.find_bin(vals, len(vals), max_bin=255, min_data_in_bin=1,
+               zero_as_missing=True)
+    assert m.missing_type == MISSING_ZERO
+    bins = m.value_to_bin(np.array([0.0, np.nan, 1.0]))
+    assert bins[0] == m.missing_bin
+    assert bins[1] == m.missing_bin
+    assert bins[2] != m.missing_bin
+
+
+def test_categorical_binning():
+    vals = np.array([3.0] * 50 + [7.0] * 30 + [1.0] * 15 + [9.0] * 5)
+    m = BinMapper()
+    m.find_bin(vals, len(vals), max_bin=255, min_data_in_bin=1,
+               bin_type=BIN_CATEGORICAL)
+    assert m.bin_type == BIN_CATEGORICAL
+    bins = m.value_to_bin(np.array([3.0, 7.0, 1.0, 999.0]))
+    assert bins[0] == 1           # most frequent category -> bin 1
+    assert bins[3] == 0           # unseen -> catch-all bin 0
+    assert m.bin_to_value(1) == 3.0
+
+
+def test_mapper_serialization_roundtrip():
+    vals = np.random.RandomState(2).normal(size=5000)
+    m = BinMapper()
+    m.find_bin(vals, len(vals), max_bin=63, min_data_in_bin=3)
+    m2 = BinMapper.from_bytes(m.to_bytes())
+    x = np.linspace(-3, 3, 50)
+    np.testing.assert_array_equal(m.value_to_bin(x), m2.value_to_bin(x))
+
+
+def test_trivial_feature():
+    vals = np.full(100, 5.0)
+    m = BinMapper()
+    m.find_bin(vals, len(vals), max_bin=255, min_data_in_bin=3)
+    assert m.is_trivial
+
+
+def test_dataset_from_raw_and_align(binary_example):
+    X, y, Xt, yt = binary_example
+    cfg = Config({"max_bin": 255})
+    ds = TpuDataset.from_raw(X, y, cfg)
+    assert ds.num_data == len(y)
+    assert ds.binned.shape[0] == len(y)
+    assert ds.binned.dtype == np.uint8
+    assert ds.max_bin_count <= 255 + 1
+    valid = TpuDataset.from_raw(Xt, yt, cfg, mappers=ds.mappers)
+    assert ds.check_align(valid)
+
+
+def test_dataset_binary_roundtrip(tmp_path, binary_example):
+    X, y, _, _ = binary_example
+    cfg = Config()
+    ds = TpuDataset.from_raw(X[:500], y[:500], cfg)
+    p = str(tmp_path / "cache.bin")
+    ds.save_binary(p)
+    assert TpuDataset.is_binary_file(p)
+    ds2 = TpuDataset.load_binary(p)
+    np.testing.assert_array_equal(ds.binned, ds2.binned)
+    np.testing.assert_array_equal(ds.metadata.label, ds2.metadata.label)
+
+
+def test_metadata_query():
+    meta = Metadata(10)
+    meta.set_query([4, 6])
+    np.testing.assert_array_equal(meta.query_boundaries, [0, 4, 10])
+    assert meta.num_queries == 2

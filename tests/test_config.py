@@ -1,0 +1,84 @@
+import pytest
+
+from lightgbm_tpu.config import ALIAS_TABLE, Config, param_docs
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def test_defaults():
+    c = Config()
+    assert c.num_leaves == 31
+    assert c.learning_rate == 0.1
+    assert c.max_bin == 255
+    assert c.min_data_in_leaf == 20
+    assert c.objective == "regression"
+    assert c.boosting == "gbdt"
+    assert c.tree_learner == "serial"
+
+
+def test_alias_resolution():
+    c = Config({"n_estimators": 50, "eta": 0.3, "min_child_samples": 5,
+                "reg_alpha": 1.0, "reg_lambda": 2.0, "subsample": 0.8,
+                "colsample_bytree": 0.7, "num_leaf": 63})
+    assert c.num_iterations == 50
+    assert c.learning_rate == 0.3
+    assert c.min_data_in_leaf == 5
+    assert c.lambda_l1 == 1.0
+    assert c.lambda_l2 == 2.0
+    assert c.bagging_fraction == 0.8
+    assert c.feature_fraction == 0.7
+    assert c.num_leaves == 63
+
+
+def test_canonical_beats_alias():
+    c = Config({"num_boost_round": 50, "num_iterations": 99})
+    assert c.num_iterations == 99
+
+
+def test_type_coercion():
+    c = Config({"num_leaves": "63", "learning_rate": "0.05",
+                "is_unbalance": "true", "use_missing": "false",
+                "eval_at": "1,3,5"})
+    assert c.num_leaves == 63
+    assert c.learning_rate == 0.05
+    assert c.is_unbalance is True
+    assert c.use_missing is False
+    assert c.eval_at == [1, 3, 5]
+
+
+def test_unknown_kept_in_raw():
+    c = Config({"totally_unknown_param": 1})
+    assert c.raw["totally_unknown_param"] == 1
+
+
+def test_validation_errors():
+    with pytest.raises(LightGBMError):
+        Config({"num_leaves": 1})
+    with pytest.raises(LightGBMError):
+        Config({"bagging_fraction": 0.0})
+    with pytest.raises(LightGBMError):
+        Config({"boosting": "rf"})  # rf needs bagging
+
+
+def test_master_seed_fanout():
+    c = Config({"seed": 7})
+    assert c.bagging_seed == 10
+    assert c.feature_fraction_seed == 9
+    c2 = Config({"seed": 7, "bagging_seed": 77})
+    assert c2.bagging_seed == 77
+
+
+def test_str2dict_conf_format():
+    text = """
+    # comment line
+    task = train
+    objective = binary
+    num_trees = 100  # inline comment
+    """
+    d = Config.str2dict(text)
+    assert d == {"task": "train", "objective": "binary", "num_trees": "100"}
+
+
+def test_alias_table_sanity():
+    assert ALIAS_TABLE["num_boost_round"] == "num_iterations"
+    assert ALIAS_TABLE["query"] == "group_column"
+    assert "## learning" in param_docs()

@@ -1,0 +1,84 @@
+import numpy as np
+
+from lightgbm_tpu.models.tree import (MISSING_NAN, MISSING_NONE, Tree,
+                                      cat_bitset)
+
+
+def build_simple_tree():
+    """x0 <= 0.5 -> leaf0 (1.0); else x1 <= 2.0 -> leaf1 (2.0) else leaf2 (3.0)"""
+    t = Tree(max_leaves=4)
+    t.split(leaf=0, feature=0, threshold_bin=5, threshold_real=0.5,
+            left_value=1.0, right_value=0.0, left_weight=10, right_weight=20,
+            left_count=10, right_count=20, gain=5.0,
+            missing_type=MISSING_NONE, default_left=False)
+    t.split(leaf=1, feature=1, threshold_bin=3, threshold_real=2.0,
+            left_value=2.0, right_value=3.0, left_weight=12, right_weight=8,
+            left_count=12, right_count=8, gain=2.0,
+            missing_type=MISSING_NONE, default_left=False)
+    return t
+
+
+def test_split_and_predict():
+    t = build_simple_tree()
+    assert t.num_leaves == 3
+    X = np.array([[0.0, 0.0], [1.0, 1.0], [1.0, 5.0]])
+    np.testing.assert_allclose(t.predict(X), [1.0, 2.0, 3.0])
+    np.testing.assert_array_equal(t.predict_leaf_index(X), [0, 1, 2])
+
+
+def test_missing_nan_default_direction():
+    t = Tree(max_leaves=2)
+    t.split(0, feature=0, threshold_bin=1, threshold_real=0.5,
+            left_value=-1.0, right_value=1.0, left_weight=1, right_weight=1,
+            left_count=1, right_count=1, gain=1.0,
+            missing_type=MISSING_NAN, default_left=True)
+    X = np.array([[np.nan], [0.0], [1.0]])
+    np.testing.assert_allclose(t.predict(X), [-1.0, -1.0, 1.0])
+
+
+def test_shrinkage():
+    t = build_simple_tree()
+    t.apply_shrinkage(0.1)
+    X = np.array([[0.0, 0.0]])
+    np.testing.assert_allclose(t.predict(X), [0.1])
+    assert t.shrinkage == 0.1
+
+
+def test_text_roundtrip():
+    t = build_simple_tree()
+    t.apply_shrinkage(0.05)
+    s = t.to_string(0)
+    t2 = Tree.from_string(s)
+    assert t2.num_leaves == t.num_leaves
+    X = np.random.RandomState(0).uniform(-1, 6, size=(50, 2))
+    np.testing.assert_allclose(t.predict(X), t2.predict(X))
+    assert t.to_string(0) == t2.to_string(0)
+
+
+def test_single_leaf_tree():
+    t = Tree(max_leaves=31)
+    t.leaf_value[0] = 0.5
+    X = np.zeros((3, 2))
+    np.testing.assert_allclose(t.predict(X), [0.5] * 3)
+    t2 = Tree.from_string(t.to_string(0))
+    np.testing.assert_allclose(t2.predict(X), [0.5] * 3)
+
+
+def test_categorical_split():
+    t = Tree(max_leaves=2)
+    t.split_categorical(0, feature=0, cat_bitset=cat_bitset([2, 5, 40]),
+                        left_value=1.0, right_value=-1.0,
+                        left_weight=1, right_weight=1, left_count=1,
+                        right_count=1, gain=1.0, missing_type=MISSING_NONE)
+    X = np.array([[2.0], [5.0], [40.0], [3.0], [np.nan]])
+    np.testing.assert_allclose(t.predict(X), [1.0, 1.0, 1.0, -1.0, -1.0])
+    t2 = Tree.from_string(t.to_string(0))
+    np.testing.assert_allclose(t2.predict(X), t.predict(X))
+
+
+def test_json_dump():
+    t = build_simple_tree()
+    j = t.to_json(0)
+    assert j["num_leaves"] == 3
+    assert j["tree_structure"]["split_feature"] == 0
+    assert j["tree_structure"]["left_child"]["leaf_value"] == 1.0
