@@ -1,0 +1,475 @@
+"""Public ``Dataset`` / ``Booster`` API.
+
+Capability parity with ``python-package/lightgbm/basic.py``: lazy
+``Dataset`` construction with reference alignment for validation sets,
+pandas and categorical handling, field get/set; ``Booster`` with
+train/eval/predict (raw / leaf index / SHAP contrib), model
+save/load/dump and continue-training.
+
+TPU-first: there is no ctypes bridge — the "native" layer is the JAX
+device program (``ops/``), and the Dataset pushes one dense binned
+matrix to HBM instead of per-feature Bin columns.
+"""
+from __future__ import annotations
+
+import io
+import os
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .config import Config
+from .io.binning import BIN_CATEGORICAL
+from .io.dataset import Metadata, TpuDataset
+from .io.parser import load_float_file, load_query_file, parse_file_full
+from .metrics import Metric, create_metrics, default_metric_for
+from .models.gbdt import GBDT
+from .models import model_io
+from .models.tree import Tree
+from .objectives import create_objective
+from .utils.log import Log
+
+__all__ = ["Dataset", "Booster"]
+
+
+def _to_matrix(data, feature_name="auto", categorical_feature="auto"):
+    """Normalize input data to (matrix, feature_names, categorical_idx)."""
+    cat_idx: List[int] = []
+    names = None
+    if hasattr(data, "dtypes") and hasattr(data, "columns"):  # pandas
+        import pandas as pd
+        df = data.copy()
+        names = [str(c) for c in df.columns]
+        for i, col in enumerate(df.columns):
+            if str(df[col].dtype) == "category":
+                df[col] = df[col].cat.codes
+                cat_idx.append(i)
+            elif df[col].dtype == object:
+                Log.fatal("pandas object column %s is not supported; "
+                          "use category dtype or numeric", col)
+        mat = df.values.astype(np.float64)
+    else:
+        mat = np.asarray(data, dtype=np.float64)
+        if mat.ndim == 1:
+            mat = mat.reshape(-1, 1)
+    if feature_name != "auto" and feature_name is not None:
+        names = list(feature_name)
+    if categorical_feature != "auto" and categorical_feature is not None:
+        cat_idx = []
+        for c in categorical_feature:
+            if isinstance(c, str):
+                if names is None or c not in names:
+                    Log.fatal("categorical feature name %s not found", c)
+                cat_idx.append(names.index(c))
+            else:
+                cat_idx.append(int(c))
+    return mat, names, cat_idx
+
+
+class Dataset:
+    """Training/validation data container (lazy construction like the
+    reference: binning happens at first use, and validation sets align
+    their bins with their ``reference`` train set)."""
+
+    def __init__(self, data, label=None, reference: "Dataset" = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name="auto", categorical_feature="auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = False, silent: bool = False):
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = dict(params) if params else {}
+        self.free_raw_data = free_raw_data
+        self._constructed: Optional[TpuDataset] = None
+        self.raw_mat: Optional[np.ndarray] = None
+        self.used_indices: Optional[np.ndarray] = None
+
+    # ------------------------------------------------------------------
+    def construct(self) -> "Dataset":
+        if self._constructed is not None:
+            return self
+        cfg = Config(self.params)
+        label, weight, group = self.label, self.weight, self.group
+
+        if isinstance(self.data, (str, os.PathLike)):
+            path = str(self.data)
+            if TpuDataset.is_binary_file(path):
+                self._constructed = TpuDataset.load_binary(path)
+                self.raw_mat = None
+                return self
+            mat, y, names, w, g = parse_file_full(
+                path, header=cfg.header, label_column=cfg.label_column,
+                ignore_columns=cfg.ignore_column,
+                weight_column=cfg.weight_column,
+                group_column=cfg.group_column)
+            label = y if label is None else label
+            if w is not None and weight is None:
+                weight = w
+            if g is not None and group is None:
+                group = g
+            sw = load_float_file(path + ".weight")
+            if sw is not None and weight is None:
+                weight = sw
+            sq = load_query_file(path + ".query")
+            if sq is not None and group is None:
+                group = sq
+            si = load_float_file(path + ".init")
+            if si is not None and self.init_score is None:
+                self.init_score = si
+            cat_idx = []
+            if self.feature_name == "auto":
+                self.feature_name = names
+        else:
+            mat, names, cat_idx = _to_matrix(self.data, self.feature_name,
+                                             self.categorical_feature)
+            if self.feature_name == "auto":
+                self.feature_name = names
+
+        if self.used_indices is not None:
+            mat = mat[self.used_indices]
+            label = None if label is None else \
+                np.asarray(label)[self.used_indices]
+            weight = None if weight is None else \
+                np.asarray(weight)[self.used_indices]
+            # group subsetting handled by caller providing group directly
+
+        mappers = None
+        if self.reference is not None:
+            self.reference.construct()
+            mappers = self.reference._constructed.mappers
+        self._constructed = TpuDataset.from_raw(
+            mat, label, cfg, weight=weight, group=group,
+            init_score=self.init_score,
+            feature_names=self.feature_name if self.feature_name else None,
+            categorical_features=cat_idx, mappers=mappers)
+        self.raw_mat = None if self.free_raw_data else mat
+        return self
+
+    # ------------------------------------------------------------------
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score,
+                       params=params or self.params)
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        ds = Dataset(self.data, label=self.label, reference=self.reference,
+                     weight=self.weight, group=None,
+                     feature_name=self.feature_name,
+                     categorical_feature=self.categorical_feature,
+                     params=params or self.params)
+        ds.used_indices = np.asarray(used_indices)
+        return ds
+
+    def save_binary(self, filename: str) -> "Dataset":
+        self.construct()
+        self._constructed.save_binary(str(filename))
+        return self
+
+    # ---- field access -------------------------------------------------
+    def num_data(self) -> int:
+        self.construct()
+        return self._constructed.num_data
+
+    def num_feature(self) -> int:
+        self.construct()
+        return self._constructed.num_total_features
+
+    def get_label(self):
+        self.construct()
+        return np.asarray(self._constructed.metadata.label)
+
+    def get_weight(self):
+        self.construct()
+        return self._constructed.metadata.weight
+
+    def get_group(self):
+        self.construct()
+        qb = self._constructed.metadata.query_boundaries
+        return None if qb is None else np.diff(qb)
+
+    def get_init_score(self):
+        self.construct()
+        return self._constructed.metadata.init_score
+
+    def set_label(self, label):
+        self.label = label
+        if self._constructed is not None:
+            self._constructed.metadata.set_label(label)
+        return self
+
+    def set_weight(self, weight):
+        self.weight = weight
+        if self._constructed is not None:
+            self._constructed.metadata.set_weight(weight)
+        return self
+
+    def set_group(self, group):
+        self.group = group
+        if self._constructed is not None:
+            self._constructed.metadata.set_query(group)
+        return self
+
+    def set_init_score(self, init_score):
+        self.init_score = init_score
+        if self._constructed is not None:
+            self._constructed.metadata.set_init_score(init_score)
+        return self
+
+    def set_field(self, name, data):
+        return {"label": self.set_label, "weight": self.set_weight,
+                "group": self.set_group,
+                "init_score": self.set_init_score}[name](data)
+
+    def get_field(self, name):
+        return {"label": self.get_label, "weight": self.get_weight,
+                "group": self.get_group,
+                "init_score": self.get_init_score}[name]()
+
+
+class Booster:
+    """Trained model handle (``basic.py:1485`` in the reference)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None, silent: bool = False):
+        params = dict(params) if params else {}
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self._gbdt: Optional[GBDT] = None
+        self._loaded: Optional[Dict] = None
+        self.train_set = train_set
+        self.params = params
+
+        if train_set is not None:
+            if not isinstance(train_set, Dataset):
+                Log.fatal("train_set must be a Dataset")
+            train_set.params = {**train_set.params, **params}
+            train_set.construct()
+            self.config = Config(params)
+            if self.config.objective in ("none", "custom", "null", "na"):
+                objective = None  # custom fobj supplies gradients
+            else:
+                objective = create_objective(self.config.objective,
+                                             self.config)
+            self._metric_names = self._resolve_metric_names(self.config)
+            metrics = create_metrics(self._metric_names, self.config)
+            self._gbdt = GBDT(self.config, train_set._constructed, objective,
+                              metrics)
+            self._valid_names: List[str] = []
+        elif model_file is not None or model_str is not None:
+            if model_file is not None:
+                with open(model_file) as f:
+                    model_str = f.read()
+            self._load_from_string(model_str)
+        else:
+            Log.fatal("need train_set, model_file or model_str")
+
+    @staticmethod
+    def _resolve_metric_names(config) -> List[str]:
+        m = config.metric
+        if isinstance(m, str):
+            names = [t.strip() for t in m.split(",")] if m else []
+        else:
+            names = list(m or [])
+        if not names:
+            if config.objective in ("none", "custom", "null", "na"):
+                return []
+            names = [default_metric_for(config.objective)]
+        if any(n.lower() in ("none", "na", "null") for n in names):
+            return []
+        return names
+
+    # ------------------------------------------------------------------
+    def _load_from_string(self, text: str) -> None:
+        info = model_io.load_model_from_string(text)
+        self._loaded = info
+        obj_str = info["objective"].split()
+        cfg_params: Dict[str, Any] = {"objective": obj_str[0] or "regression"}
+        for tok in obj_str[1:]:
+            if ":" in tok:
+                k, v = tok.split(":", 1)
+                cfg_params[k] = v
+        cfg_params["num_class"] = info["num_class"]
+        self.config = Config(cfg_params)
+        self._gbdt = GBDT.__new__(GBDT)
+        g = self._gbdt
+        g.config = self.config
+        g.train_set = None
+        g.models = info["models"]
+        g.num_class = info["num_class"]
+        g.num_tree_per_iteration = info["num_tree_per_iteration"]
+        g.metrics = []
+        g.valid_sets = []
+        g.iter = len(info["models"]) // max(info["num_tree_per_iteration"], 1)
+        g.objective = (create_objective(self.config.objective, self.config)
+                       if obj_str and obj_str[0] else None)
+        self._feature_names = info["feature_names"]
+        self._feature_infos = info["feature_infos"]
+        self._max_feature_idx = info["max_feature_idx"]
+        self._valid_names = []
+
+    # ------------------------------------------------------------------
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        data.reference = data.reference or self.train_set
+        data.construct()
+        if not self.train_set._constructed.check_align(data._constructed):
+            Log.fatal("validation set %s bins are not aligned with the "
+                      "training set (construct it with reference=train_set)",
+                      name)
+        if data.raw_mat is None:
+            Log.fatal("validation set %s needs raw data for evaluation "
+                      "(free_raw_data=False)", name)
+        self._gbdt.add_valid(name, data.raw_mat, data._constructed.metadata)
+        self._valid_names.append(name)
+        return self
+
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """One boosting iteration; returns True if training should stop."""
+        if train_set is not None:
+            Log.fatal("resetting train_set on an existing booster is not "
+                      "supported yet")
+        if fobj is None:
+            return self._gbdt.train_one_iter()
+        score = self._gbdt.train_score[0]
+        grad, hess = fobj(score.astype(np.float64), self.train_set)
+        return self._gbdt.train_one_iter(np.asarray(grad, np.float32),
+                                         np.asarray(hess, np.float32))
+
+    def rollback_one_iter(self) -> "Booster":
+        self._gbdt.rollback_one_iter()
+        return self
+
+    def current_iteration(self) -> int:
+        return self._gbdt.iter
+
+    def num_trees(self) -> int:
+        return len(self._gbdt.models)
+
+    # ------------------------------------------------------------------
+    def eval_set(self):
+        return self._gbdt.eval_set()
+
+    def eval_valid(self):
+        return [r for r in self._gbdt.eval_set() if r[0] != "training"]
+
+    def eval_train(self):
+        return [r for r in self._gbdt.eval_set() if r[0] == "training"]
+
+    # ------------------------------------------------------------------
+    def predict(self, data, num_iteration: Optional[int] = None,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False, **kwargs) -> np.ndarray:
+        if isinstance(data, Dataset):
+            Log.fatal("predict() takes a raw matrix, not a Dataset")
+        if isinstance(data, (str, os.PathLike)):
+            from .io.parser import parse_file
+            data, _, _ = parse_file(str(data), header=False)
+        mat, _, _ = _to_matrix(data)
+        # only num_iteration=None defaults to best_iteration; an explicit
+        # -1/0 means the full ensemble (reference basic.py semantics)
+        if num_iteration is None:
+            ni = self.best_iteration if self.best_iteration > 0 else -1
+        else:
+            ni = num_iteration
+        if pred_leaf:
+            return self._gbdt.predict_leaf_index(mat, ni)
+        if pred_contrib:
+            from .ops.shap import predict_contrib
+            return predict_contrib(self._gbdt.models, mat, ni,
+                                   self._gbdt.num_tree_per_iteration)
+        if raw_score:
+            return self._gbdt.predict_raw(mat, ni)
+        return self._gbdt.predict(mat, ni)
+
+    # ------------------------------------------------------------------
+    def _objective_string(self) -> str:
+        obj = self.config.objective
+        if obj in ("none", "custom", "null", "na"):
+            return ""
+        if obj == "binary":
+            return f"binary sigmoid:{self.config.sigmoid:g}"
+        if obj in ("multiclass", "multiclassova"):
+            return f"{obj} num_class:{self.config.num_class}"
+        if obj == "lambdarank":
+            return "lambdarank"
+        return obj
+
+    def model_to_string(self, num_iteration: Optional[int] = None) -> str:
+        g = self._gbdt
+        if g.train_set is not None:
+            names = g.train_set.feature_names
+            infos = g.train_set.feature_infos()
+            max_fi = g.train_set.num_total_features - 1
+        else:
+            names, infos = self._feature_names, self._feature_infos
+            max_fi = self._max_feature_idx
+        ni = num_iteration if num_iteration is not None else \
+            (self.best_iteration if self.best_iteration > 0 else -1)
+        return model_io.save_model_to_string(
+            g.models, num_class=g.num_class,
+            num_tree_per_iteration=g.num_tree_per_iteration,
+            label_index=0, max_feature_idx=max_fi,
+            objective_str=self._objective_string(),
+            feature_names=names, feature_infos=infos, num_iteration=ni,
+            parameters="")
+
+    def save_model(self, filename: str,
+                   num_iteration: Optional[int] = None) -> "Booster":
+        with open(filename, "w") as f:
+            f.write(self.model_to_string(num_iteration))
+        return self
+
+    def dump_model(self, num_iteration: Optional[int] = None) -> Dict:
+        g = self._gbdt
+        if g.train_set is not None:
+            names = g.train_set.feature_names
+            max_fi = g.train_set.num_total_features - 1
+        else:
+            names, max_fi = self._feature_names, self._max_feature_idx
+        ni = num_iteration if num_iteration is not None else -1
+        return model_io.dump_model_json(
+            g.models, num_class=g.num_class,
+            num_tree_per_iteration=g.num_tree_per_iteration,
+            label_index=0, max_feature_idx=max_fi,
+            objective_str=self._objective_string(), feature_names=names,
+            num_iteration=ni)
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        g = self._gbdt
+        nf = (g.train_set.num_total_features if g.train_set is not None
+              else self._max_feature_idx + 1)
+        models = g.models
+        if iteration is not None and iteration > 0:
+            models = models[:iteration * g.num_tree_per_iteration]
+        return model_io.feature_importance(models, importance_type, nf)
+
+    def feature_name(self) -> List[str]:
+        g = self._gbdt
+        if g.train_set is not None:
+            return list(g.train_set.feature_names)
+        return list(self._feature_names)
+
+    def __getstate__(self):
+        # picklable via model string (reference Booster pickling support)
+        state = {"model_str": self.model_to_string(num_iteration=-1),
+                 "best_iteration": self.best_iteration,
+                 "best_score": self.best_score,
+                 "params": self.params}
+        return state
+
+    def __setstate__(self, state):
+        self.best_iteration = state["best_iteration"]
+        self.best_score = state["best_score"]
+        self.params = state["params"]
+        self.train_set = None
+        self._loaded = None
+        self._load_from_string(state["model_str"])

@@ -58,23 +58,29 @@ def _find_boundaries(distinct: np.ndarray, counts: np.ndarray,
                 cur = 0
         bounds.append(np.inf)
         return bounds
-    # greedy equal-frequency with adaptive mean bin size
+    # Greedy equal-frequency with "big value" handling (GreedyFindBin,
+    # src/io/bin.cpp): a distinct value whose count exceeds the expected
+    # bin size gets a bin of its own and does not skew its neighbors'
+    # bins; the remaining values share bins targeting the mean size of
+    # the rest.
+    expected = total_cnt / max_bin
+    is_big = counts >= expected
+    n_big = int(is_big.sum())
+    rest_total = int(counts[~is_big].sum())
+    rest_bins_target = max(max_bin - n_big, 1)
+    mean_size = max(rest_total / rest_bins_target, float(min_data_in_bin))
+
     bounds = []
-    rest_cnt = int(total_cnt)
-    rest_bins = int(max_bin)
     cur = 0
-    i = 0
-    while i < n_distinct:
-        if rest_bins <= 1:
+    for i in range(n_distinct - 1):
+        if not is_big[i]:
+            cur += int(counts[i])
+        if is_big[i] or is_big[i + 1] or cur >= mean_size:
+            if cur >= min_data_in_bin or is_big[i] or is_big[i + 1]:
+                bounds.append(_midpoint(distinct[i], distinct[i + 1]))
+                cur = 0
+        if len(bounds) >= max_bin - 1:
             break
-        mean_size = max(rest_cnt / rest_bins, float(min_data_in_bin))
-        cur += int(counts[i])
-        rest_cnt -= int(counts[i])
-        if cur >= mean_size and i + 1 < n_distinct:
-            bounds.append(_midpoint(distinct[i], distinct[i + 1]))
-            rest_bins -= 1
-            cur = 0
-        i += 1
     bounds.append(np.inf)
     return bounds
 

@@ -1,0 +1,143 @@
+"""Evaluation metrics.
+
+Capability parity with ``src/metric/`` (factory ``metric.cpp:12-51``).
+Metrics evaluate on host numpy (scores come back from device once per
+eval round, matching the reference where metrics are computed locally
+per machine outside the training hot loop).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Type
+
+import numpy as np
+
+from .utils.log import Log
+
+_REGISTRY: Dict[str, Type["Metric"]] = {}
+
+
+def register(*names):
+    def deco(cls):
+        for n in names:
+            _REGISTRY[n] = cls
+        cls.name = names[0]
+        return cls
+    return deco
+
+
+# objective name -> default metric (metric.cpp behavior: metric defaults
+# to the objective's own loss)
+_DEFAULT_FOR_OBJECTIVE = {
+    "regression": "l2", "regression_l2": "l2", "l2": "l2", "mse": "l2",
+    "rmse": "rmse", "l2_root": "rmse",
+    "regression_l1": "l1", "l1": "l1", "mae": "l1",
+    "huber": "huber", "fair": "fair", "poisson": "poisson",
+    "quantile": "quantile", "mape": "mape", "gamma": "gamma",
+    "tweedie": "tweedie",
+    "binary": "binary_logloss",
+    "multiclass": "multi_logloss", "softmax": "multi_logloss",
+    "multiclassova": "multi_logloss", "ova": "multi_logloss",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda",
+    "xentlambda": "cross_entropy_lambda",
+    "lambdarank": "ndcg",
+}
+
+
+def default_metric_for(objective: str) -> str:
+    return _DEFAULT_FOR_OBJECTIVE.get(objective, "l2")
+
+
+def create_metrics(names, config) -> List["Metric"]:
+    out = []
+    for n in names:
+        n = n.strip()
+        if not n or n in ("None", "na", "null", "custom"):
+            continue
+        if n not in _REGISTRY:
+            Log.warning("unknown metric %s (skipped)", n)
+            continue
+        m = _REGISTRY[n](config)
+        if not any(type(o) is type(m) for o in out):
+            out.append(m)
+    return out
+
+
+class Metric:
+    name = "base"
+    higher_better = False
+
+    def __init__(self, config):
+        self.config = config
+
+    def eval(self, label: np.ndarray, score: np.ndarray,
+             weight: Optional[np.ndarray] = None,
+             query_boundaries: Optional[np.ndarray] = None) -> float:
+        """score is the TRANSFORMED prediction (probability for binary,
+        per-class probabilities for multiclass, raw for regression)."""
+        raise NotImplementedError
+
+    def _avg(self, values, weight):
+        values = np.asarray(values, np.float64)
+        if weight is None:
+            return float(np.mean(values))
+        return float(np.sum(values * weight) / np.sum(weight))
+
+
+@register("l2", "mean_squared_error", "mse")
+class L2Metric(Metric):
+    def eval(self, label, score, weight=None, query_boundaries=None):
+        return self._avg((score - label) ** 2, weight)
+
+
+@register("rmse", "root_mean_squared_error", "l2_root")
+class RMSEMetric(Metric):
+    def eval(self, label, score, weight=None, query_boundaries=None):
+        return float(np.sqrt(self._avg((score - label) ** 2, weight)))
+
+
+@register("l1", "mean_absolute_error", "mae", "regression_l1")
+class L1Metric(Metric):
+    def eval(self, label, score, weight=None, query_boundaries=None):
+        return self._avg(np.abs(score - label), weight)
+
+
+@register("binary_logloss", "binary")
+class BinaryLoglossMetric(Metric):
+    def eval(self, label, score, weight=None, query_boundaries=None):
+        p = np.clip(score, 1e-15, 1 - 1e-15)
+        loss = -(label * np.log(p) + (1 - label) * np.log(1 - p))
+        return self._avg(loss, weight)
+
+
+@register("binary_error")
+class BinaryErrorMetric(Metric):
+    def eval(self, label, score, weight=None, query_boundaries=None):
+        pred = (score > 0.5).astype(np.float64)
+        return self._avg(pred != label, weight)
+
+
+@register("auc")
+class AUCMetric(Metric):
+    """ROC AUC by rank-sum over sorted scores with tie handling
+    (``binary_metric.hpp`` AUCMetric)."""
+    higher_better = True
+
+    def eval(self, label, score, weight=None, query_boundaries=None):
+        if weight is None:
+            weight = np.ones_like(label, dtype=np.float64)
+        order = np.argsort(score, kind="mergesort")
+        s, y, w = score[order], label[order], weight[order]
+        pos = np.sum(w * (y > 0))
+        neg = np.sum(w) - pos
+        if pos <= 0 or neg <= 0:
+            return 1.0
+        # per unique score: area += tie_pos * (neg_below + tie_neg / 2)
+        starts = np.concatenate([[0], np.nonzero(np.diff(s))[0] + 1])
+        wp = np.where(y > 0, w, 0.0)
+        wn = np.where(y > 0, 0.0, w)
+        tie_pos = np.add.reduceat(wp, starts)
+        tie_neg = np.add.reduceat(wn, starts)
+        neg_below = np.cumsum(tie_neg) - tie_neg
+        area = np.sum(tie_pos * (neg_below + tie_neg / 2.0))
+        return float(area / (pos * neg))

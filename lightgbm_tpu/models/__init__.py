@@ -1,1 +1,3 @@
 from .tree import Tree, cat_bitset
+from .gbdt import GBDT
+from . import model_io

@@ -1,0 +1,395 @@
+"""GBDT boosting orchestrator.
+
+Capability parity with ``src/boosting/gbdt.cpp``: Init wires
+config/data/objective/metrics and the tree builder; ``TrainOneIter``
+(``gbdt.cpp:335``) = gradients → bagging → per-class tree build → leaf
+renewal → shrinkage → score update → first-iter bias absorption
+(``new_tree->AddBias(init_score)``, ``gbdt.cpp:377``); plus rollback,
+refit, and model text I/O hooks.
+
+TPU-first: gradients/scores are device-resident, the tree build is one
+jitted call (``ops/grow.py``) whose split records come back to host once
+per tree to materialize a :class:`Tree`.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..io.dataset import Metadata, TpuDataset
+from ..objectives import Objective, create_objective
+from ..metrics import Metric
+from ..utils.log import Log
+from .tree import Tree, cat_bitset
+
+_KEPS = 1e-15
+
+
+@dataclasses.dataclass
+class ValidSet:
+    name: str
+    raw: np.ndarray          # raw feature matrix (rows, total_features)
+    metadata: Metadata
+    score: np.ndarray = None  # accumulated raw score
+
+    def __post_init__(self):
+        if self.score is None:
+            n = self.raw.shape[0]
+            k = 1
+            self.score = np.zeros((k, n), dtype=np.float64)
+
+
+class GBDT:
+    """Gradient Boosting Decision Tree driver (single class for now;
+    multiclass lands with the multiclass objective)."""
+
+    def __init__(self, config: Config, train_set: TpuDataset,
+                 objective: Optional[Objective],
+                 metrics: Sequence[Metric] = ()):
+        import jax
+        import jax.numpy as jnp
+        from ..ops.grow import GrowParams, build_tree
+        from ..ops.split import SplitParams
+
+        self.config = config
+        self.train_set = train_set
+        self.objective = objective
+        self.metrics = list(metrics)
+        self.models: List[Tree] = []
+        self.iter = 0
+        self.num_class = max(config.num_class, 1)
+        self.num_tree_per_iteration = 1
+        if objective is not None:
+            self.num_tree_per_iteration = getattr(
+                objective, "num_model_per_iteration", 1)
+        self.shrinkage_rate = config.learning_rate
+        self.num_data = train_set.num_data
+        self.valid_sets: List[ValidSet] = []
+        self._prev_score = None
+        self._prev_valid_scores: List[np.ndarray] = []
+
+        F = len(train_set.used_features)
+        self.num_features = F
+        mappers = [train_set.mappers[i] for i in train_set.used_features]
+        self.max_bin = int(2 ** np.ceil(np.log2(max(
+            train_set.max_bin_count, 2))))
+        # per-feature static descriptor arrays
+        self._num_bins = jnp.asarray([m.num_bin for m in mappers], jnp.int32)
+        self._missing_type = jnp.asarray(
+            [m.missing_type for m in mappers], jnp.int32)
+        from ..io.binning import BIN_CATEGORICAL
+        self._is_cat = jnp.asarray(
+            [m.bin_type == BIN_CATEGORICAL for m in mappers], bool)
+
+        use_pallas = (config.device_type != "cpu" and
+                      jax.default_backend() not in ("cpu",))
+        rpb = int(config.tpu_rows_per_block)
+        n = train_set.num_data
+        self._n_pad = (n + rpb - 1) // rpb * rpb if use_pallas else n
+        xt = train_set.binned.T.astype(np.int32)  # (F, N)
+        if self._n_pad != n:
+            xt = np.pad(xt, ((0, 0), (0, self._n_pad - n)))
+        self._xt = jnp.asarray(xt)
+        self._base_mask = jnp.asarray(
+            np.pad(np.ones(n, np.float32), (0, self._n_pad - n)))
+
+        self.grow_params = GrowParams(
+            split=SplitParams(
+                max_bin=self.max_bin,
+                lambda_l1=config.lambda_l1,
+                lambda_l2=config.lambda_l2,
+                min_data_in_leaf=config.min_data_in_leaf,
+                min_sum_hessian_in_leaf=config.min_sum_hessian_in_leaf,
+                min_gain_to_split=config.min_gain_to_split,
+                max_delta_step=config.max_delta_step,
+                max_cat_to_onehot=config.max_cat_to_onehot,
+                max_cat_threshold=config.max_cat_threshold,
+                cat_l2=config.cat_l2,
+                cat_smooth=config.cat_smooth,
+                min_data_per_group=config.min_data_per_group),
+            num_leaves=config.num_leaves,
+            max_depth=config.max_depth,
+            hist_impl="pallas" if use_pallas else "segsum",
+            rows_per_block=rpb)
+        self._build_tree = build_tree
+
+        # scores: (num_tree_per_iteration, N) device
+        k = self.num_tree_per_iteration
+        score = np.zeros((k, n), dtype=np.float32)
+        if train_set.metadata.init_score is not None:
+            init = np.asarray(train_set.metadata.init_score,
+                              np.float64).reshape(-1)
+            score += init.reshape(k, n) if init.size == k * n else init
+        self._score = jnp.asarray(score)
+        self._rng_feature = np.random.RandomState(
+            config.feature_fraction_seed & 0x7FFFFFFF)
+        if objective is not None:
+            objective.init(train_set.metadata, n)
+
+    # ------------------------------------------------------------------
+    def add_valid(self, name: str, raw: np.ndarray, metadata: Metadata):
+        vs = ValidSet(name, raw, metadata)
+        vs.score = np.zeros((self.num_tree_per_iteration, raw.shape[0]),
+                            dtype=np.float64)
+        if metadata.init_score is not None:
+            vs.score += np.asarray(metadata.init_score).reshape(
+                vs.score.shape[0], -1)
+        # replay existing model (continue-train case)
+        for i, tree in enumerate(self.models):
+            vs.score[i % self.num_tree_per_iteration] += tree.predict(raw)
+        self.valid_sets.append(vs)
+
+    # ------------------------------------------------------------------
+    def _feature_fraction_mask(self):
+        import jax.numpy as jnp
+        F = self.num_features
+        frac = self.config.feature_fraction
+        if frac >= 1.0:
+            return jnp.ones(F, bool)
+        k = max(1, int(frac * F))
+        chosen = self._rng_feature.choice(F, size=k, replace=False)
+        mask = np.zeros(F, bool)
+        mask[chosen] = True
+        return jnp.asarray(mask)
+
+    def _bagging_mask(self):
+        """Row sample mask for this iteration (1 = in bag).  Base class:
+        bernoulli bagging every ``bagging_freq`` iterations
+        (``GBDT::Bagging``, ``gbdt.cpp:182``); GOSS/MVS override."""
+        cfg = self.config
+        if cfg.bagging_freq <= 0 or cfg.bagging_fraction >= 1.0:
+            return None
+        if self.iter % cfg.bagging_freq == 0:
+            rng = np.random.RandomState(
+                (cfg.bagging_seed + self.iter) & 0x7FFFFFFF)
+            mask = (rng.random_sample(self.num_data) <
+                    cfg.bagging_fraction).astype(np.float32)
+            self._cached_bag = mask
+        return getattr(self, "_cached_bag", None)
+
+    # ------------------------------------------------------------------
+    def train_one_iter(self, grad: Optional[np.ndarray] = None,
+                       hess: Optional[np.ndarray] = None) -> bool:
+        """One boosting iteration; returns True when training should stop
+        (no splittable leaf)."""
+        import jax.numpy as jnp
+
+        self._prev_score = self._score  # snapshot for rollback (immutable)
+        self._prev_valid_scores = [vs.score.copy() for vs in self.valid_sets]
+        init_scores = [0.0] * self.num_tree_per_iteration
+        custom = grad is not None
+        if not custom:
+            if (self.iter == 0 and self.config.boost_from_average and
+                    not self.models and
+                    self.train_set.metadata.init_score is None and
+                    self.objective is not None and
+                    self.num_features > 0):
+                for k in range(self.num_tree_per_iteration):
+                    init = self.objective.boost_from_score(k)
+                    if abs(init) > _KEPS:
+                        init_scores[k] = init
+                        self._score = self._score.at[k].add(init)
+                        for vs in self.valid_sets:
+                            vs.score[k] += init
+                        Log.info("Start training from score %f", init)
+            grad, hess = self.objective.get_gradients(self._score)
+            grad = jnp.atleast_2d(grad)
+            hess = jnp.atleast_2d(hess)
+        else:
+            grad = jnp.asarray(np.atleast_2d(np.asarray(grad, np.float32)))
+            hess = jnp.asarray(np.atleast_2d(np.asarray(hess, np.float32)))
+
+        bag = self._bagging_mask()
+        should_stop = True
+        for k in range(self.num_tree_per_iteration):
+            tree = self._train_one_tree(grad[k], hess[k], bag, init_scores[k])
+            if tree.num_leaves > 1:
+                should_stop = False
+            self.models.append(tree)
+        if should_stop:
+            Log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            return True
+        self.iter += 1
+        return False
+
+    def _train_one_tree(self, grad, hess, bag, init_score: float) -> Tree:
+        import jax
+        import jax.numpy as jnp
+
+        n, n_pad = self.num_data, self._n_pad
+        gp = jnp.pad(grad.astype(jnp.float32), (0, n_pad - n))
+        hp = jnp.pad(hess.astype(jnp.float32), (0, n_pad - n))
+        mask = self._base_mask
+        if bag is not None:
+            mask = mask * jnp.pad(jnp.asarray(bag), (0, n_pad - n))
+        fmask = self._feature_fraction_mask()
+
+        if self.num_features == 0:
+            rec = None
+            n_leaves = 1
+        else:
+            rec = self._build_tree(self._xt, gp, hp, mask, fmask,
+                                   self._num_bins, self._missing_type,
+                                   self._is_cat, self.grow_params)
+            n_leaves = int(rec["n_leaves"])
+
+        if n_leaves <= 1:
+            # constant tree holding the init score (gbdt.cpp:380-397)
+            tree = Tree(2)
+            out = init_score
+            tree.leaf_value[0] = out
+            if abs(out) > _KEPS:
+                tree_idx = len(self.models) % self.num_tree_per_iteration
+                self._score = self._score.at[tree_idx].add(out)
+                for vs in self.valid_sets:
+                    vs.score[tree_idx] += out
+            return tree
+
+        recs = jax.device_get({k: v for k, v in rec.items()
+                               if k not in ("leaf_idx",)})
+        tree = self._records_to_tree(recs)
+        # leaf renewal hook (RenewTreeOutput) — objective-specific
+        if self.objective is not None:
+            self.objective.renew_tree_output(
+                tree, self._score, rec["leaf_idx"][:n], mask)
+        tree.apply_shrinkage(self.shrinkage_rate)
+        # train-score update via the leaf assignment from the build
+        vals = jnp.asarray(tree.leaf_value[:self.config.num_leaves],
+                           jnp.float32)
+        vals = jnp.pad(vals, (0, max(0,
+                                     self.config.num_leaves - vals.shape[0])))
+        tree_idx = len(self.models) % self.num_tree_per_iteration
+        self._score = self._score.at[tree_idx].add(
+            jnp.take(vals, rec["leaf_idx"][:n]))
+        # valid scores on host via raw traversal
+        for vs in self.valid_sets:
+            vs.score[tree_idx] += tree.predict(vs.raw)
+        if abs(init_score) > _KEPS:
+            tree.add_bias(init_score)
+        return tree
+
+    # ------------------------------------------------------------------
+    def _records_to_tree(self, rec) -> Tree:
+        cfg = self.config
+        ds = self.train_set
+        tree = Tree(cfg.num_leaves)
+
+        def out(g, h):
+            o = -np.sign(_thl1(g, cfg.lambda_l1)) * abs(
+                _thl1(g, cfg.lambda_l1)) / (h + cfg.lambda_l2 + _KEPS)
+            if cfg.max_delta_step > 0:
+                o = np.clip(o, -cfg.max_delta_step, cfg.max_delta_step)
+            return float(o)
+
+        def _thl1(s, l1):
+            return np.sign(s) * max(abs(s) - l1, 0.0) if l1 > 0 else s
+
+        L1 = cfg.num_leaves - 1
+        for i in range(L1):
+            if not bool(rec["valid"][i]):
+                break
+            leaf = int(rec["leaf"][i])
+            inner_f = int(rec["feature"][i])
+            real_f = ds.real_feature_index(inner_f)
+            mapper = ds.mappers[real_f]
+            ls = rec["left_stats"][i]
+            rs = rec["right_stats"][i]
+            lv, rv = out(ls[0], ls[1]), out(rs[0], rs[1])
+            gain = float(rec["gain"][i])
+            if bool(rec["is_cat"][i]):
+                bins = np.nonzero(rec["left_mask"][i])[0]
+                cats = [mapper.bin_2_categorical[b] for b in bins
+                        if 0 < b < len(mapper.bin_2_categorical)]
+                if not cats:
+                    cats = [0]
+                tree.split_categorical(
+                    leaf, real_f, cat_bitset(cats), lv, rv,
+                    float(ls[1]), float(rs[1]), int(round(ls[2])),
+                    int(round(rs[2])), gain, mapper.missing_type)
+            else:
+                thr_bin = int(rec["threshold"][i])
+                tree.split(leaf, real_f, thr_bin,
+                           mapper.bin_to_value(thr_bin), lv, rv,
+                           float(ls[1]), float(rs[1])
+                           , int(round(ls[2])), int(round(rs[2])), gain,
+                           mapper.missing_type,
+                           bool(rec["default_left"][i]))
+            node = tree.num_leaves - 2
+            pg, ph = ls[0] + rs[0], ls[1] + rs[1]
+            tree.internal_value[node] = out(pg, ph)
+        return tree
+
+    # ------------------------------------------------------------------
+    @property
+    def train_score(self) -> np.ndarray:
+        return np.asarray(self._score)[:, :self.num_data]
+
+    def eval_set(self) -> List[Tuple[str, str, float, bool]]:
+        """Evaluate all metrics on train (optional) + valid sets.
+        Returns (dataset_name, metric_name, value, higher_better)."""
+        out = []
+        if self.config.is_provide_training_metric and self.objective:
+            score = self.objective.convert_output(
+                self.train_score[0].astype(np.float64))
+            meta = self.train_set.metadata
+            for m in self.metrics:
+                out.append(("training", m.name,
+                            m.eval(np.asarray(meta.label, np.float64), score,
+                                   meta.weight, meta.query_boundaries), m.higher_better))
+        for vs in self.valid_sets:
+            score = vs.score[0]
+            if self.objective is not None:
+                score = self.objective.convert_output(score)
+            for m in self.metrics:
+                out.append((vs.name, m.name,
+                            m.eval(np.asarray(vs.metadata.label, np.float64),
+                                   score, vs.metadata.weight,
+                                   vs.metadata.query_boundaries),
+                            m.higher_better))
+        return out
+
+    # ------------------------------------------------------------------
+    def predict_raw(self, X: np.ndarray, num_iteration: int = -1
+                    ) -> np.ndarray:
+        """Raw scores (rows,) or (rows, num_class)."""
+        X = np.ascontiguousarray(np.asarray(X, np.float64))
+        k = self.num_tree_per_iteration
+        n_trees = len(self.models)
+        if num_iteration is not None and num_iteration > 0:
+            n_trees = min(n_trees, num_iteration * k)
+        out = np.zeros((k, X.shape[0]), dtype=np.float64)
+        for i in range(n_trees):
+            out[i % k] += self.models[i].predict(X)
+        return out[0] if k == 1 else out.T
+
+    def predict(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+        raw = self.predict_raw(X, num_iteration)
+        if self.objective is not None:
+            return self.objective.convert_output(raw)
+        return raw
+
+    def predict_leaf_index(self, X: np.ndarray, num_iteration: int = -1
+                           ) -> np.ndarray:
+        X = np.ascontiguousarray(np.asarray(X, np.float64))
+        n_trees = len(self.models)
+        if num_iteration is not None and num_iteration > 0:
+            n_trees = min(n_trees, num_iteration * self.num_tree_per_iteration)
+        return np.stack([self.models[i].predict_leaf_index(X)
+                         for i in range(n_trees)], axis=1)
+
+    def rollback_one_iter(self) -> None:
+        """Undo the last iteration (``GBDT::RollbackOneIter``) using the
+        pre-iteration score snapshot taken in :meth:`train_one_iter`."""
+        if self.iter <= 0 or self._prev_score is None:
+            return
+        self._score = self._prev_score
+        for vs, snap in zip(self.valid_sets, self._prev_valid_scores):
+            vs.score = snap
+        self._prev_score = None
+        for _ in range(self.num_tree_per_iteration):
+            self.models.pop()
+        self.iter -= 1

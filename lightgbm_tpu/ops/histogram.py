@@ -1,0 +1,116 @@
+"""Histogram construction — the hottest op.
+
+Reference: ``Bin::ConstructHistogram`` (``include/LightGBM/bin.h:346-371``,
+``src/io/dense_bin.hpp:43``) on CPU and the OpenCL kernels
+(``src/treelearner/ocl/histogram256.cl``) on GPU accumulate
+``(sum_grad, sum_hess, count)`` per (feature, bin).
+
+TPU-first design: no atomics on TPU, so the scatter-add becomes a
+one-hot × values matmul on the MXU.  Two implementations:
+
+- ``histogram_segsum``: jnp reference (segment-sum), used on CPU/tests
+  and as the numerical oracle for the kernel.
+- ``histogram_pallas``: Pallas kernel — grid over row tiles, each step
+  loads an (F, T) bin tile + (3, T) value tile into VMEM, builds the
+  (T, B) one-hot per feature and accumulates ``vals @ onehot`` into a
+  (3, F*B) accumulator that lives across grid steps.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["histogram", "histogram_segsum", "histogram_pallas"]
+
+
+def histogram_segsum(bins_t: jax.Array, vals: jax.Array, max_bin: int
+                     ) -> jax.Array:
+    """(F, N) int bins × (N, 3) values -> (F, B, 3) histogram."""
+    f, n = bins_t.shape
+    ids = bins_t.astype(jnp.int32) + \
+        jnp.arange(f, dtype=jnp.int32)[:, None] * max_bin
+    flat = jax.ops.segment_sum(
+        jnp.broadcast_to(vals[None, :, :], (f, n, 3)).reshape(-1, 3),
+        ids.reshape(-1), num_segments=f * max_bin)
+    return flat.reshape(f, max_bin, 3)
+
+
+def _hist_kernel(x_ref, v_ref, out_ref, *, num_features: int, max_bin: int):
+    """One grid step: accumulate this row tile into the shared accumulator.
+
+    x_ref: (F, T) int32 bins; v_ref: (3, T) f32 [grad, hess, count];
+    out_ref: (3, F*B) f32 accumulated across the whole grid.
+    """
+    import jax.experimental.pallas as pl
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    tile = x_ref.shape[1]
+    vals = v_ref[...]  # (3, T)
+
+    def body(f, _):
+        row = x_ref[f, :]  # (T,)
+        onehot = (row[:, None] ==
+                  jax.lax.broadcasted_iota(jnp.int32, (tile, max_bin), 1)
+                  ).astype(jnp.float32)
+        acc = jax.lax.dot_general(
+            vals, onehot, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (3, B)
+        out_ref[:, pl.ds(f * max_bin, max_bin)] += acc
+        return 0
+
+    jax.lax.fori_loop(0, num_features, body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("max_bin", "rows_per_block"))
+def histogram_pallas(bins_t: jax.Array, vals: jax.Array, max_bin: int,
+                     rows_per_block: int = 1024) -> jax.Array:
+    """Pallas histogram. bins_t (F, N) integer, vals (N, 3) f32.
+
+    N must be a multiple of rows_per_block (pad with bin 0 / value 0 rows
+    upstream).  Returns (F, B, 3).
+    """
+    import jax.experimental.pallas as pl
+
+    f, n = bins_t.shape
+    assert n % rows_per_block == 0, (n, rows_per_block)
+    grid = n // rows_per_block
+    xt = bins_t.astype(jnp.int32)  # (F, N)
+    vt = vals.astype(jnp.float32).T  # (3, N)
+
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, num_features=f, max_bin=max_bin),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((f, rows_per_block), lambda i: (0, i)),
+            pl.BlockSpec((3, rows_per_block), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((3, f * max_bin), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((3, f * max_bin), jnp.float32),
+    )(xt, vt)
+    return out.reshape(3, f, max_bin).transpose(1, 2, 0)
+
+
+def _pad_rows(n: int, block: int) -> int:
+    return (n + block - 1) // block * block
+
+
+def histogram(bins_t: jax.Array, vals: jax.Array, max_bin: int,
+              impl: str = "auto", rows_per_block: int = 1024) -> jax.Array:
+    """Dispatching entry point. ``impl``: auto | segsum | pallas."""
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() not in ("cpu",) else "segsum"
+    if impl == "segsum":
+        return histogram_segsum(bins_t, vals, max_bin)
+    n = bins_t.shape[1]
+    padded = _pad_rows(n, rows_per_block)
+    if padded != n:
+        bins_t = jnp.pad(bins_t, ((0, 0), (0, padded - n)))
+        vals = jnp.pad(vals, ((0, padded - n), (0, 0)))
+        # padded rows land in (feature, bin 0) with value 0 — harmless
+    return histogram_pallas(bins_t, vals, max_bin, rows_per_block)

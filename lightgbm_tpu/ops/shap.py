@@ -1,0 +1,198 @@
+"""TreeSHAP feature contributions.
+
+Capability parity with the reference's path-dependent TreeSHAP
+(``src/io/tree.cpp:591-650``: ``ExtendPath`` / ``UnwindPath`` /
+``UnwoundPathSum`` / ``TreeSHAP`` recursion, exposed as
+``PredictContrib``).  Host-side numpy implementation of the published
+Tree SHAP algorithm (Lundberg et al.) using node covers
+(internal_count / leaf_count) for the path-dependent weighting.
+
+Output layout matches the reference: ``(rows, num_features + 1)`` with
+the last column holding the expected value (bias) term.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..models.tree import Tree, _CAT_MASK, _DEFAULT_LEFT_MASK
+
+
+class _Path:
+    __slots__ = ("feature", "zero", "one", "pweight")
+
+    def __init__(self, depth_cap: int):
+        self.feature = np.zeros(depth_cap, dtype=np.int64)
+        self.zero = np.zeros(depth_cap, dtype=np.float64)
+        self.one = np.zeros(depth_cap, dtype=np.float64)
+        self.pweight = np.zeros(depth_cap, dtype=np.float64)
+
+    def copy_to(self, other: "_Path", n: int) -> None:
+        other.feature[:n] = self.feature[:n]
+        other.zero[:n] = self.zero[:n]
+        other.one[:n] = self.one[:n]
+        other.pweight[:n] = self.pweight[:n]
+
+
+def _extend(p: _Path, unique_depth: int, zero: float, one: float,
+            fi: int) -> None:
+    p.feature[unique_depth] = fi
+    p.zero[unique_depth] = zero
+    p.one[unique_depth] = one
+    p.pweight[unique_depth] = 1.0 if unique_depth == 0 else 0.0
+    for i in range(unique_depth - 1, -1, -1):
+        p.pweight[i + 1] += one * p.pweight[i] * (i + 1) / (unique_depth + 1)
+        p.pweight[i] = zero * p.pweight[i] * \
+            (unique_depth - i) / (unique_depth + 1)
+
+
+def _unwind(p: _Path, unique_depth: int, path_index: int) -> None:
+    one = p.one[path_index]
+    zero = p.zero[path_index]
+    n = p.pweight[unique_depth]
+    for i in range(unique_depth - 1, -1, -1):
+        if one != 0.0:
+            t = p.pweight[i]
+            p.pweight[i] = n * (unique_depth + 1) / ((i + 1) * one)
+            n = t - p.pweight[i] * zero * (unique_depth - i) / \
+                (unique_depth + 1)
+        else:
+            p.pweight[i] = p.pweight[i] * (unique_depth + 1) / \
+                (zero * (unique_depth - i))
+    for i in range(path_index, unique_depth):
+        p.feature[i] = p.feature[i + 1]
+        p.zero[i] = p.zero[i + 1]
+        p.one[i] = p.one[i + 1]
+
+
+def _unwound_sum(p: _Path, unique_depth: int, path_index: int) -> float:
+    one = p.one[path_index]
+    zero = p.zero[path_index]
+    total = 0.0
+    n = p.pweight[unique_depth]
+    for i in range(unique_depth - 1, -1, -1):
+        if one != 0.0:
+            t = n * (unique_depth + 1) / ((i + 1) * one)
+            total += t
+            n = p.pweight[i] - t * zero * (unique_depth - i) / \
+                (unique_depth + 1)
+        else:
+            total += p.pweight[i] * (unique_depth + 1) / \
+                (zero * (unique_depth - i))
+    return total
+
+
+def _decide_left(tree: Tree, node: int, x: np.ndarray) -> bool:
+    v = float(x[tree.split_feature[node]])
+    dt = int(tree.decision_type[node])
+    if dt & _CAT_MASK:
+        if not np.isfinite(v):
+            return False
+        c = int(v)
+        if c < 0 or c != v:
+            return False
+        k = tree.threshold_bin[node]
+        lo, hi = tree.cat_boundaries[k], tree.cat_boundaries[k + 1]
+        w, b = divmod(c, 32)
+        return w < hi - lo and bool((tree.cat_threshold[lo + w] >> b) & 1)
+    mt = (dt >> 2) & 3
+    if mt == 2:  # NaN
+        if np.isnan(v):
+            return bool(dt & _DEFAULT_LEFT_MASK)
+    elif mt == 1:  # Zero
+        if np.isnan(v) or abs(v) <= 1e-35:
+            return bool(dt & _DEFAULT_LEFT_MASK)
+    if np.isnan(v):
+        v = 0.0
+    return v <= tree.threshold[node]
+
+
+def _tree_shap(tree: Tree, x: np.ndarray, phi: np.ndarray, node: int,
+               unique_depth: int, parent: _Path, p_zero: float, p_one: float,
+               p_fi: int) -> None:
+    path = _Path(tree.num_leaves + 2)
+    parent.copy_to(path, unique_depth)
+    _extend(path, unique_depth, p_zero, p_one, p_fi)
+    if node < 0:  # leaf
+        leaf = ~node
+        value = tree.leaf_value[leaf]
+        for i in range(1, unique_depth + 1):
+            w = _unwound_sum(path, unique_depth, i)
+            phi[path.feature[i]] += w * (path.one[i] - path.zero[i]) * value
+        return
+    node_count = float(tree.internal_count[node]) or 1.0
+    left, right = int(tree.left_child[node]), int(tree.right_child[node])
+    hot, cold = (left, right) if _decide_left(tree, node, x) else \
+        (right, left)
+
+    def child_count(c):
+        return float(tree.leaf_count[~c] if c < 0 else
+                     tree.internal_count[c])
+
+    hot_zero = child_count(hot) / node_count
+    cold_zero = child_count(cold) / node_count
+    incoming_zero, incoming_one = 1.0, 1.0
+    fi = int(tree.split_feature[node])
+    # same feature already on the path → unwind the previous occurrence
+    path_index = -1
+    for i in range(1, unique_depth + 1):
+        if path.feature[i] == fi:
+            path_index = i
+            break
+    if path_index >= 0:
+        incoming_zero = path.zero[path_index]
+        incoming_one = path.one[path_index]
+        _unwind(path, unique_depth, path_index)
+        unique_depth -= 1
+    _tree_shap(tree, x, phi, hot, unique_depth + 1, path,
+               hot_zero * incoming_zero, incoming_one, fi)
+    _tree_shap(tree, x, phi, cold, unique_depth + 1, path,
+               cold_zero * incoming_zero, 0.0, fi)
+
+
+def _expected_value(tree: Tree) -> float:
+    n = tree.num_leaves
+    if n <= 1:
+        return float(tree.leaf_value[0])
+    counts = tree.leaf_count[:n].astype(np.float64)
+    total = counts.sum()
+    if total <= 0:
+        return float(np.mean(tree.leaf_value[:n]))
+    return float(np.dot(counts, tree.leaf_value[:n]) / total)
+
+
+def shap_values_one_tree(tree: Tree, X: np.ndarray) -> np.ndarray:
+    """(rows, num_features+1) contributions of one tree (last col = bias)."""
+    rows, nf = X.shape
+    out = np.zeros((rows, nf + 1), dtype=np.float64)
+    base = _expected_value(tree)
+    out[:, -1] = base
+    if tree.num_leaves <= 1:
+        return out
+    root_path = _Path(tree.num_leaves + 2)
+    for r in range(rows):
+        _tree_shap(tree, X[r], out[r, :-1], 0, 0, root_path, 1.0, 1.0, -1)
+    return out
+
+
+def predict_contrib(models: List[Tree], X: np.ndarray,
+                    num_iteration: int = -1,
+                    num_tree_per_iteration: int = 1) -> np.ndarray:
+    """Sum of per-tree SHAP contributions (``PredictContrib``).
+
+    Multiclass returns (rows, num_class * (num_features+1)) like the
+    reference's flattened layout.
+    """
+    X = np.ascontiguousarray(np.asarray(X, np.float64))
+    k = max(num_tree_per_iteration, 1)
+    n_trees = len(models)
+    if num_iteration is not None and num_iteration > 0:
+        n_trees = min(n_trees, num_iteration * k)
+    rows, nf = X.shape
+    out = np.zeros((rows, k, nf + 1), dtype=np.float64)
+    for i in range(n_trees):
+        out[:, i % k, :] += shap_values_one_tree(models[i], X)
+    if k == 1:
+        return out[:, 0, :]
+    return out.reshape(rows, k * (nf + 1))

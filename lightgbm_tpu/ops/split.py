@@ -1,0 +1,240 @@
+"""Best-split search over per-leaf histograms.
+
+Reference: ``FeatureHistogram::FindBestThreshold`` and helpers
+(``src/treelearner/feature_histogram.hpp:84-520``): numerical threshold
+scan with missing-value default-direction handling (two scans), L1/L2
+regularization (``ThresholdL1:440``), ``max_delta_step`` clipping,
+min_data / min_sum_hessian constraints, categorical one-vs-other and
+sorted many-vs-many splits.
+
+TPU-first: the per-feature sequential bin scans become vectorized
+cumulative sums over the whole (F, B, 3) histogram tensor; the winning
+split is materialized as a (B,) boolean "goes-left" mask over bin ids so
+row routing is a single gather regardless of split kind.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-15
+NEG_INF = -1e30
+
+
+@dataclasses.dataclass(frozen=True)
+class SplitParams:
+    """Static (trace-time) split-finding parameters."""
+    max_bin: int
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    min_gain_to_split: float = 0.0
+    max_delta_step: float = 0.0
+    max_cat_to_onehot: int = 4
+    max_cat_threshold: int = 32
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    min_data_per_group: int = 100
+
+
+def threshold_l1(s, l1):
+    """ThresholdL1 (feature_histogram.hpp:440)."""
+    if l1 == 0.0:
+        return s
+    return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)
+
+
+def leaf_output(g, h, l1, l2, max_delta_step):
+    """CalculateSplittedLeafOutput (feature_histogram.hpp:445)."""
+    out = -threshold_l1(g, l1) / (h + l2 + EPS)
+    if max_delta_step > 0.0:
+        out = jnp.clip(out, -max_delta_step, max_delta_step)
+    return out
+
+
+def _gain_given_output(g, h, out, l1, l2):
+    """GetLeafSplitGainGivenOutput (feature_histogram.hpp:498)."""
+    sg = threshold_l1(g, l1)
+    return -(2.0 * sg * out + (h + l2) * out * out)
+
+
+def leaf_gain(g, h, l1, l2, max_delta_step):
+    """GetLeafSplitGain (feature_histogram.hpp:493)."""
+    return _gain_given_output(g, h, leaf_output(g, h, l1, l2, max_delta_step),
+                              l1, l2)
+
+
+def _split_gain(gl, hl, gr, hr, l1, l2, mds):
+    """GetSplitGains without monotone handling (feature_histogram.hpp:456)."""
+    return (leaf_gain(gl, hl, l1, l2, mds) +
+            leaf_gain(gr, hr, l1, l2, mds))
+
+
+def _constraints(L, R, p: SplitParams, min_data_override=None):
+    """min_data / min_sum_hessian feasibility of a candidate."""
+    min_data = p.min_data_in_leaf if min_data_override is None \
+        else min_data_override
+    return ((L[..., 2] >= max(min_data, 1)) &
+            (R[..., 2] >= max(min_data, 1)) &
+            (L[..., 1] >= p.min_sum_hessian_in_leaf) &
+            (R[..., 1] >= p.min_sum_hessian_in_leaf))
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def find_best_split(hist: jax.Array, parent: jax.Array,
+                    num_bins: jax.Array, missing_type: jax.Array,
+                    is_cat: jax.Array, feature_mask: jax.Array,
+                    params: SplitParams):
+    """Find the best split for one leaf.
+
+    hist: (F, B, 3) [sum_grad, sum_hess, count]; parent: (3,);
+    num_bins/missing_type: (F,) int32; is_cat/feature_mask: (F,) bool.
+
+    Returns dict(gain, feature, threshold, default_left, is_cat,
+    left_mask(B,), left_stats(3,)) — gain is net (minus parent gain and
+    min_gain_to_split); <= 0 means "do not split".
+    """
+    p = params
+    F, B, _ = hist.shape
+    l1, l2, mds = p.lambda_l1, p.lambda_l2, p.max_delta_step
+    parent_gain = leaf_gain(parent[0], parent[1], l1, l2, mds)
+    gain_shift = parent_gain + p.min_gain_to_split
+
+    jidx = jnp.arange(B, dtype=jnp.int32)
+    has_missing = missing_type != 0
+    nv = num_bins - has_missing.astype(jnp.int32)  # value bins per feature
+    in_value = jidx[None, :] < nv[:, None]
+    hv = hist * in_value[..., None]
+    # missing-bin stats (last bin when feature has a missing bin)
+    miss = jnp.take_along_axis(
+        hist, (num_bins - 1)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0, :] * has_missing[:, None]  # (F, 3)
+
+    # ---------------- numerical: prefix thresholds, two directions ----
+    cum = jnp.cumsum(hv, axis=1)  # (F, B, 3): left side for thr=j
+    cand_ok = (jidx[None, :] <= nv[:, None] - 2) & ~is_cat[:, None]
+
+    def scan_dir(default_left: bool):
+        L = cum + (miss[:, None, :] if default_left else 0.0)
+        R = parent[None, None, :] - L
+        g = (_split_gain(L[..., 0], L[..., 1] + EPS,
+                         R[..., 0], R[..., 1] + EPS, l1, l2, mds)
+             - gain_shift)
+        ok = cand_ok & _constraints(L, R, p)
+        return jnp.where(ok, g, NEG_INF), L
+
+    g_r, L_r = scan_dir(False)
+    g_l, L_l = scan_dir(True)
+    # when the feature has no missing data both scans coincide; prefer
+    # default-right (use_na_as_missing=false) like the reference
+    no_miss = miss[:, 2] <= 0
+    g_l = jnp.where(no_miss[:, None], NEG_INF, g_l)
+    num_gain = jnp.maximum(g_r, g_l)  # (F, B)
+    num_dir_left = g_l > g_r
+
+    # ---------------- categorical one-vs-other -----------------------
+    # bin 0 is the other/unseen catch-all (no real category id) — it can
+    # never be in the left set, so train-time routing matches the
+    # category-bitset model semantics where unseen goes right
+    not_other = jidx[None, :] > 0
+    onehot_ok = is_cat[:, None] & (nv <= p.max_cat_to_onehot)[:, None] & \
+        in_value & not_other
+    Lc = hv  # singleton {k}
+    Rc = parent[None, None, :] - Lc
+    g_c = (_split_gain(Lc[..., 0], Lc[..., 1] + EPS,
+                       Rc[..., 0], Rc[..., 1] + EPS, l1, l2 + p.cat_l2, mds)
+           - gain_shift)
+    cat1_gain = jnp.where(onehot_ok & _constraints(Lc, Rc, p), g_c, NEG_INF)
+
+    # ---------------- categorical sorted many-vs-many ----------------
+    # sort value bins by sum_grad / (sum_hess + cat_smooth); scan prefixes
+    # from both ends capped at max_cat_threshold
+    # (FindBestThresholdCategorical, feature_histogram.hpp:112)
+    cnt_ok = (hv[..., 2] > 0) & not_other
+    ratio = jnp.where(cnt_ok & in_value,
+                      hv[..., 0] / (hv[..., 1] + p.cat_smooth), jnp.inf)
+    order = jnp.argsort(ratio, axis=1)  # invalid bins (inf) sink to end
+    sorted_h = jnp.take_along_axis(hv * (cnt_ok & in_value)[..., None],
+                                   order[..., None], axis=1)
+    n_valid = jnp.sum(cnt_ok & in_value, axis=1)  # (F,)
+    cum_s = jnp.cumsum(sorted_h, axis=1)
+    many_ok = is_cat[:, None] & (nv > p.max_cat_to_onehot)[:, None]
+    rank = jnp.argsort(order, axis=1)  # bin -> position
+
+    def cat_scan(from_low: bool):
+        if from_low:
+            Ls = cum_s
+        else:
+            total_s = cum_s[:, -1:, :]
+            Ls = total_s - cum_s  # suffix after position j
+        if from_low:
+            ok = (jidx[None, :] + 1 <= jnp.minimum(
+                n_valid - 1, p.max_cat_threshold)[:, None])
+        else:
+            size = n_valid[:, None] - (jidx[None, :] + 1)
+            ok = (size >= 1) & (size <= p.max_cat_threshold) & \
+                (jidx[None, :] + 1 < n_valid[:, None])
+        Rs = parent[None, None, :] - Ls
+        g = (_split_gain(Ls[..., 0], Ls[..., 1] + EPS,
+                         Rs[..., 0], Rs[..., 1] + EPS, l1, l2 + p.cat_l2, mds)
+             - gain_shift)
+        ok = ok & many_ok & _constraints(Ls, Rs, p) & \
+            (Ls[..., 2] >= p.min_data_per_group) & \
+            (Rs[..., 2] >= p.min_data_per_group)
+        return jnp.where(ok, g, NEG_INF), Ls
+
+    gm_lo, L_lo = cat_scan(True)
+    gm_hi, L_hi = cat_scan(False)
+    many_gain = jnp.maximum(gm_lo, gm_hi)
+    many_from_low = gm_lo >= gm_hi
+
+    cat_gain = jnp.maximum(cat1_gain, many_gain)
+    cat_is_onehot = cat1_gain >= many_gain
+
+    # ---------------- combine --------------------------------------
+    all_gain = jnp.where(is_cat[:, None], cat_gain, num_gain)  # (F, B)
+    all_gain = jnp.where(feature_mask[:, None], all_gain, NEG_INF)
+    best_per_f = jnp.max(all_gain, axis=1)
+    best_j = jnp.argmax(all_gain, axis=1).astype(jnp.int32)
+    f_star = jnp.argmax(best_per_f).astype(jnp.int32)
+    j_star = best_j[f_star]
+    gain = best_per_f[f_star]
+
+    fcat = is_cat[f_star]
+    f_onehot = cat_is_onehot[f_star, j_star]
+    f_from_low = many_from_low[f_star, j_star]
+    dir_left = num_dir_left[f_star, j_star] & ~fcat
+
+    # left stats of the winner
+    L_num = jnp.where(dir_left, L_l[f_star, j_star], L_r[f_star, j_star])
+    L_cat = jnp.where(f_onehot, hv[f_star, j_star],
+                      jnp.where(f_from_low, L_lo[f_star, j_star],
+                                L_hi[f_star, j_star]))
+    left_stats = jnp.where(fcat, L_cat, L_num)
+
+    # goes-left mask over bin ids
+    nb_f = num_bins[f_star]
+    miss_bin_mask = has_missing[f_star] & (jidx == nb_f - 1)
+    nv_f = nv[f_star]
+    num_mask = (jidx <= j_star) & (jidx < nv_f)
+    num_mask = num_mask | (dir_left & miss_bin_mask)
+    rank_f = rank[f_star]
+    many_mask = jnp.where(f_from_low, rank_f <= j_star, rank_f > j_star) & \
+        (jidx < nv_f) & cnt_ok[f_star]
+    cat_mask = jnp.where(f_onehot, jidx == j_star, many_mask)
+    left_mask = jnp.where(fcat, cat_mask, num_mask)
+
+    return {
+        "gain": gain,
+        "feature": f_star,
+        "threshold": j_star,
+        "default_left": dir_left,
+        "is_cat": fcat,
+        "left_mask": left_mask,
+        "left_stats": left_stats,
+    }
