@@ -117,6 +117,218 @@ class BinaryErrorMetric(Metric):
         return self._avg(pred != label, weight)
 
 
+@register("quantile")
+class QuantileMetric(Metric):
+    def eval(self, label, score, weight=None, query_boundaries=None):
+        alpha = float(self.config.alpha)
+        d = label - score
+        loss = np.where(d >= 0, alpha * d, (alpha - 1) * d)
+        return self._avg(loss, weight)
+
+
+@register("huber")
+class HuberMetric(Metric):
+    def eval(self, label, score, weight=None, query_boundaries=None):
+        a = float(self.config.alpha)
+        d = np.abs(score - label)
+        loss = np.where(d <= a, 0.5 * d * d, a * (d - 0.5 * a))
+        return self._avg(loss, weight)
+
+
+@register("fair")
+class FairMetric(Metric):
+    def eval(self, label, score, weight=None, query_boundaries=None):
+        c = float(self.config.fair_c)
+        x = np.abs(score - label)
+        loss = c * x - c * c * np.log1p(x / c)
+        return self._avg(loss, weight)
+
+
+@register("poisson")
+class PoissonMetric(Metric):
+    """Poisson negative log-likelihood (score is the mean)."""
+    def eval(self, label, score, weight=None, query_boundaries=None):
+        eps = 1e-10
+        mu = np.maximum(score, eps)
+        loss = mu - label * np.log(mu)
+        return self._avg(loss, weight)
+
+
+@register("mape", "mean_absolute_percentage_error")
+class MAPEMetric(Metric):
+    def eval(self, label, score, weight=None, query_boundaries=None):
+        loss = np.abs(score - label) / np.maximum(1.0, np.abs(label))
+        return self._avg(loss, weight)
+
+
+@register("gamma")
+class GammaMetric(Metric):
+    """Gamma negative log-likelihood."""
+    def eval(self, label, score, weight=None, query_boundaries=None):
+        eps = 1e-10
+        mu = np.maximum(score, eps)
+        loss = label / mu + np.log(mu)
+        return self._avg(loss, weight)
+
+
+@register("gamma_deviance", "gamma-deviance")
+class GammaDevianceMetric(Metric):
+    def eval(self, label, score, weight=None, query_boundaries=None):
+        eps = 1e-10
+        r = label / np.maximum(score, eps)
+        loss = 2.0 * (np.log(np.maximum(1.0 / np.maximum(r, eps), eps)) +
+                      r - 1.0)
+        return self._avg(loss, weight)
+
+
+@register("tweedie")
+class TweedieMetric(Metric):
+    def eval(self, label, score, weight=None, query_boundaries=None):
+        rho = float(self.config.tweedie_variance_power)
+        eps = 1e-10
+        mu = np.maximum(score, eps)
+        a = label * np.power(mu, 1 - rho) / (1 - rho)
+        b = np.power(mu, 2 - rho) / (2 - rho)
+        return self._avg(-a + b, weight)
+
+
+@register("multi_logloss", "multiclass", "softmax", "multiclassova",
+          "multiclass_ova", "ova", "ovr")
+class MultiLoglossMetric(Metric):
+    """score: (rows, num_class) probabilities."""
+    def eval(self, label, score, weight=None, query_boundaries=None):
+        rows = np.arange(len(label))
+        p = np.clip(score[rows, label.astype(np.int64)], 1e-15, 1.0)
+        return self._avg(-np.log(p), weight)
+
+
+@register("multi_error")
+class MultiErrorMetric(Metric):
+    def eval(self, label, score, weight=None, query_boundaries=None):
+        k = max(int(self.config.multi_error_top_k), 1)
+        if k == 1:
+            pred = np.argmax(score, axis=1)
+            err = pred != label.astype(np.int64)
+        else:
+            topk = np.argsort(-score, axis=1)[:, :k]
+            err = ~np.any(topk == label.astype(np.int64)[:, None], axis=1)
+        return self._avg(err.astype(np.float64), weight)
+
+
+@register("cross_entropy", "xentropy")
+class CrossEntropyMetric(Metric):
+    def eval(self, label, score, weight=None, query_boundaries=None):
+        p = np.clip(score, 1e-15, 1 - 1e-15)
+        loss = -(label * np.log(p) + (1 - label) * np.log(1 - p))
+        return self._avg(loss, weight)
+
+
+@register("cross_entropy_lambda", "xentlambda")
+class CrossEntropyLambdaMetric(Metric):
+    def eval(self, label, score, weight=None, query_boundaries=None):
+        # score is log1p(exp(raw)) = hhat
+        hhat = np.maximum(score, 1e-15)
+        if weight is None:
+            z = 1.0 - np.exp(-hhat)
+        else:
+            z = 1.0 - np.exp(-weight * hhat)
+        z = np.clip(z, 1e-15, 1 - 1e-15)
+        loss = -(label * np.log(z) + (1 - label) * np.log(1 - z))
+        return float(np.mean(loss))
+
+
+@register("kldiv", "kullback_leibler")
+class KLDivMetric(Metric):
+    def eval(self, label, score, weight=None, query_boundaries=None):
+        p = np.clip(score, 1e-15, 1 - 1e-15)
+        y = np.clip(label, 0.0, 1.0)
+
+        def xlogx(x):
+            return np.where(x > 0, x * np.log(np.maximum(x, 1e-15)), 0.0)
+        kl = (xlogx(y) + xlogx(1 - y) -
+              (y * np.log(p) + (1 - y) * np.log(1 - p)))
+        return self._avg(kl, weight)
+
+
+class _RankMetric(Metric):
+    higher_better = True
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.eval_at = [int(k) for k in (config.eval_at or [1, 2, 3, 4, 5])]
+        from .objectives import default_label_gain
+        gains = config.label_gain
+        self.label_gain = (np.asarray(gains, np.float64) if gains
+                           else default_label_gain())
+
+
+@register("ndcg", "lambdarank")
+class NDCGMetric(_RankMetric):
+    """NDCG at the first ``eval_at`` position (all positions are reported
+    by the engine via ``eval_all``)."""
+
+    def eval(self, label, score, weight=None, query_boundaries=None):
+        return self.eval_all(label, score, weight, query_boundaries)[0][1]
+
+    def eval_all(self, label, score, weight=None, query_boundaries=None):
+        if query_boundaries is None:
+            raise ValueError("ndcg requires query boundaries")
+        out = []
+        for k in self.eval_at:
+            ndcgs = []
+            ws = []
+            for q in range(len(query_boundaries) - 1):
+                lo, hi = query_boundaries[q], query_boundaries[q + 1]
+                lab = label[lo:hi].astype(np.int64)
+                sc = score[lo:hi]
+                g = self.label_gain[lab]
+                if g.sum() <= 0:
+                    ndcgs.append(1.0)  # no relevant docs counts as 1
+                else:
+                    order = np.argsort(-sc, kind="stable")
+                    top = g[order[:k]]
+                    dcg = np.sum(top / np.log2(np.arange(len(top)) + 2.0))
+                    ideal = np.sort(g)[::-1][:k]
+                    idcg = np.sum(ideal /
+                                  np.log2(np.arange(len(ideal)) + 2.0))
+                    ndcgs.append(dcg / idcg)
+                ws.append(weight[lo] if weight is not None else 1.0)
+            ndcgs = np.asarray(ndcgs)
+            ws = np.asarray(ws)
+            out.append((f"ndcg@{k}", float(np.sum(ndcgs * ws) / np.sum(ws))))
+        return out
+
+
+@register("map", "mean_average_precision")
+class MAPMetric(_RankMetric):
+    def eval(self, label, score, weight=None, query_boundaries=None):
+        return self.eval_all(label, score, weight, query_boundaries)[0][1]
+
+    def eval_all(self, label, score, weight=None, query_boundaries=None):
+        if query_boundaries is None:
+            raise ValueError("map requires query boundaries")
+        out = []
+        for k in self.eval_at:
+            maps = []
+            ws = []
+            for q in range(len(query_boundaries) - 1):
+                lo, hi = query_boundaries[q], query_boundaries[q + 1]
+                rel = (label[lo:hi] > 0).astype(np.float64)
+                sc = score[lo:hi]
+                order = np.argsort(-sc, kind="stable")
+                r = rel[order[:k]]
+                hits = np.cumsum(r)
+                denom = np.arange(1, len(r) + 1)
+                n_rel = min(int(rel.sum()), k) or 1
+                ap = np.sum(r * hits / denom) / n_rel if rel.sum() > 0 else 0.0
+                maps.append(ap)
+                ws.append(weight[lo] if weight is not None else 1.0)
+            maps = np.asarray(maps)
+            ws = np.asarray(ws)
+            out.append((f"map@{k}", float(np.sum(maps * ws) / np.sum(ws))))
+        return out
+
+
 @register("auc")
 class AUCMetric(Metric):
     """ROC AUC by rank-sum over sorted scores with tie handling

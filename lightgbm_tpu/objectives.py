@@ -113,6 +113,222 @@ class RegressionL2(Objective):
         return raw
 
 
+def _weighted_percentile(values: np.ndarray, weights: Optional[np.ndarray],
+                         alpha: float) -> float:
+    """PercentileFun / WeightedPercentileFun (regression_objective.hpp)."""
+    if len(values) == 0:
+        return 0.0
+    order = np.argsort(values)
+    v = values[order]
+    if weights is None:
+        pos = alpha * (len(v) - 1)
+        lo = int(np.floor(pos))
+        hi = min(lo + 1, len(v) - 1)
+        return float(v[lo] + (pos - lo) * (v[hi] - v[lo]))
+    w = weights[order]
+    cum = np.cumsum(w)
+    threshold = alpha * cum[-1]
+    idx = int(np.searchsorted(cum, threshold, side="left"))
+    return float(v[min(idx, len(v) - 1)])
+
+
+class _RenewableRegression(Objective):
+    """Base for objectives whose leaf outputs are refit as per-leaf
+    percentiles of the residuals (``RenewTreeOutput``,
+    ``regression_objective.hpp``)."""
+    renew_alpha = 0.5
+
+    def renew_tree_output(self, tree, score, leaf_idx, mask) -> None:
+        score = np.asarray(score)[0] if np.ndim(score) > 1 else \
+            np.asarray(score)
+        leaf_idx = np.asarray(leaf_idx)
+        mask = np.asarray(mask)[:len(leaf_idx)]
+        label = np.asarray(self.label, np.float64)
+        weight = None if self.weight is None else np.asarray(self.weight)
+        residual = label - score[:len(label)]
+        in_bag = mask > 0
+        for leaf in range(tree.num_leaves):
+            rows = in_bag & (leaf_idx[:len(label)] == leaf)
+            if not np.any(rows):
+                continue
+            tree.leaf_value[leaf] = self._renew_value(
+                residual[rows], None if weight is None else weight[rows])
+
+    def _renew_value(self, residuals, weights):
+        return _weighted_percentile(residuals, weights, self.renew_alpha)
+
+
+@register("regression_l1", "l1", "mean_absolute_error", "mae")
+class RegressionL1(_RenewableRegression):
+    """L1 loss: constant gradients with per-leaf median refit."""
+    is_constant_hessian = True
+
+    def get_gradients(self, score):
+        return self._w(jnp.sign(score - self.label), jnp.ones_like(score))
+
+    def boost_from_score(self, class_id=0):
+        return _weighted_percentile(
+            np.asarray(self.label, np.float64),
+            None if self.weight is None else np.asarray(self.weight), 0.5)
+
+
+@register("quantile")
+class Quantile(_RenewableRegression):
+    """Pinball loss at ``alpha`` with per-leaf quantile refit."""
+    is_constant_hessian = True
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.alpha = float(config.alpha)
+        self.renew_alpha = self.alpha
+
+    def get_gradients(self, score):
+        grad = jnp.where(self.label > score, -self.alpha, 1.0 - self.alpha)
+        return self._w(grad, jnp.ones_like(score))
+
+    def boost_from_score(self, class_id=0):
+        return _weighted_percentile(
+            np.asarray(self.label, np.float64),
+            None if self.weight is None else np.asarray(self.weight),
+            self.alpha)
+
+
+@register("huber")
+class Huber(Objective):
+    """Huber loss with transition at ``alpha``."""
+    is_constant_hessian = True
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.alpha = float(config.alpha)
+
+    def get_gradients(self, score):
+        d = score - self.label
+        grad = jnp.clip(d, -self.alpha, self.alpha)
+        return self._w(grad, jnp.ones_like(score))
+
+    def boost_from_score(self, class_id=0):
+        lab = np.asarray(self.label, np.float64)
+        if self.weight is not None:
+            w = np.asarray(self.weight, np.float64)
+            return float(np.sum(lab * w) / np.sum(w))
+        return float(np.mean(lab))
+
+
+@register("fair")
+class Fair(Objective):
+    """Fair loss: c*d/(|d|+c) gradient (regression_objective.hpp)."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.c = float(config.fair_c)
+
+    def get_gradients(self, score):
+        d = score - self.label
+        denom = jnp.abs(d) + self.c
+        grad = self.c * d / denom
+        hess = self.c * self.c / (denom * denom)
+        return self._w(grad, hess)
+
+
+@register("poisson")
+class Poisson(Objective):
+    """Poisson regression with log link."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.max_delta = float(config.poisson_max_delta_step)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if np.any(np.asarray(metadata.label) < 0):
+            Log.fatal("poisson objective requires non-negative labels")
+
+    def get_gradients(self, score):
+        grad = jnp.exp(score) - self.label
+        hess = jnp.exp(score + self.max_delta)
+        return self._w(grad, hess)
+
+    def boost_from_score(self, class_id=0):
+        return float(np.log(max(self._weighted_mean_label(), 1e-12)))
+
+    def convert_output(self, raw):
+        return np.exp(raw)
+
+
+@register("mape")
+class MAPE(_RenewableRegression):
+    """Mean absolute percentage error: L1 with 1/|label| row weights and
+    weighted-median leaf refit."""
+    is_constant_hessian = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        lab = np.asarray(metadata.label, np.float64)
+        w = 1.0 / np.maximum(1.0, np.abs(lab))
+        if metadata.weight is not None:
+            w = w * np.asarray(metadata.weight, np.float64)
+        w = w / np.sum(w) * num_data
+        self._label_weight = jnp.asarray(w, jnp.float32)
+        self.weight = None  # folded into _label_weight
+
+    def get_gradients(self, score):
+        grad = jnp.sign(score - self.label) * self._label_weight
+        return grad, self._label_weight
+
+    def _renew_value(self, residuals, weights):
+        return _weighted_percentile(residuals, weights, 0.5)
+
+    def renew_tree_output(self, tree, score, leaf_idx, mask):
+        self.weight = self._label_weight  # residual weighting for refit
+        super().renew_tree_output(tree, score, leaf_idx, mask)
+        self.weight = None
+
+    def boost_from_score(self, class_id=0):
+        return _weighted_percentile(np.asarray(self.label, np.float64),
+                                    np.asarray(self._label_weight), 0.5)
+
+
+@register("gamma")
+class Gamma(Objective):
+    """Gamma regression with log link."""
+
+    def get_gradients(self, score):
+        e = jnp.exp(-score)
+        grad = 1.0 - self.label * e
+        hess = self.label * e
+        return self._w(grad, hess)
+
+    def boost_from_score(self, class_id=0):
+        return float(np.log(max(self._weighted_mean_label(), 1e-12)))
+
+    def convert_output(self, raw):
+        return np.exp(raw)
+
+
+@register("tweedie")
+class Tweedie(Objective):
+    """Tweedie deviance with variance power rho in [1, 2)."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.rho = float(config.tweedie_variance_power)
+
+    def get_gradients(self, score):
+        a = jnp.exp((1.0 - self.rho) * score)
+        b = jnp.exp((2.0 - self.rho) * score)
+        grad = -self.label * a + b
+        hess = (-self.label * (1.0 - self.rho) * a +
+                (2.0 - self.rho) * b)
+        return self._w(grad, hess)
+
+    def boost_from_score(self, class_id=0):
+        return float(np.log(max(self._weighted_mean_label(), 1e-12)))
+
+    def convert_output(self, raw):
+        return np.exp(raw)
+
+
 @register("binary")
 class Binary(Objective):
     """Log loss (``binary_objective.hpp``): labels {0,1} mapped to ±1,
@@ -170,3 +386,262 @@ class Binary(Objective):
 
     def convert_output(self, raw):
         return 1.0 / (1.0 + np.exp(-self.sigmoid * raw))
+
+
+@register("multiclass", "softmax")
+class MulticlassSoftmax(Objective):
+    """Softmax multiclass (``multiclass_objective.hpp``): one tree per
+    class per iteration; grad = p - 1{y=k}, hess = 2 p (1-p)."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.num_class = int(config.num_class)
+        if self.num_class < 2:
+            Log.fatal("multiclass objective requires num_class >= 2")
+        self.num_model_per_iteration = self.num_class
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        lab = np.asarray(metadata.label).astype(np.int32)
+        if lab.min() < 0 or lab.max() >= self.num_class:
+            Log.fatal("multiclass label out of range [0, %d)",
+                      self.num_class)
+        self._onehot = jnp.asarray(
+            np.eye(self.num_class, dtype=np.float32)[lab].T)  # (K, N)
+        counts = np.bincount(lab, minlength=self.num_class).astype(np.float64)
+        self._class_init = np.log(np.maximum(counts / counts.sum(), 1e-10))
+
+    def get_gradients(self, score):
+        # score (K, N)
+        p = jax.nn.softmax(score, axis=0)
+        grad = p - self._onehot
+        hess = 2.0 * p * (1.0 - p)
+        if self.weight is not None:
+            grad = grad * self.weight[None, :]
+            hess = hess * self.weight[None, :]
+        return grad, hess
+
+    def boost_from_score(self, class_id=0):
+        return float(self._class_init[class_id])
+
+    def convert_output(self, raw):
+        # raw (rows, K)
+        e = np.exp(raw - raw.max(axis=-1, keepdims=True))
+        return e / e.sum(axis=-1, keepdims=True)
+
+
+@register("multiclassova", "multiclass_ova", "ova", "ovr")
+class MulticlassOVA(Objective):
+    """One-vs-all multiclass: K independent binary objectives."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.num_class = int(config.num_class)
+        if self.num_class < 2:
+            Log.fatal("multiclassova requires num_class >= 2")
+        self.num_model_per_iteration = self.num_class
+        self.sigmoid = float(config.sigmoid)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        lab = np.asarray(metadata.label).astype(np.int32)
+        self._sign = jnp.asarray(np.where(
+            np.eye(self.num_class, dtype=bool)[lab].T, 1.0, -1.0
+        ).astype(np.float32))  # (K, N)
+        counts = np.bincount(lab, minlength=self.num_class).astype(np.float64)
+        p = np.clip(counts / counts.sum(), 1e-12, 1 - 1e-12)
+        self._class_init = np.log(p / (1 - p)) / self.sigmoid
+
+    def get_gradients(self, score):
+        t = self._sign * self.sigmoid
+        response = -t / (1.0 + jnp.exp(t * score))
+        absr = jnp.abs(response)
+        grad = response
+        hess = absr * (self.sigmoid - absr)
+        if self.weight is not None:
+            grad = grad * self.weight[None, :]
+            hess = hess * self.weight[None, :]
+        return grad, hess
+
+    def boost_from_score(self, class_id=0):
+        return float(self._class_init[class_id])
+
+    def convert_output(self, raw):
+        return 1.0 / (1.0 + np.exp(-self.sigmoid * raw))
+
+
+@register("cross_entropy", "xentropy")
+class CrossEntropy(Objective):
+    """Cross-entropy for probabilistic labels in [0, 1]
+    (``xentropy_objective.hpp:71``)."""
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        lab = np.asarray(metadata.label)
+        if lab.min() < 0 or lab.max() > 1:
+            Log.fatal("cross_entropy labels must be in [0, 1]")
+
+    def get_gradients(self, score):
+        z = jax.nn.sigmoid(score)
+        return self._w(z - self.label, z * (1.0 - z))
+
+    def boost_from_score(self, class_id=0):
+        p = np.clip(self._weighted_mean_label(), 1e-12, 1 - 1e-12)
+        return float(np.log(p / (1 - p)))
+
+    def convert_output(self, raw):
+        return 1.0 / (1.0 + np.exp(-raw))
+
+
+@register("cross_entropy_lambda", "xentlambda")
+class CrossEntropyLambda(Objective):
+    """Alternative-parameterization cross-entropy
+    (``xentropy_objective.hpp:181``)."""
+
+    def get_gradients(self, score):
+        if self.weight is None:
+            z = jax.nn.sigmoid(score)
+            return z - self.label, z * (1.0 - z)
+        w = self.weight
+        y = self.label
+        epf = jnp.exp(score)
+        hhat = jnp.log1p(epf)
+        z = 1.0 - jnp.exp(-w * hhat)
+        enf = 1.0 / epf
+        grad = (1.0 - y / z) * w / (1.0 + enf)
+        c = 1.0 / (1.0 - z)
+        d = 1.0 + epf
+        a = w * epf / (d * d)
+        d = c - 1.0
+        b = (c / (d * d)) * (1.0 + w * epf - c)
+        hess = a * (1.0 + y * b)
+        return grad, hess
+
+    def boost_from_score(self, class_id=0):
+        p = np.clip(self._weighted_mean_label(), 1e-12, 1 - 1e-12)
+        return float(np.log(np.expm1(-np.log1p(-p))))  # log(exp(hhat)-1)
+
+    def convert_output(self, raw):
+        return np.log1p(np.exp(raw))
+
+
+def default_label_gain(n: int = 31) -> np.ndarray:
+    """label_gain = 2^i - 1 (``dcg_calculator.cpp:30``)."""
+    return np.concatenate([[0.0], (2.0 ** np.arange(1, n).astype(np.float64)
+                                   - 1.0)])
+
+
+@register("lambdarank", "rank")
+class LambdaRank(Objective):
+    """LambdaRank with NDCG gains (``rank_objective.hpp:19``).
+
+    TPU-first: the reference's per-query pairwise loops become padded
+    (num_queries, max_docs) tensors — per-query sort, positional
+    discounts and an all-pairs (q, i, j) lambda tensor, chunked over
+    queries to bound memory.  Sigmoid uses the same
+    2/(1+exp(2*sigma*d)) shape the reference tabulates
+    (``rank_objective.hpp:194``).
+    """
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sigmoid = float(config.sigmoid)
+        self.norm = bool(config.lambdamart_norm)
+        self.max_position = int(config.max_position)
+        gains = config.label_gain
+        self.label_gain = (np.asarray(gains, np.float64) if gains
+                           else default_label_gain())
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            Log.fatal("lambdarank requires query information (set group)")
+        qb = np.asarray(metadata.query_boundaries)
+        self.num_queries = len(qb) - 1
+        cnts = np.diff(qb)
+        self.max_docs = int(cnts.max())
+        lab = np.asarray(metadata.label).astype(np.int64)
+        if lab.max() >= len(self.label_gain):
+            Log.fatal("label %d exceeds label_gain table size %d",
+                      int(lab.max()), len(self.label_gain))
+        # padded (nq, mq) row-index matrix; N = padding sentinel
+        nq, mq = self.num_queries, self.max_docs
+        idx = np.full((nq, mq), num_data, dtype=np.int64)
+        for q in range(nq):
+            idx[q, :cnts[q]] = np.arange(qb[q], qb[q + 1])
+        self._doc_idx = jnp.asarray(idx)
+        self._doc_valid = jnp.asarray(idx < num_data)
+        # inverse max DCG per query (truncated at max_position)
+        gains_per_row = self.label_gain[lab]
+        inv_max = np.zeros(nq)
+        for q in range(nq):
+            g = np.sort(gains_per_row[qb[q]:qb[q + 1]])[::-1]
+            g = g[:self.max_position]
+            dcg = np.sum(g / np.log2(np.arange(len(g)) + 2.0))
+            inv_max[q] = 1.0 / dcg if dcg > 0 else 0.0
+        self._inv_max_dcg = jnp.asarray(inv_max, jnp.float32)
+        self._gains_pad = jnp.asarray(
+            np.concatenate([gains_per_row, [0.0]]), jnp.float32)
+        self._label_pad = jnp.asarray(
+            np.concatenate([lab, [-1]]), jnp.int32)
+
+    def get_gradients(self, score):
+        score = score.reshape(-1)
+        n = score.shape[0]
+        sc_pad = jnp.concatenate([score, jnp.array([-jnp.inf],
+                                                   score.dtype)])
+
+        def query_chunk(args):
+            doc_idx, valid, inv_max = args
+            s = sc_pad[doc_idx]                      # (cq, mq)
+            lbl = self._label_pad[doc_idx]
+            gain = self._gains_pad[doc_idx]
+            order = jnp.argsort(-jnp.where(valid, s, -jnp.inf), axis=1,
+                                stable=True)
+            rank = jnp.argsort(order, axis=1)        # row -> position
+            disc = 1.0 / jnp.log2(2.0 + rank.astype(jnp.float32))
+            # pairwise (cq, mq, mq): i = high candidate, j = low
+            li = lbl[:, :, None]
+            lj = lbl[:, None, :]
+            pair_ok = (li > lj) & valid[:, :, None] & valid[:, None, :]
+            ds = s[:, :, None] - s[:, None, :]
+            dg = gain[:, :, None] - gain[:, None, :]
+            dd = jnp.abs(disc[:, :, None] - disc[:, None, :])
+            delta = dg * dd * inv_max[:, None, None]
+            if self.norm:
+                smax = jnp.max(jnp.where(valid, s, -jnp.inf), axis=1)
+                smin = jnp.min(jnp.where(valid, s, jnp.inf), axis=1)
+                nz = (smax != smin)[:, None, None]
+                delta = jnp.where(nz, delta / (0.01 + jnp.abs(ds)), delta)
+            p = 2.0 / (1.0 + jnp.exp(jnp.clip(
+                2.0 * self.sigmoid * ds, -60.0, 60.0)))
+            lam = jnp.where(pair_ok, -delta * p, 0.0)
+            hes = jnp.where(pair_ok, 2.0 * delta * p * (2.0 - p), 0.0)
+            g_doc = jnp.sum(lam, axis=2) - jnp.sum(lam, axis=1)
+            h_doc = jnp.sum(hes, axis=2) + jnp.sum(hes, axis=1)
+            return doc_idx, g_doc, h_doc
+
+        nq, mq = self._doc_idx.shape
+        # chunk queries so the (cq, mq, mq) tensors stay bounded
+        cq = max(1, min(nq, int(2e7 // max(mq * mq, 1))))
+        nchunks = (nq + cq - 1) // cq
+        pad_q = nchunks * cq - nq
+        di = jnp.concatenate([self._doc_idx,
+                              jnp.full((pad_q, mq), n, jnp.int64)])
+        dv = jnp.concatenate([self._doc_valid,
+                              jnp.zeros((pad_q, mq), bool)])
+        im = jnp.concatenate([self._inv_max_dcg, jnp.zeros(pad_q,
+                                                           jnp.float32)])
+        grad = jnp.zeros(n + 1, jnp.float32)
+        hess = jnp.zeros(n + 1, jnp.float32)
+        idxs, gs, hs = jax.lax.map(
+            query_chunk, (di.reshape(nchunks, cq, mq),
+                          dv.reshape(nchunks, cq, mq),
+                          im.reshape(nchunks, cq)))
+        grad = grad.at[idxs.reshape(-1)].add(gs.reshape(-1))
+        hess = hess.at[idxs.reshape(-1)].add(hs.reshape(-1))
+        grad, hess = grad[:n], hess[:n]
+        if self.weight is not None:
+            grad = grad * self.weight
+            hess = hess * self.weight
+        return grad, hess
