@@ -24,6 +24,7 @@ from .io.dataset import Metadata, TpuDataset
 from .io.parser import load_float_file, load_query_file, parse_file_full
 from .metrics import Metric, create_metrics, default_metric_for
 from .models.gbdt import GBDT
+from .models.boosting import create_boosting
 from .models import model_io
 from .models.tree import Tree
 from .objectives import create_objective
@@ -239,7 +240,8 @@ class Booster:
     def __init__(self, params: Optional[Dict[str, Any]] = None,
                  train_set: Optional[Dataset] = None,
                  model_file: Optional[str] = None,
-                 model_str: Optional[str] = None, silent: bool = False):
+                 model_str: Optional[str] = None, silent: bool = False,
+                 mesh=None):
         params = dict(params) if params else {}
         self.best_iteration = -1
         self.best_score: Dict[str, Dict[str, float]] = {}
@@ -261,8 +263,8 @@ class Booster:
                                              self.config)
             self._metric_names = self._resolve_metric_names(self.config)
             metrics = create_metrics(self._metric_names, self.config)
-            self._gbdt = GBDT(self.config, train_set._constructed, objective,
-                              metrics)
+            self._gbdt = create_boosting(self.config, train_set._constructed,
+                                         objective, metrics, mesh=mesh)
             self._valid_names: List[str] = []
         elif model_file is not None or model_str is not None:
             if model_file is not None:
@@ -309,6 +311,7 @@ class Booster:
         g.metrics = []
         g.valid_sets = []
         g.iter = len(info["models"]) // max(info["num_tree_per_iteration"], 1)
+        g.average_output = bool(info.get("average_output"))
         g.objective = (create_objective(self.config.objective, self.config)
                        if obj_str and obj_str[0] else None)
         self._feature_names = info["feature_names"]
@@ -419,7 +422,7 @@ class Booster:
             label_index=0, max_feature_idx=max_fi,
             objective_str=self._objective_string(),
             feature_names=names, feature_infos=infos, num_iteration=ni,
-            parameters="")
+            parameters="", average_output=g.average_output)
 
     def save_model(self, filename: str,
                    num_iteration: Optional[int] = None) -> "Booster":

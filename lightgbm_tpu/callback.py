@@ -1,17 +1,36 @@
-"""Training callbacks (reference ``python-package/lightgbm/callback.py``):
-``print_evaluation``, ``record_evaluation``, ``reset_parameter``,
-``early_stopping`` over the same CallbackEnv protocol."""
+"""Training callbacks.
+
+Capability parity with ``python-package/lightgbm/callback.py`` —
+periodic metric printing, metric recording, per-iteration parameter
+schedules, and validation-based early stopping — implemented as small
+callback classes over a shared :class:`CallbackEnv` snapshot.  The env
+tuple and the ``order`` / ``before_iteration`` attributes are the
+protocol the training loop (``engine.train``) sorts and dispatches on.
+"""
 from __future__ import annotations
 
-import collections
-from typing import Callable, Dict, List
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .utils.log import Log
 
-CallbackEnv = collections.namedtuple(
-    "CallbackEnv",
-    ["model", "params", "iteration", "begin_iteration", "end_iteration",
-     "evaluation_result_list"])
+
+@dataclasses.dataclass(frozen=True)
+class CallbackEnv:
+    """Per-iteration snapshot handed to every callback."""
+    model: Any
+    params: Dict[str, Any]
+    iteration: int
+    begin_iteration: int
+    end_iteration: int
+    evaluation_result_list: Optional[List[Tuple]]
+
+    # tuple-style access kept for callbacks written against the
+    # namedtuple form of the protocol (plain references, no copying)
+    def __getitem__(self, i):
+        return (self.model, self.params, self.iteration,
+                self.begin_iteration, self.end_iteration,
+                self.evaluation_result_list)[i]
 
 
 class EarlyStopException(Exception):
@@ -21,125 +40,164 @@ class EarlyStopException(Exception):
         self.best_score = best_score
 
 
-def _format_eval_result(value, show_stdv: bool = True) -> str:
-    if len(value) == 4:
-        return f"{value[0]}'s {value[1]}: {value[2]:g}"
-    if len(value) == 5:  # cv: (name, metric, mean, higher_better, stdv)
-        if show_stdv:
-            return f"{value[0]}'s {value[1]}: {value[2]:g} + {value[4]:g}"
-        return f"{value[0]}'s {value[1]}: {value[2]:g}"
-    raise ValueError(f"Wrong metric value {value}")
+def _format_eval_result(entry, show_stdv: bool = True) -> str:
+    """Render one eval tuple: (data, metric, value, higher_better[, stdv])."""
+    data, metric, value = entry[0], entry[1], entry[2]
+    if len(entry) == 5 and show_stdv:
+        return f"{data}'s {metric}: {value:g} + {entry[4]:g}"
+    if len(entry) in (4, 5):
+        return f"{data}'s {metric}: {value:g}"
+    raise ValueError(f"Wrong metric value {entry}")
+
+
+class _PrintEvaluation:
+    order = 10
+    before_iteration = False
+
+    def __init__(self, period: int, show_stdv: bool):
+        self.period = period
+        self.show_stdv = show_stdv
+
+    def __call__(self, env: CallbackEnv) -> None:
+        if self.period <= 0 or not env.evaluation_result_list:
+            return
+        if (env.iteration + 1) % self.period == 0:
+            Log.info("[%d]\t%s", env.iteration + 1,
+                     "\t".join(_format_eval_result(e, self.show_stdv)
+                               for e in env.evaluation_result_list))
 
 
 def print_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
-    def _callback(env: CallbackEnv) -> None:
-        if period > 0 and env.evaluation_result_list and \
-                (env.iteration + 1) % period == 0:
-            result = "\t".join(_format_eval_result(x, show_stdv)
-                               for x in env.evaluation_result_list)
-            Log.info("[%d]\t%s", env.iteration + 1, result)
-    _callback.order = 10
-    return _callback
+    return _PrintEvaluation(period, show_stdv)
+
+
+class _RecordEvaluation:
+    order = 20
+    before_iteration = False
+
+    def __init__(self, eval_result: Dict):
+        if not isinstance(eval_result, dict):
+            raise TypeError("eval_result must be a dict")
+        eval_result.clear()
+        self.store = eval_result
+
+    def __call__(self, env: CallbackEnv) -> None:
+        for entry in env.evaluation_result_list or []:
+            data, metric, value = entry[0], entry[1], entry[2]
+            self.store.setdefault(data, {}).setdefault(metric, []).append(
+                value)
 
 
 def record_evaluation(eval_result: Dict) -> Callable:
-    if not isinstance(eval_result, dict):
-        raise TypeError("eval_result must be a dict")
-    eval_result.clear()
+    return _RecordEvaluation(eval_result)
 
-    def _callback(env: CallbackEnv) -> None:
-        for item in env.evaluation_result_list:
-            name, metric, value = item[0], item[1], item[2]
-            eval_result.setdefault(name, collections.OrderedDict())
-            eval_result[name].setdefault(metric, [])
-            eval_result[name][metric].append(value)
-    _callback.order = 20
-    return _callback
+
+class _ResetParameter:
+    order = 10
+    before_iteration = True
+
+    def __init__(self, schedules: Dict[str, Any]):
+        self.schedules = schedules
+
+    def __call__(self, env: CallbackEnv) -> None:
+        updates = {}
+        for key, sched in self.schedules.items():
+            if callable(sched):
+                updates[key] = sched(env.iteration - env.begin_iteration)
+            else:
+                values = list(sched)
+                if len(values) != env.end_iteration - env.begin_iteration:
+                    raise ValueError(
+                        f"length of list {key!r} must equal num_boost_round")
+                updates[key] = values[env.iteration - env.begin_iteration]
+        if "learning_rate" in updates:
+            env.model._gbdt.shrinkage_rate = float(updates["learning_rate"])
+        env.params.update(updates)
 
 
 def reset_parameter(**kwargs) -> Callable:
-    """Per-iteration parameter schedules (list or callable per param);
-    currently supports ``learning_rate``."""
-    def _callback(env: CallbackEnv) -> None:
-        new_params = {}
-        for key, value in kwargs.items():
-            if isinstance(value, list):
-                if len(value) != env.end_iteration - env.begin_iteration:
-                    raise ValueError(f"length of list {key} has to be equal "
-                                     "to 'num_boost_round'")
-                new_params[key] = value[env.iteration - env.begin_iteration]
-            elif callable(value):
-                new_params[key] = value(env.iteration - env.begin_iteration)
-        if new_params:
-            if "learning_rate" in new_params:
-                env.model._gbdt.shrinkage_rate = \
-                    float(new_params["learning_rate"])
-            env.params.update(new_params)
-    _callback.before_iteration = True
-    _callback.order = 10
-    return _callback
+    """Per-iteration parameter schedules: each kwarg is a list (one value
+    per round) or a callable iteration -> value.  ``learning_rate`` is
+    applied to the booster's shrinkage."""
+    return _ResetParameter(kwargs)
 
 
-def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
-                   verbose: bool = True) -> Callable:
-    best_score: List[float] = []
-    best_iter: List[int] = []
-    best_score_list: List = []
-    cmp_op: List[Callable] = []
-    enabled = [True]
+@dataclasses.dataclass
+class _MetricState:
+    """Best-so-far tracker for one (dataset, metric) eval stream."""
+    higher_better: bool
+    best_value: float = None
+    best_round: int = 0
+    best_snapshot: Optional[List[Tuple]] = None
 
-    def _init(env: CallbackEnv) -> None:
-        enabled[0] = not any(
-            env.params.get(alias, "") == "dart"
-            for alias in ("boosting", "boosting_type", "boost"))
-        if not enabled[0]:
+    def improved(self, value: float) -> bool:
+        if self.best_value is None:
+            return True
+        return value > self.best_value if self.higher_better \
+            else value < self.best_value
+
+
+class _EarlyStopping:
+    order = 30
+    before_iteration = False
+
+    def __init__(self, patience: int, first_metric_only: bool, verbose: bool):
+        self.patience = patience
+        self.first_metric_only = first_metric_only
+        self.verbose = verbose
+        self.states: Optional[List[_MetricState]] = None
+        self.active = True
+
+    def _start(self, env: CallbackEnv) -> None:
+        # DART reweights past trees every iteration, so "best iteration"
+        # is not well-defined and early stopping is disabled
+        boosting = next((env.params[a] for a in
+                         ("boosting", "boosting_type", "boost")
+                         if a in env.params), "gbdt")
+        if boosting == "dart":
+            self.active = False
             Log.warning("Early stopping is not available in dart mode")
             return
         if not env.evaluation_result_list:
             raise ValueError("For early stopping, at least one dataset and "
                              "eval metric is required for evaluation")
-        if verbose:
+        if self.verbose:
             Log.info("Training until validation scores don't improve for "
-                     "%d rounds.", stopping_rounds)
-        for item in env.evaluation_result_list:
-            best_iter.append(0)
-            best_score_list.append(None)
-            if item[3]:  # higher better
-                best_score.append(float("-inf"))
-                cmp_op.append(lambda a, b: a > b)
-            else:
-                best_score.append(float("inf"))
-                cmp_op.append(lambda a, b: a < b)
+                     "%d rounds.", self.patience)
+        self.states = [_MetricState(higher_better=bool(entry[3]))
+                       for entry in env.evaluation_result_list]
 
-    def _callback(env: CallbackEnv) -> None:
-        if not best_score:
-            _init(env)
-        if not enabled[0]:
+    def _finish(self, state: _MetricState, reason: str) -> None:
+        if self.verbose:
+            Log.info("%s, best iteration is:\n[%d]\t%s", reason,
+                     state.best_round + 1,
+                     "\t".join(_format_eval_result(e)
+                               for e in state.best_snapshot))
+        raise EarlyStopException(state.best_round, state.best_snapshot)
+
+    def __call__(self, env: CallbackEnv) -> None:
+        if self.states is None and self.active:
+            self._start(env)
+        if not self.active:
             return
-        for i, item in enumerate(env.evaluation_result_list):
-            score = item[2]
-            if best_score_list[i] is None or cmp_op[i](score, best_score[i]):
-                best_score[i] = score
-                best_iter[i] = env.iteration
-                best_score_list[i] = env.evaluation_result_list
-            # train metric does not trigger early stopping
-            if item[0] == "training":
-                continue
-            if env.iteration - best_iter[i] >= stopping_rounds:
-                if verbose:
-                    Log.info("Early stopping, best iteration is:\n[%d]\t%s",
-                             best_iter[i] + 1,
-                             "\t".join(_format_eval_result(x)
-                                       for x in best_score_list[i]))
-                raise EarlyStopException(best_iter[i], best_score_list[i])
+        for state, entry in zip(self.states, env.evaluation_result_list):
+            if state.improved(entry[2]):
+                state.best_value = entry[2]
+                state.best_round = env.iteration
+                state.best_snapshot = env.evaluation_result_list
+            if entry[0] == "training":
+                continue  # train metric never stops training
+            if env.iteration - state.best_round >= self.patience:
+                self._finish(state, "Early stopping")
             if env.iteration == env.end_iteration - 1:
-                if verbose:
-                    Log.info("Did not meet early stopping. Best iteration "
-                             "is:\n[%d]\t%s", best_iter[i] + 1,
-                             "\t".join(_format_eval_result(x)
-                                       for x in best_score_list[i]))
-                raise EarlyStopException(best_iter[i], best_score_list[i])
-            if first_metric_only:
+                self._finish(state, "Did not meet early stopping. Best "
+                                    "iteration")
+            if self.first_metric_only:
                 break
-    _callback.order = 30
-    return _callback
+
+
+def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
+                   verbose: bool = True) -> Callable:
+    """Stop when no validation metric improves for ``stopping_rounds``
+    consecutive rounds (training metrics are tracked but never trigger)."""
+    return _EarlyStopping(stopping_rounds, first_metric_only, verbose)

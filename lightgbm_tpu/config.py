@@ -265,6 +265,9 @@ PARAMS: List[Param] = [
        group="objective"),
     _p("mvs_adaptive", True, bool, (),
        "adaptive threshold in MVS sampling", group="objective"),
+    _p("var_weight", 1e-6, float, (),
+       "regularizer inside the MVS sampling score "
+       "sqrt((sum|g*h|)^2 + var_weight)", group="objective"),
     # ---- metric ----
     _p("metric", "", object,
        ("metrics", "metric_types"),

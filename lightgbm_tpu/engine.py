@@ -29,7 +29,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
           evals_result: Optional[Dict] = None,
           verbose_eval: Union[bool, int] = True,
           learning_rates=None, keep_training_booster: bool = True,
-          callbacks: Optional[List[Callable]] = None) -> Booster:
+          callbacks: Optional[List[Callable]] = None, mesh=None) -> Booster:
     """Train a booster (``engine.py:19`` in the reference)."""
     params = dict(params)
     for alias in ("num_boost_round", "num_iterations", "num_iteration",
@@ -56,7 +56,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
         Log.fatal("objective=none requires a custom fobj")
     if fobj is not None:
         params["objective"] = "none"
-    booster = Booster(params=params, train_set=train_set)
+    booster = Booster(params=params, train_set=train_set, mesh=mesh)
 
     if init_model is not None:
         Log.warning("init_model continue-training is not wired yet; "

@@ -59,28 +59,34 @@ def _find_boundaries(distinct: np.ndarray, counts: np.ndarray,
         bounds.append(np.inf)
         return bounds
     # Greedy equal-frequency with "big value" handling (GreedyFindBin,
-    # src/io/bin.cpp): a distinct value whose count exceeds the expected
-    # bin size gets a bin of its own and does not skew its neighbors'
-    # bins; the remaining values share bins targeting the mean size of
-    # the rest.
-    expected = total_cnt / max_bin
-    is_big = counts >= expected
-    n_big = int(is_big.sum())
+    # src/io/bin.cpp:74): a distinct value whose count exceeds the mean
+    # bin size gets a bin of its own; a bin in progress is closed early
+    # (at half the mean size) when the next value is big, so the big
+    # value never absorbs its small-count neighbors; the mean target is
+    # renewed as small-value bins close.
+    if min_data_in_bin > 0:
+        max_bin = max(min(max_bin, total_cnt // min_data_in_bin), 1)
+    mean_size = total_cnt / max_bin
+    is_big = counts >= mean_size
+    rest_bins = max_bin - int(is_big.sum())
     rest_total = int(counts[~is_big].sum())
-    rest_bins_target = max(max_bin - n_big, 1)
-    mean_size = max(rest_total / rest_bins_target, float(min_data_in_bin))
+    mean_size = rest_total / max(rest_bins, 1)
 
     bounds = []
     cur = 0
     for i in range(n_distinct - 1):
         if not is_big[i]:
-            cur += int(counts[i])
-        if is_big[i] or is_big[i + 1] or cur >= mean_size:
-            if cur >= min_data_in_bin or is_big[i] or is_big[i + 1]:
-                bounds.append(_midpoint(distinct[i], distinct[i + 1]))
-                cur = 0
-        if len(bounds) >= max_bin - 1:
-            break
+            rest_total -= int(counts[i])
+        cur += int(counts[i])
+        if (is_big[i] or cur >= mean_size or
+                (is_big[i + 1] and cur >= max(1.0, mean_size * 0.5))):
+            bounds.append(_midpoint(distinct[i], distinct[i + 1]))
+            if len(bounds) >= max_bin - 1:
+                break
+            cur = 0
+            if not is_big[i]:
+                rest_bins -= 1
+                mean_size = rest_total / max(rest_bins, 1)
     bounds.append(np.inf)
     return bounds
 

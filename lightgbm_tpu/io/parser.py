@@ -3,9 +3,8 @@
 Capability parity with the reference's ``Parser`` (``src/io/parser.cpp``,
 ``include/LightGBM/dataset.h:252-277``): probes sample lines to pick the
 format, supports a header row, label column by index or ``name:`` prefix,
-ignore/weight/group columns.  A native C++ fast path lives in
-``cpp/ltpu_io.cpp`` (loaded via ctypes when built); this module is the
-always-available fallback and the single source of parsing semantics.
+ignore/weight/group columns.  This module is the single source of
+parsing semantics; a native fast path, when present, must match it.
 """
 from __future__ import annotations
 

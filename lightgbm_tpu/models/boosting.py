@@ -1,0 +1,339 @@
+"""Boosting-mode variants and the boosting factory.
+
+Capability parity with ``src/boosting/``: GOSS (``goss.hpp:26``), MVS —
+the fork's signature addition (``mvs.hpp:28``), DART (``dart.hpp:17``)
+and RF (``rf.hpp:18``), dispatched by ``config.boosting`` like
+``Boosting::CreateBoosting`` (``boosting.cpp:33-58``).
+
+TPU-first: sampling modes produce per-row WEIGHT vectors (0 = dropped,
+>1 = upweighted) consumed by the device growth loop's masked histogram
+pass, instead of the reference's index-buffer compaction — the binned
+matrix never moves, only the (N,) gradient/hessian/mask vectors change.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..config import Config
+from ..io.dataset import Metadata, TpuDataset
+from ..objectives import Objective
+from ..metrics import Metric
+from ..utils.log import Log
+from .gbdt import GBDT, _KEPS
+from .tree import Tree
+
+
+class GOSS(GBDT):
+    """Gradient-based one-side sampling (``goss.hpp:26``): keep the
+    ``top_rate`` rows by |g*h|, sample ``other_rate`` of the rest and
+    upweight their grad/hess by (n - top_k) / other_k
+    (``goss.hpp:99-128``)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        cfg = self.config
+        if cfg.top_rate + cfg.other_rate > 1.0:
+            Log.fatal("GOSS requires top_rate + other_rate <= 1")
+        if cfg.top_rate <= 0 or cfg.other_rate <= 0:
+            Log.fatal("GOSS requires top_rate > 0 and other_rate > 0")
+        if cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0:
+            Log.fatal("Cannot use bagging in GOSS")
+        Log.info("Using GOSS")
+
+    def _bagging_mask(self, grad=None, hess=None):
+        if grad is None:
+            return None
+        cfg = self.config
+        n = self.num_data
+        gh = np.sum(np.abs(np.asarray(grad) * np.asarray(hess)),
+                    axis=0)[:n]
+        top_k = max(int(n * cfg.top_rate), 1)
+        other_k = int(n * cfg.other_rate)
+        order = np.argsort(-gh, kind="stable")
+        w = np.zeros(n, np.float32)
+        w[order[:top_k]] = 1.0
+        rest = order[top_k:]
+        if other_k > 0 and len(rest):
+            rng = np.random.RandomState(
+                (cfg.bagging_seed + self.iter) & 0x7FFFFFFF)
+            take = min(other_k, len(rest))
+            pick = rng.choice(len(rest), size=take, replace=False)
+            w[rest[pick]] = (n - top_k) / float(other_k)
+        return w
+
+
+class MVS(GBDT):
+    """Minimal-variance sampling — the fork's addition (``mvs.hpp:28``):
+    per-row score sqrt((sum_k |g*h|)^2 + var_weight), adaptive threshold
+    mu solving  sum_i min(1, s_i/mu) = bagging_fraction * n
+    (``CalculateThreshold``, ``mvs.hpp:91``); rows below mu are kept
+    with probability s/mu and importance-weighted by mu/s."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        Log.info("Using MVS")
+
+    @staticmethod
+    def _threshold(scores: np.ndarray, target: float) -> float:
+        """Smallest mu with sum(min(1, s/mu)) <= target (expected
+        sample size).  Closed form over the descending order statistic
+        (equivalent to the reference's recursive partition)."""
+        s = np.sort(scores)[::-1].astype(np.float64)
+        n = len(s)
+        if target >= n:
+            return float(s[-1]) if n else 1.0
+        suffix = np.cumsum(s[::-1])[::-1]  # suffix[i] = sum(s[i:])
+        idx = np.arange(n, dtype=np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            est = idx + suffix / np.maximum(s, 1e-35)
+        # est is nondecreasing; first position whose estimate exceeds
+        # the target brackets the threshold
+        over = np.nonzero(est > target)[0]
+        if len(over) == 0:
+            return float(s[-1])
+        i = int(over[0])
+        denom = max(target - i, 1e-10)
+        return float(suffix[i] / denom)
+
+    def _bagging_mask(self, grad=None, hess=None):
+        if grad is None:
+            return None
+        cfg = self.config
+        if cfg.bagging_fraction >= 1.0:
+            return None
+        n = self.num_data
+        gh = np.sum(np.abs(np.asarray(grad) * np.asarray(hess)),
+                    axis=0)[:n]
+        s = np.sqrt(gh * gh + float(cfg.var_weight))
+        mu = self._threshold(s, cfg.bagging_fraction * n)
+        rng = np.random.RandomState(
+            (cfg.bagging_seed + self.iter) & 0x7FFFFFFF)
+        prob = np.minimum(s / max(mu, 1e-35), 1.0)
+        keep = rng.random_sample(n) < prob
+        w = np.where(keep, 1.0 / np.maximum(prob, 1e-35), 0.0)
+        return w.astype(np.float32)
+
+
+class DART(GBDT):
+    """Dropouts meet MART (``dart.hpp:17``): per iteration, drop a
+    random subset of past trees from the training score, fit the new
+    tree against the reduced score, then renormalize the new and
+    dropped trees by k/(k+1) (``DroppingTrees:91``, ``Normalize:59``;
+    xgboost mode uses k/(k+lr))."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._track_train_leaf = True
+        self._rng_drop = np.random.RandomState(
+            self.config.drop_seed & 0x7FFFFFFF)
+        self.tree_weight: List[float] = []
+        self.sum_weight = 0.0
+        self._drop_index: List[int] = []
+        Log.info("Using DART")
+
+    # -- per-tree train contribution from the stored leaf assignment --
+    def _train_contrib(self, model_idx: int):
+        import jax.numpy as jnp
+        tree = self.models[model_idx]
+        la = self._train_leaf_idx[model_idx]
+        if la is None:
+            return jnp.float32(tree.leaf_value[0])
+        vals = jnp.asarray(tree.leaf_value[:tree.num_leaves], jnp.float32)
+        return jnp.take(vals, jnp.asarray(la, jnp.int32))
+
+    def _select_drops(self) -> None:
+        cfg = self.config
+        self._drop_index = []
+        if self._rng_drop.random_sample() < cfg.skip_drop or self.iter == 0:
+            pass
+        elif cfg.uniform_drop:
+            rate = cfg.drop_rate
+            if cfg.max_drop > 0:
+                rate = min(rate, cfg.max_drop / float(self.iter))
+            for i in range(self.iter):
+                if self._rng_drop.random_sample() < rate:
+                    self._drop_index.append(i)
+                    if len(self._drop_index) >= cfg.max_drop > 0:
+                        break
+        else:
+            inv_avg = len(self.tree_weight) / max(self.sum_weight, _KEPS)
+            rate = cfg.drop_rate
+            if cfg.max_drop > 0:
+                rate = min(rate, cfg.max_drop * inv_avg /
+                           max(self.sum_weight, _KEPS))
+            for i in range(self.iter):
+                if self._rng_drop.random_sample() < \
+                        rate * self.tree_weight[i] * inv_avg:
+                    self._drop_index.append(i)
+                    if len(self._drop_index) >= cfg.max_drop > 0:
+                        break
+        k = float(len(self._drop_index))
+        lr = self.config.learning_rate
+        if not cfg.xgboost_dart_mode:
+            self.shrinkage_rate = lr / (1.0 + k)
+        else:
+            self.shrinkage_rate = lr if not self._drop_index else \
+                lr / (lr + k)
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        # snapshot BEFORE drops so rollback restores a consistent state
+        pre_score = self._score
+        pre_valid = [vs.score.copy() for vs in self.valid_sets]
+        pre_weights = (list(self.tree_weight), self.sum_weight)
+        self._select_drops()
+        K = self.num_tree_per_iteration
+        # remove dropped trees from the training score so gradients see
+        # the reduced ensemble
+        for i in self._drop_index:
+            for k in range(K):
+                self._score = self._score.at[k].add(
+                    -self._train_contrib(i * K + k))
+        stop = super().train_one_iter(grad, hess)
+        if stop:
+            # no tree was added: restore the dropped contributions so
+            # the score matches the (unchanged) model, and invalidate
+            # the undo snapshot (it describes an older iteration)
+            for i in self._drop_index:
+                for k in range(K):
+                    self._score = self._score.at[k].add(
+                        self._train_contrib(i * K + k))
+            self._drop_index = []
+            self._dart_undo = None
+            return stop
+        scale = self._normalize()
+        if not self.config.uniform_drop:
+            self.tree_weight.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
+        self._dart_undo = (pre_score, pre_valid, pre_weights,
+                           list(self._drop_index), scale)
+        return False
+
+    def rollback_one_iter(self) -> None:
+        """Undo the last DART iteration: restore pre-drop scores, unscale
+        the renormalized dropped trees, and pop the new tree."""
+        if self.iter <= 0 or getattr(self, "_dart_undo", None) is None:
+            return
+        pre_score, pre_valid, (tw, sw), dropped, scale = self._dart_undo
+        K = self.num_tree_per_iteration
+        for i in dropped:
+            for k in range(K):
+                self.models[i * K + k].apply_shrinkage(1.0 / scale)
+        self._score = pre_score
+        for vs, snap in zip(self.valid_sets, pre_valid):
+            vs.score = snap
+        self.tree_weight, self.sum_weight = tw, sw
+        for _ in range(K):
+            self.models.pop()
+            if self._train_leaf_idx:
+                self._train_leaf_idx.pop()
+        self.iter -= 1
+        self._dart_undo = None
+
+    def _normalize(self) -> float:
+        k = float(len(self._drop_index))
+        if k == 0:
+            return 1.0
+        cfg = self.config
+        lr = cfg.learning_rate
+        scale = k / (k + 1.0) if not cfg.xgboost_dart_mode else \
+            k / (k + lr)
+        K = self.num_tree_per_iteration
+        for i in self._drop_index:
+            for kk in range(K):
+                mi = i * K + kk
+                tree = self.models[mi]
+                tree.apply_shrinkage(scale)
+                # train score: net change is -(1-scale) x original
+                self._score = self._score.at[kk].add(
+                    self._train_contrib(mi))
+                # valid scores: subtract the same (1-scale) slice
+                if self.valid_sets:
+                    factor = (1.0 - scale) / scale
+                    for vs in self.valid_sets:
+                        vs.score[kk] -= tree.predict(vs.raw) * factor
+            if not cfg.uniform_drop:
+                unit = (k + 1.0) if not cfg.xgboost_dart_mode else (k + lr)
+                self.sum_weight -= self.tree_weight[i] / unit
+                self.tree_weight[i] *= scale
+        return scale
+
+
+class RF(GBDT):
+    """Random forest (``rf.hpp:18``): unit shrinkage, mandatory
+    bagging, gradients computed ONCE from the constant init score, and
+    the model score maintained as the AVERAGE of tree outputs."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        cfg = self.config
+        if not (cfg.bagging_freq > 0 and 0 < cfg.bagging_fraction < 1):
+            Log.fatal("random forest requires bagging "
+                      "(bagging_freq > 0, 0 < bagging_fraction < 1)")
+        self.average_output = True
+        self.shrinkage_rate = 1.0
+        if self.objective is None:
+            Log.fatal("rf does not support a custom objective")
+        Log.info("Using RF")
+        K = self.num_tree_per_iteration
+        self._init_scores = [0.0] * K
+        if self.config.boost_from_average and self.objective is not None:
+            for k in range(K):
+                self._init_scores[k] = self.objective.boost_from_score(k)
+        # fixed gradients from the constant init score (RF::Boosting)
+        import jax.numpy as jnp
+        base = jnp.asarray(
+            np.repeat(np.asarray(self._init_scores, np.float32)[:, None],
+                      self.num_data, axis=1))
+        g, h = self.objective.get_gradients(base)
+        self._rf_grad = jnp.atleast_2d(g)
+        self._rf_hess = jnp.atleast_2d(h)
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        import jax.numpy as jnp
+        if grad is not None:
+            Log.fatal("rf does not support a custom objective")
+        self._prev_score = self._score
+        self._prev_valid_scores = [vs.score.copy() for vs in self.valid_sets]
+        bag = self._bagging_mask()
+        K = self.num_tree_per_iteration
+        m = float(self.iter)
+        for k in range(K):
+            # average-maintaining update: score <- (score*m + tree)/(m+1)
+            self._score = self._score.at[k].multiply(m)
+            for vs in self.valid_sets:
+                vs.score[k] *= m
+            tree = self._train_one_tree(self._rf_grad[k], self._rf_hess[k],
+                                        bag, self._init_scores[k])
+            # the per-tree bias is inside the tree but excluded from the
+            # incremental score update; add it so the average is exact
+            if abs(self._init_scores[k]) > _KEPS and tree.num_leaves > 1:
+                self._score = self._score.at[k].add(self._init_scores[k])
+                for vs in self.valid_sets:
+                    vs.score[k] += self._init_scores[k]
+            self._score = self._score.at[k].multiply(1.0 / (m + 1.0))
+            for vs in self.valid_sets:
+                vs.score[k] /= (m + 1.0)
+            self.models.append(tree)
+        self.iter += 1
+        return False
+
+
+_BOOSTING_TYPES = {
+    "gbdt": GBDT, "gbrt": GBDT,
+    "dart": DART,
+    "goss": GOSS,
+    "rf": RF, "random_forest": RF,
+    "mvs": MVS,
+}
+
+
+def create_boosting(config: Config, train_set: TpuDataset,
+                    objective: Optional[Objective],
+                    metrics: Sequence[Metric] = (), mesh=None) -> GBDT:
+    """``Boosting::CreateBoosting`` (``boosting.cpp:33-58``)."""
+    cls = _BOOSTING_TYPES.get(config.boosting)
+    if cls is None:
+        Log.fatal("unknown boosting type %s", config.boosting)
+    return cls(config, train_set, objective, metrics, mesh=mesh)

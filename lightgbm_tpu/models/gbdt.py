@@ -48,10 +48,10 @@ class GBDT:
 
     def __init__(self, config: Config, train_set: TpuDataset,
                  objective: Optional[Objective],
-                 metrics: Sequence[Metric] = ()):
+                 metrics: Sequence[Metric] = (), mesh=None):
         import jax
         import jax.numpy as jnp
-        from ..ops.grow import GrowParams, build_tree
+        from ..ops.grow import DistConfig, GrowParams, build_tree
         from ..ops.split import SplitParams
 
         self.config = config
@@ -70,6 +70,11 @@ class GBDT:
         self.valid_sets: List[ValidSet] = []
         self._prev_score = None
         self._prev_valid_scores: List[np.ndarray] = []
+        # RF averages tree outputs instead of summing (rf.hpp:22)
+        self.average_output = False
+        # DART needs per-tree train contributions to drop/restore them
+        self._track_train_leaf = False
+        self._train_leaf_idx: List[Optional[np.ndarray]] = []
 
         F = len(train_set.used_features)
         self.num_features = F
@@ -88,13 +93,6 @@ class GBDT:
                       jax.default_backend() not in ("cpu",))
         rpb = int(config.tpu_rows_per_block)
         n = train_set.num_data
-        self._n_pad = (n + rpb - 1) // rpb * rpb if use_pallas else n
-        xt = train_set.binned.T.astype(np.int32)  # (F, N)
-        if self._n_pad != n:
-            xt = np.pad(xt, ((0, 0), (0, self._n_pad - n)))
-        self._xt = jnp.asarray(xt)
-        self._base_mask = jnp.asarray(
-            np.pad(np.ones(n, np.float32), (0, self._n_pad - n)))
 
         self.grow_params = GrowParams(
             split=SplitParams(
@@ -113,8 +111,47 @@ class GBDT:
             num_leaves=config.num_leaves,
             max_depth=config.max_depth,
             hist_impl="pallas" if use_pallas else "segsum",
-            rows_per_block=rpb)
-        self._build_tree = build_tree
+            rows_per_block=rpb,
+            dist=DistConfig(top_k=config.top_k))
+
+        # parallel tree learner over the device mesh
+        # (tree_learner={data,feature,voting}, tree_learner.cpp:9-33)
+        self._dist = None
+        learner = config.tree_learner
+        if learner not in ("serial", ""):
+            from ..parallel import DistributedBuilder, resolve_num_shards
+            num_shards = resolve_num_shards(config, mesh)
+            if num_shards <= 1:
+                Log.warning("tree_learner=%s requested but only one device "
+                            "is available; using the serial learner",
+                            learner)
+            else:
+                self._dist = DistributedBuilder(
+                    learner, self.grow_params, num_shards, mesh)
+                Log.info("tree_learner=%s over a %d-way device mesh",
+                         learner, num_shards)
+
+        row_block = rpb if use_pallas else 1
+        if self._dist is not None:
+            self._n_pad = self._dist.pad_rows(n, row_block)
+            self._F_pad = self._dist.pad_features(F)
+        else:
+            self._n_pad = (n + row_block - 1) // row_block * row_block
+            self._F_pad = F
+        xt = train_set.binned.T.astype(np.int32)  # (F, N)
+        xt = np.pad(xt, ((0, self._F_pad - F), (0, self._n_pad - n)))
+        self._xt = jnp.asarray(xt)
+        self._base_mask = jnp.asarray(
+            np.pad(np.ones(n, np.float32), (0, self._n_pad - n)))
+        if self._F_pad != F:
+            # padded features are trivial: one bin, never splittable
+            self._num_bins = jnp.concatenate(
+                [self._num_bins, jnp.ones(self._F_pad - F, jnp.int32)])
+            self._missing_type = jnp.concatenate(
+                [self._missing_type, jnp.zeros(self._F_pad - F, jnp.int32)])
+            self._is_cat = jnp.concatenate(
+                [self._is_cat, jnp.zeros(self._F_pad - F, bool)])
+        self._build_tree = build_tree if self._dist is None else self._dist
 
         # scores: (num_tree_per_iteration, N) device
         k = self.num_tree_per_iteration
@@ -147,18 +184,20 @@ class GBDT:
         import jax.numpy as jnp
         F = self.num_features
         frac = self.config.feature_fraction
+        mask = np.zeros(self._F_pad, bool)
         if frac >= 1.0:
-            return jnp.ones(F, bool)
-        k = max(1, int(frac * F))
-        chosen = self._rng_feature.choice(F, size=k, replace=False)
-        mask = np.zeros(F, bool)
-        mask[chosen] = True
+            mask[:F] = True
+        else:
+            k = max(1, int(frac * F))
+            mask[self._rng_feature.choice(F, size=k, replace=False)] = True
         return jnp.asarray(mask)
 
-    def _bagging_mask(self):
-        """Row sample mask for this iteration (1 = in bag).  Base class:
-        bernoulli bagging every ``bagging_freq`` iterations
-        (``GBDT::Bagging``, ``gbdt.cpp:182``); GOSS/MVS override."""
+    def _bagging_mask(self, grad=None, hess=None):
+        """Per-row sample weights for this iteration (0 = out of bag;
+        non-0/1 weights rescale grad/hess, counts stay presence-based).
+        Base class: bernoulli bagging every ``bagging_freq`` iterations
+        (``GBDT::Bagging``, ``gbdt.cpp:182``); GOSS/MVS override using
+        the gradient magnitudes."""
         cfg = self.config
         if cfg.bagging_freq <= 0 or cfg.bagging_fraction >= 1.0:
             return None
@@ -202,7 +241,7 @@ class GBDT:
             grad = jnp.asarray(np.atleast_2d(np.asarray(grad, np.float32)))
             hess = jnp.asarray(np.atleast_2d(np.asarray(hess, np.float32)))
 
-        bag = self._bagging_mask()
+        bag = self._bagging_mask(grad, hess)
         should_stop = True
         for k in range(self.num_tree_per_iteration):
             tree = self._train_one_tree(grad[k], hess[k], bag, init_scores[k])
@@ -225,7 +264,13 @@ class GBDT:
         hp = jnp.pad(hess.astype(jnp.float32), (0, n_pad - n))
         mask = self._base_mask
         if bag is not None:
-            mask = mask * jnp.pad(jnp.asarray(bag), (0, n_pad - n))
+            # weights scale grad/hess (GOSS/MVS upweighting); the count
+            # channel stays presence-based like the reference's subsets
+            w = jnp.pad(jnp.asarray(bag, jnp.float32).reshape(-1),
+                        (0, n_pad - n))
+            gp = gp * w
+            hp = hp * w
+            mask = mask * (w > 0)
         fmask = self._feature_fraction_mask()
 
         if self.num_features == 0:
@@ -247,11 +292,18 @@ class GBDT:
                 self._score = self._score.at[tree_idx].add(out)
                 for vs in self.valid_sets:
                     vs.score[tree_idx] += out
+            if self._track_train_leaf:
+                self._train_leaf_idx.append(None)
             return tree
 
         recs = jax.device_get({k: v for k, v in rec.items()
                                if k not in ("leaf_idx",)})
         tree = self._records_to_tree(recs)
+        if self._track_train_leaf:
+            # compact dtype: leaf count is bounded by num_leaves
+            dt = np.uint8 if self.config.num_leaves <= 256 else np.uint16
+            self._train_leaf_idx.append(
+                np.asarray(rec["leaf_idx"][:n]).astype(dt))
         # leaf renewal hook (RenewTreeOutput) — objective-specific
         if self.objective is not None:
             self.objective.renew_tree_output(
@@ -328,28 +380,41 @@ class GBDT:
     def train_score(self) -> np.ndarray:
         return np.asarray(self._score)[:, :self.num_data]
 
+    def _eval_one_set(self, name: str, score_kn: np.ndarray,
+                      meta: Metadata) -> List[Tuple[str, str, float, bool]]:
+        """Run every metric on one dataset.  ``score_kn`` is the raw
+        (num_tree_per_iteration, rows) score block; multiclass metrics
+        receive the full (rows, K) matrix, single-output objectives the
+        1-D vector.  Rank metrics report one entry per eval_at position
+        (the reference's ndcg@1..ndcg@5 rows)."""
+        if self.num_tree_per_iteration > 1:
+            score = np.asarray(score_kn, np.float64).T  # (rows, K)
+        else:
+            score = np.asarray(score_kn[0], np.float64)
+        if self.objective is not None:
+            score = self.objective.convert_output(score)
+        label = np.asarray(meta.label, np.float64)
+        out = []
+        for m in self.metrics:
+            if hasattr(m, "eval_all"):
+                for mname, val in m.eval_all(label, score, meta.weight,
+                                             meta.query_boundaries):
+                    out.append((name, mname, val, m.higher_better))
+            else:
+                out.append((name, m.name,
+                            m.eval(label, score, meta.weight,
+                                   meta.query_boundaries), m.higher_better))
+        return out
+
     def eval_set(self) -> List[Tuple[str, str, float, bool]]:
         """Evaluate all metrics on train (optional) + valid sets.
         Returns (dataset_name, metric_name, value, higher_better)."""
         out = []
         if self.config.is_provide_training_metric and self.objective:
-            score = self.objective.convert_output(
-                self.train_score[0].astype(np.float64))
-            meta = self.train_set.metadata
-            for m in self.metrics:
-                out.append(("training", m.name,
-                            m.eval(np.asarray(meta.label, np.float64), score,
-                                   meta.weight, meta.query_boundaries), m.higher_better))
+            out.extend(self._eval_one_set("training", self.train_score,
+                                          self.train_set.metadata))
         for vs in self.valid_sets:
-            score = vs.score[0]
-            if self.objective is not None:
-                score = self.objective.convert_output(score)
-            for m in self.metrics:
-                out.append((vs.name, m.name,
-                            m.eval(np.asarray(vs.metadata.label, np.float64),
-                                   score, vs.metadata.weight,
-                                   vs.metadata.query_boundaries),
-                            m.higher_better))
+            out.extend(self._eval_one_set(vs.name, vs.score, vs.metadata))
         return out
 
     # ------------------------------------------------------------------
@@ -364,6 +429,8 @@ class GBDT:
         out = np.zeros((k, X.shape[0]), dtype=np.float64)
         for i in range(n_trees):
             out[i % k] += self.models[i].predict(X)
+        if self.average_output and n_trees:
+            out /= max(n_trees // k, 1)
         return out[0] if k == 1 else out.T
 
     def predict(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:

@@ -25,7 +25,8 @@ def save_model_to_string(models: List[Tree], *, num_class: int,
                          feature_names: List[str],
                          feature_infos: List[str],
                          num_iteration: int = -1,
-                         parameters: str = "") -> str:
+                         parameters: str = "",
+                         average_output: bool = False) -> str:
     k = num_tree_per_iteration
     n_trees = len(models)
     if num_iteration is not None and num_iteration > 0:
@@ -36,11 +37,13 @@ def save_model_to_string(models: List[Tree], *, num_class: int,
            f"num_tree_per_iteration={k}",
            f"label_index={label_index}",
            f"max_feature_idx={max_feature_idx}",
-           f"objective={objective_str}",
-           "feature_names=" + " ".join(feature_names),
-           "feature_infos=" + " ".join(feature_infos),
-           "tree_sizes=" + " ".join(str(len(s) + 1) for s in tree_strs),
-           ""]
+           f"objective={objective_str}"]
+    if average_output:
+        out.append("average_output")  # RF marker (gbdt_model_text.cpp:258)
+    out += ["feature_names=" + " ".join(feature_names),
+            "feature_infos=" + " ".join(feature_infos),
+            "tree_sizes=" + " ".join(str(len(s) + 1) for s in tree_strs),
+            ""]
     for s in tree_strs:
         out.append(s)
     out.append(_EOT + "\n")
@@ -83,6 +86,8 @@ def load_model_from_string(text: str) -> Dict:
         "objective": kv.get("objective", "regression"),
         "feature_names": kv.get("feature_names", "").split(),
         "feature_infos": kv.get("feature_infos", "").split(),
+        "average_output": any(line.strip() == "average_output"
+                              for line in header.splitlines()),
     }
 
 

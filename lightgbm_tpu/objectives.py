@@ -361,9 +361,17 @@ class Binary(Objective):
                 w_pos = cnt_neg / cnt_pos
         w_pos *= float(self.config.scale_pos_weight)
         self.label_weights = (w_neg, w_pos)
-        self._p_mean = (cnt_pos * self.label_weights[1]) / max(
-            cnt_pos * self.label_weights[1] +
-            cnt_neg * self.label_weights[0], 1e-12)
+        # initial probability from per-row weights x class weights
+        # (BinaryLogloss::BoostFromScore accumulates weighted sums)
+        if metadata.weight is not None:
+            sw = np.asarray(metadata.weight, np.float64)
+            sum_pos = float(np.sum(sw * (lab == 1)))
+            sum_neg = float(np.sum(sw * (lab == 0)))
+        else:
+            sum_pos, sum_neg = cnt_pos, cnt_neg
+        self._p_mean = (sum_pos * self.label_weights[1]) / max(
+            sum_pos * self.label_weights[1] +
+            sum_neg * self.label_weights[0], 1e-12)
         self.sign_label = jnp.asarray(np.where(lab == 1, 1.0, -1.0),
                                       jnp.float32)
         self.cls_weight = jnp.asarray(

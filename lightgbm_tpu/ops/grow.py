@@ -14,6 +14,24 @@ iterations are masked no-ops), and per-leaf histograms live in a
 ``(num_leaves, F, B, 3)`` pool (the ``HistogramPool`` analog) enabling
 subtraction.  The output is a flat record-of-splits that the host turns
 into a :class:`~lightgbm_tpu.models.tree.Tree`.
+
+Distributed growth (``DistConfig``) runs the same loop SPMD under
+``jax.shard_map`` over a named mesh axis, with the reference's three
+parallel learners re-expressed as XLA collectives:
+
+- ``data``: rows sharded; per-leaf histograms ``psum_scatter``-ed over
+  the feature axis so each shard owns full histograms for its feature
+  block, finds its block-local best split, and the winner is merged by
+  an all-gather arg-max — mirroring ``DataParallelTreeLearner``
+  (``data_parallel_tree_learner.cpp:147-239``, reducer ``bin.h:40-56``).
+- ``feature``: features sharded, rows replicated; no histogram traffic
+  at all, only the tiny best-split merge plus a one-bit row-routing
+  broadcast from the winning feature's owner — mirroring
+  ``FeatureParallelTreeLearner`` (``feature_parallel_tree_learner.cpp``).
+- ``voting``: rows sharded; each shard votes its local top-k features,
+  the global top-2k by votes are elected, and ONLY those features'
+  histograms are ``psum``-ed — mirroring the PV-Tree
+  ``VotingParallelTreeLearner`` (``voting_parallel_tree_learner.cpp``).
 """
 from __future__ import annotations
 
@@ -27,7 +45,22 @@ import jax.numpy as jnp
 from .histogram import histogram_pallas, histogram_segsum
 from .split import NEG_INF, SplitParams, find_best_split, leaf_output
 
-__all__ = ["GrowParams", "build_tree"]
+__all__ = ["DistConfig", "GrowParams", "build_tree"]
+
+
+@dataclasses.dataclass(frozen=True)
+class DistConfig:
+    """Static distribution strategy for the growth loop.
+
+    ``kind``: serial | data | feature | voting (``tree_learner`` values,
+    ``tree_learner.cpp:9-33``).  ``num_shards`` is the mesh-axis size;
+    ``axis`` the mesh axis name the collectives run over.  ``top_k`` is
+    the per-shard ballot size for voting-parallel (``config.h:349``).
+    """
+    kind: str = "serial"
+    axis: str = "shard"
+    num_shards: int = 1
+    top_k: int = 20
 
 
 @dataclasses.dataclass(frozen=True)
@@ -37,12 +70,28 @@ class GrowParams:
     max_depth: int = -1
     hist_impl: str = "segsum"  # segsum | pallas
     rows_per_block: int = 1024
+    dist: DistConfig = DistConfig()
 
 
 def _hist(xt, vals, p: GrowParams):
     if p.hist_impl == "pallas":
         return histogram_pallas(xt, vals, p.split.max_bin, p.rows_per_block)
     return histogram_segsum(xt, vals, p.split.max_bin)
+
+
+_MERGE_KEYS = ("gain", "feature", "threshold", "default_left", "is_cat",
+               "left_mask", "left_stats")
+
+
+def _merge_best(best, axis):
+    """All-gather per-shard winners and keep the arg-max — the
+    ``SyncUpGlobalBestSplit`` allreduce (``parallel_tree_learner.h:183``).
+    Ties resolve to the lowest shard, matching the serial scan's
+    feature-major arg-max order."""
+    small = {k: best[k] for k in _MERGE_KEYS}
+    stacked = jax.lax.all_gather(small, axis)  # each leaf: (D, ...)
+    i = jnp.argmax(stacked["gain"])
+    return jax.tree.map(lambda a: a[i], stacked)
 
 
 @functools.partial(jax.jit, static_argnames=("params",))
@@ -58,6 +107,10 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
     padding); feature_mask: (F,) bool (feature_fraction);
     num_bins/missing_type: (F,) i32; is_cat: (F,) bool.
 
+    Under a distributed strategy all array arguments are the LOCAL
+    shards (rows sharded for data/voting, features for feature) and the
+    function must run inside ``shard_map`` over ``params.dist.axis``.
+
     Returns a dict of per-split records (length num_leaves-1), final
     leaf assignment, per-leaf values and the realized leaf count.
     """
@@ -66,30 +119,117 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
     F, N = xt.shape
     B = p.split.max_bin
     sp = p.split
+    dist = p.dist
+    kind = dist.kind
+    ax = dist.axis
+    D = dist.num_shards
+
+    if kind == "data":
+        # each shard owns histograms for one contiguous feature block
+        # after the reduce-scatter (data_parallel_tree_learner.cpp:147)
+        assert F % D == 0, (F, D)
+        F_hist = F // D
+        f_offset = jax.lax.axis_index(ax) * F_hist
+        blk = lambda a: jax.lax.dynamic_slice_in_dim(a, f_offset, F_hist)
+        nb_l, mt_l = blk(num_bins), blk(missing_type)
+        cat_l, fmask_l = blk(is_cat), blk(feature_mask)
+    elif kind == "feature":
+        # features are sharded in memory; descriptor arrays arrive local
+        F_hist = F
+        f_offset = jax.lax.axis_index(ax) * F
+        nb_l, mt_l, cat_l, fmask_l = (num_bins, missing_type, is_cat,
+                                      feature_mask)
+    else:
+        F_hist = F
+        f_offset = jnp.int32(0)
+        nb_l, mt_l, cat_l, fmask_l = (num_bins, missing_type, is_cat,
+                                      feature_mask)
+
+    if kind == "voting":
+        # local ballots use constraints scaled by 1/num_machines
+        # (voting_parallel_tree_learner.cpp:53-55)
+        vote_sp = dataclasses.replace(
+            sp, min_data_in_leaf=max(sp.min_data_in_leaf // D, 1),
+            min_sum_hessian_in_leaf=sp.min_sum_hessian_in_leaf / D)
+        n_vote = min(dist.top_k, F)
+        n_elect = min(2 * dist.top_k, F)
 
     def masked_hist(leaf_idx, leaf_id):
+        """Histogram of one leaf — local pass + strategy collective."""
         m = sample_mask * (leaf_idx == leaf_id)
         vals = jnp.stack([grad * m, hess * m, m], axis=-1)
-        return _hist(xt, vals, p)
+        h = _hist(xt, vals, p)
+        if kind == "data":
+            # HistogramBinEntry::SumReducer over the wire becomes one
+            # XLA reduce-scatter over the feature dimension
+            h = jax.lax.psum_scatter(h, ax, scatter_dimension=0, tiled=True)
+        return h  # (F_hist, B, 3); local (not yet summed) for voting
+
+    def global_stats(local):
+        if kind in ("data", "voting"):
+            return jax.lax.psum(local, ax)
+        return local
 
     def best_of(hist_leaf, stats, depth):
-        b = find_best_split(hist_leaf, stats, num_bins, missing_type,
-                            is_cat, feature_mask, sp)
+        """Best split for one leaf from its (strategy-local) histogram.
+        Returns a record with a GLOBAL feature index."""
+        if kind == "voting":
+            b = _best_voting(hist_leaf, stats)
+        else:
+            b = find_best_split(hist_leaf, stats, nb_l, mt_l,
+                                cat_l, fmask_l, sp)
+            b["feature"] = b["feature"] + f_offset
+            if kind in ("data", "feature"):
+                b = _merge_best(b, ax)
         allowed = (p.max_depth <= 0) | (depth < p.max_depth)
         b["gain"] = jnp.where(allowed, b["gain"], NEG_INF)
         return b
 
+    def _best_voting(hist_local, stats):
+        # stage 1: every shard votes its top-k features by local gain
+        local_stats = jnp.sum(hist_local[0], axis=0)  # any feature's bins
+        lb = find_best_split(hist_local, local_stats, num_bins,
+                             missing_type, is_cat, feature_mask, vote_sp)
+        _, ballot = jax.lax.top_k(lb["per_feature_gain"], n_vote)
+        # stage 2: elect global top-2k by vote count (GlobalVoting:166)
+        all_ballots = jax.lax.all_gather(ballot, ax).reshape(-1)
+        votes = jnp.zeros(F, jnp.int32).at[all_ballots].add(1)
+        _, elected = jax.lax.top_k(votes, n_elect)  # replicated
+        # stage 3: sum ONLY the elected features' histograms
+        h_sel = jax.lax.psum(hist_local[elected], ax)  # (2k, B, 3)
+        b = find_best_split(h_sel, stats, num_bins[elected],
+                            missing_type[elected], is_cat[elected],
+                            feature_mask[elected], sp)
+        b["feature"] = elected[b["feature"]]
+        return b
+
+    def goes_left_of(feat, left_mask_row):
+        """Row routing for the winning split.  For data/voting/serial the
+        winner's column is locally present; for feature-parallel only the
+        owner shard has it and broadcasts a one-bit mask."""
+        if kind == "feature":
+            local_f = feat - f_offset
+            owner = (local_f >= 0) & (local_f < F)
+            col = jax.lax.dynamic_index_in_dim(
+                xt, jnp.clip(local_f, 0, F - 1), axis=0, keepdims=False)
+            cand = jnp.take(left_mask_row, col.astype(jnp.int32))
+            return jax.lax.psum(
+                jnp.where(owner, cand.astype(jnp.float32), 0.0), ax) > 0.5
+        col = jax.lax.dynamic_index_in_dim(xt, feat, axis=0, keepdims=False)
+        return jnp.take(left_mask_row, col.astype(jnp.int32))
+
     # ---- init: root ------------------------------------------------
     leaf_idx = jnp.zeros(N, dtype=jnp.int32)
     root_hist = masked_hist(leaf_idx, 0)
-    root_stats = jnp.stack([jnp.sum(grad * sample_mask),
-                            jnp.sum(hess * sample_mask),
-                            jnp.sum(sample_mask)])
+    root_stats = global_stats(jnp.stack([jnp.sum(grad * sample_mask),
+                                         jnp.sum(hess * sample_mask),
+                                         jnp.sum(sample_mask)]))
     root_best = best_of(root_hist, root_stats, jnp.int32(0))
 
     state = {
         "leaf_idx": leaf_idx,
-        "hist": jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(root_hist),
+        "hist": jnp.zeros((L, F_hist, B, 3), jnp.float32).at[0].set(
+            root_hist),
         "leaf_stats": jnp.zeros((L, 3), jnp.float32).at[0].set(root_stats),
         "leaf_depth": jnp.zeros(L, jnp.int32),
         "best_gain": jnp.full(L, NEG_INF, jnp.float32).at[0].set(
@@ -126,10 +266,7 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         def do_split(st):
             new = jnp.int32(t + 1)
             feat = st["best_feature"][l]
-            col = jax.lax.dynamic_index_in_dim(
-                xt, feat, axis=0, keepdims=False)  # (N,)
-            goes_left = jnp.take(st["best_left_mask"][l],
-                                 col.astype(jnp.int32))
+            goes_left = goes_left_of(feat, st["best_left_mask"][l])
             mine = st["leaf_idx"] == l
             leaf_idx = jnp.where(mine & ~goes_left, new, st["leaf_idx"])
 

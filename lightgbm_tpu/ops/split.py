@@ -237,4 +237,7 @@ def find_best_split(hist: jax.Array, parent: jax.Array,
         "is_cat": fcat,
         "left_mask": left_mask,
         "left_stats": left_stats,
+        # per-feature best gains — the voting-parallel learner's ballot
+        # (VotingParallelTreeLearner, parallel_tree_learner.h:100-180)
+        "per_feature_gain": best_per_f,
     }

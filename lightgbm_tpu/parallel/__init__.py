@@ -1,0 +1,21 @@
+"""Distributed training over a :class:`jax.sharding.Mesh`.
+
+The reference's network layer (``src/network/``, ``include/LightGBM/
+network.h:86-257``) hand-builds Bruck / recursive-halving collectives
+over TCP/MPI linkers; its three parallel tree learners call
+``ReduceScatter`` / ``Allgather`` / allreduce-arg-max on top
+(``data_parallel_tree_learner.cpp``, ``feature_parallel_tree_learner
+.cpp``, ``voting_parallel_tree_learner.cpp``).  On TPU the whole linker
+layer disappears: the mesh, topology and schedules belong to XLA, and
+the collectives become ``jax.lax.psum_scatter`` / ``all_gather`` /
+``psum`` over a named mesh axis riding ICI (and DCN across slices, via
+standard ``jax.distributed`` multi-host init).  What this package keeps
+from the reference is the *interface shape* — which learner shards what,
+and which reductions run where — as documented on
+:class:`~lightgbm_tpu.ops.grow.DistConfig`.
+"""
+from .learners import (AXIS_NAME, DistributedBuilder, make_mesh_for,
+                       resolve_num_shards)
+
+__all__ = ["AXIS_NAME", "DistributedBuilder", "make_mesh_for",
+           "resolve_num_shards"]

@@ -1,0 +1,117 @@
+"""Parallel tree-learner builders: ``shard_map`` wrappers around the
+device growth loop.
+
+Maps ``tree_learner={data,feature,voting}`` (``tree_learner.cpp:9-33``)
+onto a 1-D named mesh.  The growth loop itself
+(:func:`lightgbm_tpu.ops.grow.build_tree`) contains the per-strategy
+collectives; this module owns mesh construction, sharding specs, and
+the feature-axis padding the block-cyclic layouts need.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import numpy as np
+
+from ..ops.grow import DistConfig, GrowParams, build_tree
+from ..utils.log import Log
+
+AXIS_NAME = "shard"
+
+
+def resolve_num_shards(config, mesh=None) -> int:
+    """How many ways to shard: an explicit mesh wins; otherwise all
+    local devices, capped by ``num_machines`` when the user set it."""
+    import jax
+    if mesh is not None:
+        return int(np.prod(mesh.devices.shape))
+    n = len(jax.devices())
+    if config.num_machines > 1:
+        n = min(n, config.num_machines)
+    return n
+
+
+def make_mesh_for(num_shards: int):
+    """A 1-D mesh over the first ``num_shards`` local devices."""
+    import jax
+    devices = jax.devices()[:num_shards]
+    return jax.sharding.Mesh(np.asarray(devices), (AXIS_NAME,))
+
+
+class DistributedBuilder:
+    """Callable with :func:`build_tree`'s signature that runs it SPMD.
+
+    Inputs arrive as GLOBAL (host-shaped) arrays; ``jit`` + ``shard_map``
+    split them onto the mesh per the learner's specs and reassemble the
+    outputs (split records replicated, ``leaf_idx`` row-sharded for the
+    data/voting learners).
+    """
+
+    def __init__(self, kind: str, params: GrowParams, num_shards: int,
+                 mesh=None):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        if kind not in ("data", "feature", "voting"):
+            raise ValueError(f"unknown parallel tree_learner {kind!r}")
+        self.kind = kind
+        self.num_shards = num_shards
+        self.mesh = mesh if mesh is not None else make_mesh_for(num_shards)
+        if len(self.mesh.axis_names) != 1:
+            raise ValueError(
+                f"tree learners shard over a 1-D mesh; got axes "
+                f"{self.mesh.axis_names}")
+        axis = self.mesh.axis_names[0]
+        self.params = dataclasses.replace(
+            params, dist=DistConfig(kind=kind, axis=axis,
+                                    num_shards=num_shards,
+                                    top_k=params.dist.top_k))
+
+        S = P(axis)
+        R = P()
+        if kind == "feature":
+            xt_spec, row_spec, feat_spec = P(axis, None), R, S
+            leaf_idx_spec = R
+        else:  # data | voting: rows sharded, features whole
+            xt_spec, row_spec, feat_spec = P(None, axis), S, R
+            leaf_idx_spec = S
+
+        out_specs = {k: R for k in (
+            "leaf", "feature", "threshold", "default_left", "is_cat",
+            "gain", "left_stats", "right_stats", "left_mask", "valid",
+            "leaf_values", "leaf_stats", "n_leaves")}
+        out_specs["leaf_idx"] = leaf_idx_spec
+
+        fn = functools.partial(build_tree, params=self.params)
+        sharded = jax.shard_map(
+            fn, mesh=self.mesh,
+            in_specs=(xt_spec, row_spec, row_spec, row_spec, feat_spec,
+                      feat_spec, feat_spec, feat_spec),
+            out_specs=out_specs, check_vma=False)
+        self._call = jax.jit(sharded)
+
+    # ------------------------------------------------------------------
+    def pad_rows(self, n: int, base: int = 1) -> int:
+        """Rows must split evenly over the mesh (and per-shard row count
+        must honor the histogram kernel's block size)."""
+        if self.kind == "feature":
+            step = base
+        else:
+            step = base * self.num_shards
+        return (n + step - 1) // step * step
+
+    def pad_features(self, f: int) -> int:
+        """Features must split evenly for the feature-block layouts."""
+        if self.kind == "voting":
+            return f
+        d = self.num_shards
+        return (f + d - 1) // d * d
+
+    def __call__(self, xt, grad, hess, sample_mask, feature_mask,
+                 num_bins, missing_type, is_cat, params=None):
+        # params is baked in at construction (signature-compatible with
+        # the jitted serial build_tree)
+        return self._call(xt, grad, hess, sample_mask, feature_mask,
+                          num_bins, missing_type, is_cat)

@@ -1,0 +1,21 @@
+"""Environment hygiene helpers for hermetic CPU runs."""
+from __future__ import annotations
+
+import os
+
+
+def strip_non_cpu_backends() -> None:
+    """Drop accelerator backend factories registered by interpreter
+    startup hooks (e.g. a site-wide PJRT plugin) so CPU-only runs can
+    never block on accelerator-tunnel health.  No-op unless
+    ``JAX_PLATFORMS`` requests cpu; best-effort — the registry is a
+    private jax internal."""
+    if "cpu" not in os.environ.get("JAX_PLATFORMS", ""):
+        return
+    try:
+        import jax._src.xla_bridge as xb
+
+        for name in [k for k in xb._backend_factories if k != "cpu"]:
+            xb._backend_factories.pop(name, None)
+    except (ImportError, AttributeError):  # pragma: no cover
+        pass

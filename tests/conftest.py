@@ -1,16 +1,21 @@
 """Test configuration: force an 8-device virtual CPU mesh.
 
-Multi-chip sharding paths (data/feature/voting parallel) are exercised in
-CI on a virtual device mesh; real-TPU runs come from bench.py and the
-driver's dryrun.  Must run before jax is imported anywhere.
+The env vars must be set before jax is imported anywhere; tests that
+exercise sharded paths build a Mesh from these 8 virtual devices.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Tests are CPU-hermetic and must not block on accelerator-tunnel
+# health (a site-registered PJRT plugin initializes in every process).
+from lightgbm_tpu.utils.env import strip_non_cpu_backends  # noqa: E402
+
+strip_non_cpu_backends()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

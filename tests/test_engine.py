@@ -23,7 +23,11 @@ def test_binary(binary_example):
                     train, num_boost_round=30, valid_sets=[valid],
                     evals_result=evals_result, verbose_eval=False)
     auc = evals_result["valid_0"]["auc"][-1]
-    assert auc > 0.81
+    # reference CLI (oracle build) gets 0.826625 at 30 rounds on this
+    # config; we measure 0.8361 — pin tight so regressions below the
+    # reference fail loudly
+    assert auc == pytest.approx(0.836, abs=0.007)
+    assert auc > 0.8266 - 0.005  # never fall below the reference
     # predictions are probabilities
     p = bst.predict(Xt)
     assert np.all((p >= 0) & (p <= 1))
@@ -223,3 +227,94 @@ def test_dataset_from_file_with_sidecars():
     bst = lgb.train({"objective": "binary", "verbose": -1}, train,
                     num_boost_round=3, verbose_eval=False)
     assert bst.num_trees() == 3
+
+
+def test_multiclass(multiclass_example):
+    """End-to-end softmax multiclass on the reference example dataset
+    (``examples/multiclass_classification``)."""
+    X, y, Xt, yt = multiclass_example
+    train = lgb.Dataset(X, label=y)
+    valid = train.create_valid(Xt, label=yt)
+    er = {}
+    bst = lgb.train({"objective": "multiclass", "num_class": 5,
+                     "metric": ["multi_logloss", "multi_error"],
+                     "verbose": -1},
+                    train, num_boost_round=30, valid_sets=[valid],
+                    evals_result=er, verbose_eval=False)
+    ll = er["valid_0"]["multi_logloss"][-1]
+    # measured 1.3919 here; reference CLI lands in the same region on
+    # this (noisy synthetic) dataset — pin tight to catch regressions
+    assert ll == pytest.approx(1.392, abs=0.015)
+    assert er["valid_0"]["multi_logloss"][0] > ll  # it actually learns
+    p = bst.predict(Xt)
+    assert p.shape == (len(yt), 5)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-6)
+    acc = float(np.mean(np.argmax(p, axis=1) == yt))
+    assert acc == pytest.approx(0.422, abs=0.02)
+    # raw scores round-trip through save/load
+    raw = bst.predict(Xt, raw_score=True)
+    assert raw.shape == (len(yt), 5)
+
+
+def test_multiclass_ova(multiclass_example):
+    X, y, Xt, yt = multiclass_example
+    train = lgb.Dataset(X, label=y)
+    valid = train.create_valid(Xt, label=yt)
+    er = {}
+    lgb.train({"objective": "multiclassova", "num_class": 5,
+               "metric": "multi_error", "verbose": -1},
+              train, num_boost_round=20, valid_sets=[valid],
+              evals_result=er, verbose_eval=False)
+    errs = er["valid_0"]["multi_error"]
+    assert errs[-1] < 0.70  # 5-class random = 0.8
+    assert errs[-1] <= errs[0]
+
+
+def test_multiclass_early_stopping(multiclass_example):
+    """Early stopping must work for multiclass (regression test for the
+    class-0-only eval bug)."""
+    X, y, Xt, yt = multiclass_example
+    train = lgb.Dataset(X, label=y)
+    valid = train.create_valid(Xt, label=yt)
+    bst = lgb.train({"objective": "multiclass", "num_class": 5,
+                     "metric": "multi_logloss", "verbose": -1,
+                     "learning_rate": 0.3},
+                    train, num_boost_round=60, valid_sets=[valid],
+                    early_stopping_rounds=5, verbose_eval=False)
+    assert 0 < bst.best_iteration <= 60
+
+
+def test_lambdarank(rank_example):
+    """End-to-end LambdaRank on ``examples/lambdarank`` with per-position
+    NDCG reporting."""
+    X, y, q, Xt, yt, qt = rank_example
+    train = lgb.Dataset(X, label=y, group=q)
+    valid = train.create_valid(Xt, label=yt, group=qt)
+    er = {}
+    lgb.train({"objective": "lambdarank", "metric": "ndcg",
+               "eval_at": [1, 3, 5], "verbose": -1},
+              train, num_boost_round=50, valid_sets=[valid],
+              evals_result=er, verbose_eval=False)
+    # each eval_at position is reported separately (reference behavior)
+    assert set(er["valid_0"]) == {"ndcg@1", "ndcg@3", "ndcg@5"}
+    n1 = er["valid_0"]["ndcg@1"][-1]
+    n5 = er["valid_0"]["ndcg@5"][-1]
+    # measured 0.617/0.663 @50 iters; reference example README reports
+    # the same ballpark for this dataset
+    assert n1 == pytest.approx(0.617, abs=0.02)
+    assert n5 == pytest.approx(0.663, abs=0.02)
+    assert n5 > er["valid_0"]["ndcg@5"][0]
+
+
+def test_early_stopping_first_metric_only_with_train_metric(binary_example):
+    """first_metric_only must not short-circuit on the training entry
+    (which is listed first) — validation metrics still stop training."""
+    X, y, Xt, yt = binary_example
+    train = lgb.Dataset(X, label=y)
+    valid = train.create_valid(Xt, label=yt)
+    bst = lgb.train({"objective": "binary", "metric": "auc",
+                     "first_metric_only": True, "verbose": -1},
+                    train, num_boost_round=300,
+                    valid_sets=[train, valid],
+                    early_stopping_rounds=10, verbose_eval=False)
+    assert 0 < bst.best_iteration < 300
