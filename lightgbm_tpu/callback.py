@@ -105,13 +105,23 @@ class _ResetParameter:
             if callable(sched):
                 updates[key] = sched(env.iteration - env.begin_iteration)
             else:
+                if not isinstance(sched, (list, tuple)):
+                    raise ValueError(
+                        f"reset_parameter: {key!r} must be a list of "
+                        f"per-iteration values or a callable "
+                        f"iteration -> value, got {type(sched).__name__}")
                 values = list(sched)
                 if len(values) != env.end_iteration - env.begin_iteration:
                     raise ValueError(
                         f"length of list {key!r} must equal num_boost_round")
                 updates[key] = values[env.iteration - env.begin_iteration]
         if "learning_rate" in updates:
-            env.model._gbdt.shrinkage_rate = float(updates["learning_rate"])
+            lr = float(updates["learning_rate"])
+            env.model._gbdt.shrinkage_rate = lr
+            # modes that derive their per-iteration shrinkage from the
+            # configured rate (DART's k/(k+1) scaling) read the config,
+            # matching the reference's ResetConfig path
+            env.model._gbdt.config.learning_rate = lr
         env.params.update(updates)
 
 

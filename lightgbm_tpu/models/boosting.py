@@ -275,6 +275,10 @@ class RF(GBDT):
         self.shrinkage_rate = 1.0
         if self.objective is None:
             Log.fatal("rf does not support a custom objective")
+        if self.train_set.metadata.init_score is not None:
+            # rf.hpp:38 — the averaged-score update is incompatible
+            # with a per-row initial score
+            Log.fatal("cannot use initial score for random forest")
         Log.info("Using RF")
         K = self.num_tree_per_iteration
         self._init_scores = [0.0] * K

@@ -112,6 +112,13 @@ class DistributedBuilder:
     def __call__(self, xt, grad, hess, sample_mask, feature_mask,
                  num_bins, missing_type, is_cat, params=None):
         # params is baked in at construction (signature-compatible with
-        # the jitted serial build_tree)
+        # the jitted serial build_tree); reject a drifting override
+        # instead of silently training with stale parameters
+        if params is not None and \
+                dataclasses.replace(params, dist=self.params.dist) != \
+                self.params:
+            raise ValueError(
+                "DistributedBuilder was constructed with different "
+                "GrowParams; rebuild the builder to change them")
         return self._call(xt, grad, hess, sample_mask, feature_mask,
                           num_bins, missing_type, is_cat)

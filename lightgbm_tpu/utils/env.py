@@ -13,8 +13,14 @@ def strip_non_cpu_backends() -> None:
     if "cpu" not in os.environ.get("JAX_PLATFORMS", ""):
         return
     try:
+        import jax
         import jax._src.xla_bridge as xb
 
+        # site startup hooks may have already forced a different
+        # platform selection through jax.config (overriding the env
+        # var) — pin the config itself back to cpu
+        if jax.config.jax_platforms != "cpu":
+            jax.config.update("jax_platforms", "cpu")
         for name in [k for k in xb._backend_factories if k != "cpu"]:
             xb._backend_factories.pop(name, None)
     except (ImportError, AttributeError):  # pragma: no cover

@@ -155,7 +155,6 @@ def test_dart_rollback(binary_example):
                     verbose_eval=False)
     g = bst._gbdt
     n_before = len(g.models)
-    raw_before = None
     g.rollback_one_iter()
     assert len(g.models) == n_before - 1
     # score and (restored) model agree after rollback
