@@ -330,7 +330,8 @@ class Booster:
         if data.raw_mat is None:
             Log.fatal("validation set %s needs raw data for evaluation "
                       "(free_raw_data=False)", name)
-        self._gbdt.add_valid(name, data.raw_mat, data._constructed.metadata)
+        self._gbdt.add_valid(name, data.raw_mat, data._constructed.metadata,
+                             binned=data._constructed)
         self._valid_names.append(name)
         return self
 

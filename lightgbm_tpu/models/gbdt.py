@@ -34,6 +34,7 @@ class ValidSet:
     raw: np.ndarray          # raw feature matrix (rows, total_features)
     metadata: Metadata
     score: np.ndarray = None  # accumulated raw score
+    xt: object = None        # device (F_pad, rows) binned matrix, or None
 
     def __post_init__(self):
         if self.score is None:
@@ -94,6 +95,30 @@ class GBDT:
         rpb = int(config.tpu_rows_per_block)
         n = train_set.num_data
 
+        # resolve the tree learner FIRST: the feature-padded width (and
+        # with it the static per-feature constraint tuples) depends on
+        # the mesh sharding
+        learner = config.tree_learner
+        num_shards = 1
+        if learner not in ("serial", ""):
+            from ..parallel import resolve_num_shards
+            num_shards = resolve_num_shards(config, mesh)
+            if num_shards <= 1:
+                Log.warning("tree_learner=%s requested but only one device "
+                            "is available; using the serial learner",
+                            learner)
+                learner = "serial"
+        dist_active = learner not in ("serial", "") and num_shards > 1
+
+        from ..parallel.learners import pad_features_for, pad_rows_for
+        row_block = rpb if use_pallas else 1
+        kind = learner if dist_active else "serial"
+        self._n_pad = pad_rows_for(kind, num_shards, n, row_block)
+        self._F_pad = pad_features_for(kind, num_shards, F)
+
+        monotone, penalty = self._constraint_tuples(config, train_set, F)
+        forced = self._forced_splits(config, train_set, dist_active)
+
         self.grow_params = GrowParams(
             split=SplitParams(
                 max_bin=self.max_bin,
@@ -107,37 +132,25 @@ class GBDT:
                 max_cat_threshold=config.max_cat_threshold,
                 cat_l2=config.cat_l2,
                 cat_smooth=config.cat_smooth,
-                min_data_per_group=config.min_data_per_group),
+                min_data_per_group=config.min_data_per_group,
+                monotone=monotone,
+                penalty=penalty),
             num_leaves=config.num_leaves,
             max_depth=config.max_depth,
             hist_impl="pallas" if use_pallas else "segsum",
             rows_per_block=rpb,
-            dist=DistConfig(top_k=config.top_k))
+            dist=DistConfig(top_k=config.top_k),
+            forced=forced)
 
         # parallel tree learner over the device mesh
         # (tree_learner={data,feature,voting}, tree_learner.cpp:9-33)
         self._dist = None
-        learner = config.tree_learner
-        if learner not in ("serial", ""):
-            from ..parallel import DistributedBuilder, resolve_num_shards
-            num_shards = resolve_num_shards(config, mesh)
-            if num_shards <= 1:
-                Log.warning("tree_learner=%s requested but only one device "
-                            "is available; using the serial learner",
-                            learner)
-            else:
-                self._dist = DistributedBuilder(
-                    learner, self.grow_params, num_shards, mesh)
-                Log.info("tree_learner=%s over a %d-way device mesh",
-                         learner, num_shards)
-
-        row_block = rpb if use_pallas else 1
-        if self._dist is not None:
-            self._n_pad = self._dist.pad_rows(n, row_block)
-            self._F_pad = self._dist.pad_features(F)
-        else:
-            self._n_pad = (n + row_block - 1) // row_block * row_block
-            self._F_pad = F
+        if dist_active:
+            from ..parallel import DistributedBuilder
+            self._dist = DistributedBuilder(
+                learner, self.grow_params, num_shards, mesh)
+            Log.info("tree_learner=%s over a %d-way device mesh",
+                     learner, num_shards)
         xt = train_set.binned.T.astype(np.int32)  # (F, N)
         xt = np.pad(xt, ((0, self._F_pad - F), (0, self._n_pad - n)))
         self._xt = jnp.asarray(xt)
@@ -167,7 +180,79 @@ class GBDT:
             objective.init(train_set.metadata, n)
 
     # ------------------------------------------------------------------
-    def add_valid(self, name: str, raw: np.ndarray, metadata: Metadata):
+    def _constraint_tuples(self, config: Config, train_set: TpuDataset,
+                           F: int):
+        """Static per-feature (monotone, penalty) tuples padded to the
+        device feature width.  Config lists are indexed by ORIGINAL
+        column (config.h:357 monotone_constraints, feature_contri);
+        remap through used_features and pad with neutral values."""
+        pad = self._F_pad
+        mono = ()
+        if config.monotone_constraints:
+            mc = list(config.monotone_constraints)
+            vals = [int(mc[i]) if i < len(mc) else 0
+                    for i in train_set.used_features]
+            if any(vals):
+                mono = tuple(vals + [0] * (pad - F))
+        pen = ()
+        if config.feature_contri:
+            fc = list(config.feature_contri)
+            vals = [float(fc[i]) if i < len(fc) else 1.0
+                    for i in train_set.used_features]
+            if any(v != 1.0 for v in vals):
+                pen = tuple(vals + [1.0] * (pad - F))
+        return mono, pen
+
+    def _forced_splits(self, config: Config, train_set: TpuDataset,
+                       dist_active: bool):
+        """BFS-flattened forced splits from ``forcedsplits_filename``
+        (``ForceSplits``, serial_tree_learner.cpp:544): JSON nodes
+        {feature, threshold, left, right} become (leaf_id,
+        inner_feature, threshold_bin) triples in the order the growth
+        loop will apply them (left child keeps the parent's leaf id,
+        right child gets id t+1 at iteration t)."""
+        fname = config.forcedsplits_filename
+        if not fname:
+            return ()
+        if dist_active:
+            Log.warning("forced splits are not supported by parallel "
+                        "tree learners; ignoring %s", fname)
+            return ()
+        import json as _json
+        with open(fname) as f:
+            root = _json.load(f)
+        out = []
+        queue = [(root, 0)]
+        t = 0
+        while queue and t < config.num_leaves - 1:
+            node, leaf = queue.pop(0)
+            real_f = int(node["feature"])
+            inner = train_set.inner_feature_index(real_f)
+            if inner is None or inner < 0:
+                Log.warning("forced split on unused feature %d; "
+                            "stopping forced splits", real_f)
+                break
+            mapper = train_set.mappers[real_f]
+            thr_bin = int(np.asarray(mapper.value_to_bin(
+                np.asarray([float(node["threshold"])]))).reshape(-1)[0])
+            out.append((leaf, inner, thr_bin))
+            if node.get("left"):
+                queue.append((node["left"], leaf))
+            if node.get("right"):
+                queue.append((node["right"], t + 1))
+            t += 1
+        return tuple(out)
+
+    # ------------------------------------------------------------------
+    def add_valid(self, name: str, raw: np.ndarray, metadata: Metadata,
+                  binned: Optional[TpuDataset] = None):
+        """Register a validation set.  When its aligned binned matrix is
+        provided, per-iteration scoring runs on device by replaying the
+        fresh tree's split records (:func:`~lightgbm_tpu.ops.grow.
+        route_rows`) instead of a host tree traversal — O(1) host work
+        per iteration."""
+        import jax.numpy as jnp
+
         vs = ValidSet(name, raw, metadata)
         vs.score = np.zeros((self.num_tree_per_iteration, raw.shape[0]),
                             dtype=np.float64)
@@ -177,6 +262,10 @@ class GBDT:
         # replay existing model (continue-train case)
         for i, tree in enumerate(self.models):
             vs.score[i % self.num_tree_per_iteration] += tree.predict(raw)
+        if binned is not None and self.num_features > 0:
+            xtv = binned.binned.T.astype(np.int32)  # (F, rows)
+            xtv = np.pad(xtv, ((0, self._F_pad - xtv.shape[0]), (0, 0)))
+            vs.xt = jnp.asarray(xtv)
         self.valid_sets.append(vs)
 
     # ------------------------------------------------------------------
@@ -317,9 +406,18 @@ class GBDT:
         tree_idx = len(self.models) % self.num_tree_per_iteration
         self._score = self._score.at[tree_idx].add(
             jnp.take(vals, rec["leaf_idx"][:n]))
-        # valid scores on host via raw traversal
+        # valid scores: device split-record replay when the binned
+        # matrix is resident, host traversal fallback otherwise
+        from ..ops.grow import route_rows
         for vs in self.valid_sets:
-            vs.score[tree_idx] += tree.predict(vs.raw)
+            if vs.xt is not None:
+                li = route_rows(vs.xt, rec["leaf"], rec["feature"],
+                                rec["left_mask"], rec["valid"],
+                                self.config.num_leaves)
+                vs.score[tree_idx] += np.asarray(jnp.take(vals, li),
+                                                 np.float64)
+            else:
+                vs.score[tree_idx] += tree.predict(vs.raw)
         if abs(init_score) > _KEPS:
             tree.add_bias(init_score)
         return tree
@@ -351,6 +449,13 @@ class GBDT:
             ls = rec["left_stats"][i]
             rs = rec["right_stats"][i]
             lv, rv = out(ls[0], ls[1]), out(rs[0], rs[1])
+            if "rec_left_min" in rec:
+                # monotone value constraints (the device loop clamped
+                # identically; redo in f64 on the host-side outputs)
+                lv = float(np.clip(lv, rec["rec_left_min"][i],
+                                   rec["rec_left_max"][i]))
+                rv = float(np.clip(rv, rec["rec_right_min"][i],
+                                   rec["rec_right_max"][i]))
             gain = float(rec["gain"][i])
             if bool(rec["is_cat"][i]):
                 bins = np.nonzero(rec["left_mask"][i])[0]

@@ -574,7 +574,7 @@ class LambdaRank(Objective):
                       int(lab.max()), len(self.label_gain))
         # padded (nq, mq) row-index matrix; N = padding sentinel
         nq, mq = self.num_queries, self.max_docs
-        idx = np.full((nq, mq), num_data, dtype=np.int64)
+        idx = np.full((nq, mq), num_data, dtype=np.int32)
         for q in range(nq):
             idx[q, :cnts[q]] = np.arange(qb[q], qb[q + 1])
         self._doc_idx = jnp.asarray(idx)
@@ -635,7 +635,7 @@ class LambdaRank(Objective):
         nchunks = (nq + cq - 1) // cq
         pad_q = nchunks * cq - nq
         di = jnp.concatenate([self._doc_idx,
-                              jnp.full((pad_q, mq), n, jnp.int64)])
+                              jnp.full((pad_q, mq), n, jnp.int32)])
         dv = jnp.concatenate([self._doc_valid,
                               jnp.zeros((pad_q, mq), bool)])
         im = jnp.concatenate([self._inv_max_dcg, jnp.zeros(pad_q,

@@ -43,7 +43,8 @@ import jax
 import jax.numpy as jnp
 
 from .histogram import histogram_pallas, histogram_segsum
-from .split import NEG_INF, SplitParams, find_best_split, leaf_output
+from .split import (NEG_INF, SplitParams, eval_forced_split,
+                    find_best_split, leaf_output)
 
 __all__ = ["DistConfig", "GrowParams", "build_tree"]
 
@@ -71,6 +72,10 @@ class GrowParams:
     hist_impl: str = "segsum"  # segsum | pallas
     rows_per_block: int = 1024
     dist: DistConfig = DistConfig()
+    # forced splits (ForceSplits, serial_tree_learner.cpp:544) in BFS
+    # order as (leaf_id, global_feature, threshold_bin) triples —
+    # precomputed on host from the forcedsplits JSON; serial only
+    forced: tuple = ()
 
 
 def _hist(xt, vals, p: GrowParams):
@@ -124,6 +129,14 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
     ax = dist.axis
     D = dist.num_shards
 
+    # static per-feature monotone directions / gain penalties; the
+    # tuples are GLOBAL (padded) feature descriptors
+    has_mono = sp.has_monotone
+    has_pen = sp.has_penalty
+    mono_g = jnp.asarray(sp.monotone, jnp.int32) if has_mono else None
+    pen_g = jnp.asarray(sp.penalty, jnp.float32) if has_pen else None
+    BIG = jnp.float32(jnp.inf)
+
     if kind == "data":
         # each shard owns histograms for one contiguous feature block
         # after the reduce-scatter (data_parallel_tree_learner.cpp:147)
@@ -137,13 +150,17 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         # features are sharded in memory; descriptor arrays arrive local
         F_hist = F
         f_offset = jax.lax.axis_index(ax) * F
+        blk = lambda a: jax.lax.dynamic_slice_in_dim(a, f_offset, F)
         nb_l, mt_l, cat_l, fmask_l = (num_bins, missing_type, is_cat,
                                       feature_mask)
     else:
         F_hist = F
         f_offset = jnp.int32(0)
+        blk = lambda a: a
         nb_l, mt_l, cat_l, fmask_l = (num_bins, missing_type, is_cat,
                                       feature_mask)
+    mono_l = blk(mono_g) if has_mono else None
+    pen_l = blk(pen_g) if has_pen else None
 
     if kind == "voting":
         # local ballots use constraints scaled by 1/num_machines
@@ -170,14 +187,17 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
             return jax.lax.psum(local, ax)
         return local
 
-    def best_of(hist_leaf, stats, depth):
+    def best_of(hist_leaf, stats, depth, mn=None, mx=None):
         """Best split for one leaf from its (strategy-local) histogram.
-        Returns a record with a GLOBAL feature index."""
+        Returns a record with a GLOBAL feature index.  ``mn``/``mx`` are
+        the leaf's inherited monotone output bounds."""
         if kind == "voting":
-            b = _best_voting(hist_leaf, stats)
+            b = _best_voting(hist_leaf, stats, mn, mx)
         else:
             b = find_best_split(hist_leaf, stats, nb_l, mt_l,
-                                cat_l, fmask_l, sp)
+                                cat_l, fmask_l, sp, monotone=mono_l,
+                                penalty=pen_l, min_output=mn,
+                                max_output=mx)
             b["feature"] = b["feature"] + f_offset
             if kind in ("data", "feature"):
                 b = _merge_best(b, ax)
@@ -185,11 +205,13 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         b["gain"] = jnp.where(allowed, b["gain"], NEG_INF)
         return b
 
-    def _best_voting(hist_local, stats):
+    def _best_voting(hist_local, stats, mn=None, mx=None):
         # stage 1: every shard votes its top-k features by local gain
         local_stats = jnp.sum(hist_local[0], axis=0)  # any feature's bins
         lb = find_best_split(hist_local, local_stats, num_bins,
-                             missing_type, is_cat, feature_mask, vote_sp)
+                             missing_type, is_cat, feature_mask, vote_sp,
+                             monotone=mono_g, penalty=pen_g,
+                             min_output=mn, max_output=mx)
         _, ballot = jax.lax.top_k(lb["per_feature_gain"], n_vote)
         # stage 2: elect global top-2k by vote count (GlobalVoting:166)
         all_ballots = jax.lax.all_gather(ballot, ax).reshape(-1)
@@ -199,7 +221,12 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         h_sel = jax.lax.psum(hist_local[elected], ax)  # (2k, B, 3)
         b = find_best_split(h_sel, stats, num_bins[elected],
                             missing_type[elected], is_cat[elected],
-                            feature_mask[elected], sp)
+                            feature_mask[elected], sp,
+                            monotone=None if mono_g is None
+                            else mono_g[elected],
+                            penalty=None if pen_g is None
+                            else pen_g[elected],
+                            min_output=mn, max_output=mx)
         b["feature"] = elected[b["feature"]]
         return b
 
@@ -224,7 +251,20 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
     root_stats = global_stats(jnp.stack([jnp.sum(grad * sample_mask),
                                          jnp.sum(hess * sample_mask),
                                          jnp.sum(sample_mask)]))
-    root_best = best_of(root_hist, root_stats, jnp.int32(0))
+    root_mn = -BIG if has_mono else None
+    root_mx = BIG if has_mono else None
+    root_best = best_of(root_hist, root_stats, jnp.int32(0),
+                        root_mn, root_mx)
+
+    n_forced = min(len(p.forced), L - 1)
+    if n_forced:
+        assert kind == "serial", \
+            "forced splits are supported by the serial learner only"
+        leaves, feats, thrs = (list(x) for x in zip(*p.forced))
+        pad = [0] * ((L - 1) - n_forced)
+        forced_leaf = jnp.asarray((leaves + pad)[:L - 1], jnp.int32)
+        forced_feat = jnp.asarray((feats + pad)[:L - 1], jnp.int32)
+        forced_thr = jnp.asarray((thrs + pad)[:L - 1], jnp.int32)
 
     state = {
         "leaf_idx": leaf_idx,
@@ -257,20 +297,63 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         "rec_valid": jnp.zeros(L - 1, bool),
         "n_leaves": jnp.int32(1),
     }
+    if has_mono:
+        # per-leaf inherited output bounds (LeafSplits min/max
+        # constraint propagation, leaf_splits.hpp:16)
+        state["leaf_min"] = jnp.full(L, -BIG, jnp.float32)
+        state["leaf_max"] = jnp.full(L, BIG, jnp.float32)
+        state["rec_left_min"] = jnp.full(L - 1, -BIG, jnp.float32)
+        state["rec_left_max"] = jnp.full(L - 1, BIG, jnp.float32)
+        state["rec_right_min"] = jnp.full(L - 1, -BIG, jnp.float32)
+        state["rec_right_max"] = jnp.full(L - 1, BIG, jnp.float32)
+    if n_forced:
+        state["force_active"] = jnp.asarray(True)
 
     def body(t, st):
-        l = jnp.argmax(st["best_gain"]).astype(jnp.int32)
-        gain = st["best_gain"][l]
-        valid = gain > 0
+        best_l_id = jnp.argmax(st["best_gain"]).astype(jnp.int32)
+
+        if n_forced:
+            # forced phase: split the BFS-scheduled leaf at the fixed
+            # (feature, threshold) while feasible; the first infeasible
+            # forced split aborts forcing (aborted_last_force_split)
+            in_force = (t < n_forced) & st["force_active"]
+            fl = forced_leaf[t]
+            f_mn = st["leaf_min"][fl] if has_mono else None
+            f_mx = st["leaf_max"][fl] if has_mono else None
+            frec = eval_forced_split(
+                st["hist"][fl], st["leaf_stats"][fl], forced_feat[t],
+                forced_thr[t], nb_l, mt_l, sp, monotone=mono_l,
+                min_output=f_mn, max_output=f_mx)
+            usef = in_force & frec["feasible"]
+            st = dict(st)
+            st["force_active"] = st["force_active"] & \
+                (~in_force | frec["feasible"])
+            l = jnp.where(usef, fl, best_l_id)
+        else:
+            l = best_l_id
+
+        # the split to apply this iteration: the globally-best stored
+        # candidate of leaf l, or the forced record
+        cand = {k: st["best_" + k][l] for k in
+                ("gain", "feature", "threshold", "default_left",
+                 "is_cat", "left_mask", "left_stats")}
+        if n_forced:
+            for k in cand:
+                cand[k] = jnp.where(usef, frec[k].astype(cand[k].dtype),
+                                    cand[k])
+            valid = jnp.where(usef, True, cand["gain"] > 0)
+        else:
+            valid = cand["gain"] > 0
+        gain = cand["gain"]
 
         def do_split(st):
             new = jnp.int32(t + 1)
-            feat = st["best_feature"][l]
-            goes_left = goes_left_of(feat, st["best_left_mask"][l])
+            feat = cand["feature"]
+            goes_left = goes_left_of(feat, cand["left_mask"])
             mine = st["leaf_idx"] == l
             leaf_idx = jnp.where(mine & ~goes_left, new, st["leaf_idx"])
 
-            left_stats = st["best_left_stats"][l]
+            left_stats = cand["left_stats"]
             parent_stats = st["leaf_stats"][l]
             right_stats = parent_stats - left_stats
             small_is_left = left_stats[2] <= right_stats[2]
@@ -281,8 +364,31 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
             hist_r = jnp.where(small_is_left, hist_large, hist_small)
 
             depth = st["leaf_depth"][l] + 1
-            best_l = best_of(hist_l, left_stats, depth)
-            best_r = best_of(hist_r, right_stats, depth)
+            if has_mono:
+                # child bound propagation
+                # (serial_tree_learner.cpp:767-777): a numerical split
+                # on a monotone feature pins the children on either
+                # side of mid = (left_output + right_output) / 2
+                mn_p, mx_p = st["leaf_min"][l], st["leaf_max"][l]
+                l1_, l2_, mds_ = sp.lambda_l1, sp.lambda_l2, \
+                    sp.max_delta_step
+                lo = jnp.clip(leaf_output(left_stats[0], left_stats[1],
+                                          l1_, l2_, mds_), mn_p, mx_p)
+                ro = jnp.clip(leaf_output(right_stats[0], right_stats[1],
+                                          l1_, l2_, mds_), mn_p, mx_p)
+                mid = 0.5 * (lo + ro)
+                mono_f = mono_g[feat]
+                up = (mono_f > 0) & ~cand["is_cat"]
+                dn = (mono_f < 0) & ~cand["is_cat"]
+                l_min = jnp.where(dn, mid, mn_p)
+                l_max = jnp.where(up, mid, mx_p)
+                r_min = jnp.where(up, mid, mn_p)
+                r_max = jnp.where(dn, mid, mx_p)
+            else:
+                l_min = l_max = r_min = r_max = None
+
+            best_l = best_of(hist_l, left_stats, depth, l_min, l_max)
+            best_r = best_of(hist_r, right_stats, depth, r_min, r_max)
 
             st = dict(st)
             st["leaf_idx"] = leaf_idx
@@ -291,6 +397,15 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
                                                .at[new].set(right_stats)
             st["leaf_depth"] = st["leaf_depth"].at[l].set(depth) \
                                                .at[new].set(depth)
+            if has_mono:
+                st["leaf_min"] = st["leaf_min"].at[l].set(l_min) \
+                                               .at[new].set(r_min)
+                st["leaf_max"] = st["leaf_max"].at[l].set(l_max) \
+                                               .at[new].set(r_max)
+                st["rec_left_min"] = st["rec_left_min"].at[t].set(l_min)
+                st["rec_left_max"] = st["rec_left_max"].at[t].set(l_max)
+                st["rec_right_min"] = st["rec_right_min"].at[t].set(r_min)
+                st["rec_right_max"] = st["rec_right_max"].at[t].set(r_max)
             for key, src in (("best_gain", "gain"),
                              ("best_feature", "feature"),
                              ("best_threshold", "threshold"),
@@ -307,13 +422,13 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
             return st, jnp.zeros(3, jnp.float32), jnp.zeros(3, jnp.float32), \
                 jnp.float32(0)
 
-        # record fields that need pre-split best_* values
+        # record fields that need pre-split candidate values
         pre = {
-            "feature": st["best_feature"][l],
-            "threshold": st["best_threshold"][l],
-            "default_left": st["best_default_left"][l],
-            "is_cat": st["best_is_cat"][l],
-            "left_mask": st["best_left_mask"][l],
+            "feature": cand["feature"],
+            "threshold": cand["threshold"],
+            "default_left": cand["default_left"],
+            "is_cat": cand["is_cat"],
+            "left_mask": cand["left_mask"],
         }
         st2, ls, rs, g = jax.lax.cond(valid, do_split, skip, st)
         st2["rec_leaf"] = st2["rec_leaf"].at[t].set(
@@ -339,7 +454,16 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
                               state["leaf_stats"][:, 1],
                               sp.lambda_l1, sp.lambda_l2,
                               sp.max_delta_step)
+    if has_mono:
+        leaf_values = jnp.clip(leaf_values, state["leaf_min"],
+                               state["leaf_max"])
+    extra = {}
+    if has_mono:
+        extra = {k: state[k] for k in
+                 ("rec_left_min", "rec_left_max",
+                  "rec_right_min", "rec_right_max")}
     return {
+        **extra,
         "leaf": state["rec_leaf"],
         "feature": state["rec_feature"],
         "threshold": state["rec_threshold"],
@@ -355,3 +479,30 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         "leaf_stats": state["leaf_stats"],
         "n_leaves": state["n_leaves"],
     }
+
+
+@functools.partial(jax.jit, static_argnames=("num_leaves",))
+def route_rows(xt: jax.Array, rec_leaf: jax.Array, rec_feature: jax.Array,
+               rec_left_mask: jax.Array, rec_valid: jax.Array,
+               num_leaves: int) -> jax.Array:
+    """Replay a tree's split records over a binned matrix.
+
+    Routes every row of ``xt`` (F, N binned ints) through the splits
+    recorded by :func:`build_tree`, producing the (N,) leaf assignment.
+    This is the device-side scorer for binned validation sets — the
+    TPU-first replacement for the reference's per-row tree traversal in
+    ``ScoreUpdater::AddScore`` (``score_updater.hpp:17``): one gather
+    per split instead of a host walk per row.
+    """
+    N = xt.shape[1]
+    leaf_idx = jnp.zeros(N, dtype=jnp.int32)
+
+    def body(t, li):
+        feat = rec_feature[t]
+        col = jax.lax.dynamic_index_in_dim(xt, feat, axis=0, keepdims=False)
+        goes_left = jnp.take(rec_left_mask[t], col.astype(jnp.int32))
+        mine = li == rec_leaf[t]
+        move = rec_valid[t] & mine & ~goes_left
+        return jnp.where(move, jnp.int32(t + 1), li)
+
+    return jax.lax.fori_loop(0, num_leaves - 1, body, leaf_idx)

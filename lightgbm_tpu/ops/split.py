@@ -27,7 +27,13 @@ NEG_INF = -1e30
 
 @dataclasses.dataclass(frozen=True)
 class SplitParams:
-    """Static (trace-time) split-finding parameters."""
+    """Static (trace-time) split-finding parameters.
+
+    ``monotone``/``penalty`` are per-feature tuples (padded to the
+    device feature count); empty means no constraints / all ones.
+    Carried here (static) so the common unconstrained case traces with
+    zero extra work.
+    """
     max_bin: int
     lambda_l1: float = 0.0
     lambda_l2: float = 0.0
@@ -40,6 +46,16 @@ class SplitParams:
     cat_l2: float = 10.0
     cat_smooth: float = 10.0
     min_data_per_group: int = 100
+    monotone: Tuple[int, ...] = ()   # -1/0/+1 per feature (config.h:357)
+    penalty: Tuple[float, ...] = ()  # feature_contri gain multipliers
+
+    @property
+    def has_monotone(self) -> bool:
+        return bool(self.monotone) and any(self.monotone)
+
+    @property
+    def has_penalty(self) -> bool:
+        return bool(self.penalty) and any(x != 1.0 for x in self.penalty)
 
 
 def threshold_l1(s, l1):
@@ -69,10 +85,22 @@ def leaf_gain(g, h, l1, l2, max_delta_step):
                               l1, l2)
 
 
-def _split_gain(gl, hl, gr, hr, l1, l2, mds):
-    """GetSplitGains without monotone handling (feature_histogram.hpp:456)."""
-    return (leaf_gain(gl, hl, l1, l2, mds) +
-            leaf_gain(gr, hr, l1, l2, mds))
+def _split_gain(gl, hl, gr, hr, l1, l2, mds, mn=None, mx=None, mono=None):
+    """GetSplitGains (feature_histogram.hpp:456-465): child outputs are
+    clamped to the leaf's inherited [mn, mx] value constraint, and a
+    candidate violating the per-feature monotone direction (left output
+    above/below right) is discarded."""
+    lo = leaf_output(gl, hl, l1, l2, mds)
+    ro = leaf_output(gr, hr, l1, l2, mds)
+    if mn is not None:
+        lo = jnp.clip(lo, mn, mx)
+        ro = jnp.clip(ro, mn, mx)
+    g = (_gain_given_output(gl, hl, lo, l1, l2) +
+         _gain_given_output(gr, hr, ro, l1, l2))
+    if mono is not None:
+        viol = ((mono > 0) & (lo > ro)) | ((mono < 0) & (lo < ro))
+        g = jnp.where(viol, NEG_INF, g)
+    return g
 
 
 def _constraints(L, R, p: SplitParams, min_data_override=None):
@@ -89,11 +117,15 @@ def _constraints(L, R, p: SplitParams, min_data_override=None):
 def find_best_split(hist: jax.Array, parent: jax.Array,
                     num_bins: jax.Array, missing_type: jax.Array,
                     is_cat: jax.Array, feature_mask: jax.Array,
-                    params: SplitParams):
+                    params: SplitParams, monotone=None, penalty=None,
+                    min_output=None, max_output=None):
     """Find the best split for one leaf.
 
     hist: (F, B, 3) [sum_grad, sum_hess, count]; parent: (3,);
     num_bins/missing_type: (F,) int32; is_cat/feature_mask: (F,) bool.
+    monotone: optional (F,) int32 per-feature direction; penalty:
+    optional (F,) f32 gain multipliers; min_output/max_output: optional
+    scalar leaf-value bounds inherited from monotone ancestors.
 
     Returns dict(gain, feature, threshold, default_left, is_cat,
     left_mask(B,), left_stats(3,)) — gain is net (minus parent gain and
@@ -102,6 +134,7 @@ def find_best_split(hist: jax.Array, parent: jax.Array,
     p = params
     F, B, _ = hist.shape
     l1, l2, mds = p.lambda_l1, p.lambda_l2, p.max_delta_step
+    mn, mx = min_output, max_output
     parent_gain = leaf_gain(parent[0], parent[1], l1, l2, mds)
     gain_shift = parent_gain + p.min_gain_to_split
 
@@ -119,11 +152,14 @@ def find_best_split(hist: jax.Array, parent: jax.Array,
     cum = jnp.cumsum(hv, axis=1)  # (F, B, 3): left side for thr=j
     cand_ok = (jidx[None, :] <= nv[:, None] - 2) & ~is_cat[:, None]
 
+    mono_col = None if monotone is None else monotone[:, None]
+
     def scan_dir(default_left: bool):
         L = cum + (miss[:, None, :] if default_left else 0.0)
         R = parent[None, None, :] - L
         g = (_split_gain(L[..., 0], L[..., 1] + EPS,
-                         R[..., 0], R[..., 1] + EPS, l1, l2, mds)
+                         R[..., 0], R[..., 1] + EPS, l1, l2, mds,
+                         mn, mx, mono_col)
              - gain_shift)
         ok = cand_ok & _constraints(L, R, p)
         return jnp.where(ok, g, NEG_INF), L
@@ -146,8 +182,11 @@ def find_best_split(hist: jax.Array, parent: jax.Array,
         in_value & not_other
     Lc = hv  # singleton {k}
     Rc = parent[None, None, :] - Lc
+    # categorical splits clamp outputs but carry no monotone direction
+    # (feature_histogram.hpp:148 passes monotone 0)
     g_c = (_split_gain(Lc[..., 0], Lc[..., 1] + EPS,
-                       Rc[..., 0], Rc[..., 1] + EPS, l1, l2 + p.cat_l2, mds)
+                       Rc[..., 0], Rc[..., 1] + EPS, l1, l2 + p.cat_l2, mds,
+                       mn, mx)
            - gain_shift)
     cat1_gain = jnp.where(onehot_ok & _constraints(Lc, Rc, p), g_c, NEG_INF)
 
@@ -181,7 +220,8 @@ def find_best_split(hist: jax.Array, parent: jax.Array,
                 (jidx[None, :] + 1 < n_valid[:, None])
         Rs = parent[None, None, :] - Ls
         g = (_split_gain(Ls[..., 0], Ls[..., 1] + EPS,
-                         Rs[..., 0], Rs[..., 1] + EPS, l1, l2 + p.cat_l2, mds)
+                         Rs[..., 0], Rs[..., 1] + EPS, l1, l2 + p.cat_l2, mds,
+                         mn, mx)
              - gain_shift)
         ok = ok & many_ok & _constraints(Ls, Rs, p) & \
             (Ls[..., 2] >= p.min_data_per_group) & \
@@ -198,6 +238,11 @@ def find_best_split(hist: jax.Array, parent: jax.Array,
 
     # ---------------- combine --------------------------------------
     all_gain = jnp.where(is_cat[:, None], cat_gain, num_gain)  # (F, B)
+    if penalty is not None:
+        # feature_contri: net gain scaled per feature
+        # (feature_histogram.hpp:81 ``output->gain *= meta_->penalty``)
+        all_gain = jnp.where(all_gain > 0.5 * NEG_INF,
+                             all_gain * penalty[:, None], all_gain)
     all_gain = jnp.where(feature_mask[:, None], all_gain, NEG_INF)
     best_per_f = jnp.max(all_gain, axis=1)
     best_j = jnp.argmax(all_gain, axis=1).astype(jnp.int32)
@@ -240,4 +285,70 @@ def find_best_split(hist: jax.Array, parent: jax.Array,
         # per-feature best gains — the voting-parallel learner's ballot
         # (VotingParallelTreeLearner, parallel_tree_learner.h:100-180)
         "per_feature_gain": best_per_f,
+    }
+
+
+def eval_forced_split(hist: jax.Array, parent: jax.Array, feat, thr,
+                      num_bins: jax.Array, missing_type: jax.Array,
+                      params: SplitParams, monotone=None,
+                      min_output=None, max_output=None):
+    """Evaluate a NUMERICAL split at a fixed (feature, threshold-bin).
+
+    The forced-splits path (``SerialTreeLearner::ForceSplits``,
+    ``serial_tree_learner.cpp:544``; per-threshold stats gathered by
+    ``FeatureHistogram::GatherInfoForThreshold``): instead of scanning
+    all candidates, gather left/right stats at bin ``thr`` of feature
+    ``feat``, choosing the better missing default direction.  Returns
+    the same record dict as :func:`find_best_split` plus ``feasible``
+    (both children populated and net gain >= 0 — a forced split below
+    that aborts forcing, matching the reference's gain<0 erase).
+    """
+    p = params
+    F, B, _ = hist.shape
+    l1, l2, mds = p.lambda_l1, p.lambda_l2, p.max_delta_step
+    mn, mx = min_output, max_output
+    parent_gain = leaf_gain(parent[0], parent[1], l1, l2, mds)
+    gain_shift = parent_gain + p.min_gain_to_split
+
+    col = jax.lax.dynamic_index_in_dim(hist, feat, axis=0, keepdims=False)
+    nb_f = jax.lax.dynamic_index_in_dim(num_bins, feat, keepdims=False)
+    has_miss = jax.lax.dynamic_index_in_dim(
+        missing_type, feat, keepdims=False) != 0
+    nv_f = nb_f - has_miss.astype(jnp.int32)
+    jidx = jnp.arange(B, dtype=jnp.int32)
+    in_value = jidx < nv_f
+    colv = col * in_value[:, None]
+    thr = jnp.clip(thr, 0, B - 1)
+    cum = jnp.cumsum(colv, axis=0)
+    L_base = cum[thr]
+    miss = col[nb_f - 1] * has_miss
+    mono_f = None if monotone is None else \
+        jax.lax.dynamic_index_in_dim(monotone, feat, keepdims=False)
+
+    def one_dir(default_left: bool):
+        L = L_base + (miss if default_left else 0.0)
+        R = parent - L
+        g = (_split_gain(L[0], L[1] + EPS, R[0], R[1] + EPS,
+                         l1, l2, mds, mn, mx, mono_f) - gain_shift)
+        ok = (L[2] >= 1) & (R[2] >= 1) & (thr <= nv_f - 2)
+        return jnp.where(ok, g, NEG_INF), L
+
+    g_r, L_r = one_dir(False)
+    g_l, L_l = one_dir(True)
+    no_miss = miss[2] <= 0
+    g_l = jnp.where(no_miss, NEG_INF, g_l)
+    dir_left = g_l > g_r
+    gain = jnp.maximum(g_r, g_l)
+    left_stats = jnp.where(dir_left, L_l, L_r)
+    miss_bin_mask = has_miss & (jidx == nb_f - 1)
+    left_mask = ((jidx <= thr) & (jidx < nv_f)) | (dir_left & miss_bin_mask)
+    return {
+        "gain": gain,
+        "feature": feat,
+        "threshold": thr,
+        "default_left": dir_left,
+        "is_cat": jnp.asarray(False),
+        "left_mask": left_mask,
+        "left_stats": left_stats,
+        "feasible": gain >= 0,
     }

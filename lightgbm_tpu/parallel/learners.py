@@ -40,6 +40,22 @@ def make_mesh_for(num_shards: int):
     return jax.sharding.Mesh(np.asarray(devices), (AXIS_NAME,))
 
 
+def pad_rows_for(kind: str, num_shards: int, n: int, base: int = 1) -> int:
+    """Rows must split evenly over the mesh (and per-shard row count
+    must honor the histogram kernel's block size)."""
+    step = base if kind in ("feature", "serial", "") \
+        else base * num_shards
+    return (n + step - 1) // step * step
+
+
+def pad_features_for(kind: str, num_shards: int, f: int) -> int:
+    """Features must split evenly for the feature-block layouts."""
+    if kind in ("voting", "serial", ""):
+        return f
+    d = num_shards
+    return (f + d - 1) // d * d
+
+
 class DistributedBuilder:
     """Callable with :func:`build_tree`'s signature that runs it SPMD.
 
@@ -82,6 +98,10 @@ class DistributedBuilder:
             "leaf", "feature", "threshold", "default_left", "is_cat",
             "gain", "left_stats", "right_stats", "left_mask", "valid",
             "leaf_values", "leaf_stats", "n_leaves")}
+        if self.params.split.has_monotone:
+            for k in ("rec_left_min", "rec_left_max",
+                      "rec_right_min", "rec_right_max"):
+                out_specs[k] = R
         out_specs["leaf_idx"] = leaf_idx_spec
 
         fn = functools.partial(build_tree, params=self.params)
@@ -94,20 +114,10 @@ class DistributedBuilder:
 
     # ------------------------------------------------------------------
     def pad_rows(self, n: int, base: int = 1) -> int:
-        """Rows must split evenly over the mesh (and per-shard row count
-        must honor the histogram kernel's block size)."""
-        if self.kind == "feature":
-            step = base
-        else:
-            step = base * self.num_shards
-        return (n + step - 1) // step * step
+        return pad_rows_for(self.kind, self.num_shards, n, base)
 
     def pad_features(self, f: int) -> int:
-        """Features must split evenly for the feature-block layouts."""
-        if self.kind == "voting":
-            return f
-        d = self.num_shards
-        return (f + d - 1) // d * d
+        return pad_features_for(self.kind, self.num_shards, f)
 
     def __call__(self, xt, grad, hess, sample_mask, feature_mask,
                  num_bins, missing_type, is_cat, params=None):

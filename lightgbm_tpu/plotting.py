@@ -1,0 +1,268 @@
+"""Plotting utilities.
+
+Capability parity with ``python-package/lightgbm/plotting.py``
+(``plot_importance:30``, ``plot_metric:144``, ``create_tree_digraph:318``,
+``plot_tree:391``).  ``plot_tree`` renders natively with matplotlib (no
+graphviz binary needed); ``create_tree_digraph`` still produces a
+``graphviz.Digraph`` for users who have the toolchain.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from .basic import Booster
+from .utils.log import Log
+
+__all__ = ["plot_importance", "plot_metric", "plot_tree",
+           "create_tree_digraph"]
+
+
+def _check_not_tuple_of_2_elements(obj, obj_name: str) -> None:
+    if not isinstance(obj, (list, tuple)) or len(obj) != 2:
+        raise TypeError(f"{obj_name} must be a tuple of 2 elements")
+
+
+def _to_booster(obj) -> Booster:
+    if isinstance(obj, Booster):
+        return obj
+    booster = getattr(obj, "booster_", None)
+    if booster is not None:
+        return booster
+    raise TypeError("booster must be a Booster or a fitted LGBMModel")
+
+
+def plot_importance(booster, ax=None, height: float = 0.2,
+                    xlim=None, ylim=None,
+                    title: str = "Feature importance",
+                    xlabel: str = "Feature importance",
+                    ylabel: str = "Features",
+                    importance_type: str = "split",
+                    max_num_features: Optional[int] = None,
+                    ignore_zero: bool = True, figsize=None, grid: bool = True,
+                    precision: Optional[int] = 3, **kwargs):
+    """Horizontal bar chart of feature importance
+    (``plotting.py:30``)."""
+    import matplotlib.pyplot as plt
+
+    booster = _to_booster(booster)
+    importance = booster.feature_importance(importance_type=importance_type)
+    feature_name = booster.feature_name()
+    if not len(importance):
+        raise ValueError("Booster's feature_importance is empty")
+
+    tuples = sorted(zip(feature_name, importance), key=lambda x: x[1])
+    if ignore_zero:
+        tuples = [t for t in tuples if t[1] > 0]
+    if max_num_features is not None and max_num_features > 0:
+        tuples = tuples[-max_num_features:]
+    labels, values = zip(*tuples) if tuples else ((), ())
+
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize)
+    ylocs = np.arange(len(values))
+    ax.barh(ylocs, values, align="center", height=height, **kwargs)
+    for x, y in zip(values, ylocs):
+        txt = f"{x:.{precision}f}" if isinstance(x, float) and precision \
+            else str(x)
+        ax.text(x + 1, y, txt, va="center")
+    ax.set_yticks(ylocs)
+    ax.set_yticklabels(labels)
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+    else:
+        xlim = (0, max(values) * 1.1 if values else 1)
+    ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+    else:
+        ylim = (-1, len(values))
+    ax.set_ylim(ylim)
+    if title:
+        ax.set_title(title)
+    if xlabel:
+        ax.set_xlabel(xlabel)
+    if ylabel:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_metric(booster_or_record, metric: Optional[str] = None,
+                dataset_names=None, ax=None, xlim=None, ylim=None,
+                title: str = "Metric during training",
+                xlabel: str = "Iterations", ylabel: str = "auto",
+                figsize=None, grid: bool = True):
+    """Plot one metric's curves from an evals_result dict or a Booster
+    trained with ``record_evaluation`` (``plotting.py:144``)."""
+    import matplotlib.pyplot as plt
+
+    if isinstance(booster_or_record, dict):
+        eval_results = booster_or_record
+    else:
+        raise TypeError("booster_or_record must be the evals_result dict "
+                        "recorded by record_evaluation()")
+    if not eval_results:
+        raise ValueError("eval results cannot be empty")
+
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize)
+
+    names = list(dataset_names) if dataset_names else list(eval_results)
+    first = eval_results[names[0]]
+    if metric is None:
+        metric = next(iter(first))
+    elif metric not in first:
+        raise ValueError(f"Specified metric {metric!r} not found")
+    for name in names:
+        results = eval_results[name][metric]
+        ax.plot(range(len(results)), results, label=name)
+    ax.legend(loc="best")
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+        ax.set_ylim(ylim)
+    if title:
+        ax.set_title(title)
+    if xlabel:
+        ax.set_xlabel(xlabel)
+    ax.set_ylabel(metric if ylabel == "auto" else ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def _node_label(node: Dict, show_info: List[str], precision: int,
+                feature_names) -> str:
+    if "split_feature" in node:
+        feat = node["split_feature"]
+        if feature_names is not None and feat < len(feature_names):
+            feat = feature_names[feat]
+        if node.get("decision_type") == "==":
+            op, thr = "=", node["threshold"]
+        else:
+            op, thr = "<=", f"{node['threshold']:.{precision}f}"
+        lines = [f"{feat} {op} {thr}"]
+        if "split_gain" in show_info:
+            lines.append(f"gain: {node['split_gain']:.{precision}f}")
+        if "internal_value" in show_info:
+            lines.append(f"value: {node['internal_value']:.{precision}f}")
+        if "internal_count" in show_info:
+            lines.append(f"count: {node['internal_count']}")
+        return "\n".join(lines)
+    lines = [f"leaf {node.get('leaf_index', '')}:",
+             f"{node['leaf_value']:.{precision}f}"]
+    if "leaf_count" in show_info and "leaf_count" in node:
+        lines.append(f"count: {node['leaf_count']}")
+    return "\n".join(lines)
+
+
+def _tree_layout(node: Dict, depth=0, x_next=None) -> Dict:
+    """Assign (x, y) positions bottom-up: leaves take consecutive x
+    slots, internal nodes center over their children."""
+    if x_next is None:
+        x_next = [0]
+    if "split_feature" not in node:
+        pos = {"x": x_next[0], "y": -depth}
+        x_next[0] += 1
+        return {"pos": pos, "node": node, "children": []}
+    lt = _tree_layout(node["left_child"], depth + 1, x_next)
+    rt = _tree_layout(node["right_child"], depth + 1, x_next)
+    pos = {"x": (lt["pos"]["x"] + rt["pos"]["x"]) / 2.0, "y": -depth}
+    return {"pos": pos, "node": node, "children": [lt, rt]}
+
+
+def plot_tree(booster, tree_index: int = 0, ax=None, figsize=None,
+              show_info: Optional[List[str]] = None, precision: int = 3,
+              **kwargs):
+    """Draw one tree with matplotlib (``plotting.py:391`` renders via
+    graphviz; this implementation is self-contained)."""
+    import matplotlib.pyplot as plt
+
+    booster = _to_booster(booster)
+    model = booster.dump_model()
+    if tree_index >= len(model["tree_info"]):
+        raise IndexError("tree_index is out of range")
+    tree = model["tree_info"][tree_index]["tree_structure"]
+    feature_names = model.get("feature_names")
+    show_info = show_info or []
+
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize or (12, 8))
+
+    layout = _tree_layout(tree, x_next=[0])
+
+    def draw(nd):
+        x, y = nd["pos"]["x"], nd["pos"]["y"]
+        is_leaf = not nd["children"]
+        ax.annotate(
+            _node_label(nd["node"], show_info, precision, feature_names),
+            (x, y), ha="center", va="center", fontsize=9,
+            bbox=dict(boxstyle="round",
+                      fc="lightyellow" if is_leaf else "lightblue",
+                      ec="gray"))
+        for i, ch in enumerate(nd["children"]):
+            cx, cy = ch["pos"]["x"], ch["pos"]["y"]
+            ax.plot([x, cx], [y - 0.12, cy + 0.12], "-", color="gray",
+                    lw=1, zorder=0)
+            ax.text((x + cx) / 2, (y + cy) / 2, "yes" if i == 0 else "no",
+                    fontsize=7, color="dimgray", ha="center")
+            draw(ch)
+
+    draw(layout)
+    ax.set_axis_off()
+    ax.margins(0.1)
+    return ax
+
+
+def create_tree_digraph(booster, tree_index: int = 0,
+                        show_info: Optional[List[str]] = None,
+                        precision: int = 3, name=None, comment=None,
+                        filename=None, directory=None, format=None,
+                        engine=None, encoding=None, graph_attr=None,
+                        node_attr=None, edge_attr=None, body=None,
+                        strict: bool = False):
+    """Build a ``graphviz.Digraph`` of one tree (``plotting.py:318``)."""
+    try:
+        import graphviz
+    except ImportError:
+        raise ImportError("You must install graphviz to use "
+                          "create_tree_digraph")
+
+    booster = _to_booster(booster)
+    model = booster.dump_model()
+    if tree_index >= len(model["tree_info"]):
+        raise IndexError("tree_index is out of range")
+    tree = model["tree_info"][tree_index]["tree_structure"]
+    feature_names = model.get("feature_names")
+    show_info = show_info or []
+
+    graph = graphviz.Digraph(
+        name=name, comment=comment, filename=filename, directory=directory,
+        format=format, engine=engine, encoding=encoding,
+        graph_attr=graph_attr, node_attr=node_attr, edge_attr=edge_attr,
+        body=body, strict=strict)
+
+    def add(node, parent=None, decision=None):
+        if "split_feature" in node:
+            nid = f"split{node['split_index']}"
+        else:
+            nid = f"leaf{node.get('leaf_index', id(node))}"
+        label = _node_label(node, show_info, precision, feature_names)
+        graph.node(nid, label=label.replace("\n", "\\n"))
+        if parent is not None:
+            graph.edge(parent, nid, label=decision)
+        if "split_feature" in node:
+            add(node["left_child"], nid, "yes")
+            add(node["right_child"], nid, "no")
+
+    add(tree)
+    return graph

@@ -1,0 +1,443 @@
+"""scikit-learn estimator API.
+
+Capability parity with ``python-package/lightgbm/sklearn.py``
+(``LGBMModel:133``, ``LGBMRegressor:667``, ``LGBMClassifier:693``,
+``LGBMRanker:821``): the same constructor surface, fitted attributes
+(``booster_``, ``best_score_``, ``feature_importances_``, ...), custom
+objective/metric adapters, and classifier label encoding — implemented
+over this package's :func:`~lightgbm_tpu.engine.train` rather than a
+ctypes bridge.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .engine import train as _train_fn
+from .utils.log import Log
+
+try:  # sklearn integration is optional, like the reference's compat shims
+    from sklearn.base import BaseEstimator as _SKBase
+    from sklearn.base import ClassifierMixin as _SKClassifier
+    from sklearn.base import RegressorMixin as _SKRegressor
+    _SKLEARN = True
+except ImportError:  # pragma: no cover
+    _SKBase = object
+
+    class _SKClassifier:  # type: ignore
+        pass
+
+    class _SKRegressor:  # type: ignore
+        pass
+    _SKLEARN = False
+
+__all__ = ["LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker"]
+
+
+def _adapt_sklearn_fobj(func: Callable) -> Callable:
+    """Wrap an sklearn-style objective ``f(y_true, y_pred) -> (grad,
+    hess)`` into the engine's ``f(preds, dataset)`` protocol."""
+    def inner(preds, dataset):
+        return func(dataset.get_label(), preds)
+    return inner
+
+
+def _adapt_sklearn_feval(func: Callable) -> Callable:
+    """Wrap ``f(y_true, y_pred) -> (name, value, higher_better)``."""
+    def inner(preds, dataset):
+        return func(dataset.get_label(), preds)
+    return inner
+
+
+class LGBMModel(_SKBase):
+    """Base estimator (reference ``sklearn.py:133``)."""
+
+    def __init__(self, boosting_type: str = "gbdt", num_leaves: int = 31,
+                 max_depth: int = -1, learning_rate: float = 0.1,
+                 n_estimators: int = 100, subsample_for_bin: int = 200000,
+                 objective: Optional[str] = None, class_weight=None,
+                 min_split_gain: float = 0.0, min_child_weight: float = 1e-3,
+                 min_child_samples: int = 20, subsample: float = 1.0,
+                 subsample_freq: int = 0, colsample_bytree: float = 1.0,
+                 reg_alpha: float = 0.0, reg_lambda: float = 0.0,
+                 random_state=None, n_jobs: int = -1, silent: bool = True,
+                 importance_type: str = "split", **kwargs):
+        self.boosting_type = boosting_type
+        self.num_leaves = num_leaves
+        self.max_depth = max_depth
+        self.learning_rate = learning_rate
+        self.n_estimators = n_estimators
+        self.subsample_for_bin = subsample_for_bin
+        self.objective = objective
+        self.class_weight = class_weight
+        self.min_split_gain = min_split_gain
+        self.min_child_weight = min_child_weight
+        self.min_child_samples = min_child_samples
+        self.subsample = subsample
+        self.subsample_freq = subsample_freq
+        self.colsample_bytree = colsample_bytree
+        self.reg_alpha = reg_alpha
+        self.reg_lambda = reg_lambda
+        self.random_state = random_state
+        self.n_jobs = n_jobs
+        self.silent = silent
+        self.importance_type = importance_type
+        self._other_params: Dict[str, Any] = dict(kwargs)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self._Booster: Optional[Booster] = None
+        self._evals_result = None
+        self._best_score = {}
+        self._best_iteration = -1
+        self._n_features = -1
+        self._classes = None
+        self._n_classes = -1
+        self._objective = objective
+
+    # -- sklearn plumbing ------------------------------------------------
+    def get_params(self, deep: bool = True) -> Dict[str, Any]:
+        params = (super().get_params(deep=deep) if _SKLEARN
+                  else {k: getattr(self, k) for k in (
+                      "boosting_type", "num_leaves", "max_depth",
+                      "learning_rate", "n_estimators", "subsample_for_bin",
+                      "objective", "class_weight", "min_split_gain",
+                      "min_child_weight", "min_child_samples", "subsample",
+                      "subsample_freq", "colsample_bytree", "reg_alpha",
+                      "reg_lambda", "random_state", "n_jobs", "silent",
+                      "importance_type")})
+        params.update(self._other_params)
+        return params
+
+    def set_params(self, **params) -> "LGBMModel":
+        for k, v in params.items():
+            setattr(self, k, v)
+            if not hasattr(type(self), k):
+                self._other_params[k] = v
+        return self
+
+    # -- fitting ---------------------------------------------------------
+    def _engine_params(self) -> Dict[str, Any]:
+        """Translate the sklearn constructor names to engine params."""
+        params = self.get_params()
+        params.pop("silent", None)
+        params.pop("importance_type", None)
+        params.pop("n_estimators", None)
+        params.pop("class_weight", None)
+        ren = {"boosting_type": "boosting",
+               "min_split_gain": "min_gain_to_split",
+               "min_child_weight": "min_sum_hessian_in_leaf",
+               "min_child_samples": "min_data_in_leaf",
+               "subsample": "bagging_fraction",
+               "subsample_freq": "bagging_freq",
+               "colsample_bytree": "feature_fraction",
+               "reg_alpha": "lambda_l1",
+               "reg_lambda": "lambda_l2",
+               "subsample_for_bin": "bin_construct_sample_cnt",
+               "random_state": "seed",
+               "n_jobs": "num_threads"}
+        for src, dst in ren.items():
+            if src in params:
+                v = params.pop(src)
+                if v is not None:
+                    params[dst] = v
+        if params.get("seed") is None:
+            params.pop("seed", None)
+        if callable(params.get("objective")):
+            params.pop("objective")
+        elif params.get("objective") is None:
+            params["objective"] = self._default_objective()
+        params.setdefault("verbose", -1 if self.silent else 1)
+        return params
+
+    def _default_objective(self) -> str:
+        return "regression"
+
+    def _fit_param_overrides(self) -> Dict[str, Any]:
+        return {}
+
+    def fit(self, X, y, sample_weight=None, init_score=None, group=None,
+            eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_class_weight=None, eval_init_score=None, eval_group=None,
+            eval_metric=None, early_stopping_rounds=None, verbose=True,
+            feature_name="auto", categorical_feature="auto",
+            callbacks=None) -> "LGBMModel":
+        """Build the model from the training set (reference
+        ``sklearn.py:329``)."""
+        X = np.asarray(X, dtype=np.float64)
+        y = np.asarray(y, dtype=np.float64).reshape(-1)
+        self._n_features = X.shape[1]
+
+        fobj = None
+        if callable(self.objective):
+            fobj = _adapt_sklearn_fobj(self.objective)
+            self._objective = "none"
+        else:
+            self._objective = self._engine_params().get("objective")
+
+        params = self._engine_params()
+        if eval_metric is not None and not callable(eval_metric):
+            metrics = ([eval_metric] if isinstance(eval_metric, str)
+                       else list(eval_metric))
+            existing = params.get("metric")
+            if existing:
+                existing = ([existing] if isinstance(existing, str)
+                            else list(existing))
+                metrics = existing + [m for m in metrics
+                                      if m not in existing]
+            params["metric"] = metrics
+        feval = _adapt_sklearn_feval(eval_metric) if callable(eval_metric) \
+            else None
+        # per-fit overrides (num_class, eval_at) — deliberately NOT
+        # persisted on the estimator so refitting on different data
+        # cannot inherit stale settings
+        params.update(self._fit_param_overrides())
+
+        sample_weight = self._class_sample_weight(y, sample_weight)
+        train_set = Dataset(X, label=y, weight=sample_weight,
+                            group=group, init_score=init_score,
+                            feature_name=feature_name,
+                            categorical_feature=categorical_feature)
+
+        valid_sets: List[Dataset] = []
+        if eval_set is not None:
+            if isinstance(eval_set, tuple):
+                eval_set = [eval_set]
+            for i, (vx, vy) in enumerate(eval_set):
+                vx = np.asarray(vx, np.float64)
+                vy = self._encode_labels(np.asarray(vy).reshape(-1))
+                vw = self._meta_item(eval_sample_weight, i)
+                if eval_class_weight is not None:
+                    cw = self._meta_item(eval_class_weight, i)
+                    vw = self._class_sample_weight(vy, vw, cw)
+                if vx is X and vy.shape == y.shape and \
+                        np.array_equal(vy, y):
+                    valid_sets.append(train_set)
+                    continue
+                valid_sets.append(Dataset(
+                    vx, label=vy, weight=vw,
+                    group=self._meta_item(eval_group, i),
+                    init_score=self._meta_item(eval_init_score, i),
+                    reference=train_set))
+
+        evals_result: Dict = {}
+        self._Booster = _train_fn(
+            params, train_set, num_boost_round=self.n_estimators,
+            valid_sets=valid_sets or None, valid_names=eval_names,
+            fobj=fobj, feval=feval,
+            early_stopping_rounds=early_stopping_rounds,
+            evals_result=evals_result, verbose_eval=verbose,
+            callbacks=callbacks)
+        self._evals_result = evals_result
+        self._best_iteration = self._Booster.best_iteration
+        self._best_score = getattr(self._Booster, "best_score", {})
+        return self
+
+    @staticmethod
+    def _meta_item(collection, i):
+        if collection is None:
+            return None
+        if isinstance(collection, dict):
+            return collection.get(i)
+        return collection[i] if i < len(collection) else None
+
+    def _class_sample_weight(self, y, sample_weight, class_weight=None):
+        """Fold ``class_weight`` into per-row weights (the reference
+        delegates to sklearn's compute_sample_weight)."""
+        cw = class_weight if class_weight is not None else self.class_weight
+        if cw is None:
+            return sample_weight
+        if cw == "balanced":
+            classes, counts = np.unique(y, return_counts=True)
+            w_by_class = {c: len(y) / (len(classes) * cnt)
+                          for c, cnt in zip(classes, counts)}
+        elif isinstance(cw, dict):
+            # dict keys are ORIGINAL class labels; y may already be
+            # encoded to 0..K-1 by the classifier
+            w_by_class = self._translate_class_weight(cw)
+        else:
+            Log.fatal("class_weight must be 'balanced' or a dict")
+        w = np.asarray([w_by_class.get(v, 1.0) for v in y], np.float64)
+        if sample_weight is not None:
+            w = w * np.asarray(sample_weight, np.float64)
+        return w
+
+    def _encode_labels(self, y):
+        return y
+
+    def _translate_class_weight(self, cw: Dict) -> Dict:
+        return cw
+
+    # -- prediction -------------------------------------------------------
+    def predict(self, X, raw_score: bool = False,
+                num_iteration: Optional[int] = None,
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                **kwargs):
+        if self._Booster is None:
+            raise ValueError("Estimator not fitted; call fit first")
+        X = np.asarray(X, np.float64)
+        if X.shape[1] != self._n_features:
+            raise ValueError(
+                f"Number of features of the model must match the input. "
+                f"Model n_features_ is {self._n_features} and input "
+                f"n_features is {X.shape[1]}")
+        return self._Booster.predict(
+            X, raw_score=raw_score, num_iteration=num_iteration,
+            pred_leaf=pred_leaf, pred_contrib=pred_contrib)
+
+    # -- fitted attributes -------------------------------------------------
+    @property
+    def n_features_(self) -> int:
+        if self._n_features < 0:
+            raise ValueError("No n_features found; call fit first")
+        return self._n_features
+
+    @property
+    def best_score_(self):
+        return self._best_score
+
+    @property
+    def best_iteration_(self):
+        if self._Booster is None:
+            raise ValueError("No best_iteration found; call fit first")
+        return self._best_iteration
+
+    @property
+    def objective_(self):
+        if self._Booster is None:
+            raise ValueError("No objective found; call fit first")
+        return self._objective
+
+    @property
+    def booster_(self) -> Booster:
+        if self._Booster is None:
+            raise ValueError("No booster found; call fit first")
+        return self._Booster
+
+    @property
+    def evals_result_(self):
+        return self._evals_result
+
+    @property
+    def feature_importances_(self) -> np.ndarray:
+        if self._Booster is None:
+            raise ValueError("No feature_importances found; call fit first")
+        return self._Booster.feature_importance(
+            importance_type=self.importance_type)
+
+
+class LGBMRegressor(LGBMModel, _SKRegressor):
+    """Regression estimator (reference ``sklearn.py:667``)."""
+
+    def _default_objective(self) -> str:
+        return "regression"
+
+
+class LGBMClassifier(LGBMModel, _SKClassifier):
+    """Classification estimator (reference ``sklearn.py:693``)."""
+
+    def fit(self, X, y, **kwargs):
+        y = np.asarray(y).reshape(-1)
+        self._le_classes = np.unique(y)
+        self._classes = self._le_classes
+        self._n_classes = len(self._le_classes)
+        y_enc = np.searchsorted(self._le_classes, y).astype(np.float64)
+        super().fit(X, y_enc, **kwargs)
+        return self
+
+    def _default_objective(self) -> str:
+        return "multiclass" if self._n_classes > 2 else "binary"
+
+    def _fit_param_overrides(self) -> Dict[str, Any]:
+        # num_class accompanies any multiclass objective, whether the
+        # user set objective= explicitly or we defaulted it
+        if self._n_classes > 2:
+            return {"num_class": self._n_classes}
+        return {}
+
+    def _encode_labels(self, y):
+        if getattr(self, "_le_classes", None) is not None:
+            return np.searchsorted(self._le_classes, y).astype(np.float64)
+        return y
+
+    def _translate_class_weight(self, cw: Dict) -> Dict:
+        out = {}
+        for k, v in cw.items():
+            pos = np.nonzero(self._le_classes == k)[0]
+            if len(pos) == 0:
+                Log.warning("class_weight key %r not found in training "
+                            "labels", k)
+                continue
+            out[float(pos[0])] = v
+        return out
+
+    def predict(self, X, raw_score: bool = False,
+                num_iteration: Optional[int] = None,
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                **kwargs):
+        result = self.predict_proba(X, raw_score, num_iteration,
+                                    pred_leaf, pred_contrib, **kwargs)
+        if raw_score or pred_leaf or pred_contrib:
+            return result
+        idx = np.argmax(result, axis=1)
+        return self._classes[idx]
+
+    def predict_proba(self, X, raw_score: bool = False,
+                      num_iteration: Optional[int] = None,
+                      pred_leaf: bool = False, pred_contrib: bool = False,
+                      **kwargs):
+        result = super().predict(X, raw_score, num_iteration, pred_leaf,
+                                 pred_contrib, **kwargs)
+        if raw_score or pred_leaf or pred_contrib:
+            return result
+        if self._n_classes > 2:
+            return result
+        return np.column_stack((1.0 - result, result))
+
+    @property
+    def classes_(self):
+        if self._classes is None:
+            raise ValueError("No classes found; call fit first")
+        return self._classes
+
+    @property
+    def n_classes_(self) -> int:
+        if self._n_classes < 0:
+            raise ValueError("No classes found; call fit first")
+        return self._n_classes
+
+
+class LGBMRanker(LGBMModel):
+    """Ranking estimator (reference ``sklearn.py:821``)."""
+
+    def _default_objective(self) -> str:
+        return "lambdarank"
+
+    def _fit_param_overrides(self) -> Dict[str, Any]:
+        return {"eval_at": getattr(self, "_eval_at", [1])}
+
+    def fit(self, X, y, sample_weight=None, init_score=None, group=None,
+            eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_init_score=None, eval_group=None, eval_metric=None,
+            eval_at=(1,), early_stopping_rounds=None, verbose=True,
+            feature_name="auto", categorical_feature="auto",
+            callbacks=None) -> "LGBMRanker":
+        if group is None:
+            raise ValueError("Should set group for ranking task")
+        if eval_set is not None and eval_group is None:
+            raise ValueError("Eval_group cannot be None when eval_set "
+                             "is not None")
+        self._eval_at = list(eval_at)
+        super().fit(X, y, sample_weight=sample_weight,
+                    init_score=init_score, group=group, eval_set=eval_set,
+                    eval_names=eval_names,
+                    eval_sample_weight=eval_sample_weight,
+                    eval_init_score=eval_init_score, eval_group=eval_group,
+                    eval_metric=eval_metric,
+                    early_stopping_rounds=early_stopping_rounds,
+                    verbose=verbose, feature_name=feature_name,
+                    categorical_feature=categorical_feature,
+                    callbacks=callbacks)
+        return self

@@ -1,0 +1,154 @@
+"""Monotone constraints, feature penalties, forced splits.
+
+Mirrors the reference's ``test_engine.py:670`` monotone pattern and the
+``ForceSplits`` semantics (``serial_tree_learner.cpp:544``).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _monotone_data(rng, n=3000):
+    x1 = rng.random_sample(n)   # positively correlated with y
+    x2 = rng.random_sample(n)   # negatively correlated with y
+    x3 = rng.random_sample(n)   # irrelevant
+    X = np.column_stack((x1, x2, x3))
+    zs = rng.normal(loc=0.0, scale=0.01, size=n)
+    y = (5 * x1 + np.sin(10 * np.pi * x1)
+         - 5 * x2 - np.cos(10 * np.pi * x2) + zs)
+    return X, y
+
+
+def _is_correctly_constrained(bst, n=100):
+    variable_x = np.linspace(0, 1, n).reshape((n, 1))
+    for fx in np.linspace(0, 1, 20):
+        fixed = fx * np.ones((n, 1))
+        inc = bst.predict(np.column_stack((variable_x, fixed, fixed)))
+        dec = bst.predict(np.column_stack((fixed, variable_x, fixed)))
+        if not (np.diff(inc) >= -1e-10).all():
+            return False
+        if not (np.diff(dec) <= 1e-10).all():
+            return False
+    return True
+
+
+def test_monotone_constraints(rng):
+    X, y = _monotone_data(rng)
+    bst = lgb.train(
+        {"objective": "regression", "monotone_constraints": [1, -1, 0],
+         "num_leaves": 31, "min_data_in_leaf": 20, "verbose": -1},
+        lgb.Dataset(X, label=y), num_boost_round=30, verbose_eval=False)
+    assert _is_correctly_constrained(bst)
+    # unconstrained training on the same (wiggly) target violates
+    # monotonicity — proves the test can fail
+    un = lgb.train(
+        {"objective": "regression", "num_leaves": 31, "verbose": -1},
+        lgb.Dataset(X, label=y), num_boost_round=30, verbose_eval=False)
+    assert not _is_correctly_constrained(un)
+
+
+def test_monotone_trains_reasonably(rng):
+    X, y = _monotone_data(rng)
+    bst = lgb.train(
+        {"objective": "regression", "metric": "l2",
+         "monotone_constraints": [1, -1, 0], "num_leaves": 31,
+         "verbose": -1},
+        lgb.Dataset(X, label=y), num_boost_round=50, verbose_eval=False)
+    pred = bst.predict(X)
+    mse = float(np.mean((pred - y) ** 2))
+    assert mse < np.var(y) * 0.5  # much better than the mean predictor
+
+
+def test_feature_penalty(rng):
+    # a crushing penalty on the only informative feature stops it from
+    # being used
+    n = 1000
+    X = rng.randn(n, 3)
+    y = 2.0 * X[:, 0] + 0.01 * rng.randn(n)
+    params = {"objective": "regression", "num_leaves": 15, "verbose": -1}
+    bst = lgb.train(dict(params, feature_contri=[1e-12, 1.0, 1.0]),
+                    lgb.Dataset(X, label=y), num_boost_round=10,
+                    verbose_eval=False)
+    imp = bst.feature_importance(importance_type="split")
+    assert imp[0] == 0
+    # sanity: unpenalized training uses it heavily
+    bst2 = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=10,
+                     verbose_eval=False)
+    imp2 = bst2.feature_importance(importance_type="split")
+    assert imp2[0] > 0
+
+
+def test_forced_splits(rng, tmp_path):
+    n = 2000
+    X = rng.randn(n, 3)
+    y = X[:, 0] + 0.5 * X[:, 1] + 0.1 * rng.randn(n)
+    forced = {"feature": 2, "threshold": 0.0,
+              "left": {"feature": 2, "threshold": -1.0}}
+    fname = os.path.join(str(tmp_path), "forced.json")
+    with open(fname, "w") as f:
+        json.dump(forced, f)
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 8,
+         "min_data_in_leaf": 5, "forcedsplits_filename": fname,
+         "verbose": -1},
+        lgb.Dataset(X, label=y), num_boost_round=3, verbose_eval=False)
+    dump = bst.dump_model()
+    for tree in dump["tree_info"]:
+        root = tree["tree_structure"]
+        # the root split is forced onto feature 2 at threshold bin(0.0)
+        assert root["split_feature"] == 2
+        left = root["left_child"]
+        assert left.get("split_feature", None) == 2
+    # predictions still sane
+    pred = bst.predict(X)
+    assert np.all(np.isfinite(pred))
+
+
+def test_forced_splits_ignored_distributed(rng, tmp_path):
+    import jax
+    from lightgbm_tpu.parallel.learners import make_mesh_for
+    n = 512
+    X = rng.randn(n, 4)
+    y = X[:, 0] + 0.1 * rng.randn(n)
+    fname = os.path.join(str(tmp_path), "forced.json")
+    with open(fname, "w") as f:
+        json.dump({"feature": 1, "threshold": 0.0}, f)
+    mesh = make_mesh_for(4)
+    bst = lgb.train(
+        {"objective": "regression", "tree_learner": "data",
+         "num_leaves": 8, "min_data_in_leaf": 5,
+         "forcedsplits_filename": fname, "verbose": -1},
+        lgb.Dataset(X, label=y), num_boost_round=2, verbose_eval=False,
+        mesh=mesh)
+    assert np.all(np.isfinite(bst.predict(X)))
+
+
+def test_monotone_distributed_equals_serial(rng):
+    from lightgbm_tpu.parallel.learners import make_mesh_for
+    n = 1024
+    X = rng.randn(n, 4)
+    y = X[:, 0] - X[:, 1] + 0.05 * rng.randn(n)
+    params = {"objective": "regression", "num_leaves": 8,
+              "min_data_in_leaf": 10,
+              "monotone_constraints": [1, -1, 0, 0], "verbose": -1}
+    serial = lgb.train(params, lgb.Dataset(X, label=y),
+                       num_boost_round=3, verbose_eval=False)
+    mesh = make_mesh_for(4)
+    dist = lgb.train(dict(params, tree_learner="data"),
+                     lgb.Dataset(X, label=y), num_boost_round=3,
+                     verbose_eval=False, mesh=mesh)
+    # float-summation order under psum_scatter can reorder near-tie
+    # splits, so compare the models by their function, not their text
+    np.testing.assert_allclose(serial.predict(X), dist.predict(X),
+                               rtol=1e-6, atol=1e-7)
+    # the distributed model is itself monotone in the constrained dims
+    grid = np.linspace(X.min(), X.max(), 50).reshape(-1, 1)
+    fixed = np.zeros((50, 1))
+    inc = dist.predict(np.column_stack((grid, fixed, fixed, fixed)))
+    dec = dist.predict(np.column_stack((fixed, grid, fixed, fixed)))
+    assert (np.diff(inc) >= -1e-10).all()
+    assert (np.diff(dec) <= 1e-10).all()

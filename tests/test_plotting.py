@@ -1,0 +1,70 @@
+"""Plotting module (reference test_plotting.py patterns)."""
+import matplotlib
+
+matplotlib.use("Agg")  # noqa: E402 — headless
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def fitted(request):
+    rng = np.random.RandomState(0)
+    X = rng.randn(300, 4)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    evals = {}
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "metric": "binary_logloss",
+                     "num_leaves": 7, "verbose": -1}, train,
+                    num_boost_round=10, valid_sets=[train],
+                    evals_result=evals, verbose_eval=False)
+    return bst, evals
+
+
+def test_plot_importance(fitted):
+    bst, _ = fitted
+    ax = lgb.plot_importance(bst)
+    assert ax is not None
+    assert len(ax.patches) > 0
+    ax2 = lgb.plot_importance(bst, importance_type="gain",
+                              max_num_features=2)
+    assert len(ax2.patches) <= 2
+
+
+def test_plot_metric(fitted):
+    _, evals = fitted
+    ax = lgb.plot_metric(evals)
+    assert ax is not None
+    assert len(ax.lines) == 1
+    with pytest.raises(ValueError):
+        lgb.plot_metric(evals, metric="nonexistent")
+    with pytest.raises(TypeError):
+        lgb.plot_metric("not a dict")
+
+
+def test_plot_tree(fitted):
+    bst, _ = fitted
+    ax = lgb.plot_tree(bst, tree_index=0,
+                       show_info=["internal_count", "leaf_count"])
+    assert ax is not None
+    assert len(ax.texts) > 0
+    with pytest.raises(IndexError):
+        lgb.plot_tree(bst, tree_index=999)
+
+
+def test_create_tree_digraph(fitted):
+    pytest.importorskip("graphviz")
+    bst, _ = fitted
+    g = lgb.create_tree_digraph(bst, tree_index=1)
+    s = g.source
+    assert "leaf" in s and "split" in s
+
+
+def test_plot_with_sklearn_estimator(rng):
+    X = rng.randn(200, 3)
+    y = X[:, 0] + 0.1 * rng.randn(200)
+    reg = lgb.LGBMRegressor(n_estimators=5, num_leaves=7).fit(X, y)
+    ax = lgb.plot_importance(reg)
+    assert ax is not None

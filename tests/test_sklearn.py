@@ -1,0 +1,123 @@
+"""sklearn estimator API (reference test_sklearn.py patterns)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def test_regressor(rng):
+    X = rng.randn(500, 5)
+    y = X[:, 0] * 2 + X[:, 1] + 0.1 * rng.randn(500)
+    reg = lgb.LGBMRegressor(n_estimators=30, num_leaves=15)
+    reg.fit(X, y)
+    pred = reg.predict(X)
+    mse = float(np.mean((pred - y) ** 2))
+    assert mse < np.var(y) * 0.3
+    assert reg.n_features_ == 5
+    assert len(reg.feature_importances_) == 5
+    assert reg.feature_importances_[0] > 0
+
+
+def test_binary_classifier(binary_example):
+    X, y, Xt, yt = binary_example
+    clf = lgb.LGBMClassifier(n_estimators=30, num_leaves=31)
+    clf.fit(X, y)
+    assert set(clf.classes_) == {0.0, 1.0}
+    assert clf.n_classes_ == 2
+    proba = clf.predict_proba(Xt)
+    assert proba.shape == (len(yt), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+    pred = clf.predict(Xt)
+    acc = float(np.mean(pred == yt))
+    assert acc > 0.7
+
+
+def test_multiclass_classifier(rng):
+    X = rng.randn(600, 4)
+    y_raw = np.digitize(X[:, 0], [-0.5, 0.5])
+    # non-contiguous string-free labels exercise the encoder
+    labels = np.array([3, 7, 11])[y_raw]
+    clf = lgb.LGBMClassifier(n_estimators=20, num_leaves=7)
+    clf.fit(X, labels)
+    assert clf.n_classes_ == 3
+    assert list(clf.classes_) == [3, 7, 11]
+    proba = clf.predict_proba(X)
+    assert proba.shape == (600, 3)
+    pred = clf.predict(X)
+    assert set(np.unique(pred)).issubset({3, 7, 11})
+    acc = float(np.mean(pred == labels))
+    assert acc > 0.8
+
+
+def test_classifier_eval_set_early_stopping(binary_example):
+    X, y, Xt, yt = binary_example
+    clf = lgb.LGBMClassifier(n_estimators=200, num_leaves=31)
+    clf.fit(X, y, eval_set=[(Xt, yt)], eval_metric="auc",
+            early_stopping_rounds=5, verbose=False)
+    assert clf.best_iteration_ > 0
+    assert "valid_0" in clf.evals_result_
+    assert "auc" in clf.evals_result_["valid_0"]
+
+
+def test_ranker(rank_example):
+    X, y, q, Xt, yt, qt = rank_example
+    rk = lgb.LGBMRanker(n_estimators=20, num_leaves=15)
+    rk.fit(X, y, group=q, eval_set=[(Xt, yt)], eval_group=[qt],
+           eval_at=[1, 3], verbose=False)
+    assert "ndcg@1" in rk.evals_result_["valid_0"]
+    assert "ndcg@3" in rk.evals_result_["valid_0"]
+    pred = rk.predict(Xt)
+    assert pred.shape == (len(yt),)
+    with pytest.raises(ValueError):
+        lgb.LGBMRanker().fit(X, y)  # group required
+
+
+def test_custom_objective_regressor(rng):
+    X = rng.randn(400, 3)
+    y = X[:, 0] + 0.05 * rng.randn(400)
+
+    def l2_obj(y_true, y_pred):
+        return y_pred - y_true, np.ones_like(y_true)
+
+    reg = lgb.LGBMRegressor(n_estimators=20, num_leaves=7,
+                            objective=l2_obj)
+    reg.fit(X, y)
+    pred = reg.predict(X)
+    assert float(np.mean((pred - y) ** 2)) < np.var(y) * 0.5
+
+
+def test_get_set_params_clone():
+    reg = lgb.LGBMRegressor(n_estimators=10, num_leaves=7, max_bin=63)
+    params = reg.get_params()
+    assert params["n_estimators"] == 10
+    assert params["max_bin"] == 63
+    reg.set_params(num_leaves=15)
+    assert reg.get_params()["num_leaves"] == 15
+    try:
+        from sklearn.base import clone
+        cl = clone(reg)
+        assert cl.get_params()["num_leaves"] == 15
+    except ImportError:
+        pass
+
+
+def test_class_weight_balanced(rng):
+    X = rng.randn(1000, 3)
+    y = (X[:, 0] > 1.0).astype(int)  # imbalanced ~16%
+    clf = lgb.LGBMClassifier(n_estimators=20, num_leaves=7,
+                             class_weight="balanced")
+    clf.fit(X, y)
+    # balanced weighting should shift predicted positive rate upward
+    # relative to unweighted training
+    un = lgb.LGBMClassifier(n_estimators=20, num_leaves=7).fit(X, y)
+    assert clf.predict_proba(X)[:, 1].mean() > \
+        un.predict_proba(X)[:, 1].mean()
+
+
+def test_sklearn_pickle(binary_example, tmp_path):
+    import pickle
+    X, y, Xt, yt = binary_example
+    clf = lgb.LGBMClassifier(n_estimators=10, num_leaves=15).fit(X, y)
+    blob = pickle.dumps(clf)
+    clf2 = pickle.loads(blob)
+    np.testing.assert_array_equal(clf.predict(Xt), clf2.predict(Xt))

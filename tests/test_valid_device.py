@@ -1,0 +1,96 @@
+"""Device-resident validation-set scoring (split-record replay)."""
+import time
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _data(rng, n, f=10, missing=False):
+    X = rng.randn(n, f)
+    if missing:
+        X[rng.random_sample((n, f)) < 0.1] = np.nan
+    y = ((np.nan_to_num(X[:, 0]) + np.nan_to_num(X[:, 1])) > 0).astype(float)
+    return X, y
+
+
+def test_device_valid_matches_host_traversal(rng):
+    X, y = _data(rng, 3000, missing=True)
+    Xv, yv = _data(rng, 1000, missing=True)
+    train = lgb.Dataset(X, label=y)
+    valid = lgb.Dataset(Xv, label=yv, reference=train)
+    evals = {}
+    bst = lgb.train(
+        {"objective": "binary", "metric": ["binary_logloss", "auc"],
+         "num_leaves": 15, "verbose": -1},
+        train, num_boost_round=20, valid_sets=[valid],
+        evals_result=evals, verbose_eval=False)
+    # the accumulated device-routed score must equal a from-scratch
+    # host prediction of the final model
+    vs = bst._gbdt.valid_sets[0]
+    assert vs.xt is not None  # device path actually active
+    device_score = vs.score[0]
+    host_score = bst.predict(Xv, raw_score=True)
+    np.testing.assert_allclose(device_score, host_score, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_device_valid_multiclass(rng):
+    n = 1500
+    X = rng.randn(n, 6)
+    y = (np.digitize(X[:, 0], [-0.5, 0.5])).astype(float)
+    Xv = rng.randn(500, 6)
+    yv = (np.digitize(Xv[:, 0], [-0.5, 0.5])).astype(float)
+    train = lgb.Dataset(X, label=y)
+    valid = lgb.Dataset(Xv, label=yv, reference=train)
+    bst = lgb.train(
+        {"objective": "multiclass", "num_class": 3,
+         "metric": "multi_logloss", "num_leaves": 15, "verbose": -1},
+        train, num_boost_round=10, valid_sets=[valid], verbose_eval=False)
+    vs = bst._gbdt.valid_sets[0]
+    assert vs.xt is not None
+    np.testing.assert_allclose(vs.score.T, bst.predict(Xv, raw_score=True),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_device_valid_faster_than_host(rng):
+    """The device replay path must clearly beat per-row host traversal
+    (the verdict's O(trees x rows) eval bottleneck)."""
+    X, y = _data(rng, 4000)
+    Xv, yv = _data(rng, 300_000)
+    train = lgb.Dataset(X, label=y)
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "num_leaves": 31, "verbose": -1}
+
+    bst = lgb.train(params, train, num_boost_round=2, verbose_eval=False)
+    tree = bst._gbdt.models[-1]
+
+    valid = lgb.Dataset(Xv, label=yv, reference=train)
+    valid.construct()
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.grow import route_rows
+
+    g = lgb.train(params, train, num_boost_round=1, valid_sets=[
+        lgb.Dataset(Xv, label=yv, reference=train)],
+        verbose_eval=False)._gbdt
+    vs = g.valid_sets[0]
+
+    # host traversal timing
+    t0 = time.perf_counter()
+    tree.predict(Xv)
+    t_host = time.perf_counter() - t0
+
+    # device replay timing (records already on device from training)
+    xtv = vs.xt
+    rec = g._build_tree(g._xt, jnp.zeros(g._n_pad), jnp.ones(g._n_pad),
+                        g._base_mask, jnp.ones(g._F_pad, bool),
+                        g._num_bins, g._missing_type, g._is_cat,
+                        g.grow_params)
+    route_rows(xtv, rec["leaf"], rec["feature"], rec["left_mask"],
+               rec["valid"], g.config.num_leaves).block_until_ready()
+    t0 = time.perf_counter()
+    route_rows(xtv, rec["leaf"], rec["feature"], rec["left_mask"],
+               rec["valid"], g.config.num_leaves).block_until_ready()
+    t_dev = time.perf_counter() - t0
+
+    assert t_dev < t_host, (t_dev, t_host)
