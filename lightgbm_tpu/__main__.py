@@ -1,0 +1,203 @@
+"""CLI application: ``python -m lightgbm_tpu config=train.conf [k=v ...]``.
+
+Capability parity with the reference CLI (``src/application/
+application.cpp:30``, ``src/main.cpp``): ``key=value`` args merged over
+an optional config file, dispatch on ``task`` = train / predict /
+convert_model / refit, reading the reference's ``.conf`` format
+verbatim (the ``examples/*/train.conf`` files run unmodified).
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List
+
+import numpy as np
+
+from .config import Config
+from .utils.log import Log
+
+
+def _parse_args(argv: List[str]) -> Dict[str, str]:
+    """CLI ``key=value`` pairs + optional ``config=`` file
+    (``Application::LoadParameters``, ``application.cpp:48``): explicit
+    CLI keys win over config-file keys."""
+    cli: Dict[str, str] = {}
+    for a in argv:
+        if "=" not in a:
+            Log.fatal("unknown argument %r (expected key=value)", a)
+        k, v = a.split("=", 1)
+        cli[k.strip()] = v.strip()
+    conf_path = cli.get("config", cli.get("config_file", ""))
+    params: Dict[str, str] = {}
+    if conf_path:
+        with open(conf_path) as f:
+            params.update(Config.str2dict(f.read()))
+        # data paths inside a conf file are relative to the conf's dir
+        base = os.path.dirname(os.path.abspath(conf_path))
+        for key in ("data", "train", "train_data", "train_data_file",
+                    "valid", "test", "valid_data", "valid_data_file",
+                    "test_data", "input_model", "output_model",
+                    "output_result", "machine_list_filename",
+                    "machine_list_file", "machine_list", "mlist",
+                    "forcedsplits_filename", "forced_splits_filename",
+                    "forced_splits_file", "forced_splits"):
+            if key in params and params[key]:
+                p = params[key]
+                vals = []
+                for item in p.split(","):
+                    item = item.strip()
+                    if item and not os.path.isabs(item) and \
+                            not os.path.exists(item):
+                        cand = os.path.join(base, item)
+                        if os.path.exists(cand):
+                            item = cand
+                    vals.append(item)
+                params[key] = ",".join(vals)
+    params.update(cli)
+    params.pop("config", None)
+    params.pop("config_file", None)
+    return params
+
+
+def _task_train(params: Dict[str, str], config: Config) -> None:
+    from .basic import Booster, Dataset
+    from .engine import train
+
+    if not config.data:
+        Log.fatal("No training data: set data=<file>")
+    train_set = Dataset(config.data, params=params)
+    valid_sets, valid_names = [], []
+    if config.valid:
+        for i, path in enumerate(str(config.valid).split(",")):
+            path = path.strip()
+            if not path:
+                continue
+            valid_sets.append(Dataset(path, params=params,
+                                      reference=train_set))
+            valid_names.append(os.path.basename(path))
+
+    callbacks = []
+    if config.snapshot_freq > 0:
+        freq, out_path = config.snapshot_freq, config.output_model
+
+        def _snapshot(env):
+            i = env.iteration + 1
+            if i % freq == 0:
+                env.model.save_model(f"{out_path}.snapshot_iter_{i}")
+                Log.info("Saved snapshot at iteration %d", i)
+        callbacks.append(_snapshot)
+
+    init_model = config.input_model or None
+    booster = train(params, train_set,
+                    num_boost_round=config.num_iterations,
+                    valid_sets=valid_sets or None,
+                    valid_names=valid_names or None,
+                    init_model=init_model,
+                    callbacks=callbacks or None,
+                    verbose_eval=max(config.metric_freq, 1))
+    booster.save_model(config.output_model)
+    Log.info("Finished training; model saved to %s", config.output_model)
+
+
+def _task_predict(params: Dict[str, str], config: Config) -> None:
+    from .basic import Booster
+    from .io.parser import parse_file
+
+    if not config.input_model:
+        Log.fatal("No model file: set input_model=<file>")
+    if not config.data:
+        Log.fatal("No data to predict: set data=<file>")
+    booster = Booster(model_file=config.input_model)
+    X, _, _ = parse_file(config.data, header=config.header,
+                         label_column=config.label_column)
+    num_iteration = config.num_iteration_predict \
+        if config.num_iteration_predict > 0 else None
+    kw = {}
+    if config.pred_early_stop:
+        kw = {"pred_early_stop": True,
+              "pred_early_stop_freq": config.pred_early_stop_freq,
+              "pred_early_stop_margin": config.pred_early_stop_margin}
+    if config.predict_leaf_index:
+        out = booster.predict(X, num_iteration=num_iteration,
+                              pred_leaf=True)
+    elif config.predict_contrib:
+        out = booster.predict(X, num_iteration=num_iteration,
+                              pred_contrib=True)
+    elif config.predict_raw_score:
+        out = booster.predict(X, num_iteration=num_iteration,
+                              raw_score=True, **kw)
+    else:
+        out = booster.predict(X, num_iteration=num_iteration, **kw)
+    out = np.atleast_1d(np.asarray(out))
+    with open(config.output_result, "w") as f:
+        if out.ndim == 1:
+            f.writelines(f"{v:.18g}\n" for v in out)
+        else:
+            f.writelines("\t".join(f"{v:.18g}" for v in row) + "\n"
+                         for row in out)
+    Log.info("Finished prediction; results saved to %s",
+             config.output_result)
+
+
+def _task_convert_model(params: Dict[str, str], config: Config) -> None:
+    from .basic import Booster
+    from .models.codegen import model_to_ifelse
+
+    if not config.input_model:
+        Log.fatal("No model file: set input_model=<file>")
+    if config.convert_model_language not in ("", "cpp"):
+        Log.fatal("convert_model_language %r not supported (cpp only)",
+                  config.convert_model_language)
+    booster = Booster(model_file=config.input_model)
+    code = model_to_ifelse(booster._gbdt.models,
+                           booster._gbdt.num_tree_per_iteration,
+                           booster._objective_string())
+    with open(config.convert_model, "w") as f:
+        f.write(code)
+    Log.info("Finished converting model; code saved to %s",
+             config.convert_model)
+
+
+def _task_refit(params: Dict[str, str], config: Config) -> None:
+    from .basic import Booster
+    from .io.parser import parse_file
+
+    if not config.input_model:
+        Log.fatal("No model file: set input_model=<file>")
+    if not config.data:
+        Log.fatal("No data to refit with: set data=<file>")
+    booster = Booster(model_file=config.input_model)
+    X, y, _ = parse_file(config.data, header=config.header,
+                         label_column=config.label_column)
+    if y is None:
+        Log.fatal("refit requires labels in the data file")
+    booster.refit(X, y, decay_rate=config.refit_decay_rate)
+    booster.save_model(config.output_model)
+    Log.info("Finished refit; model saved to %s", config.output_model)
+
+
+def main(argv: List[str] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        print("tasks: train | predict | convert_model | refit")
+        return 0
+    params = _parse_args(argv)
+    config = Config(params)
+    task = config.task
+    if task == "train":
+        _task_train(params, config)
+    elif task in ("predict", "prediction", "test"):
+        _task_predict(params, config)
+    elif task == "convert_model":
+        _task_convert_model(params, config)
+    elif task in ("refit", "refit_tree"):
+        _task_refit(params, config)
+    else:
+        Log.fatal("unknown task %r", task)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -351,6 +351,14 @@ class Booster:
         self._gbdt.rollback_one_iter()
         return self
 
+    def refit(self, data, label, weight=None,
+              decay_rate: float = 0.9) -> "Booster":
+        """Refit the trees' leaf values to new data in place
+        (``GBDT::RefitTree``, ``gbdt.cpp:265``)."""
+        mat, _, _ = _to_matrix(data)
+        self._gbdt.refit(mat, label, weight=weight, decay_rate=decay_rate)
+        return self
+
     def current_iteration(self) -> int:
         return self._gbdt.iter
 
@@ -389,8 +397,19 @@ class Booster:
             from .ops.shap import predict_contrib
             return predict_contrib(self._gbdt.models, mat, ni,
                                    self._gbdt.num_tree_per_iteration)
+        es = {}
+        if kwargs.get("pred_early_stop"):
+            es = {"early_stop": True,
+                  "early_stop_freq": int(
+                      kwargs.get("pred_early_stop_freq", 10)),
+                  "early_stop_margin": float(
+                      kwargs.get("pred_early_stop_margin", 10.0))}
         if raw_score:
-            return self._gbdt.predict_raw(mat, ni)
+            return self._gbdt.predict_raw(mat, ni, **es)
+        if es:
+            raw = self._gbdt.predict_raw(mat, ni, **es)
+            obj = self._gbdt.objective
+            return obj.convert_output(raw) if obj is not None else raw
         return self._gbdt.predict(mat, ni)
 
     # ------------------------------------------------------------------

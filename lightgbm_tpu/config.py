@@ -99,9 +99,6 @@ PARAMS: List[Param] = [
     _p("feature_fraction", 1.0, float,
        ("sub_feature", "colsample_bytree"),
        "per-tree feature subsample fraction", group="learning", check="0<x<=1"),
-    _p("feature_fraction_bynode", 1.0, float,
-       ("sub_feature_bynode", "colsample_bynode"),
-       "per-node feature subsample fraction", group="learning"),
     _p("feature_fraction_seed", 2, int, (), "feature_fraction seed",
        group="learning"),
     _p("early_stopping_round", 0, int,
@@ -432,6 +429,31 @@ class Config:
                 if name not in self._user_set:
                     setattr(self, name, seed + offset)
         self._validate()
+        self._warn_inert()
+
+    # params accepted for reference-config compatibility but without
+    # effect in the TPU-native design (dense device bins, XLA
+    # collectives instead of sockets, one process per host)
+    _INERT = {
+        "two_round": "data loads in one pass on this backend",
+        "is_enable_sparse": "bins are dense device arrays",
+        "sparse_threshold": "bins are dense device arrays",
+        "machines": "distribution uses the JAX device mesh, not sockets",
+        "machine_list_filename": "distribution uses the JAX device mesh",
+        "local_listen_port": "distribution uses the JAX device mesh",
+        "time_out": "distribution uses the JAX device mesh",
+        "gpu_platform_id": "device selection is JAX_PLATFORMS",
+        "gpu_device_id": "device selection is JAX_PLATFORMS",
+        "gpu_use_dp": "histograms always accumulate in f32 hi/lo pairs",
+        "pre_partition": "single-process data loading",
+    }
+
+    def _warn_inert(self) -> None:
+        for name in sorted(self._user_set & set(self._INERT)):
+            default = next(p.default for p in PARAMS if p.name == name)
+            if getattr(self, name) != default:
+                Log.warning("parameter %s has no effect: %s", name,
+                            self._INERT[name])
 
     def _validate(self) -> None:
         if self.num_leaves < 2:

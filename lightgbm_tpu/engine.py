@@ -59,8 +59,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
     booster = Booster(params=params, train_set=train_set, mesh=mesh)
 
     if init_model is not None:
-        Log.warning("init_model continue-training is not wired yet; "
-                    "starting fresh")  # TODO round 2
+        prev = init_model if isinstance(init_model, Booster) \
+            else Booster(model_file=str(init_model))
+        booster._gbdt.init_from_model(prev._gbdt.models,
+                                      train_set.raw_mat)
 
     valid_sets = list(valid_sets) if valid_sets else []
     valid_names = list(valid_names) if valid_names else []

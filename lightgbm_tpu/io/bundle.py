@@ -1,0 +1,169 @@
+"""Exclusive Feature Bundling (EFB).
+
+Capability parity with the reference's greedy conflict-bounded bundling
+(``src/io/dataset.cpp:38-180``: ``FindGroups``, ``FastFeatureBundling``)
+re-designed for the dense TPU layout: bundles are capped at the
+histogram bin budget (the GPU learner's 256-bin-per-group rule,
+``gpu_tree_learner.h:67-70``) so the device histogram tensor keeps its
+``(groups, max_bin, 3)`` shape — wide sparse data shrinks the group
+axis instead of growing the bin axis.
+
+Bundle layout: bin 0 = "every member at its default"; member ``j``
+occupies ``num_bin_j - 1`` slots ``[offset_j, offset_j + num_bin_j - 1)``
+holding its non-default bins in order (its default bin is skipped and
+reconstructed from leaf totals at split time, like ``FixHistogram``,
+``dataset.h:411``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+from ..utils.log import Log
+
+
+@dataclasses.dataclass
+class FeatureBundles:
+    """Static bundling description over INNER (used) feature indices."""
+    groups: List[List[int]]        # inner feature ids per bundle
+    group_id: np.ndarray           # (F,) bundle owning each feature
+    offsets: np.ndarray            # (F,) bundle-bin offset of each feature
+    default_bin: np.ndarray        # (F,) each feature's skipped bin
+    group_num_bins: np.ndarray     # (G,) total bins per bundle
+    is_singleton: np.ndarray       # (G,) group holds exactly one feature
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.groups)
+
+    def to_bundle_map(self, B: int, num_bins: np.ndarray) -> np.ndarray:
+        """(F, B) feature-bin -> bundle-bin; -1 for the skipped default
+        bin and bins beyond the feature's own range."""
+        F = len(self.group_id)
+        out = np.full((F, B), -1, np.int32)
+        for f in range(F):
+            g = self.group_id[f]
+            if self.is_singleton[g]:
+                out[f] = np.arange(B)
+                continue
+            db = int(self.default_bin[f])
+            off = int(self.offsets[f])
+            for b in range(min(int(num_bins[f]), B)):
+                if b == db:
+                    continue
+                out[f, b] = off + b - (b > db)
+        return out
+
+    def from_bundle_map(self, B: int, num_bins: np.ndarray) -> np.ndarray:
+        """(F, B) bundle-bin -> feature-bin; positions outside the
+        feature's slot range (including bundle bin 0 and other members'
+        slots) resolve to the feature's default bin."""
+        F = len(self.group_id)
+        out = np.zeros((F, B), np.int32)
+        for f in range(F):
+            g = self.group_id[f]
+            if self.is_singleton[g]:
+                out[f] = np.arange(B)
+                continue
+            db = int(self.default_bin[f])
+            off = int(self.offsets[f])
+            nb = int(num_bins[f])
+            out[f, :] = db
+            for s in range(nb - 1):
+                b = s if s < db else s + 1
+                if off + s < B:
+                    out[f, off + s] = b
+        return out
+
+    def bundle_matrix(self, binned: np.ndarray) -> np.ndarray:
+        """(N, F) binned -> (N, G) bundled columns."""
+        N = binned.shape[0]
+        G = self.num_groups
+        dtype = binned.dtype
+        out = np.zeros((N, G), dtype=dtype)
+        for g, feats in enumerate(self.groups):
+            if self.is_singleton[g]:
+                out[:, g] = binned[:, feats[0]]
+                continue
+            col = np.zeros(N, np.int32)
+            for f in feats:
+                b = binned[:, f].astype(np.int32)
+                db = int(self.default_bin[f])
+                nz = b != db
+                val = self.offsets[f] + b - (b > db)
+                # later members overwrite on (rare) conflicts, like the
+                # reference's per-feature Push into a shared column
+                col[nz] = val[nz]
+            out[:, g] = col.astype(dtype)
+        return out
+
+
+def find_bundles(binned: np.ndarray, num_bins: np.ndarray,
+                 default_bin: np.ndarray, max_conflict_rate: float,
+                 bin_budget: int, sample_cnt: int = 50_000,
+                 seed: int = 1) -> FeatureBundles:
+    """Greedy conflict-bounded grouping (``FindGroups``,
+    ``dataset.cpp:66-135``): try two feature orders (original and
+    by descending non-default count) and keep the one with fewer
+    groups.  Conflicts are counted on a row sample, as the reference
+    counts them on its construction sample."""
+    N, F = binned.shape
+    rng = np.random.RandomState(seed & 0x7FFFFFFF)
+    if N > sample_cnt:
+        rows = rng.choice(N, size=sample_cnt, replace=False)
+        sample = binned[rows]
+    else:
+        sample = binned
+    S = sample.shape[0]
+    nz = sample != default_bin[None, :]          # (S, F) non-default
+    nz_cnt = nz.sum(axis=0)
+    max_error = int(S * max_conflict_rate)
+
+    def greedy(order):
+        groups: List[List[int]] = []
+        marks: List[np.ndarray] = []
+        conflict: List[int] = []
+        bins: List[int] = []
+        for f in order:
+            nb_extra = int(num_bins[f]) - 1
+            placed = False
+            for g in range(len(groups)):
+                if bins[g] + nb_extra > bin_budget:
+                    continue
+                cnt = int(np.count_nonzero(marks[g] & nz[:, f]))
+                if conflict[g] + cnt <= max_error:
+                    groups[g].append(f)
+                    marks[g] |= nz[:, f]
+                    conflict[g] += cnt
+                    bins[g] += nb_extra
+                    placed = True
+                    break
+            if not placed:
+                groups.append([f])
+                marks.append(nz[:, f].copy())
+                conflict.append(0)
+                bins.append(1 + nb_extra)
+        return groups
+
+    g1 = greedy(range(F))
+    g2 = greedy(list(np.argsort(-nz_cnt, kind="stable")))
+    groups = g2 if len(g2) < len(g1) else g1
+
+    group_id = np.zeros(F, np.int32)
+    offsets = np.zeros(F, np.int32)
+    gnb = np.zeros(len(groups), np.int32)
+    single = np.zeros(len(groups), bool)
+    for g, feats in enumerate(groups):
+        single[g] = len(feats) == 1
+        off = 1  # bundle bin 0 = all-default
+        for f in feats:
+            group_id[f] = g
+            offsets[f] = off
+            off += int(num_bins[f]) - 1
+        gnb[g] = int(num_bins[feats[0]]) if single[g] else off
+    return FeatureBundles(groups=[list(f) for f in groups],
+                          group_id=group_id, offsets=offsets,
+                          default_bin=np.asarray(default_bin, np.int32),
+                          group_num_bins=gnb, is_singleton=single)

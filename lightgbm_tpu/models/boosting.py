@@ -133,6 +133,16 @@ class DART(GBDT):
         self._drop_index: List[int] = []
         Log.info("Using DART")
 
+    def init_from_model(self, models, raw) -> None:
+        super().init_from_model(models, raw)
+        # seed per-iteration drop weights: each seeded tree's stored
+        # cumulative shrinkage is the best available estimate of its
+        # normalized DART weight
+        K = self.num_tree_per_iteration
+        self.tree_weight = [float(self.models[i * K].shrinkage)
+                            for i in range(self.iter)]
+        self.sum_weight = float(sum(self.tree_weight))
+
     # -- per-tree train contribution from the stored leaf assignment --
     def _train_contrib(self, model_idx: int):
         import jax.numpy as jnp

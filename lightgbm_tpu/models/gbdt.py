@@ -28,6 +28,12 @@ from .tree import Tree, cat_bitset
 _KEPS = 1e-15
 
 
+def _threshold_l1(s, l1):
+    if l1 <= 0:
+        return np.asarray(s, np.float64)
+    return np.sign(s) * np.maximum(np.abs(s) - l1, 0.0)
+
+
 @dataclasses.dataclass
 class ValidSet:
     name: str
@@ -119,6 +125,64 @@ class GBDT:
         monotone, penalty = self._constraint_tuples(config, train_set, F)
         forced = self._forced_splits(config, train_set, dist_active)
 
+        # EFB bundling (FindGroups/FastFeatureBundling,
+        # dataset.cpp:38-180): serial learner only; bundles capped at
+        # the histogram bin budget so the device tensors keep shape
+        self._bundles = None
+        self._bundle_maps = None
+        if config.enable_bundle and not dist_active and F > 1:
+            from ..io.binning import BIN_CATEGORICAL as _CAT
+            from ..io.bundle import find_bundles
+            db = np.asarray(
+                [0 if mappers[j].bin_type == _CAT else
+                 int(np.asarray(mappers[j].value_to_bin(
+                     np.zeros(1))).reshape(-1)[0])
+                 for j in range(F)], np.int32)
+            nb_arr = np.asarray([m.num_bin for m in mappers], np.int32)
+            bundles = find_bundles(
+                train_set.binned, nb_arr, db,
+                max_conflict_rate=config.max_conflict_rate,
+                bin_budget=min(config.max_bin, 255),
+                seed=config.data_random_seed)
+            # cost model for the one-hot-matmul histogram: work is
+            # columns x padded-bin-width, so bundling only pays when
+            # G x pow2(bundle bins) beats F x pow2(feature bins)
+            pow2 = lambda v: int(2 ** np.ceil(np.log2(max(int(v), 2))))
+            B_bun = pow2(bundles.group_num_bins.max())
+            cost_bundled = bundles.num_groups * B_bun
+            cost_plain = F * self.max_bin
+            if bundles.num_groups < F and cost_bundled < 0.95 * cost_plain:
+                self._bundles = bundles
+                self.max_bin = max(self.max_bin, B_bun)
+                B = self.max_bin
+                fix = np.zeros((F, B), np.float32)
+                for f in range(F):
+                    if not bundles.is_singleton[bundles.group_id[f]]:
+                        fix[f, db[f]] = 1.0
+                self._bundle_maps = (
+                    jnp.asarray(bundles.group_id),
+                    jnp.asarray(bundles.to_bundle_map(B, nb_arr)),
+                    jnp.asarray(bundles.from_bundle_map(B, nb_arr)),
+                    jnp.asarray(fix))
+                Log.info("EFB: bundled %d features into %d groups",
+                         F, bundles.num_groups)
+
+        # HistogramPool memory policy: the (L, G, B, 3) pool enables
+        # the subtraction trick; when it exceeds histogram_pool_size
+        # (or a 4 GB default), children are recomputed fresh instead
+        G_cols = self._bundles.num_groups if self._bundles else self._F_pad
+        pool_bytes = (config.num_leaves * G_cols * self.max_bin * 3 * 4)
+        cap = config.histogram_pool_size * 1e6 \
+            if config.histogram_pool_size > 0 else 4e9
+        use_pool = pool_bytes <= cap
+        if not use_pool and forced:
+            Log.warning("forced splits require the histogram pool; "
+                        "keeping the pool despite histogram_pool_size")
+            use_pool = True
+        if not use_pool:
+            Log.info("histogram pool (%.0f MB) exceeds budget; "
+                     "recomputing child histograms", pool_bytes / 1e6)
+
         self.grow_params = GrowParams(
             split=SplitParams(
                 max_bin=self.max_bin,
@@ -140,7 +204,9 @@ class GBDT:
             hist_impl="pallas" if use_pallas else "segsum",
             rows_per_block=rpb,
             dist=DistConfig(top_k=config.top_k),
-            forced=forced)
+            forced=forced,
+            bundled=self._bundles is not None,
+            use_hist_pool=use_pool)
 
         # parallel tree learner over the device mesh
         # (tree_learner={data,feature,voting}, tree_learner.cpp:9-33)
@@ -151,8 +217,13 @@ class GBDT:
                 learner, self.grow_params, num_shards, mesh)
             Log.info("tree_learner=%s over a %d-way device mesh",
                      learner, num_shards)
-        xt = train_set.binned.T.astype(np.int32)  # (F, N)
-        xt = np.pad(xt, ((0, self._F_pad - F), (0, self._n_pad - n)))
+        if self._bundles is not None:
+            xt = self._bundles.bundle_matrix(
+                train_set.binned).T.astype(np.int32)  # (G, N)
+        else:
+            xt = train_set.binned.T.astype(np.int32)  # (F, N)
+        col_pad = 0 if self._bundles is not None else self._F_pad - F
+        xt = np.pad(xt, ((0, col_pad), (0, self._n_pad - n)))
         self._xt = jnp.asarray(xt)
         self._base_mask = jnp.asarray(
             np.pad(np.ones(n, np.float32), (0, self._n_pad - n)))
@@ -263,8 +334,13 @@ class GBDT:
         for i, tree in enumerate(self.models):
             vs.score[i % self.num_tree_per_iteration] += tree.predict(raw)
         if binned is not None and self.num_features > 0:
-            xtv = binned.binned.T.astype(np.int32)  # (F, rows)
-            xtv = np.pad(xtv, ((0, self._F_pad - xtv.shape[0]), (0, 0)))
+            if self._bundles is not None:
+                xtv = self._bundles.bundle_matrix(
+                    binned.binned).T.astype(np.int32)  # (G, rows)
+            else:
+                xtv = binned.binned.T.astype(np.int32)  # (F, rows)
+                xtv = np.pad(xtv,
+                             ((0, self._F_pad - xtv.shape[0]), (0, 0)))
             vs.xt = jnp.asarray(xtv)
         self.valid_sets.append(vs)
 
@@ -288,13 +364,25 @@ class GBDT:
         (``GBDT::Bagging``, ``gbdt.cpp:182``); GOSS/MVS override using
         the gradient magnitudes."""
         cfg = self.config
-        if cfg.bagging_freq <= 0 or cfg.bagging_fraction >= 1.0:
+        pos_neg = (cfg.pos_bagging_fraction < 1.0 or
+                   cfg.neg_bagging_fraction < 1.0)
+        if cfg.bagging_freq <= 0 or \
+                (cfg.bagging_fraction >= 1.0 and not pos_neg):
             return None
         if self.iter % cfg.bagging_freq == 0:
             rng = np.random.RandomState(
                 (cfg.bagging_seed + self.iter) & 0x7FFFFFFF)
-            mask = (rng.random_sample(self.num_data) <
-                    cfg.bagging_fraction).astype(np.float32)
+            u = rng.random_sample(self.num_data)
+            if pos_neg:
+                # class-stratified bagging: positives/negatives sampled
+                # at their own fractions
+                pos = np.asarray(
+                    self.train_set.metadata.label)[:self.num_data] > 0
+                mask = np.where(pos, u < cfg.pos_bagging_fraction,
+                                u < cfg.neg_bagging_fraction
+                                ).astype(np.float32)
+            else:
+                mask = (u < cfg.bagging_fraction).astype(np.float32)
             self._cached_bag = mask
         return getattr(self, "_cached_bag", None)
 
@@ -365,6 +453,12 @@ class GBDT:
         if self.num_features == 0:
             rec = None
             n_leaves = 1
+        elif self._bundle_maps is not None:
+            rec = self._build_tree(self._xt, gp, hp, mask, fmask,
+                                   self._num_bins, self._missing_type,
+                                   self._is_cat, self.grow_params,
+                                   bundle_maps=self._bundle_maps)
+            n_leaves = int(rec["n_leaves"])
         else:
             rec = self._build_tree(self._xt, gp, hp, mask, fmask,
                                    self._num_bins, self._missing_type,
@@ -413,7 +507,8 @@ class GBDT:
             if vs.xt is not None:
                 li = route_rows(vs.xt, rec["leaf"], rec["feature"],
                                 rec["left_mask"], rec["valid"],
-                                self.config.num_leaves)
+                                self.config.num_leaves,
+                                bundle_maps=self._bundle_maps)
                 vs.score[tree_idx] += np.asarray(jnp.take(vals, li),
                                                  np.float64)
             else:
@@ -523,17 +618,40 @@ class GBDT:
         return out
 
     # ------------------------------------------------------------------
-    def predict_raw(self, X: np.ndarray, num_iteration: int = -1
-                    ) -> np.ndarray:
-        """Raw scores (rows,) or (rows, num_class)."""
+    def predict_raw(self, X: np.ndarray, num_iteration: int = -1,
+                    early_stop: bool = False, early_stop_freq: int = 10,
+                    early_stop_margin: float = 10.0) -> np.ndarray:
+        """Raw scores (rows,) or (rows, num_class).
+
+        ``early_stop``: per-row prediction early stopping
+        (``prediction_early_stop.cpp``): every ``early_stop_freq``
+        iterations, rows whose margin (|score| for binary, top1-top2
+        for multiclass) exceeds ``early_stop_margin`` stop accumulating
+        further trees."""
         X = np.ascontiguousarray(np.asarray(X, np.float64))
         k = self.num_tree_per_iteration
         n_trees = len(self.models)
         if num_iteration is not None and num_iteration > 0:
             n_trees = min(n_trees, num_iteration * k)
-        out = np.zeros((k, X.shape[0]), dtype=np.float64)
+        n = X.shape[0]
+        out = np.zeros((k, n), dtype=np.float64)
+        use_es = early_stop and k >= 1 and not self.average_output
+        active = np.ones(n, dtype=bool)
         for i in range(n_trees):
-            out[i % k] += self.models[i].predict(X)
+            if use_es and not np.all(active):
+                idx = np.nonzero(active)[0]
+                if len(idx) == 0:
+                    break
+                out[i % k, idx] += self.models[i].predict(X[idx])
+            else:
+                out[i % k] += self.models[i].predict(X)
+            if use_es and (i + 1) % (early_stop_freq * k) == 0:
+                if k == 1:
+                    margin = np.abs(out[0])
+                else:
+                    top2 = np.partition(out, k - 2, axis=0)[-2:]
+                    margin = top2[1] - top2[0]
+                active &= margin < early_stop_margin
         if self.average_output and n_trees:
             out /= max(n_trees // k, 1)
         return out[0] if k == 1 else out.T
@@ -552,6 +670,96 @@ class GBDT:
             n_trees = min(n_trees, num_iteration * self.num_tree_per_iteration)
         return np.stack([self.models[i].predict_leaf_index(X)
                          for i in range(n_trees)], axis=1)
+
+    def init_from_model(self, models: List[Tree],
+                        raw: Optional[np.ndarray]) -> None:
+        """Continue-training: seed this booster with an existing model's
+        trees (``engine.py`` init_model / ``application.cpp:90-93``) and
+        replay them into the training score.  ``raw`` is the training
+        set's raw feature matrix (the init model may have been trained
+        with different bin boundaries, so replay must use real values).
+        """
+        import jax.numpy as jnp
+        if len(models) % max(self.num_tree_per_iteration, 1):
+            Log.fatal("init model has %d trees, not a multiple of "
+                      "num_tree_per_iteration=%d", len(models),
+                      self.num_tree_per_iteration)
+        import copy
+        # deep-copy: later in-place mutations (DART renormalization,
+        # refit) must not corrupt the donor booster's trees
+        self.models = [copy.deepcopy(t) for t in models]
+        self.iter = len(models) // max(self.num_tree_per_iteration, 1)
+        if raw is None:
+            Log.fatal("continue-training requires the training set's raw "
+                      "matrix (free_raw_data=False)")
+        k = self.num_tree_per_iteration
+        add = np.zeros((k, self.num_data), np.float32)
+        for i, tree in enumerate(self.models):
+            add[i % k] += tree.predict(raw)
+        self._score = self._score + jnp.asarray(
+            np.pad(add, ((0, 0), (0, self._score.shape[1] - add.shape[1]))))
+        if self._track_train_leaf:
+            # DART needs per-tree train-leaf assignments to drop and
+            # renormalize the seeded trees
+            dt = np.uint8 if self.config.num_leaves <= 256 else np.uint16
+            self._train_leaf_idx = [
+                t.predict_leaf_index(raw).astype(dt) for t in self.models]
+
+    def refit(self, X: np.ndarray, y: np.ndarray, weight=None,
+              decay_rate: float = 0.9) -> None:
+        """Refit the existing trees' leaf values to new data
+        (``GBDT::RefitTree``, ``gbdt.cpp:265``;
+        ``SerialTreeLearner::FitByExistingTree``,
+        ``serial_tree_learner.cpp:223-252``): keep every tree's
+        structure, recompute each leaf's output from the new data's
+        gradient statistics at that leaf, and blend
+        ``decay_rate*old + (1-decay_rate)*new``."""
+        from ..ops.split import EPS
+        if self.objective is None:
+            Log.fatal("refit requires a built-in objective")
+        X = np.ascontiguousarray(np.asarray(X, np.float64))
+        n = X.shape[0]
+        meta = Metadata(n)
+        meta.set_label(np.asarray(y, np.float64).reshape(-1))
+        if weight is not None:
+            meta.set_weight(weight)
+        # a FRESH objective bound to the refit data — the training
+        # objective must stay bound to the train set (the reference's
+        # RefitTree reuses the training gradients buffer, but its
+        # objective is naturally re-pointed via leaf_pred; ours is
+        # stateful over Metadata)
+        objective = create_objective(self.config.objective, self.config)
+        objective.init(meta, n)
+        k = max(self.num_tree_per_iteration, 1)
+        # per-tree leaf assignment of the new data (rows, n_trees)
+        leaf_pred = np.stack([t.predict_leaf_index(X)
+                              for t in self.models], axis=1)
+        import jax.numpy as jnp
+        score = jnp.zeros((k, n), jnp.float32)
+        cfg = self.config
+        n_iters = len(self.models) // k
+        for it in range(n_iters):
+            g, h = objective.get_gradients(score)
+            g = np.atleast_2d(np.asarray(g))
+            h = np.atleast_2d(np.asarray(h))
+            for tree_id in range(k):
+                mi = it * k + tree_id
+                tree = self.models[mi]
+                lp = leaf_pred[:, mi]
+                nl = tree.num_leaves
+                sg = np.bincount(lp, weights=g[tree_id], minlength=nl)
+                sh = np.bincount(lp, weights=h[tree_id],
+                                 minlength=nl) + EPS
+                out = -_threshold_l1(sg, cfg.lambda_l1) / \
+                    (sh + cfg.lambda_l2)
+                if cfg.max_delta_step > 0:
+                    out = np.clip(out, -cfg.max_delta_step,
+                                  cfg.max_delta_step)
+                new_out = out * tree.shrinkage
+                tree.leaf_value[:nl] = (decay_rate * tree.leaf_value[:nl]
+                                        + (1.0 - decay_rate) * new_out)
+                score = score.at[tree_id].add(
+                    jnp.asarray(tree.leaf_value[lp], jnp.float32))
 
     def rollback_one_iter(self) -> None:
         """Undo the last iteration (``GBDT::RollbackOneIter``) using the

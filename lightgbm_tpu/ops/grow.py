@@ -76,6 +76,13 @@ class GrowParams:
     # order as (leaf_id, global_feature, threshold_bin) triples —
     # precomputed on host from the forcedsplits JSON; serial only
     forced: tuple = ()
+    # EFB: xt rows are bundles, not features; histograms expand to
+    # logical features at split time (serial learner only)
+    bundled: bool = False
+    # False = recompute both children's histograms fresh each split
+    # instead of keeping the (L, G, B, 3) pool for the subtraction
+    # trick — the HistogramPool memory policy (histogram_pool_size)
+    use_hist_pool: bool = True
 
 
 def _hist(xt, vals, p: GrowParams):
@@ -103,7 +110,7 @@ def _merge_best(best, axis):
 def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
                sample_mask: jax.Array, feature_mask: jax.Array,
                num_bins: jax.Array, missing_type: jax.Array,
-               is_cat: jax.Array, params: GrowParams):
+               is_cat: jax.Array, params: GrowParams, bundle_maps=None):
     """Grow one tree.
 
     xt: (F, N) binned features (transposed layout — contiguous per-feature
@@ -111,6 +118,14 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
     grad/hess/sample_mask: (N,) f32 (mask carries bagging weights and row
     padding); feature_mask: (F,) bool (feature_fraction);
     num_bins/missing_type: (F,) i32; is_cat: (F,) bool.
+
+    With ``params.bundled`` (EFB), xt is the (G, N) BUNDLE matrix and
+    ``bundle_maps`` = (group_id (F,), to_bundle (F, B),
+    from_bundle (F, B), fix_default (F, B) one-hot of the skipped
+    default bin, zero rows for singleton groups); histograms are built
+    per bundle and expanded to logical features for the split search,
+    the default bin reconstructed from leaf totals (``FixHistogram``,
+    ``dataset.h:411``).
 
     Under a distributed strategy all array arguments are the LOCAL
     shards (rows sharded for data/voting, features for feature) and the
@@ -121,8 +136,17 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
     """
     p = params
     L = p.num_leaves
-    F, N = xt.shape
     B = p.split.max_bin
+    if p.bundled:
+        assert p.dist.kind == "serial", \
+            "EFB bundling is supported by the serial learner only"
+        assert bundle_maps is not None
+        G_cols, N = xt.shape
+        F = num_bins.shape[0]
+        bm_group, bm_to, bm_from, bm_fix = bundle_maps
+    else:
+        F, N = xt.shape
+        G_cols = F
     sp = p.split
     dist = p.dist
     kind = dist.kind
@@ -154,13 +178,26 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         nb_l, mt_l, cat_l, fmask_l = (num_bins, missing_type, is_cat,
                                       feature_mask)
     else:
-        F_hist = F
+        F_hist = G_cols  # histogram rows = device columns (bundles)
         f_offset = jnp.int32(0)
         blk = lambda a: a
         nb_l, mt_l, cat_l, fmask_l = (num_bins, missing_type, is_cat,
                                       feature_mask)
     mono_l = blk(mono_g) if has_mono else None
     pen_l = blk(pen_g) if has_pen else None
+
+    def expand(hist_cols, stats):
+        """Bundle histogram (G, B, 3) -> logical features (F, B, 3):
+        gather each feature's slot range and rebuild its skipped
+        default bin from the leaf totals."""
+        if not p.bundled:
+            return hist_cols
+        hf = hist_cols[bm_group]                       # (F, B, 3)
+        idx = jnp.clip(bm_to, 0, B - 1)
+        hf = jnp.take_along_axis(hf, idx[..., None], axis=1)
+        hf = hf * (bm_to >= 0)[..., None]
+        rem = stats[None, :] - jnp.sum(hf, axis=1)     # (F, 3)
+        return hf + bm_fix[..., None] * rem[:, None, :]
 
     if kind == "voting":
         # local ballots use constraints scaled by 1/num_machines
@@ -194,8 +231,8 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         if kind == "voting":
             b = _best_voting(hist_leaf, stats, mn, mx)
         else:
-            b = find_best_split(hist_leaf, stats, nb_l, mt_l,
-                                cat_l, fmask_l, sp, monotone=mono_l,
+            b = find_best_split(expand(hist_leaf, stats), stats, nb_l,
+                                mt_l, cat_l, fmask_l, sp, monotone=mono_l,
                                 penalty=pen_l, min_output=mn,
                                 max_output=mx)
             b["feature"] = b["feature"] + f_offset
@@ -234,6 +271,16 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         """Row routing for the winning split.  For data/voting/serial the
         winner's column is locally present; for feature-parallel only the
         owner shard has it and broadcasts a one-bit mask."""
+        if p.bundled:
+            # translate the feature-bin mask onto the bundle's bins
+            g = jax.lax.dynamic_index_in_dim(bm_group, feat,
+                                             keepdims=False)
+            fb = jax.lax.dynamic_index_in_dim(bm_from, feat, axis=0,
+                                              keepdims=False)  # (B,)
+            col = jax.lax.dynamic_index_in_dim(xt, g, axis=0,
+                                               keepdims=False)
+            bundle_mask = jnp.take(left_mask_row, fb)
+            return jnp.take(bundle_mask, col.astype(jnp.int32))
         if kind == "feature":
             local_f = feat - f_offset
             owner = (local_f >= 0) & (local_f < F)
@@ -260,6 +307,8 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
     if n_forced:
         assert kind == "serial", \
             "forced splits are supported by the serial learner only"
+        assert p.use_hist_pool, \
+            "forced splits require the histogram pool"
         leaves, feats, thrs = (list(x) for x in zip(*p.forced))
         pad = [0] * ((L - 1) - n_forced)
         forced_leaf = jnp.asarray((leaves + pad)[:L - 1], jnp.int32)
@@ -268,8 +317,6 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
 
     state = {
         "leaf_idx": leaf_idx,
-        "hist": jnp.zeros((L, F_hist, B, 3), jnp.float32).at[0].set(
-            root_hist),
         "leaf_stats": jnp.zeros((L, 3), jnp.float32).at[0].set(root_stats),
         "leaf_depth": jnp.zeros(L, jnp.int32),
         "best_gain": jnp.full(L, NEG_INF, jnp.float32).at[0].set(
@@ -297,6 +344,11 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         "rec_valid": jnp.zeros(L - 1, bool),
         "n_leaves": jnp.int32(1),
     }
+    if p.use_hist_pool:
+        # the HistogramPool analog: per-leaf histograms enabling the
+        # parent-minus-smaller-child subtraction trick
+        state["hist"] = jnp.zeros((L, F_hist, B, 3),
+                                  jnp.float32).at[0].set(root_hist)
     if has_mono:
         # per-leaf inherited output bounds (LeafSplits min/max
         # constraint propagation, leaf_splits.hpp:16)
@@ -321,7 +373,8 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
             f_mn = st["leaf_min"][fl] if has_mono else None
             f_mx = st["leaf_max"][fl] if has_mono else None
             frec = eval_forced_split(
-                st["hist"][fl], st["leaf_stats"][fl], forced_feat[t],
+                expand(st["hist"][fl], st["leaf_stats"][fl]),
+                st["leaf_stats"][fl], forced_feat[t],
                 forced_thr[t], nb_l, mt_l, sp, monotone=mono_l,
                 min_output=f_mn, max_output=f_mx)
             usef = in_force & frec["feasible"]
@@ -356,12 +409,19 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
             left_stats = cand["left_stats"]
             parent_stats = st["leaf_stats"][l]
             right_stats = parent_stats - left_stats
-            small_is_left = left_stats[2] <= right_stats[2]
-            small_id = jnp.where(small_is_left, l, new)
-            hist_small = masked_hist(leaf_idx, small_id)
-            hist_large = st["hist"][l] - hist_small
-            hist_l = jnp.where(small_is_left, hist_small, hist_large)
-            hist_r = jnp.where(small_is_left, hist_large, hist_small)
+            if p.use_hist_pool:
+                # subtraction trick: smaller child from scratch,
+                # larger = parent − smaller (:506-511)
+                small_is_left = left_stats[2] <= right_stats[2]
+                small_id = jnp.where(small_is_left, l, new)
+                hist_small = masked_hist(leaf_idx, small_id)
+                hist_large = st["hist"][l] - hist_small
+                hist_l = jnp.where(small_is_left, hist_small, hist_large)
+                hist_r = jnp.where(small_is_left, hist_large, hist_small)
+            else:
+                # no-pool memory policy: two fresh passes, nothing kept
+                hist_l = masked_hist(leaf_idx, l)
+                hist_r = masked_hist(leaf_idx, new)
 
             depth = st["leaf_depth"][l] + 1
             if has_mono:
@@ -392,7 +452,9 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
 
             st = dict(st)
             st["leaf_idx"] = leaf_idx
-            st["hist"] = st["hist"].at[l].set(hist_l).at[new].set(hist_r)
+            if p.use_hist_pool:
+                st["hist"] = st["hist"].at[l].set(hist_l) \
+                                       .at[new].set(hist_r)
             st["leaf_stats"] = st["leaf_stats"].at[l].set(left_stats) \
                                                .at[new].set(right_stats)
             st["leaf_depth"] = st["leaf_depth"].at[l].set(depth) \
@@ -484,7 +546,7 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
 @functools.partial(jax.jit, static_argnames=("num_leaves",))
 def route_rows(xt: jax.Array, rec_leaf: jax.Array, rec_feature: jax.Array,
                rec_left_mask: jax.Array, rec_valid: jax.Array,
-               num_leaves: int) -> jax.Array:
+               num_leaves: int, bundle_maps=None) -> jax.Array:
     """Replay a tree's split records over a binned matrix.
 
     Routes every row of ``xt`` (F, N binned ints) through the splits
@@ -493,14 +555,31 @@ def route_rows(xt: jax.Array, rec_leaf: jax.Array, rec_feature: jax.Array,
     TPU-first replacement for the reference's per-row tree traversal in
     ``ScoreUpdater::AddScore`` (``score_updater.hpp:17``): one gather
     per split instead of a host walk per row.
+
+    With ``bundle_maps`` (EFB), xt is the (G, N) bundle matrix and the
+    per-feature bin masks are translated onto bundle bins.
     """
     N = xt.shape[1]
     leaf_idx = jnp.zeros(N, dtype=jnp.int32)
+    bundled = bundle_maps is not None
+    if bundled:
+        bm_group, _, bm_from, _ = bundle_maps
 
     def body(t, li):
         feat = rec_feature[t]
-        col = jax.lax.dynamic_index_in_dim(xt, feat, axis=0, keepdims=False)
-        goes_left = jnp.take(rec_left_mask[t], col.astype(jnp.int32))
+        mask_row = rec_left_mask[t]
+        if bundled:
+            g = jax.lax.dynamic_index_in_dim(bm_group, feat,
+                                             keepdims=False)
+            fb = jax.lax.dynamic_index_in_dim(bm_from, feat, axis=0,
+                                              keepdims=False)
+            col = jax.lax.dynamic_index_in_dim(xt, g, axis=0,
+                                               keepdims=False)
+            mask_row = jnp.take(mask_row, fb)
+        else:
+            col = jax.lax.dynamic_index_in_dim(xt, feat, axis=0,
+                                               keepdims=False)
+        goes_left = jnp.take(mask_row, col.astype(jnp.int32))
         mine = li == rec_leaf[t]
         move = rec_valid[t] & mine & ~goes_left
         return jnp.where(move, jnp.int32(t + 1), li)

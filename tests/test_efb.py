@@ -1,0 +1,113 @@
+"""Exclusive Feature Bundling (EFB) correctness and memory policy."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io.bundle import find_bundles
+
+
+def _sparse_onehot_data(rng, n=3000, groups=8, cards=6):
+    """One-hot indicator blocks: classic perfectly-exclusive,
+    low-cardinality features (the case where bundling shrinks the
+    histogram work; high-cardinality sparse columns exhaust the bin
+    budget and correctly stay unbundled)."""
+    cols = []
+    signal = np.zeros(n)
+    for g in range(groups):
+        cat = rng.randint(0, cards, size=n)
+        block = np.zeros((n, cards))
+        block[np.arange(n), cat] = 1.0
+        cols.append(block)
+        signal += (cat == 0) * (g + 1) * 0.3
+    X = np.concatenate(cols, axis=1)
+    y = signal + 0.05 * rng.randn(n)
+    return X, y
+
+
+def test_find_bundles_onehot(rng):
+    X, y = _sparse_onehot_data(rng)
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    t = ds._constructed
+    F = len(t.used_features)
+    db = np.asarray([int(np.asarray(t.mappers[f].value_to_bin(
+        np.zeros(1))).reshape(-1)[0]) for f in t.used_features])
+    nb = np.asarray([t.mappers[f].num_bin for f in t.used_features])
+    bundles = find_bundles(t.binned, nb, db, max_conflict_rate=0.0,
+                           bin_budget=256)
+    # 8 groups x 6 exclusive columns collapse to ~8 bundles
+    assert bundles.num_groups <= F // 3
+    # every feature appears in exactly one group
+    all_feats = sorted(f for g in bundles.groups for f in g)
+    assert all_feats == list(range(F))
+
+
+def test_bundled_training_matches_unbundled(rng):
+    X, y = _sparse_onehot_data(rng)
+    params = {"objective": "regression", "num_leaves": 15,
+              "min_data_in_leaf": 10, "verbose": -1}
+    a = lgb.train(dict(params, enable_bundle=True),
+                  lgb.Dataset(X, label=y), num_boost_round=8,
+                  verbose_eval=False)
+    b = lgb.train(dict(params, enable_bundle=False),
+                  lgb.Dataset(X, label=y), num_boost_round=8,
+                  verbose_eval=False)
+    assert a._gbdt._bundles is not None      # bundling actually active
+    assert b._gbdt._bundles is None
+    # identical predictions: bundling is exact when conflict rate is 0
+    np.testing.assert_allclose(a.predict(X), b.predict(X),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_bundled_valid_sets_and_metrics(rng):
+    X, y = _sparse_onehot_data(rng, n=2000)
+    Xv, yv = _sparse_onehot_data(rng, n=700)
+    evals = {}
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "metric": "l2",
+                     "num_leaves": 15, "verbose": -1},
+                    train, num_boost_round=10,
+                    valid_sets=[lgb.Dataset(Xv, label=yv,
+                                            reference=train)],
+                    evals_result=evals, verbose_eval=False)
+    assert bst._gbdt._bundles is not None
+    vs = bst._gbdt.valid_sets[0]
+    assert vs.xt is not None
+    # device-accumulated valid score equals a fresh host prediction
+    np.testing.assert_allclose(vs.score[0],
+                               bst.predict(Xv, raw_score=True),
+                               rtol=1e-5, atol=1e-6)
+    l2 = evals["valid_0"]["l2"]
+    assert l2[-1] < l2[0]
+
+
+def test_no_pool_mode_matches_pooled(rng):
+    X = rng.randn(1500, 6)
+    y = X[:, 0] - X[:, 1] + 0.05 * rng.randn(1500)
+    params = {"objective": "regression", "num_leaves": 15, "verbose": -1}
+    pooled = lgb.train(params, lgb.Dataset(X, label=y),
+                       num_boost_round=5, verbose_eval=False)
+    # a 1-byte pool budget forces the no-pool path
+    nopool = lgb.train(dict(params, histogram_pool_size=1e-6),
+                       lgb.Dataset(X, label=y), num_boost_round=5,
+                       verbose_eval=False)
+    assert pooled._gbdt.grow_params.use_hist_pool
+    assert not nopool._gbdt.grow_params.use_hist_pool
+    # fresh-histogram children are exact (no subtraction error), so
+    # models agree to float tolerance
+    np.testing.assert_allclose(pooled.predict(X), nopool.predict(X),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_epsilon_shaped_wide_sparse(rng):
+    """400-feature one-hot-ish wide data trains with a bounded
+    histogram pool (the Epsilon/Bosch scenario, scaled for CI)."""
+    X, y = _sparse_onehot_data(rng, n=4000, groups=40, cards=15)  # 600 cols
+    bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                     "verbose": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=5, verbose_eval=False)
+    g = bst._gbdt
+    assert g._bundles is not None
+    assert g._bundles.num_groups < 100  # ~40 bundles + change
+    pred = bst.predict(X)
+    assert float(np.mean((pred - y) ** 2)) < np.var(y) * 0.6
