@@ -1,0 +1,393 @@
+// Native data-loading fast path.
+//
+// Capability parity with the reference's C++ text pipeline
+// (src/io/parser.cpp Parser, include/LightGBM/utils/text_reader.h
+// TextReader, pipeline_reader.h): multithreaded parsing of dense
+// CSV/TSV/space tables and LibSVM files into row-major double
+// matrices. Exposed as a C ABI consumed by ctypes
+// (lightgbm_tpu/io/native.py); semantics must match the Python parser
+// in lightgbm_tpu/io/parser.py (NaN tokens, libsvm densification).
+
+#include <algorithm>
+#include <atomic>
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <limits>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Matrix {
+  std::vector<double> data;
+  int64_t rows = 0;
+  int64_t cols = 0;
+};
+
+bool ReadWholeFile(const char* path, std::string* out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  if (size < 0) { std::fclose(f); return false; }
+  out->resize(static_cast<size_t>(size));
+  size_t got = size ? std::fread(&(*out)[0], 1, size, f) : 0;
+  std::fclose(f);
+  return got == static_cast<size_t>(size);
+}
+
+// line start offsets (skipping blank lines)
+std::vector<size_t> LineStarts(const std::string& buf) {
+  std::vector<size_t> starts;
+  size_t i = 0, n = buf.size();
+  while (i < n) {
+    size_t j = buf.find('\n', i);
+    if (j == std::string::npos) j = n;
+    size_t k = i;
+    while (k < j && std::isspace(static_cast<unsigned char>(buf[k]))) ++k;
+    if (k < j) starts.push_back(i);
+    i = j + 1;
+  }
+  return starts;
+}
+
+inline size_t LineEnd(const std::string& buf, size_t start) {
+  size_t j = buf.find('\n', start);
+  if (j == std::string::npos) j = buf.size();
+  while (j > start && (buf[j - 1] == '\r')) --j;
+  return j;
+}
+
+// parse one token; non-numeric ("na", "?", "null", empty) -> NaN,
+// matching parser.py _safe_float
+inline double ParseToken(const char* s, const char* end) {
+  while (s < end && (*s == ' ' || *s == '\t')) ++s;
+  if (s >= end) return std::nan("");
+  char* stop = nullptr;
+  double v = std::strtod(s, &stop);
+  if (stop == s) return std::nan("");
+  return v;
+}
+
+int NumThreads(int64_t lines) {
+  unsigned hw = std::thread::hardware_concurrency();
+  if (hw == 0) hw = 4;
+  int64_t t = static_cast<int64_t>(hw);
+  if (lines < 4096) t = 1;
+  return static_cast<int>(t > 64 ? 64 : t);
+}
+
+template <typename Fn>
+void ParallelFor(int64_t n, Fn fn) {
+  int nt = NumThreads(n);
+  if (nt <= 1) {
+    fn(0, n);
+    return;
+  }
+  std::vector<std::thread> threads;
+  int64_t chunk = (n + nt - 1) / nt;
+  for (int t = 0; t < nt; ++t) {
+    int64_t lo = t * chunk, hi = lo + chunk > n ? n : lo + chunk;
+    if (lo >= hi) break;
+    threads.emplace_back([=] { fn(lo, hi); });
+  }
+  for (auto& th : threads) th.join();
+}
+
+int CountColumns(const std::string& buf, size_t start, char sep) {
+  size_t end = LineEnd(buf, start);
+  int cols = 1;
+  if (sep) {
+    for (size_t i = start; i < end; ++i)
+      if (buf[i] == sep) ++cols;
+  } else {
+    cols = 0;
+    size_t i = start;
+    while (i < end) {
+      while (i < end && std::isspace(static_cast<unsigned char>(buf[i])))
+        ++i;
+      if (i < end) {
+        ++cols;
+        while (i < end && !std::isspace(static_cast<unsigned char>(buf[i])))
+          ++i;
+      }
+    }
+  }
+  return cols;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse a dense table. sep: ',' '\t' ' ' or 0 for any-whitespace.
+// Returns an opaque Matrix*; null on error.
+void* ltpu_parse_dense(const char* path, char sep, int skip_header,
+                       int64_t* out_rows, int64_t* out_cols) {
+  std::string buf;
+  if (!ReadWholeFile(path, &buf)) return nullptr;
+  std::vector<size_t> starts = LineStarts(buf);
+  size_t first = skip_header ? 1 : 0;
+  if (starts.size() < first) return nullptr;
+  int64_t rows = static_cast<int64_t>(starts.size() - first);
+  auto* m = new Matrix();
+  if (rows == 0) {
+    *out_rows = 0;
+    *out_cols = 0;
+    return m;
+  }
+  int cols = CountColumns(buf, starts[first], sep);
+  m->rows = rows;
+  m->cols = cols;
+  m->data.resize(static_cast<size_t>(rows) * cols);
+  std::atomic<bool> ok{true};
+
+  ParallelFor(rows, [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; ++r) {
+      size_t s = starts[first + r];
+      size_t e = LineEnd(buf, s);
+      double* out = &m->data[static_cast<size_t>(r) * cols];
+      const char* p = buf.data() + s;
+      const char* end = buf.data() + e;
+      int c = 0;
+      if (sep) {
+        while (c < cols) {
+          const char* q = static_cast<const char*>(
+              memchr(p, sep, static_cast<size_t>(end - p)));
+          const char* tok_end = q ? q : end;
+          out[c++] = ParseToken(p, tok_end);
+          if (!q) break;
+          p = q + 1;
+        }
+      } else {
+        while (c < cols && p < end) {
+          while (p < end && std::isspace(static_cast<unsigned char>(*p)))
+            ++p;
+          if (p >= end) break;
+          const char* q = p;
+          while (q < end && !std::isspace(static_cast<unsigned char>(*q)))
+            ++q;
+          out[c++] = ParseToken(p, q);
+          p = q;
+        }
+      }
+      if (c != cols) ok.store(false, std::memory_order_relaxed);
+      for (; c < cols; ++c) out[c] = std::nan("");
+    }
+  });
+  if (!ok.load()) {
+    delete m;
+    return nullptr;  // ragged rows: let the python parser decide
+  }
+  *out_rows = m->rows;
+  *out_cols = m->cols;
+  return m;
+}
+
+// Parse LibSVM into a dense matrix with the label in column 0 and
+// feature j at column j+1 (missing pairs are 0.0, reference sparse
+// semantics).
+void* ltpu_parse_libsvm(const char* path, int skip_header,
+                        int64_t* out_rows, int64_t* out_cols) {
+  std::string buf;
+  if (!ReadWholeFile(path, &buf)) return nullptr;
+  std::vector<size_t> starts = LineStarts(buf);
+  size_t first = skip_header ? 1 : 0;
+  int64_t rows = static_cast<int64_t>(
+      starts.size() > first ? starts.size() - first : 0);
+  // pass 1: max feature index
+  int nt = NumThreads(rows);
+  std::vector<int64_t> max_idx(nt > 0 ? nt : 1, -1);
+  std::atomic<int> tid{0};
+  ParallelFor(rows, [&](int64_t lo, int64_t hi) {
+    int my = tid.fetch_add(1);
+    int64_t mx = -1;
+    for (int64_t r = lo; r < hi; ++r) {
+      size_t s = starts[first + r];
+      size_t e = LineEnd(buf, s);
+      const char* p = buf.data() + s;
+      const char* end = buf.data() + e;
+      // skip label token
+      while (p < end && !std::isspace(static_cast<unsigned char>(*p))) ++p;
+      while (p < end) {
+        while (p < end && std::isspace(static_cast<unsigned char>(*p))) ++p;
+        const char* q = p;
+        while (q < end && *q != ':' &&
+               !std::isspace(static_cast<unsigned char>(*q)))
+          ++q;
+        if (q < end && *q == ':') {
+          int64_t idx = std::strtoll(p, nullptr, 10);
+          if (idx > mx) mx = idx;
+          p = q + 1;
+          while (p < end && !std::isspace(static_cast<unsigned char>(*p)))
+            ++p;
+        } else {
+          p = q;
+        }
+      }
+    }
+    if (my < static_cast<int>(max_idx.size())) max_idx[my] = mx;
+  });
+  int64_t mx = -1;
+  for (int64_t v : max_idx) mx = v > mx ? v : mx;
+  auto* m = new Matrix();
+  m->rows = rows;
+  m->cols = mx + 2;  // label + features 0..mx
+  m->data.assign(static_cast<size_t>(m->rows) * m->cols, 0.0);
+  ParallelFor(rows, [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; ++r) {
+      size_t s = starts[first + r];
+      size_t e = LineEnd(buf, s);
+      const char* p = buf.data() + s;
+      const char* end = buf.data() + e;
+      double* out = &m->data[static_cast<size_t>(r) * m->cols];
+      const char* q = p;
+      while (q < end && !std::isspace(static_cast<unsigned char>(*q))) ++q;
+      out[0] = ParseToken(p, q);
+      p = q;
+      while (p < end) {
+        while (p < end && std::isspace(static_cast<unsigned char>(*p))) ++p;
+        q = p;
+        while (q < end && *q != ':' &&
+               !std::isspace(static_cast<unsigned char>(*q)))
+          ++q;
+        if (q < end && *q == ':') {
+          int64_t idx = std::strtoll(p, nullptr, 10);
+          const char* v = q + 1;
+          const char* ve = v;
+          while (ve < end && !std::isspace(static_cast<unsigned char>(*ve)))
+            ++ve;
+          if (idx >= 0 && idx <= mx) out[idx + 1] = ParseToken(v, ve);
+          p = ve;
+        } else {
+          p = q;
+        }
+      }
+    }
+  });
+  *out_rows = m->rows;
+  *out_cols = m->cols;
+  return m;
+}
+
+const double* ltpu_matrix_data(void* h) {
+  return static_cast<Matrix*>(h)->data.data();
+}
+
+void ltpu_matrix_free(void* h) { delete static_cast<Matrix*>(h); }
+
+int ltpu_abi_version(void) { return 1; }
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------
+// Binning fast paths (port of lightgbm_tpu/io/binning.py semantics,
+// themselves mirroring BinMapper::FindBin / ValueToBin, src/io/bin.cpp)
+// ---------------------------------------------------------------------
+
+extern "C" {
+
+// Greedy equal-frequency boundary search over (distinct, count) pairs.
+// Returns the number of boundaries written to out_bounds (the +inf
+// terminator included).  Mirrors _find_boundaries in io/binning.py.
+int ltpu_find_boundaries(const double* distinct, const int64_t* counts,
+                         int64_t n_distinct, int max_bin,
+                         int64_t total_cnt, int min_data_in_bin,
+                         double kzero, double* out_bounds) {
+  auto midpoint = [&](double a, double b) {
+    double m = (a + b) / 2.0;
+    if (m > -kzero && m < kzero) m = (b <= 0) ? -kzero : kzero;
+    return m;
+  };
+  const double kInf = std::numeric_limits<double>::infinity();
+  int nb = 0;
+  if (n_distinct == 0) {
+    out_bounds[nb++] = kInf;
+    return nb;
+  }
+  if (n_distinct <= max_bin) {
+    int64_t cur = 0;
+    for (int64_t i = 0; i + 1 < n_distinct; ++i) {
+      cur += counts[i];
+      if (cur >= min_data_in_bin) {
+        out_bounds[nb++] = midpoint(distinct[i], distinct[i + 1]);
+        cur = 0;
+      }
+    }
+    out_bounds[nb++] = kInf;
+    return nb;
+  }
+  if (min_data_in_bin > 0) {
+    int64_t cap = total_cnt / min_data_in_bin;
+    if (cap < max_bin) max_bin = static_cast<int>(cap);
+    if (max_bin < 1) max_bin = 1;
+  }
+  double mean_size = static_cast<double>(total_cnt) / max_bin;
+  std::vector<bool> is_big(n_distinct);
+  int64_t n_big = 0, rest_total = 0;
+  for (int64_t i = 0; i < n_distinct; ++i) {
+    is_big[i] = counts[i] >= mean_size;
+    if (is_big[i]) ++n_big; else rest_total += counts[i];
+  }
+  int64_t rest_bins = max_bin - n_big;
+  mean_size = static_cast<double>(rest_total) /
+              (rest_bins > 1 ? rest_bins : 1);
+  int64_t cur = 0;
+  for (int64_t i = 0; i + 1 < n_distinct; ++i) {
+    if (!is_big[i]) rest_total -= counts[i];
+    cur += counts[i];
+    if (is_big[i] || cur >= mean_size ||
+        (is_big[i + 1] &&
+         cur >= (mean_size * 0.5 > 1.0 ? mean_size * 0.5 : 1.0))) {
+      out_bounds[nb++] = midpoint(distinct[i], distinct[i + 1]);
+      if (nb >= max_bin - 1) break;
+      cur = 0;
+      if (!is_big[i]) {
+        --rest_bins;
+        mean_size = static_cast<double>(rest_total) /
+                    (rest_bins > 1 ? rest_bins : 1);
+      }
+    }
+  }
+  out_bounds[nb++] = kInf;
+  return nb;
+}
+
+// Vectorized multithreaded value -> bin for NUMERICAL features
+// (BinMapper::ValueToBin, bin.h:452-488; port of value_to_bin's
+// numerical branches).  missing_type: 0=None, 1=Zero, 2=NaN.
+void ltpu_value_to_bin(const double* vals, int64_t n, const double* ub,
+                       int64_t n_ub, int missing_type, int num_bin,
+                       double kzero, int32_t* out) {
+  int n_val = num_bin - 1;  // value bins when a missing bin exists
+  ParallelFor(n, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      double v = vals[i];
+      bool vnan = std::isnan(v);
+      if (missing_type == 2) {  // NaN bin
+        if (vnan) { out[i] = num_bin - 1; continue; }
+        int64_t cap = n_val < n_ub ? n_val : n_ub;
+        int64_t idx = std::lower_bound(ub, ub + cap, v) - ub;
+        out[i] = static_cast<int32_t>(idx < n_val - 1 ? idx : n_val - 1);
+      } else if (missing_type == 1) {  // zero bin
+        bool zero = vnan || std::fabs(v) <= kzero;
+        if (zero) { out[i] = num_bin - 1; continue; }
+        int64_t idx = std::lower_bound(ub, ub + n_ub, v) - ub;
+        out[i] = static_cast<int32_t>(idx < n_val - 1 ? idx : n_val - 1);
+      } else {
+        if (vnan) v = 0.0;
+        int64_t idx = std::lower_bound(ub, ub + n_ub, v) - ub;
+        out[i] = static_cast<int32_t>(idx < num_bin - 1 ? idx
+                                                        : num_bin - 1);
+      }
+    }
+  });
+}
+
+}  // extern "C"

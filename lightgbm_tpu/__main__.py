@@ -108,9 +108,16 @@ def _task_predict(params: Dict[str, str], config: Config) -> None:
         Log.fatal("No model file: set input_model=<file>")
     if not config.data:
         Log.fatal("No data to predict: set data=<file>")
+    from .io.parser import parse_file_full
     booster = Booster(model_file=config.input_model)
-    X, _, _ = parse_file(config.data, header=config.header,
-                         label_column=config.label_column)
+    # drop the same non-feature columns training dropped, or feature
+    # indices shift against the trained model
+    X, _, _, _, _ = parse_file_full(
+        config.data, header=config.header,
+        label_column=config.label_column,
+        ignore_columns=config.ignore_column,
+        weight_column=config.weight_column,
+        group_column=config.group_column)
     num_iteration = config.num_iteration_predict \
         if config.num_iteration_predict > 0 else None
     kw = {}
@@ -167,12 +174,17 @@ def _task_refit(params: Dict[str, str], config: Config) -> None:
         Log.fatal("No model file: set input_model=<file>")
     if not config.data:
         Log.fatal("No data to refit with: set data=<file>")
+    from .io.parser import parse_file_full
     booster = Booster(model_file=config.input_model)
-    X, y, _ = parse_file(config.data, header=config.header,
-                         label_column=config.label_column)
+    X, y, _, w, _ = parse_file_full(
+        config.data, header=config.header,
+        label_column=config.label_column,
+        ignore_columns=config.ignore_column,
+        weight_column=config.weight_column,
+        group_column=config.group_column)
     if y is None:
         Log.fatal("refit requires labels in the data file")
-    booster.refit(X, y, decay_rate=config.refit_decay_rate)
+    booster.refit(X, y, weight=w, decay_rate=config.refit_decay_rate)
     booster.save_model(config.output_model)
     Log.info("Finished refit; model saved to %s", config.output_model)
 

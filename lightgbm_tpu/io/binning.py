@@ -42,6 +42,11 @@ def _find_boundaries(distinct: np.ndarray, counts: np.ndarray,
     least ``min_data_in_bin`` samples (when feasible), and zero is kept in
     its own ±1e-35 band like the reference so sparse semantics survive.
     """
+    from . import native
+    nb = native.find_boundaries(distinct, counts, max_bin, total_cnt,
+                                min_data_in_bin, KZERO)
+    if nb is not None:
+        return nb
     n_distinct = len(distinct)
     if n_distinct == 0:
         return [np.inf]
@@ -247,6 +252,12 @@ class BinMapper:
                 out[nan] = self.num_bin - 1
             elif self.missing_type == MISSING_ZERO:
                 out[nan | (np.abs(values) <= KZERO)] = self.num_bin - 1
+            return out
+        from . import native
+        out = native.value_to_bin_numerical(
+            values, self.bin_upper_bound, self.missing_type,
+            self.num_bin, KZERO)
+        if out is not None:
             return out
         nan = np.isnan(values)
         if self.missing_type == MISSING_NAN:

@@ -1,0 +1,160 @@
+"""ctypes bridge to the native IO library (``cpp/ltpu_io.cpp``).
+
+The native parser is the analog of the reference's C++ text pipeline
+(``TextReader`` / ``Parser`` / ``PipelineReader``); Python falls back
+to :mod:`.parser`'s pure-numpy path when the shared library has not
+been built (``make -C cpp``).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+_LIB_LOCATIONS = (
+    # repo checkout: <root>/cpp/libltpu_io.so
+    os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "cpp", "libltpu_io.so"),
+    # installed package: alongside the package
+    os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "libltpu_io.so"),
+)
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = os.environ.get("LTPU_IO_LIB", "")
+    candidates = ([path] if path else []) + list(_LIB_LOCATIONS)
+    for cand in candidates:
+        if not os.path.exists(cand):
+            continue
+        try:
+            lib = ctypes.CDLL(cand)
+            lib.ltpu_parse_dense.restype = ctypes.c_void_p
+            lib.ltpu_parse_dense.argtypes = [
+                ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64)]
+            lib.ltpu_parse_libsvm.restype = ctypes.c_void_p
+            lib.ltpu_parse_libsvm.argtypes = [
+                ctypes.c_char_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64)]
+            lib.ltpu_matrix_data.restype = ctypes.POINTER(ctypes.c_double)
+            lib.ltpu_matrix_data.argtypes = [ctypes.c_void_p]
+            lib.ltpu_matrix_free.argtypes = [ctypes.c_void_p]
+            if lib.ltpu_abi_version() == 1:
+                _LIB = lib
+                break
+        except OSError:
+            continue
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _copy_out(lib, handle, rows: int, cols: int) -> np.ndarray:
+    try:
+        ptr = lib.ltpu_matrix_data(handle)
+        if rows == 0 or cols == 0:
+            return np.zeros((rows, cols), np.float64)
+        flat = np.ctypeslib.as_array(ptr, shape=(rows * cols,))
+        return flat.reshape(rows, cols).copy()
+    finally:
+        lib.ltpu_matrix_free(handle)
+
+
+def parse_dense(path: str, sep: Optional[str],
+                skip_header: bool) -> Optional[np.ndarray]:
+    """Full numeric table (all columns) or None when the native path is
+    unavailable / declines (ragged rows)."""
+    lib = _load()
+    if lib is None:
+        return None
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    sep_c = (sep or "\0").encode()[0]
+    h = lib.ltpu_parse_dense(path.encode(), sep_c, int(skip_header),
+                             ctypes.byref(rows), ctypes.byref(cols))
+    if not h:
+        return None
+    return _copy_out(lib, h, rows.value, cols.value)
+
+
+def parse_libsvm(path: str, skip_header: bool) -> Optional[np.ndarray]:
+    """LibSVM as dense (rows, 1 + max_feature_idx + 1): label in
+    column 0."""
+    lib = _load()
+    if lib is None:
+        return None
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    h = lib.ltpu_parse_libsvm(path.encode(), int(skip_header),
+                              ctypes.byref(rows), ctypes.byref(cols))
+    if not h:
+        return None
+    return _copy_out(lib, h, rows.value, cols.value)
+
+
+def _bind_binning(lib):
+    lib.ltpu_find_boundaries.restype = ctypes.c_int
+    lib.ltpu_find_boundaries.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int, ctypes.c_int64, ctypes.c_int,
+        ctypes.c_double, ctypes.POINTER(ctypes.c_double)]
+    lib.ltpu_value_to_bin.restype = None
+    lib.ltpu_value_to_bin.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_int,
+        ctypes.c_int, ctypes.c_double, ctypes.POINTER(ctypes.c_int32)]
+
+
+def find_boundaries(distinct, counts, max_bin: int, total_cnt: int,
+                    min_data_in_bin: int, kzero: float):
+    """Native greedy boundary search; None when unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    if not hasattr(lib, "_binning_bound"):
+        _bind_binning(lib)
+        lib._binning_bound = True
+    distinct = np.ascontiguousarray(distinct, np.float64)
+    counts = np.ascontiguousarray(counts, np.int64)
+    out = np.empty(max(max_bin + 1, 2), np.float64)
+    nb = lib.ltpu_find_boundaries(
+        distinct.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(distinct), int(max_bin), int(total_cnt),
+        int(min_data_in_bin), float(kzero),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    return list(out[:nb])
+
+
+def value_to_bin_numerical(values, upper_bounds, missing_type: int,
+                           num_bin: int, kzero: float):
+    """Native multithreaded numerical binning; None when unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    if not hasattr(lib, "_binning_bound"):
+        _bind_binning(lib)
+        lib._binning_bound = True
+    values = np.ascontiguousarray(values, np.float64)
+    ub = np.ascontiguousarray(upper_bounds, np.float64)
+    out = np.empty(len(values), np.int32)
+    lib.ltpu_value_to_bin(
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        len(values), ub.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        len(ub), int(missing_type), int(num_bin), float(kzero),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return out

@@ -110,8 +110,18 @@ def parse_file_full(path: str, header: bool = False,
             label_idx = int(label_column)
 
     if kind == "libsvm":
+        from . import native
+        full = native.parse_libsvm(path, header)
+        if full is not None:
+            return full[:, 1:], full[:, 0].copy(), None, None, None
         X, y, names = _parse_libsvm(path, header)
         return X, y, names, None, None
+
+    native_out = _parse_dense_native(path, sep, header, label_column,
+                                     ignore_columns, weight_column,
+                                     group_column)
+    if native_out is not None:
+        return native_out
 
     rows: List[np.ndarray] = []
     labels: List[float] = []
@@ -152,6 +162,46 @@ def parse_file_full(path: str, header: bool = False,
     w = np.asarray(weights, dtype=np.float64) if w_cols else None
     g = np.asarray(groups, dtype=np.float64) if g_cols else None
     return X, y, names, w, g
+
+
+def _parse_dense_native(path, sep, header, label_column, ignore_columns,
+                        weight_column, group_column):
+    """Native C++ fast path (cpp/ltpu_io.cpp via io/native.py): parse
+    the full table natively, slice label/weight/group/ignore columns
+    with numpy.  Returns None when the library isn't built or declines
+    (ragged rows), letting the line-by-line parser handle it."""
+    from . import native
+    if not native.available():
+        return None
+    full = native.parse_dense(path, sep, header)
+    if full is None or full.size == 0:
+        return None
+    hdr: Optional[List[str]] = None
+    if header:
+        with open(path, "r") as f:
+            hdr = _split(f.readline(), sep)
+    label_idx = 0
+    if label_column != "":
+        if label_column.startswith("name:"):
+            if hdr is None:
+                Log.fatal("label_column %s requires header", label_column)
+            label_idx = hdr.index(label_column[5:])
+        else:
+            label_idx = int(label_column)
+    drop = {label_idx}
+    ignore = _resolve_columns(ignore_columns, hdr)
+    w_cols = _resolve_columns(weight_column, hdr)
+    g_cols = _resolve_columns(group_column, hdr)
+    drop.update(ignore)
+    drop.update(w_cols)
+    drop.update(g_cols)
+    names = [h for i, h in enumerate(hdr) if i not in drop] \
+        if hdr is not None else None
+    keep = [i for i in range(full.shape[1]) if i not in drop]
+    y = full[:, label_idx].copy()
+    w = full[:, w_cols[0]].copy() if w_cols else None
+    g = full[:, g_cols[0]].copy() if g_cols else None
+    return full[:, keep], y, names, w, g
 
 
 def _split(line: str, sep: Optional[str]) -> List[str]:

@@ -134,10 +134,8 @@ class GBDT:
             from ..io.binning import BIN_CATEGORICAL as _CAT
             from ..io.bundle import find_bundles
             db = np.asarray(
-                [0 if mappers[j].bin_type == _CAT else
-                 int(np.asarray(mappers[j].value_to_bin(
-                     np.zeros(1))).reshape(-1)[0])
-                 for j in range(F)], np.int32)
+                [0 if mappers[j].bin_type == _CAT
+                 else mappers[j].default_bin for j in range(F)], np.int32)
             nb_arr = np.asarray([m.num_bin for m in mappers], np.int32)
             bundles = find_bundles(
                 train_set.binned, nb_arr, db,
@@ -206,7 +204,13 @@ class GBDT:
             dist=DistConfig(top_k=config.top_k),
             forced=forced,
             bundled=self._bundles is not None,
-            use_hist_pool=use_pool)
+            use_hist_pool=use_pool,
+            # speculative child arming fills the MXU lanes (~21 leaves
+            # x 6 value columns ~ 128); enabled on the accelerator
+            # path where the batched pallas kernel exists
+            speculate=(min(21, config.num_leaves)
+                       if (use_pallas and not dist_active and use_pool
+                           and not forced) else 0))
 
         # parallel tree learner over the device mesh
         # (tree_learner={data,feature,voting}, tree_learner.cpp:9-33)
@@ -647,7 +651,8 @@ class GBDT:
                 out[i % k] += self.models[i].predict(X)
             if use_es and (i + 1) % (early_stop_freq * k) == 0:
                 if k == 1:
-                    margin = np.abs(out[0])
+                    # binary margin = 2|raw| (prediction_early_stop.cpp)
+                    margin = 2.0 * np.abs(out[0])
                 else:
                     top2 = np.partition(out, k - 2, axis=0)[-2:]
                     margin = top2[1] - top2[0]

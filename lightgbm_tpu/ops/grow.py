@@ -42,7 +42,8 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
-from .histogram import histogram_pallas, histogram_segsum
+from .histogram import (histogram_pallas, histogram_pallas_multi,
+                        histogram_segsum, histogram_segsum_multi)
 from .split import (NEG_INF, SplitParams, eval_forced_split,
                     find_best_split, leaf_output)
 
@@ -83,12 +84,41 @@ class GrowParams:
     # instead of keeping the (L, G, B, 3) pool for the subtraction
     # trick — the HistogramPool memory policy (histogram_pool_size)
     use_hist_pool: bool = True
+    # speculative child arming: each histogram pass batches the
+    # smaller-child histograms of the top-`speculate` unarmed leaves
+    # (their cached best splits fully determine the children), filling
+    # the MXU lane dimension a single 6-wide pass leaves idle; splits
+    # whose children were pre-armed cost no pass at all.  0 = off.
+    # Exact best-first semantics either way.  Serial learner only.
+    speculate: int = 0
 
 
 def _hist(xt, vals, p: GrowParams):
     if p.hist_impl == "pallas":
         return histogram_pallas(xt, vals, p.split.max_bin, p.rows_per_block)
     return histogram_segsum(xt, vals, p.split.max_bin)
+
+
+def mask_lookup(mask_row: jax.Array, col: jax.Array) -> jax.Array:
+    """Gather-free bin-mask lookup: ``mask_row[col]`` for a (B,) bool
+    mask and (N,) int bins.
+
+    XLA's gather lowers poorly on TPU (serialized element loads); the
+    mask is instead packed into B/32 uint32 words and each row resolves
+    its word with a static chain of broadcast selects — pure VPU ops.
+    """
+    B = mask_row.shape[0]
+    nw = (B + 31) // 32
+    pad = nw * 32 - B
+    bits = jnp.pad(mask_row.astype(jnp.uint32), (0, pad))
+    words = jnp.sum(bits.reshape(nw, 32) <<
+                    jnp.arange(32, dtype=jnp.uint32)[None, :], axis=1)
+    col = col.astype(jnp.int32)
+    hi = col >> 5
+    acc = jnp.zeros(col.shape, dtype=jnp.uint32)
+    for k in range(nw):
+        acc = acc | jnp.where(hi == k, words[k], jnp.uint32(0))
+    return ((acc >> (col & 31).astype(jnp.uint32)) & 1) > 0
 
 
 _MERGE_KEYS = ("gain", "feature", "threshold", "default_left", "is_cat",
@@ -219,6 +249,21 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
             h = jax.lax.psum_scatter(h, ax, scatter_dimension=0, tiled=True)
         return h  # (F_hist, B, 3); local (not yet summed) for voting
 
+    # speculative child arming (serial only): one batched pass fills
+    # the MXU lanes with up to `speculate` smaller-child histograms
+    W_spec = p.speculate if (kind == "serial" and p.use_hist_pool and
+                             not p.forced and p.speculate > 1) else 0
+    do_spec = W_spec > 1
+    if do_spec:
+        base_vals = jnp.stack([grad * sample_mask, hess * sample_mask,
+                               sample_mask], axis=-1)
+
+        def multi_hist(sel):
+            if p.hist_impl == "pallas":
+                return histogram_pallas_multi(xt, base_vals, sel, B,
+                                              W_spec, p.rows_per_block)
+            return histogram_segsum_multi(xt, base_vals, sel, B, W_spec)
+
     def global_stats(local):
         if kind in ("data", "voting"):
             return jax.lax.psum(local, ax)
@@ -280,17 +325,17 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
             col = jax.lax.dynamic_index_in_dim(xt, g, axis=0,
                                                keepdims=False)
             bundle_mask = jnp.take(left_mask_row, fb)
-            return jnp.take(bundle_mask, col.astype(jnp.int32))
+            return mask_lookup(bundle_mask, col)
         if kind == "feature":
             local_f = feat - f_offset
             owner = (local_f >= 0) & (local_f < F)
             col = jax.lax.dynamic_index_in_dim(
                 xt, jnp.clip(local_f, 0, F - 1), axis=0, keepdims=False)
-            cand = jnp.take(left_mask_row, col.astype(jnp.int32))
+            cand = mask_lookup(left_mask_row, col)
             return jax.lax.psum(
                 jnp.where(owner, cand.astype(jnp.float32), 0.0), ax) > 0.5
         col = jax.lax.dynamic_index_in_dim(xt, feat, axis=0, keepdims=False)
-        return jnp.take(left_mask_row, col.astype(jnp.int32))
+        return mask_lookup(left_mask_row, col)
 
     # ---- init: root ------------------------------------------------
     leaf_idx = jnp.zeros(N, dtype=jnp.int32)
@@ -349,6 +394,12 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         # parent-minus-smaller-child subtraction trick
         state["hist"] = jnp.zeros((L, F_hist, B, 3),
                                   jnp.float32).at[0].set(root_hist)
+    if do_spec:
+        # smaller-child histograms keyed by PARENT leaf; slot L is the
+        # write target for unused arming lanes
+        state["armed"] = jnp.zeros(L + 1, bool)
+        state["armed_hist"] = jnp.zeros((L + 1, F_hist, B, 3),
+                                        jnp.float32)
     if has_mono:
         # per-leaf inherited output bounds (LeafSplits min/max
         # constraint propagation, leaf_splits.hpp:16)
@@ -360,6 +411,36 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         state["rec_right_max"] = jnp.full(L - 1, BIG, jnp.float32)
     if n_forced:
         state["force_active"] = jnp.asarray(True)
+
+    def arm_pass(st):
+        """One batched pass arming the smaller-child histograms of the
+        top-``W_spec`` unarmed splittable leaves (their cached best
+        splits determine the children exactly)."""
+        gains = jnp.where(st["armed"][:L] | ~(st["best_gain"] > 0),
+                          NEG_INF, st["best_gain"])
+        topg, ids = jax.lax.top_k(gains, W_spec)
+        valid_w = topg > 0.5 * NEG_INF
+        ids_safe = jnp.where(valid_w, ids, L)
+        sel = jnp.full(N, -1, jnp.int32)
+
+        def per_w(w, sel):
+            l = ids[w]
+            feat = st["best_feature"][l]
+            goes_left = goes_left_of(feat, st["best_left_mask"][l])
+            ls = st["best_left_stats"][l]
+            ps = st["leaf_stats"][l]
+            small_is_left = ls[2] <= ps[2] - ls[2]
+            pick = (st["leaf_idx"] == l) & (goes_left == small_is_left) & \
+                valid_w[w]
+            return jnp.where(pick, jnp.int32(w), sel)
+
+        sel = jax.lax.fori_loop(0, W_spec, per_w, sel)
+        hists = multi_hist(sel)  # (W, F_hist, B, 3)
+        st = dict(st)
+        st["armed_hist"] = st["armed_hist"].at[ids_safe].set(hists)
+        st["armed"] = st["armed"].at[ids_safe].set(valid_w) \
+                                 .at[L].set(False)
+        return st
 
     def body(t, st):
         best_l_id = jnp.argmax(st["best_gain"]).astype(jnp.int32)
@@ -399,6 +480,13 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
             valid = cand["gain"] > 0
         gain = cand["gain"]
 
+        if do_spec:
+            # cache miss: the chosen leaf's children are not armed —
+            # run one batched arming pass (it always includes l, the
+            # top unarmed leaf by gain)
+            st = jax.lax.cond(valid & ~st["armed"][l], arm_pass,
+                              lambda s: s, st)
+
         def do_split(st):
             new = jnp.int32(t + 1)
             feat = cand["feature"]
@@ -414,7 +502,11 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
                 # larger = parent − smaller (:506-511)
                 small_is_left = left_stats[2] <= right_stats[2]
                 small_id = jnp.where(small_is_left, l, new)
-                hist_small = masked_hist(leaf_idx, small_id)
+                if do_spec:
+                    # the arming cond above guarantees a cache hit
+                    hist_small = st["armed_hist"][l]
+                else:
+                    hist_small = masked_hist(leaf_idx, small_id)
                 hist_large = st["hist"][l] - hist_small
                 hist_l = jnp.where(small_is_left, hist_small, hist_large)
                 hist_r = jnp.where(small_is_left, hist_large, hist_small)
@@ -452,6 +544,10 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
 
             st = dict(st)
             st["leaf_idx"] = leaf_idx
+            if do_spec:
+                # both children are fresh leaves with unknown splits
+                st["armed"] = st["armed"].at[l].set(False) \
+                                         .at[new].set(False)
             if p.use_hist_pool:
                 st["hist"] = st["hist"].at[l].set(hist_l) \
                                        .at[new].set(hist_r)
@@ -579,7 +675,7 @@ def route_rows(xt: jax.Array, rec_leaf: jax.Array, rec_feature: jax.Array,
         else:
             col = jax.lax.dynamic_index_in_dim(xt, feat, axis=0,
                                                keepdims=False)
-        goes_left = jnp.take(mask_row, col.astype(jnp.int32))
+        goes_left = mask_lookup(mask_row, col)
         mine = li == rec_leaf[t]
         move = rec_valid[t] & mine & ~goes_left
         return jnp.where(move, jnp.int32(t + 1), li)

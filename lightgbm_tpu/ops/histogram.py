@@ -97,9 +97,12 @@ def histogram_pallas(bins_t: jax.Array, vals: jax.Array, max_bin: int,
     f, n = bins_t.shape
     t = rows_per_block
     assert n % t == 0, (n, t)
-    # feature-chunk size: multiple of 8 (sublane tiling), one-hot
-    # (FC, B, T) bf16 within ~8MB of VMEM
-    budget_fc = max(8 * 1024 * 1024 // (2 * max_bin * t), 8)
+    # feature-chunk size: multiple of 8 (sublane tiling); the one-hot
+    # (FC, B, T) bf16 + (FC*B, 6) f32 accumulator must fit the ~16MB
+    # scoped-VMEM limit — fewer chunks means the per-row-tile one-hot
+    # is rebuilt fewer times
+    per_fc = 2 * max_bin * t + max_bin * 6 * 4
+    budget_fc = max(12 * 1024 * 1024 // per_fc, 8)
     fc = (budget_fc // 8) * 8
     f_pad = (f + 7) // 8 * 8
     fc = min(fc, f_pad)
@@ -142,3 +145,105 @@ def histogram(bins_t: jax.Array, vals: jax.Array, max_bin: int,
         vals = jnp.pad(vals, ((0, padded - n), (0, 0)))
         # padded rows land in (feature, bin 0) with value 0 — harmless
     return histogram_pallas(bins_t, vals, max_bin, rows_per_block)
+
+
+def _hist_kernel_multi(x_ref, v_ref, s_ref, out_ref, *, max_bin: int,
+                       width: int):
+    """Multi-leaf variant: one pass accumulates histograms for up to
+    ``width`` row-disjoint subsets (the speculative child-arming pass).
+
+    x_ref: (FC, T) int32 bins; v_ref: (3, T) f32; s_ref: (1, T) int32
+    subset selector in [-1, width); out_ref: (FC*B, 6*width) f32.
+
+    The rhs grows from 6 to 6*width columns, filling the MXU lane
+    dimension (~128 at width 21) that the single-leaf pass leaves ~95%
+    idle — a batched pass costs barely more than a single-leaf one.
+    """
+    import jax.experimental.pallas as pl
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    FC, T = x_ref.shape
+    B = max_bin
+    x = x_ref[...]
+    v = v_ref[...]                      # (3, T)
+    sel = s_ref[...]                    # (1, T)
+    v_hi = jax.lax.bitcast_convert_type(
+        jax.lax.bitcast_convert_type(v, jnp.uint32) &
+        jnp.uint32(0xFFFF0000), jnp.float32)
+    v_lo = v - v_hi
+    vals6 = jnp.concatenate([v_hi, v_lo], axis=0)          # (6, T) f32
+    sel_oh = (sel == jax.lax.broadcasted_iota(
+        jnp.int32, (width, T), 0)).astype(jnp.float32)     # (W, T)
+    rhs = (sel_oh[:, None, :] * vals6[None, :, :]).reshape(
+        width * 6, T).astype(jnp.bfloat16)                 # (6W, T)
+    onehot = (x[:, None, :] ==
+              jax.lax.broadcasted_iota(jnp.int32, (FC, B, T), 1)
+              ).astype(jnp.bfloat16)
+    acc = jax.lax.dot_general(
+        onehot.reshape(FC * B, T), rhs.T, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                # (FC*B, 6W)
+    out_ref[...] += acc
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("max_bin", "width", "rows_per_block"))
+def histogram_pallas_multi(bins_t: jax.Array, vals: jax.Array,
+                           sel: jax.Array, max_bin: int, width: int,
+                           rows_per_block: int = 1024) -> jax.Array:
+    """Batched histogram over ``width`` disjoint row subsets.
+
+    bins_t (F, N) ints; vals (N, 3) f32; sel (N,) int32 subset id per
+    row (-1 = no subset).  Returns (width, F, B, 3).
+    """
+    import jax.experimental.pallas as pl
+
+    f, n = bins_t.shape
+    t = rows_per_block
+    assert n % t == 0, (n, t)
+    W = width
+    # VMEM: onehot (FC,B,T) bf16 + out block (FC*B, 6W) f32 within the
+    # ~16MB scoped limit; fewer feature chunks means the per-row-tile
+    # onehot and rhs are rebuilt fewer times
+    per_fc = 2 * max_bin * t + max_bin * 6 * W * 4
+    budget_fc = max(12 * 1024 * 1024 // per_fc, 8)
+    fc = (budget_fc // 8) * 8
+    f_pad = (f + 7) // 8 * 8
+    fc = min(fc, f_pad)
+    while f_pad % fc:
+        f_pad += 8
+    xt = bins_t.astype(jnp.int32)
+    if f_pad != f:
+        xt = jnp.pad(xt, ((0, f_pad - f), (0, 0)))
+    vt = vals.astype(jnp.float32).T          # (3, N)
+    st = sel.astype(jnp.int32)[None, :]      # (1, N)
+
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel_multi, max_bin=max_bin, width=W),
+        grid=(f_pad // fc, n // t),
+        in_specs=[
+            pl.BlockSpec((fc, t), lambda j, i: (j, i)),
+            pl.BlockSpec((3, t), lambda j, i: (0, i)),
+            pl.BlockSpec((1, t), lambda j, i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((fc * max_bin, 6 * W), lambda j, i: (j, 0)),
+        out_shape=jax.ShapeDtypeStruct((f_pad * max_bin, 6 * W),
+                                       jnp.float32),
+    )(xt, vt, st)
+    out = out.reshape(f_pad, max_bin, W, 6)
+    out = out[..., :3] + out[..., 3:]        # hi + lo
+    return jnp.moveaxis(out[:f], 2, 0)       # (W, F, B, 3)
+
+
+def histogram_segsum_multi(bins_t: jax.Array, vals: jax.Array,
+                           sel: jax.Array, max_bin: int, width: int
+                           ) -> jax.Array:
+    """jnp reference for :func:`histogram_pallas_multi` (CPU/tests)."""
+    f, n = bins_t.shape
+    outs = []
+    for w in range(width):
+        m = (sel == w).astype(vals.dtype)[:, None]
+        outs.append(histogram_segsum(bins_t, vals * m, max_bin))
+    return jnp.stack(outs)

@@ -1,0 +1,80 @@
+"""Native C++ IO fast path (cpp/ltpu_io.cpp)."""
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "cpp", "libltpu_io.so")
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    if not os.path.exists(LIB):
+        if shutil.which("g++") is None:
+            pytest.skip("no g++ and no prebuilt libltpu_io.so")
+        subprocess.run(["make", "-C", os.path.join(REPO, "cpp")],
+                       check=True, capture_output=True)
+    from lightgbm_tpu.io import native
+    if not native.available():
+        pytest.skip("native lib failed to load")
+    return native
+
+
+def _python_parse(path, **kw):
+    """Run the parser with the native lib disabled."""
+    from lightgbm_tpu.io import native, parser
+    saved, native._LIB = native._LIB, None
+    try:
+        return parser.parse_file_full(path, **kw)
+    finally:
+        native._LIB = saved
+
+
+@pytest.mark.parametrize("rel", [
+    "binary_classification/binary.train",
+    "regression/regression.train",
+    "lambdarank/rank.train",          # libsvm
+])
+def test_native_matches_python(native_lib, rel):
+    from lightgbm_tpu.io import parser
+    path = os.path.join("/root/reference/examples", rel)
+    Xn, yn, _, wn, gn = parser.parse_file_full(path)
+    Xp, yp, _, wp, gp = _python_parse(path)
+    np.testing.assert_array_equal(Xn, Xp)
+    np.testing.assert_array_equal(yn, yp)
+
+
+def test_native_nan_and_header(native_lib, tmp_path):
+    from lightgbm_tpu.io import parser
+    p = os.path.join(str(tmp_path), "data.csv")
+    with open(p, "w") as f:
+        f.write("label,a,b\n1,2.5,na\n0,nan,-3\n1,?,1e3\n")
+    X, y, names, _, _ = parser.parse_file_full(
+        p, header=True, label_column="name:label")
+    np.testing.assert_array_equal(y, [1, 0, 1])
+    assert names == ["a", "b"]
+    assert np.isnan(X[0, 1]) and np.isnan(X[1, 0]) and np.isnan(X[2, 0])
+    assert X[2, 1] == 1e3
+    Xp, yp, namesp, _, _ = _python_parse(p, header=True,
+                                         label_column="name:label")
+    np.testing.assert_array_equal(np.nan_to_num(X, nan=-9),
+                                  np.nan_to_num(Xp, nan=-9))
+
+
+def test_native_weight_group_columns(native_lib, tmp_path):
+    from lightgbm_tpu.io import parser
+    p = os.path.join(str(tmp_path), "data.tsv")
+    with open(p, "w") as f:
+        for i in range(10):
+            f.write(f"{i % 2}\t{i}\t{i * 0.5}\t{1.0 + i}\n")
+    X, y, _, w, g = parser.parse_file_full(p, label_column="0",
+                                           weight_column="3")
+    assert X.shape == (10, 2)
+    np.testing.assert_array_equal(w, 1.0 + np.arange(10))
+    Xp, yp, _, wp, _ = _python_parse(p, label_column="0",
+                                     weight_column="3")
+    np.testing.assert_array_equal(X, Xp)
+    np.testing.assert_array_equal(w, wp)

@@ -1,0 +1,76 @@
+"""Speculative child-arming: exactness vs the per-split path."""
+import dataclasses
+import functools
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def test_speculative_arming_is_exact(rng):
+    """The armed-histogram loop must reproduce the per-split loop's
+    trees exactly (same split sequence, thresholds, gains)."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.grow import GrowParams, build_tree
+    from lightgbm_tpu.ops.split import SplitParams
+
+    N, F, B = 20_000, 8, 64
+    Xc = rng.randn(N, F).astype(np.float32)
+    w = rng.randn(F)
+    y = (rng.random_sample(N) <
+         1 / (1 + np.exp(-(Xc @ w)))).astype(np.float32)
+    xt = jnp.asarray(np.clip(
+        (Xc - Xc.min(0)) / (np.ptp(Xc, 0) + 1e-9) * 62, 0, 62
+    ).astype(np.int32).T)
+    grad = jnp.asarray(0.5 - y)
+    hess = jnp.full((N,), 0.25, jnp.float32)
+    mask = jnp.ones(N, jnp.float32)
+    fmask = jnp.ones(F, bool)
+    nb = jnp.full(F, 63, jnp.int32)
+    mt = jnp.zeros(F, jnp.int32)
+    cat = jnp.zeros(F, bool)
+    base = GrowParams(split=SplitParams(max_bin=B, min_data_in_leaf=20),
+                      num_leaves=31, hist_impl="segsum")
+    spec = dataclasses.replace(base, speculate=7)
+
+    r_off = build_tree(xt, grad, hess, mask, fmask, nb, mt, cat, base)
+    r_on = build_tree(xt, grad, hess, mask, fmask, nb, mt, cat, spec)
+    for key in ("leaf", "feature", "threshold", "default_left", "valid",
+                "left_mask"):
+        np.testing.assert_array_equal(np.asarray(r_off[key]),
+                                      np.asarray(r_on[key]), err_msg=key)
+    np.testing.assert_allclose(np.asarray(r_off["gain"]),
+                               np.asarray(r_on["gain"]), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(r_off["leaf_idx"]),
+                                  np.asarray(r_on["leaf_idx"]))
+
+
+def test_mask_lookup_matches_take(rng):
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.grow import mask_lookup
+
+    for B in (2, 33, 64, 256):
+        mask = jnp.asarray(rng.random_sample(B) < 0.5)
+        col = jnp.asarray(rng.randint(0, B, size=5000, dtype=np.int32))
+        got = np.asarray(mask_lookup(mask, col))
+        want = np.asarray(jnp.take(mask, col))
+        np.testing.assert_array_equal(got, want, err_msg=f"B={B}")
+
+
+def test_multi_histogram_matches_reference(rng):
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.histogram import histogram_segsum_multi, \
+        histogram_segsum
+
+    N, F, B, W = 4000, 5, 32, 4
+    xt = jnp.asarray(rng.randint(0, B - 1, size=(F, N), dtype=np.int32))
+    vals = jnp.asarray(rng.randn(N, 3).astype(np.float32))
+    sel = jnp.asarray(rng.randint(-1, W, size=N, dtype=np.int32))
+    multi = np.asarray(histogram_segsum_multi(xt, vals, sel, B, W))
+    for w_i in range(W):
+        m = (np.asarray(sel) == w_i).astype(np.float32)[:, None]
+        single = np.asarray(histogram_segsum(
+            xt, jnp.asarray(np.asarray(vals) * m), B))
+        np.testing.assert_allclose(multi[w_i], single, rtol=1e-5,
+                                   atol=1e-5)
