@@ -64,14 +64,17 @@ inline size_t LineEnd(const std::string& buf, size_t start) {
   return j;
 }
 
-// parse one token; non-numeric ("na", "?", "null", empty) -> NaN,
-// matching parser.py _safe_float
+// parse one token; non-numeric ("na", "?", "null", "3.5cm", empty) ->
+// NaN, matching parser.py _safe_float (which requires the WHOLE token
+// to be numeric)
 inline double ParseToken(const char* s, const char* end) {
   while (s < end && (*s == ' ' || *s == '\t')) ++s;
-  if (s >= end) return std::nan("");
+  const char* e = end;
+  while (e > s && (e[-1] == ' ' || e[-1] == '\t')) --e;
+  if (s >= e) return std::nan("");
   char* stop = nullptr;
   double v = std::strtod(s, &stop);
-  if (stop == s) return std::nan("");
+  if (stop != e) return std::nan("");  // trailing junk: not a number
   return v;
 }
 
@@ -205,6 +208,7 @@ void* ltpu_parse_libsvm(const char* path, int skip_header,
   int nt = NumThreads(rows);
   std::vector<int64_t> max_idx(nt > 0 ? nt : 1, -1);
   std::atomic<int> tid{0};
+  std::atomic<bool> bad{false};
   ParallelFor(rows, [&](int64_t lo, int64_t hi) {
     int my = tid.fetch_add(1);
     int64_t mx = -1;
@@ -222,6 +226,16 @@ void* ltpu_parse_libsvm(const char* path, int skip_header,
                !std::isspace(static_cast<unsigned char>(*q)))
           ++q;
         if (q < end && *q == ':') {
+          bool digits = q > p;
+          for (const char* d = p; d < q; ++d)
+            if (!std::isdigit(static_cast<unsigned char>(*d)))
+              digits = false;
+          if (!digits) {
+            // non-numeric key (e.g. qid:3): decline so the python
+            // parser reports it loudly
+            bad.store(true, std::memory_order_relaxed);
+            break;
+          }
           int64_t idx = std::strtoll(p, nullptr, 10);
           if (idx > mx) mx = idx;
           p = q + 1;
@@ -234,6 +248,7 @@ void* ltpu_parse_libsvm(const char* path, int skip_header,
     }
     if (my < static_cast<int>(max_idx.size())) max_idx[my] = mx;
   });
+  if (bad.load()) return nullptr;
   int64_t mx = -1;
   for (int64_t v : max_idx) mx = v > mx ? v : mx;
   auto* m = new Matrix();

@@ -94,14 +94,21 @@ def train(params: Dict[str, Any], train_set: Dataset,
     cbs_before.sort(key=lambda c: getattr(c, "order", 0))
     cbs_after.sort(key=lambda c: getattr(c, "order", 0))
 
+    import time as _time
+    from .utils.profiling import timed
+    t_train0 = _time.perf_counter()
     for i in range(num_boost_round):
         for cb in cbs_before:
             cb(CallbackEnv(booster, params, i, 0, num_boost_round, None))
         should_stop = booster.update(fobj=fobj)
+        # per-iteration wall clock (GBDT::Train, gbdt.cpp:253-256)
+        Log.debug("%.6f seconds elapsed, finished iteration %d",
+                  _time.perf_counter() - t_train0, i + 1)
         evaluation_result_list = []
         if booster._gbdt.metrics and (booster._gbdt.valid_sets or
                                       booster.config.is_provide_training_metric):
-            evaluation_result_list = booster.eval_set()
+            with timed("eval/metrics"):
+                evaluation_result_list = booster.eval_set()
         if feval is not None:
             evaluation_result_list.extend(
                 _run_feval(feval, booster, train_set, valid_sets,

@@ -38,6 +38,11 @@ def _load():
             continue
         try:
             lib = ctypes.CDLL(cand)
+            # abi gate FIRST: a stale gitignored .so must fall back to
+            # python, not crash binding newer symbols
+            if not hasattr(lib, "ltpu_abi_version") or \
+                    lib.ltpu_abi_version() != 1:
+                continue
             lib.ltpu_parse_dense.restype = ctypes.c_void_p
             lib.ltpu_parse_dense.argtypes = [
                 ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
@@ -51,10 +56,9 @@ def _load():
             lib.ltpu_matrix_data.restype = ctypes.POINTER(ctypes.c_double)
             lib.ltpu_matrix_data.argtypes = [ctypes.c_void_p]
             lib.ltpu_matrix_free.argtypes = [ctypes.c_void_p]
-            if lib.ltpu_abi_version() == 1:
-                _LIB = lib
-                break
-        except OSError:
+            _LIB = lib
+            break
+        except (OSError, AttributeError):
             continue
     return _LIB
 

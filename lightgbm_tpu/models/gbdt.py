@@ -222,13 +222,14 @@ class GBDT:
             Log.info("tree_learner=%s over a %d-way device mesh",
                      learner, num_shards)
         if self._bundles is not None:
-            xt = self._bundles.bundle_matrix(
-                train_set.binned).T.astype(np.int32)  # (G, N)
+            xt = self._bundles.bundle_matrix(train_set.binned).T  # (G, N)
         else:
-            xt = train_set.binned.T.astype(np.int32)  # (F, N)
+            xt = train_set.binned.T  # (F, N) narrow uint8/16
         col_pad = 0 if self._bundles is not None else self._F_pad - F
         xt = np.pad(xt, ((0, col_pad), (0, self._n_pad - n)))
-        self._xt = jnp.asarray(xt)
+        # ship the NARROW dtype over the host->device link (it can be
+        # the bottleneck) and widen on device
+        self._xt = jnp.asarray(xt).astype(jnp.int32)
         self._base_mask = jnp.asarray(
             np.pad(np.ones(n, np.float32), (0, self._n_pad - n)))
         if self._F_pad != F:
@@ -339,13 +340,12 @@ class GBDT:
             vs.score[i % self.num_tree_per_iteration] += tree.predict(raw)
         if binned is not None and self.num_features > 0:
             if self._bundles is not None:
-                xtv = self._bundles.bundle_matrix(
-                    binned.binned).T.astype(np.int32)  # (G, rows)
+                xtv = self._bundles.bundle_matrix(binned.binned).T
             else:
-                xtv = binned.binned.T.astype(np.int32)  # (F, rows)
+                xtv = binned.binned.T  # (F, rows) narrow dtype
                 xtv = np.pad(xtv,
                              ((0, self._F_pad - xtv.shape[0]), (0, 0)))
-            vs.xt = jnp.asarray(xtv)
+            vs.xt = jnp.asarray(xtv).astype(jnp.int32)
         self.valid_sets.append(vs)
 
     # ------------------------------------------------------------------
@@ -415,17 +415,22 @@ class GBDT:
                         for vs in self.valid_sets:
                             vs.score[k] += init
                         Log.info("Start training from score %f", init)
-            grad, hess = self.objective.get_gradients(self._score)
+            from ..utils.profiling import timed
+            with timed("boosting/gradients"):
+                grad, hess = self.objective.get_gradients(self._score)
             grad = jnp.atleast_2d(grad)
             hess = jnp.atleast_2d(hess)
         else:
             grad = jnp.asarray(np.atleast_2d(np.asarray(grad, np.float32)))
             hess = jnp.asarray(np.atleast_2d(np.asarray(hess, np.float32)))
 
+        from ..utils.profiling import timed
         bag = self._bagging_mask(grad, hess)
         should_stop = True
         for k in range(self.num_tree_per_iteration):
-            tree = self._train_one_tree(grad[k], hess[k], bag, init_scores[k])
+            with timed("tree/build"):
+                tree = self._train_one_tree(grad[k], hess[k], bag,
+                                            init_scores[k])
             if tree.num_leaves > 1:
                 should_stop = False
             self.models.append(tree)
@@ -454,20 +459,27 @@ class GBDT:
             mask = mask * (w > 0)
         fmask = self._feature_fraction_mask()
 
+        recs = None
         if self.num_features == 0:
             rec = None
             n_leaves = 1
-        elif self._bundle_maps is not None:
-            rec = self._build_tree(self._xt, gp, hp, mask, fmask,
-                                   self._num_bins, self._missing_type,
-                                   self._is_cat, self.grow_params,
-                                   bundle_maps=self._bundle_maps)
-            n_leaves = int(rec["n_leaves"])
         else:
-            rec = self._build_tree(self._xt, gp, hp, mask, fmask,
-                                   self._num_bins, self._missing_type,
-                                   self._is_cat, self.grow_params)
-            n_leaves = int(rec["n_leaves"])
+            if self._bundle_maps is not None:
+                rec = self._build_tree(self._xt, gp, hp, mask, fmask,
+                                       self._num_bins, self._missing_type,
+                                       self._is_cat, self.grow_params,
+                                       bundle_maps=self._bundle_maps)
+            else:
+                rec = self._build_tree(self._xt, gp, hp, mask, fmask,
+                                       self._num_bins, self._missing_type,
+                                       self._is_cat, self.grow_params)
+            # ONE device->host transfer per tree: every record except
+            # the (N,) leaf assignment (which stays on device for the
+            # score update) — host round-trips are ~100ms through a
+            # remote tunnel, so they must not multiply
+            recs = jax.device_get({k: v for k, v in rec.items()
+                                   if k != "leaf_idx"})
+            n_leaves = int(recs["n_leaves"])
 
         if n_leaves <= 1:
             # constant tree holding the init score (gbdt.cpp:380-397)
@@ -483,14 +495,13 @@ class GBDT:
                 self._train_leaf_idx.append(None)
             return tree
 
-        recs = jax.device_get({k: v for k, v in rec.items()
-                               if k not in ("leaf_idx",)})
         tree = self._records_to_tree(recs)
         if self._track_train_leaf:
-            # compact dtype: leaf count is bounded by num_leaves
-            dt = np.uint8 if self.config.num_leaves <= 256 else np.uint16
+            # compact dtype ON DEVICE: leaf ids fit uint8/16 and the
+            # device->host link is slow, so never ship int32
+            dt = jnp.uint8 if self.config.num_leaves <= 256 else jnp.uint16
             self._train_leaf_idx.append(
-                np.asarray(rec["leaf_idx"][:n]).astype(dt))
+                np.asarray(rec["leaf_idx"][:n].astype(dt)))
         # leaf renewal hook (RenewTreeOutput) — objective-specific
         if self.objective is not None:
             self.objective.renew_tree_output(

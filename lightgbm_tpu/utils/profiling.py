@@ -1,0 +1,85 @@
+"""Phase timers + device tracing.
+
+The reference accumulates per-phase ``std::chrono`` timers behind the
+compile-time ``TIMETAG`` flag (``serial_tree_learner.cpp:161-215``,
+``gbdt.cpp:253-256``) and prints them at shutdown.  Here the registry
+is always on (the overhead is two clock reads per phase), summarized
+on demand; device-side traces come from the JAX profiler.
+
+Usage::
+
+    from lightgbm_tpu.utils.profiling import timed, summary
+    with timed("tree"):
+        ...
+    print(summary())
+
+    with jax_trace("/tmp/tb"):   # view in TensorBoard / xprof
+        bst = lgb.train(...)
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Iterator, Tuple
+
+__all__ = ["timed", "summary", "reset", "get", "jax_trace"]
+
+_lock = threading.Lock()
+_acc: Dict[str, Tuple[float, int]] = {}
+
+
+@contextlib.contextmanager
+def timed(name: str) -> Iterator[None]:
+    """Accumulate wall time under ``name`` (TIMETAG analog)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        with _lock:
+            total, count = _acc.get(name, (0.0, 0))
+            _acc[name] = (total + dt, count + 1)
+
+
+def get(name: str) -> Tuple[float, int]:
+    """(total seconds, call count) for a phase."""
+    with _lock:
+        return _acc.get(name, (0.0, 0))
+
+
+def reset() -> None:
+    with _lock:
+        _acc.clear()
+
+
+def summary() -> str:
+    """One line per phase: name, total, count, mean."""
+    with _lock:
+        items = sorted(_acc.items(), key=lambda kv: -kv[1][0])
+    lines = [f"{name:<24s} {total:10.3f}s  x{count:<7d} "
+             f"{total / max(count, 1) * 1e3:9.2f} ms/call"
+             for name, (total, count) in items]
+    return "\n".join(lines) if lines else "(no phases recorded)"
+
+
+@contextlib.contextmanager
+def jax_trace(log_dir: str) -> Iterator[None]:
+    """Capture a device trace viewable in TensorBoard/xprof.  No-op if
+    the profiler is unavailable on the backend."""
+    import jax
+
+    started = False
+    try:
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception:  # pragma: no cover - backend-dependent
+        pass
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # pragma: no cover
+                pass
