@@ -445,7 +445,6 @@ class Config:
         "gpu_platform_id": "device selection is JAX_PLATFORMS",
         "gpu_device_id": "device selection is JAX_PLATFORMS",
         "gpu_use_dp": "histograms always accumulate in f32 hi/lo pairs",
-        "pre_partition": "single-process data loading",
     }
 
     def _warn_inert(self) -> None:

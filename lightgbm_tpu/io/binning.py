@@ -358,3 +358,47 @@ def find_bin_mappers(X: np.ndarray, max_bin: int, min_data_in_bin: int,
                    bin_type=BIN_CATEGORICAL if f in cat else BIN_NUMERICAL)
         mappers.append(m)
     return mappers
+
+
+def find_bin_mappers_sharded(X_shards: Sequence[np.ndarray], max_bin: int,
+                             min_data_in_bin: int, sample_cnt: int,
+                             seed: int,
+                             categorical_features: Sequence[int] = (),
+                             use_missing: bool = True,
+                             zero_as_missing: bool = False
+                             ) -> List[BinMapper]:
+    """Distributed ("parallel find bin") bin construction.
+
+    Mirrors ``DatasetLoader::ConstructBinMappersFromTextData``'s
+    distributed path (``dataset_loader.cpp:863-944``): with the rows
+    partitioned across shards, features are assigned round-robin; shard
+    ``s`` finds the mappers for its feature slice from ITS OWN rows'
+    sample, and the mappers are exchanged SERIALIZED — the reference's
+    ``Network::Allgather`` of ``BinMapper::CopyTo`` buffers, here a
+    bytes round-trip through :meth:`BinMapper.to_bytes` so the wire
+    format is exercised.  Each shard ends up with the identical full
+    mapper list.
+    """
+    S = len(X_shards)
+    if S == 0:
+        return []
+    num_feat = X_shards[0].shape[1]
+    cat = set(int(c) for c in categorical_features)
+    per_shard_cnt = max(sample_cnt // S, 1)
+    # each shard samples its own rows and bins its feature slice
+    wire: List[Tuple[int, bytes]] = []  # (feature, serialized mapper)
+    for s, Xs in enumerate(X_shards):
+        idx = sample_rows(Xs.shape[0], per_shard_cnt, seed + s)
+        for f in range(s, num_feat, S):
+            m = BinMapper()
+            m.find_bin(Xs[idx, f], len(idx), max_bin, min_data_in_bin,
+                       use_missing=use_missing,
+                       zero_as_missing=zero_as_missing,
+                       bin_type=BIN_CATEGORICAL if f in cat
+                       else BIN_NUMERICAL)
+            wire.append((f, m.to_bytes()))
+    # the allgather: every shard deserializes the full set
+    mappers: List[Optional[BinMapper]] = [None] * num_feat
+    for f, blob in wire:
+        mappers[f] = BinMapper.from_bytes(blob)
+    return mappers  # type: ignore[return-value]

@@ -118,14 +118,32 @@ class TpuDataset:
         X = np.ascontiguousarray(X)
         num_data = X.shape[0]
         if mappers is None:
-            mappers = find_bin_mappers(
-                X, max_bin=config.max_bin,
-                min_data_in_bin=config.min_data_in_bin,
-                sample_cnt=config.bin_construct_sample_cnt,
-                seed=config.data_random_seed,
-                categorical_features=categorical_features,
-                use_missing=config.use_missing,
-                zero_as_missing=config.zero_as_missing)
+            ns = config.num_machines \
+                if (config.pre_partition and config.num_machines > 1 and
+                    num_data >= 2 * config.num_machines) else 1
+            if ns > 1:
+                # distributed ("parallel") bin finding: row shards bin
+                # round-robin feature slices from their own samples and
+                # exchange serialized mappers
+                # (dataset_loader.cpp:863-944)
+                from .binning import find_bin_mappers_sharded
+                mappers = find_bin_mappers_sharded(
+                    np.array_split(X, ns), max_bin=config.max_bin,
+                    min_data_in_bin=config.min_data_in_bin,
+                    sample_cnt=config.bin_construct_sample_cnt,
+                    seed=config.data_random_seed,
+                    categorical_features=categorical_features,
+                    use_missing=config.use_missing,
+                    zero_as_missing=config.zero_as_missing)
+            else:
+                mappers = find_bin_mappers(
+                    X, max_bin=config.max_bin,
+                    min_data_in_bin=config.min_data_in_bin,
+                    sample_cnt=config.bin_construct_sample_cnt,
+                    seed=config.data_random_seed,
+                    categorical_features=categorical_features,
+                    use_missing=config.use_missing,
+                    zero_as_missing=config.zero_as_missing)
         used = [i for i, m in enumerate(mappers) if not m.is_trivial]
         dtype = np.uint8 if all(mappers[i].num_bin <= 256 for i in used) \
             else np.uint16
