@@ -67,6 +67,14 @@ def _task_train(params: Dict[str, str], config: Config) -> None:
     if not config.data:
         Log.fatal("No training data: set data=<file>")
     train_set = Dataset(config.data, params=params)
+    if config.save_binary:
+        # cache the binned dataset next to the text file
+        # (Dataset::SaveBinaryFile; reloaded transparently by
+        # data=<file>.bin on later runs); skip when the input already
+        # IS a binary cache
+        from .io.dataset import TpuDataset
+        if not TpuDataset.is_binary_file(config.data):
+            train_set.save_binary(config.data + ".bin")
     valid_sets, valid_names = [], []
     if config.valid:
         for i, path in enumerate(str(config.valid).split(",")):

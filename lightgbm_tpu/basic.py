@@ -49,6 +49,11 @@ def _to_matrix(data, feature_name="auto", categorical_feature="auto"):
                 Log.fatal("pandas object column %s is not supported; "
                           "use category dtype or numeric", col)
         mat = df.values.astype(np.float64)
+    elif hasattr(data, "toarray"):
+        # scipy CSR/CSC/COO: densify (the TPU layout is dense; EFB
+        # re-narrows exclusive sparse columns downstream), matching the
+        # C API's CSR/CSC construction surface (c_api.h:48-232)
+        mat = np.asarray(data.toarray(), dtype=np.float64)
     else:
         mat = np.asarray(data, dtype=np.float64)
         if mat.ndim == 1:

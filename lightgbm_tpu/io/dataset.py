@@ -23,7 +23,8 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from ..utils.log import Log
-from .binning import (BIN_CATEGORICAL, BinMapper, find_bin_mappers)
+from .binning import (BIN_CATEGORICAL, BinMapper, find_bin_mappers,
+                      find_bin_mappers_sharded)
 
 _BINARY_MAGIC = b"LGBTPU_DATASET_V1\n"
 
@@ -118,32 +119,32 @@ class TpuDataset:
         X = np.ascontiguousarray(X)
         num_data = X.shape[0]
         if mappers is None:
+            bin_kwargs = dict(
+                max_bin=config.max_bin,
+                min_data_in_bin=config.min_data_in_bin,
+                sample_cnt=config.bin_construct_sample_cnt,
+                seed=config.data_random_seed,
+                categorical_features=categorical_features,
+                use_missing=config.use_missing,
+                zero_as_missing=config.zero_as_missing)
             ns = config.num_machines \
                 if (config.pre_partition and config.num_machines > 1 and
                     num_data >= 2 * config.num_machines) else 1
             if ns > 1:
                 # distributed ("parallel") bin finding: row shards bin
                 # round-robin feature slices from their own samples and
-                # exchange serialized mappers
-                # (dataset_loader.cpp:863-944)
-                from .binning import find_bin_mappers_sharded
+                # exchange serialized mappers (dataset_loader.cpp:
+                # 863-944).  There is no real machine boundary here, so
+                # shard assignment is RANDOMIZED — contiguous splits of
+                # ordered data would bias each feature's boundaries to
+                # one shard's value range
+                perm = np.random.RandomState(
+                    config.data_random_seed & 0x7FFFFFFF).permutation(
+                        num_data)
                 mappers = find_bin_mappers_sharded(
-                    np.array_split(X, ns), max_bin=config.max_bin,
-                    min_data_in_bin=config.min_data_in_bin,
-                    sample_cnt=config.bin_construct_sample_cnt,
-                    seed=config.data_random_seed,
-                    categorical_features=categorical_features,
-                    use_missing=config.use_missing,
-                    zero_as_missing=config.zero_as_missing)
+                    np.array_split(X[perm], ns), **bin_kwargs)
             else:
-                mappers = find_bin_mappers(
-                    X, max_bin=config.max_bin,
-                    min_data_in_bin=config.min_data_in_bin,
-                    sample_cnt=config.bin_construct_sample_cnt,
-                    seed=config.data_random_seed,
-                    categorical_features=categorical_features,
-                    use_missing=config.use_missing,
-                    zero_as_missing=config.zero_as_missing)
+                mappers = find_bin_mappers(X, **bin_kwargs)
         used = [i for i, m in enumerate(mappers) if not m.is_trivial]
         dtype = np.uint8 if all(mappers[i].num_bin <= 256 for i in used) \
             else np.uint16

@@ -178,9 +178,14 @@ def test_pre_partition_triggers_sharded_binning():
     plain.construct()
     a = sharded._constructed.mappers
     b = plain._constructed.mappers
-    # different sampling/assignment -> generally different boundaries,
-    # but both usable; training works on the sharded-binned dataset
     assert len(a) == len(b) == 4
+    # the sharded path must actually have run: per-shard sampling gives
+    # different boundaries than whole-data binning
+    assert any(
+        len(x.bin_upper_bound) != len(y.bin_upper_bound) or
+        not np.array_equal(np.asarray(x.bin_upper_bound),
+                           np.asarray(y.bin_upper_bound))
+        for x, y in zip(a, b))
     bst = lgb.train({"objective": "binary", "num_leaves": 7,
                      "pre_partition": True, "num_machines": 4,
                      "verbose": -1}, sharded, num_boost_round=3,
