@@ -96,19 +96,22 @@ def main():
 
     iters_done = 2
     t_steady = time.time()
-    measured = 0
+    iter_times = []
     while iters_done < n_iters and (time.time() - t_steady) < budget:
+        t1 = time.time()
         booster.update()
+        iter_times.append(time.time() - t1)
         iters_done += 1
-        measured += 1
     steady_s = time.time() - t_steady
-    if measured == 0:
+    if not iter_times:
         # budget too small for a single steady iteration: fall back to
         # the (compile-inclusive, pessimistic) warmup rate rather than
         # fabricating a near-zero per-iteration time
         per_iter = warmup_s / 2
     else:
-        per_iter = steady_s / measured
+        # median resists the shared-device contention spikes seen on
+        # tunneled TPU runs (2x swings between identical runs)
+        per_iter = sorted(iter_times)[len(iter_times) // 2]
     if iters_done >= n_iters:
         total_s = warmup_s + steady_s
         projected = False
@@ -148,11 +151,13 @@ def main():
             b63.update()
             b63.update()  # compiles
             t0 = time.time()
-            it63 = 0
-            while it63 < 40 and time.time() - t0 < 90:
+            times63 = []
+            while len(times63) < 40 and time.time() - t0 < 90:
+                t1 = time.time()
                 b63.update()
-                it63 += 1
-            per63 = (time.time() - t0) / max(it63, 1)
+                times63.append(time.time() - t1)
+            per63 = sorted(times63)[len(times63) // 2] if times63 \
+                else float("inf")
             out["bins63_iters_per_s"] = round(1.0 / per63, 4)
             out["bins63_projected_500iter_s"] = round(per63 * n_iters, 2)
         except Exception as exc:  # the primary result must survive

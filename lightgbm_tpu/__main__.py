@@ -77,11 +77,19 @@ def _task_train(params: Dict[str, str], config: Config) -> None:
             train_set.save_binary(config.data + ".bin")
     valid_sets, valid_names = [], []
     if config.valid:
+        # valid_data_initscores: one init-score file per valid set
+        vinits = [p.strip() for p in
+                  str(config.valid_data_initscores or "").split(",")]
         for i, path in enumerate(str(config.valid).split(",")):
             path = path.strip()
             if not path:
                 continue
+            init = None
+            if i < len(vinits) and vinits[i]:
+                from .io.parser import load_float_file
+                init = load_float_file(vinits[i])
             valid_sets.append(Dataset(path, params=params,
+                                      init_score=init,
                                       reference=train_set))
             valid_names.append(os.path.basename(path))
 

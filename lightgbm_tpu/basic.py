@@ -125,7 +125,13 @@ class Dataset:
             sq = load_query_file(path + ".query")
             if sq is not None and group is None:
                 group = sq
-            si = load_float_file(path + ".init")
+            # initscore_filename overrides the ``<data>.init`` sidecar
+            # for the TRAINING set only; valid sets get theirs from
+            # valid_data_initscores (wired in the CLI)
+            init_path = ""
+            if self.reference is None:
+                init_path = getattr(cfg, "initscore_filename", "")
+            si = load_float_file(init_path or path + ".init")
             if si is not None and self.init_score is None:
                 self.init_score = si
             cat_idx = []

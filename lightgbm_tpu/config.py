@@ -260,8 +260,6 @@ PARAMS: List[Param] = [
        "normalize lambdas in lambdarank", group="objective"),
     _p("label_gain", [], list, (), "gain per label level in lambdarank",
        group="objective"),
-    _p("mvs_adaptive", True, bool, (),
-       "adaptive threshold in MVS sampling", group="objective"),
     _p("var_weight", 1e-6, float, (),
        "regularizer inside the MVS sampling score "
        "sqrt((sum|g*h|)^2 + var_weight)", group="objective"),
@@ -298,10 +296,16 @@ PARAMS: List[Param] = [
     _p("gpu_device_id", -1, int, (), "(compat) device id", group="device"),
     _p("gpu_use_dp", False, bool, (),
        "use float64 accumulation in device histograms", group="device"),
-    _p("tpu_hist_dtype", "float32", str, (),
-       "accumulator dtype for histogram kernel", group="device"),
-    _p("tpu_rows_per_block", 1024, int, (),
+    _p("tpu_rows_per_block", 2048, int, (),
        "rows per Pallas histogram block", group="device"),
+    _p("use_quantized_grad", False, bool, ("quantized_grad",),
+       "histogram gradients/hessians as stochastically-rounded small "
+       "integers: exact in bf16, so the speculative histogram pass packs "
+       "42 leaves per MXU matmul instead of 21 (device learner only)",
+       group="device"),
+    _p("num_grad_quant_bins", 120, int, (),
+       "quantization levels per side for use_quantized_grad",
+       group="device", check=">0, <=250"),
 ]
 
 _PARAM_BY_NAME: Dict[str, Param] = {p.name: p for p in PARAMS}
@@ -430,6 +434,11 @@ class Config:
                     setattr(self, name, seed + offset)
         self._validate()
         self._warn_inert()
+        # only an explicit user setting moves the global log level — a
+        # default-constructed Config (e.g. a valid set with no params)
+        # must not clobber the level the training config established
+        if "verbosity" in self._user_set:
+            Log.reset_level(self.verbosity)
 
     # params accepted for reference-config compatibility but without
     # effect in the TPU-native design (dense device bins, XLA

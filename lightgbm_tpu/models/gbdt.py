@@ -59,6 +59,7 @@ class GBDT:
         import jax
         import jax.numpy as jnp
         from ..ops.grow import DistConfig, GrowParams, build_tree
+        from ..ops.histogram import multi_width
         from ..ops.split import SplitParams
 
         self.config = config
@@ -205,10 +206,16 @@ class GBDT:
             forced=forced,
             bundled=self._bundles is not None,
             use_hist_pool=use_pool,
-            # speculative child arming fills the MXU lanes (~21 leaves
-            # x 6 value columns ~ 128); enabled on the accelerator
-            # path where the batched pallas kernel exists
-            speculate=(min(21, config.num_leaves)
+            # quantized-gradient histograms (serial device learner):
+            # small ints are exact in bf16, halving the value columns
+            quantize=(config.num_grad_quant_bins
+                      if (config.use_quantized_grad and not dist_active)
+                      else 0),
+            # speculative child arming fills the MXU lanes (21 leaves x
+            # 6 value columns, or 42 x 3 quantized); enabled on the
+            # accelerator path where the batched pallas kernel exists
+            speculate=(min(multi_width(config.use_quantized_grad),
+                           config.num_leaves)
                        if (use_pallas and not dist_active and use_pool
                            and not forced) else 0))
 
@@ -252,6 +259,9 @@ class GBDT:
         self._score = jnp.asarray(score)
         self._rng_feature = np.random.RandomState(
             config.feature_fraction_seed & 0x7FFFFFFF)
+        self._quant_key = (jax.random.PRNGKey(
+            config.data_random_seed & 0x7FFFFFFF)
+            if self.grow_params.quantize else None)
         if objective is not None:
             objective.init(train_set.metadata, n)
 
@@ -464,15 +474,20 @@ class GBDT:
             rec = None
             n_leaves = 1
         else:
+            kw = {}
+            if self.grow_params.quantize:
+                # fresh stochastic-rounding randomness per tree
+                kw["quant_key"] = jax.random.fold_in(
+                    self._quant_key, len(self.models))
             if self._bundle_maps is not None:
                 rec = self._build_tree(self._xt, gp, hp, mask, fmask,
                                        self._num_bins, self._missing_type,
                                        self._is_cat, self.grow_params,
-                                       bundle_maps=self._bundle_maps)
+                                       bundle_maps=self._bundle_maps, **kw)
             else:
                 rec = self._build_tree(self._xt, gp, hp, mask, fmask,
                                        self._num_bins, self._missing_type,
-                                       self._is_cat, self.grow_params)
+                                       self._is_cat, self.grow_params, **kw)
             # ONE device->host transfer per tree: every record except
             # the (N,) leaf assignment (which stays on device for the
             # score update) — host round-trips are ~100ms through a

@@ -91,11 +91,18 @@ class GrowParams:
     # whose children were pre-armed cost no pass at all.  0 = off.
     # Exact best-first semantics either way.  Serial learner only.
     speculate: int = 0
+    # >0: histogram gradients/hessians as stochastically-rounded ints in
+    # [-q, q] (LightGBM 4's quantized-training idea re-cast for the MXU:
+    # small ints are exact in bf16, so the hi/lo mantissa split drops
+    # from 6 value columns to 3 and the speculative pass packs 42
+    # leaves per matmul).  Serial learner only.
+    quantize: int = 0
 
 
 def _hist(xt, vals, p: GrowParams):
     if p.hist_impl == "pallas":
-        return histogram_pallas(xt, vals, p.split.max_bin, p.rows_per_block)
+        return histogram_pallas(xt, vals, p.split.max_bin, p.rows_per_block,
+                                exact=p.quantize > 0)
     return histogram_segsum(xt, vals, p.split.max_bin)
 
 
@@ -140,7 +147,8 @@ def _merge_best(best, axis):
 def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
                sample_mask: jax.Array, feature_mask: jax.Array,
                num_bins: jax.Array, missing_type: jax.Array,
-               is_cat: jax.Array, params: GrowParams, bundle_maps=None):
+               is_cat: jax.Array, params: GrowParams, bundle_maps=None,
+               quant_key=None):
     """Grow one tree.
 
     xt: (F, N) binned features (transposed layout — contiguous per-feature
@@ -182,6 +190,24 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
     kind = dist.kind
     ax = dist.axis
     D = dist.num_shards
+
+    assert p.quantize == 0 or kind == "serial", \
+        "quantized histograms are supported by the serial learner only"
+    hist_scale = None
+    if p.quantize:
+        # stochastic rounding to ±quantize integer levels; sample_mask
+        # must be 0/1 here (fractional weights ride grad/hess, which
+        # the driver pre-multiplies)
+        q = jnp.float32(p.quantize)
+        key = quant_key if quant_key is not None else jax.random.PRNGKey(0)
+        kg, kh = jax.random.split(key)
+        g_w = grad * sample_mask
+        h_w = hess * sample_mask
+        sg = jnp.maximum(jnp.max(jnp.abs(g_w)), jnp.float32(1e-30)) / q
+        sh = jnp.maximum(jnp.max(jnp.abs(h_w)), jnp.float32(1e-30)) / q
+        grad = jnp.floor(g_w / sg + jax.random.uniform(kg, grad.shape))
+        hess = jnp.floor(h_w / sh + jax.random.uniform(kh, hess.shape))
+        hist_scale = jnp.stack([sg, sh, jnp.float32(1.0)])
 
     # static per-feature monotone directions / gain penalties; the
     # tuples are GLOBAL (padded) feature descriptors
@@ -243,6 +269,8 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         m = sample_mask * (leaf_idx == leaf_id)
         vals = jnp.stack([grad * m, hess * m, m], axis=-1)
         h = _hist(xt, vals, p)
+        if hist_scale is not None:
+            h = h * hist_scale  # dequantize: ints -> gradient units
         if kind == "data":
             # HistogramBinEntry::SumReducer over the wire becomes one
             # XLA reduce-scatter over the feature dimension
@@ -260,9 +288,12 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
 
         def multi_hist(sel):
             if p.hist_impl == "pallas":
-                return histogram_pallas_multi(xt, base_vals, sel, B,
-                                              W_spec, p.rows_per_block)
-            return histogram_segsum_multi(xt, base_vals, sel, B, W_spec)
+                h = histogram_pallas_multi(xt, base_vals, sel, B, W_spec,
+                                           p.rows_per_block,
+                                           exact=p.quantize > 0)
+            else:
+                h = histogram_segsum_multi(xt, base_vals, sel, B, W_spec)
+            return h if hist_scale is None else h * hist_scale
 
     def global_stats(local):
         if kind in ("data", "voting"):
@@ -343,6 +374,10 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
     root_stats = global_stats(jnp.stack([jnp.sum(grad * sample_mask),
                                          jnp.sum(hess * sample_mask),
                                          jnp.sum(sample_mask)]))
+    if hist_scale is not None:
+        # keep root stats in the same (dequantized) units as the
+        # histograms so subtraction and FixHistogram stay consistent
+        root_stats = root_stats * hist_scale
     root_mn = -BIG if has_mono else None
     root_mx = BIG if has_mono else None
     root_best = best_of(root_hist, root_stats, jnp.int32(0),
@@ -400,6 +435,7 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         state["armed"] = jnp.zeros(L + 1, bool)
         state["armed_hist"] = jnp.zeros((L + 1, F_hist, B, 3),
                                         jnp.float32)
+        state["n_arm_passes"] = jnp.int32(0)
     if has_mono:
         # per-leaf inherited output bounds (LeafSplits min/max
         # constraint propagation, leaf_splits.hpp:16)
@@ -440,6 +476,7 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         st["armed_hist"] = st["armed_hist"].at[ids_safe].set(hists)
         st["armed"] = st["armed"].at[ids_safe].set(valid_w) \
                                  .at[L].set(False)
+        st["n_arm_passes"] = st["n_arm_passes"] + 1
         return st
 
     def body(t, st):
@@ -620,6 +657,8 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         extra = {k: state[k] for k in
                  ("rec_left_min", "rec_left_max",
                   "rec_right_min", "rec_right_max")}
+    if do_spec:
+        extra["n_arm_passes"] = state["n_arm_passes"]
     return {
         **extra,
         "leaf": state["rec_leaf"],

@@ -11,9 +11,26 @@ one-hot × values matmul on the MXU.  Two implementations:
 - ``histogram_segsum``: jnp reference (segment-sum), used on CPU/tests
   and as the numerical oracle for the kernel.
 - ``histogram_pallas``: Pallas kernel — grid over row tiles, each step
-  loads an (F, T) bin tile + (3, T) value tile into VMEM, builds the
-  (T, B) one-hot per feature and accumulates ``vals @ onehot`` into a
-  (3, F*B) accumulator that lives across grid steps.
+  loads an (FC, T) bin tile + (3, T) value tile into VMEM, builds the
+  (FC, B, T) one-hot per feature and accumulates ``onehot @ vals`` into
+  an (FC*B, C) accumulator that lives across grid steps.
+
+Tiling notes (measured on v5e):
+- The accumulator's row count FC*B must be a multiple of the 128-lane
+  MXU tile or the streamed matmul pays ~40% — bins are padded to
+  ``_pad_bins`` and sliced off on exit.
+- FC=32 features per chunk with 512-row tiles beats 16×1024 by ~25%
+  (fewer, larger one-hot builds against the same accumulator traffic).
+
+Value columns:
+- default: values are split into a bf16 hi part via mantissa masking
+  (which ``--xla_allow_excess_precision`` cannot fold away) plus a bf16
+  residual, so two bf16 passes reach ~2^-16 relative accuracy at full
+  bf16 throughput → 6 columns per histogram triple.
+- ``exact=True``: the caller guarantees values are integers with
+  |v| ≤ 256 (quantized gradients) — exactly representable in bf16, so
+  3 columns suffice.  This doubles the leaf width of the speculative
+  multi-leaf pass (21 → 42 histograms per matmul) for free.
 """
 from __future__ import annotations
 
@@ -23,7 +40,15 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["histogram", "histogram_segsum", "histogram_pallas"]
+__all__ = ["histogram", "histogram_segsum", "histogram_pallas",
+           "histogram_segsum_multi", "histogram_pallas_multi",
+           "multi_width"]
+
+
+def multi_width(exact: bool) -> int:
+    """Leaves per speculative pass: 6 columns each (hi/lo) fills the
+    128-lane MXU tile at 21; exact 3-column values fit 42."""
+    return 42 if exact else 21
 
 
 def histogram_segsum(bins_t: jax.Array, vals: jax.Array, max_bin: int
@@ -38,23 +63,57 @@ def histogram_segsum(bins_t: jax.Array, vals: jax.Array, max_bin: int
     return flat.reshape(f, max_bin, 3)
 
 
-def _hist_kernel(x_ref, v_ref, out_ref, *, max_bin: int):
+def _pad_bins(max_bin: int) -> int:
+    # multiple of 16 keeps FC*B a multiple of 128 for FC ∈ {8,16,32};
+    # padded bins hold no rows and are sliced off on exit
+    return (max_bin + 15) // 16 * 16
+
+
+def _tile(b_pad: int, f_pad: int, cols: int, rows_per_block: int
+          ) -> Tuple[int, int]:
+    """(features-per-chunk, rows-per-tile) under the VMEM budget:
+    one-hot (FC, B, T) bf16 + accumulator (FC*B, cols) f32.  Measured
+    on v5e: larger row tiles win (fewer accumulator revisits), then
+    larger feature chunks."""
+    budget = 20 * 1024 * 1024
+    for fc, t in ((32, 2048), (16, 2048), (32, 1024), (16, 1024),
+                  (8, 2048), (32, 512), (16, 512), (8, 1024), (8, 512),
+                  (8, 256)):
+        if f_pad % fc or t % rows_per_block and rows_per_block % t:
+            continue
+        t_eff = min(t, rows_per_block)
+        vmem = b_pad * (fc * t_eff * 2 + fc * cols * 4) \
+            + fc * t_eff * 4 * 2
+        if vmem <= budget:
+            return fc, t_eff
+    # fallback must keep t dividing the caller's row-padding quantum
+    if rows_per_block % 256 == 0:
+        return 8, 256
+    return 8, rows_per_block
+
+
+def _split_hi_lo(v: jax.Array) -> jax.Array:
+    """(3, T) f32 -> (6, T): exact truncation split, hi = top 16 bits."""
+    v_hi = jax.lax.bitcast_convert_type(
+        jax.lax.bitcast_convert_type(v, jnp.uint32) &
+        jnp.uint32(0xFFFF0000), jnp.float32)
+    return jnp.concatenate([v_hi, v - v_hi], axis=0)
+
+
+def _hist_kernel(x_ref, v_ref, out_ref, *, b_pad: int, cols: int,
+                 exact: bool):
     """One grid step: accumulate one (feature-chunk × row-tile) into the
     shared accumulator.
 
     x_ref: (FC, T) int32 bins; v_ref: (3, T) f32 [grad, hess, count];
-    out_ref: (FC*B, 6) f32 accumulated over the row-tile grid dim (cols
-    0:3 = bf16-hi contribution, 3:6 = residual-lo; caller sums them).
+    out_ref: (FC*B, cols) f32 accumulated over the row-tile grid dim.
 
     Design: the scatter-add of the reference's CPU/OpenCL histogram
     kernels becomes one one-hot × values MXU contraction per tile.  The
     one-hot is laid out (FC*B, T) so the dot STREAMS FC·B rows through
-    the MXU while the tiny (T, 6) value matrix sits stationary as
+    the MXU while the tiny (T, cols) value matrix sits stationary as
     weights; the reverse orientation reloads K×B weight tiles to stream
-    only 6 rows and is ~100x slower.  Values are split into a bf16 hi
-    part via mantissa masking (which --xla_allow_excess_precision cannot
-    fold away) plus a bf16 residual, so two bf16 passes reach ~2^-16
-    relative accuracy at full bf16 throughput.
+    only a few rows and is ~100x slower.
     """
     import jax.experimental.pallas as pl
 
@@ -66,27 +125,23 @@ def _hist_kernel(x_ref, v_ref, out_ref, *, max_bin: int):
         out_ref[...] = jnp.zeros_like(out_ref)
 
     FC, T = x_ref.shape
-    B = max_bin
     x = x_ref[...]  # (FC, T)
     v = v_ref[...]  # (3, T) f32
-    # exact truncation split: hi = top 16 bits of the f32, lo = residual
-    v_hi = jax.lax.bitcast_convert_type(
-        jax.lax.bitcast_convert_type(v, jnp.uint32) &
-        jnp.uint32(0xFFFF0000), jnp.float32)
-    v_lo = v - v_hi
-    vals6 = jnp.concatenate([v_hi, v_lo], axis=0).astype(jnp.bfloat16)
+    rhs = (v if exact else _split_hi_lo(v)).astype(jnp.bfloat16)
     onehot = (x[:, None, :] ==
-              jax.lax.broadcasted_iota(jnp.int32, (FC, B, T), 1)
+              jax.lax.broadcasted_iota(jnp.int32, (FC, b_pad, T), 1)
               ).astype(jnp.bfloat16)
     acc = jax.lax.dot_general(
-        onehot.reshape(FC * B, T), vals6.T, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)  # (FC*B, 6)
+        onehot.reshape(FC * b_pad, T), rhs.T, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)  # (FC*B, cols)
     out_ref[...] += acc
 
 
-@functools.partial(jax.jit, static_argnames=("max_bin", "rows_per_block"))
+@functools.partial(jax.jit,
+                   static_argnames=("max_bin", "rows_per_block", "exact"))
 def histogram_pallas(bins_t: jax.Array, vals: jax.Array, max_bin: int,
-                     rows_per_block: int = 1024) -> jax.Array:
+                     rows_per_block: int = 1024, exact: bool = False
+                     ) -> jax.Array:
     """Pallas histogram. bins_t (F, N) integer, vals (N, 3) f32.
 
     N must be a multiple of rows_per_block (pad with bin 0 / value 0 rows
@@ -95,36 +150,30 @@ def histogram_pallas(bins_t: jax.Array, vals: jax.Array, max_bin: int,
     import jax.experimental.pallas as pl
 
     f, n = bins_t.shape
-    t = rows_per_block
-    assert n % t == 0, (n, t)
-    # feature-chunk size: multiple of 8 (sublane tiling); the one-hot
-    # (FC, B, T) bf16 + (FC*B, 6) f32 accumulator must fit the ~16MB
-    # scoped-VMEM limit — fewer chunks means the per-row-tile one-hot
-    # is rebuilt fewer times
-    per_fc = 2 * max_bin * t + max_bin * 6 * 4
-    budget_fc = max(12 * 1024 * 1024 // per_fc, 8)
-    fc = (budget_fc // 8) * 8
+    b_pad = _pad_bins(max_bin)
+    cols = 3 if exact else 6
     f_pad = (f + 7) // 8 * 8
-    fc = min(fc, f_pad)
-    while f_pad % fc:
-        f_pad += 8
+    fc, t = _tile(b_pad, f_pad, cols, rows_per_block)
+    assert n % t == 0, (n, t)
     xt = bins_t.astype(jnp.int32)  # (F, N)
     if f_pad != f:
         xt = jnp.pad(xt, ((0, f_pad - f), (0, 0)))
     vt = vals.astype(jnp.float32).T  # (3, N)
 
     out = pl.pallas_call(
-        functools.partial(_hist_kernel, max_bin=max_bin),
+        functools.partial(_hist_kernel, b_pad=b_pad, cols=cols,
+                          exact=exact),
         grid=(f_pad // fc, n // t),  # (feature chunks, row tiles)
         in_specs=[
             pl.BlockSpec((fc, t), lambda j, i: (j, i)),
             pl.BlockSpec((3, t), lambda j, i: (0, i)),
         ],
-        out_specs=pl.BlockSpec((fc * max_bin, 6), lambda j, i: (j, 0)),
-        out_shape=jax.ShapeDtypeStruct((f_pad * max_bin, 6), jnp.float32),
+        out_specs=pl.BlockSpec((fc * b_pad, cols), lambda j, i: (j, 0)),
+        out_shape=jax.ShapeDtypeStruct((f_pad * b_pad, cols), jnp.float32),
     )(xt, vt)
-    out = out[:, :3] + out[:, 3:]  # hi + lo passes
-    return out.reshape(f_pad, max_bin, 3)[:f]
+    if not exact:
+        out = out[:, :3] + out[:, 3:]  # hi + lo passes
+    return out.reshape(f_pad, b_pad, 3)[:f, :max_bin]
 
 
 def _pad_rows(n: int, block: int) -> int:
@@ -132,7 +181,8 @@ def _pad_rows(n: int, block: int) -> int:
 
 
 def histogram(bins_t: jax.Array, vals: jax.Array, max_bin: int,
-              impl: str = "auto", rows_per_block: int = 1024) -> jax.Array:
+              impl: str = "auto", rows_per_block: int = 1024,
+              exact: bool = False) -> jax.Array:
     """Dispatching entry point. ``impl``: auto | segsum | pallas."""
     if impl == "auto":
         impl = "pallas" if jax.default_backend() not in ("cpu",) else "segsum"
@@ -144,20 +194,23 @@ def histogram(bins_t: jax.Array, vals: jax.Array, max_bin: int,
         bins_t = jnp.pad(bins_t, ((0, 0), (0, padded - n)))
         vals = jnp.pad(vals, ((0, padded - n), (0, 0)))
         # padded rows land in (feature, bin 0) with value 0 — harmless
-    return histogram_pallas(bins_t, vals, max_bin, rows_per_block)
+    return histogram_pallas(bins_t, vals, max_bin, rows_per_block,
+                            exact=exact)
 
 
-def _hist_kernel_multi(x_ref, v_ref, s_ref, out_ref, *, max_bin: int,
-                       width: int):
+def _hist_kernel_multi(x_ref, v_ref, s_ref, out_ref, *, b_pad: int,
+                       width: int, exact: bool):
     """Multi-leaf variant: one pass accumulates histograms for up to
     ``width`` row-disjoint subsets (the speculative child-arming pass).
 
     x_ref: (FC, T) int32 bins; v_ref: (3, T) f32; s_ref: (1, T) int32
-    subset selector in [-1, width); out_ref: (FC*B, 6*width) f32.
+    subset selector in [-1, width); out_ref: (FC*B, 128) f32, columns
+    beyond cols*width are zero padding.
 
-    The rhs grows from 6 to 6*width columns, filling the MXU lane
-    dimension (~128 at width 21) that the single-leaf pass leaves ~95%
-    idle — a batched pass costs barely more than a single-leaf one.
+    The rhs grows from cols to cols*width columns, filling the MXU lane
+    dimension (126/128 at width 21×6 or 42×3) that the single-leaf pass
+    leaves ~95% idle — a batched pass costs barely more than a
+    single-leaf one.
     """
     import jax.experimental.pallas as pl
 
@@ -166,33 +219,31 @@ def _hist_kernel_multi(x_ref, v_ref, s_ref, out_ref, *, max_bin: int,
         out_ref[...] = jnp.zeros_like(out_ref)
 
     FC, T = x_ref.shape
-    B = max_bin
     x = x_ref[...]
     v = v_ref[...]                      # (3, T)
     sel = s_ref[...]                    # (1, T)
-    v_hi = jax.lax.bitcast_convert_type(
-        jax.lax.bitcast_convert_type(v, jnp.uint32) &
-        jnp.uint32(0xFFFF0000), jnp.float32)
-    v_lo = v - v_hi
-    vals6 = jnp.concatenate([v_hi, v_lo], axis=0)          # (6, T) f32
+    cols = 3 if exact else 6
+    valsc = v if exact else _split_hi_lo(v)            # (cols, T) f32
     sel_oh = (sel == jax.lax.broadcasted_iota(
-        jnp.int32, (width, T), 0)).astype(jnp.float32)     # (W, T)
-    rhs = (sel_oh[:, None, :] * vals6[None, :, :]).reshape(
-        width * 6, T).astype(jnp.bfloat16)                 # (6W, T)
+        jnp.int32, (width, T), 0)).astype(jnp.float32)  # (W, T)
+    rhs = (sel_oh[:, None, :] * valsc[None, :, :]).reshape(
+        width * cols, T).astype(jnp.bfloat16)          # (cols*W, T)
+    rhs = jnp.pad(rhs, ((0, 128 - width * cols), (0, 0)))
     onehot = (x[:, None, :] ==
-              jax.lax.broadcasted_iota(jnp.int32, (FC, B, T), 1)
+              jax.lax.broadcasted_iota(jnp.int32, (FC, b_pad, T), 1)
               ).astype(jnp.bfloat16)
     acc = jax.lax.dot_general(
-        onehot.reshape(FC * B, T), rhs.T, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)                # (FC*B, 6W)
+        onehot.reshape(FC * b_pad, T), rhs.T, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)            # (FC*B, 128)
     out_ref[...] += acc
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("max_bin", "width", "rows_per_block"))
+@functools.partial(jax.jit, static_argnames=("max_bin", "width",
+                                             "rows_per_block", "exact"))
 def histogram_pallas_multi(bins_t: jax.Array, vals: jax.Array,
                            sel: jax.Array, max_bin: int, width: int,
-                           rows_per_block: int = 1024) -> jax.Array:
+                           rows_per_block: int = 1024,
+                           exact: bool = False) -> jax.Array:
     """Batched histogram over ``width`` disjoint row subsets.
 
     bins_t (F, N) ints; vals (N, 3) f32; sel (N,) int32 subset id per
@@ -201,19 +252,13 @@ def histogram_pallas_multi(bins_t: jax.Array, vals: jax.Array,
     import jax.experimental.pallas as pl
 
     f, n = bins_t.shape
-    t = rows_per_block
-    assert n % t == 0, (n, t)
+    b_pad = _pad_bins(max_bin)
+    cols = 3 if exact else 6
     W = width
-    # VMEM: onehot (FC,B,T) bf16 + out block (FC*B, 6W) f32 within the
-    # ~16MB scoped limit; fewer feature chunks means the per-row-tile
-    # onehot and rhs are rebuilt fewer times
-    per_fc = 2 * max_bin * t + max_bin * 6 * W * 4
-    budget_fc = max(12 * 1024 * 1024 // per_fc, 8)
-    fc = (budget_fc // 8) * 8
+    assert W * cols <= 128, (W, cols)
     f_pad = (f + 7) // 8 * 8
-    fc = min(fc, f_pad)
-    while f_pad % fc:
-        f_pad += 8
+    fc, t = _tile(b_pad, f_pad, 128, rows_per_block)
+    assert n % t == 0, (n, t)
     xt = bins_t.astype(jnp.int32)
     if f_pad != f:
         xt = jnp.pad(xt, ((0, f_pad - f), (0, 0)))
@@ -221,20 +266,22 @@ def histogram_pallas_multi(bins_t: jax.Array, vals: jax.Array,
     st = sel.astype(jnp.int32)[None, :]      # (1, N)
 
     out = pl.pallas_call(
-        functools.partial(_hist_kernel_multi, max_bin=max_bin, width=W),
+        functools.partial(_hist_kernel_multi, b_pad=b_pad, width=W,
+                          exact=exact),
         grid=(f_pad // fc, n // t),
         in_specs=[
             pl.BlockSpec((fc, t), lambda j, i: (j, i)),
             pl.BlockSpec((3, t), lambda j, i: (0, i)),
             pl.BlockSpec((1, t), lambda j, i: (0, i)),
         ],
-        out_specs=pl.BlockSpec((fc * max_bin, 6 * W), lambda j, i: (j, 0)),
-        out_shape=jax.ShapeDtypeStruct((f_pad * max_bin, 6 * W),
+        out_specs=pl.BlockSpec((fc * b_pad, 128), lambda j, i: (j, 0)),
+        out_shape=jax.ShapeDtypeStruct((f_pad * b_pad, 128),
                                        jnp.float32),
     )(xt, vt, st)
-    out = out.reshape(f_pad, max_bin, W, 6)
-    out = out[..., :3] + out[..., 3:]        # hi + lo
-    return jnp.moveaxis(out[:f], 2, 0)       # (W, F, B, 3)
+    out = out[:, :cols * W].reshape(f_pad, b_pad, W, cols)
+    if not exact:
+        out = out[..., :3] + out[..., 3:]    # hi + lo
+    return jnp.moveaxis(out[:f, :max_bin], 2, 0)   # (W, F, B, 3)
 
 
 def histogram_segsum_multi(bins_t: jax.Array, vals: jax.Array,

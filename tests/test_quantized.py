@@ -1,0 +1,47 @@
+"""Quantized-gradient training (use_quantized_grad).
+
+Histogram gradients/hessians are stochastically rounded to small
+integers inside the growth loop (``ops/grow.py``); the split search
+runs on dequantized sums.  The mode exists for the TPU kernel's
+exact-bf16 fast path; on the segsum backend it exercises the same
+quantize → dequantize algebra, so CPU tests pin its accuracy.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _auc(extra, X, y, Xv, yv):
+    train = lgb.Dataset(X, label=y)
+    valid = lgb.Dataset(Xv, label=yv, reference=train)
+    res = {}
+    params = {"objective": "binary", "num_leaves": 31, "verbose": -1,
+              "metric": "auc", **extra}
+    lgb.train(params, train, num_boost_round=30, valid_sets=[valid],
+              valid_names=["v"], evals_result=res)
+    return res["v"]["auc"][-1]
+
+
+def test_quantized_matches_exact_auc(rng):
+    X = rng.randn(4000, 10).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] + 0.3 * rng.randn(4000) > 0).astype(np.float32)
+    Xv = rng.randn(2000, 10).astype(np.float32)
+    yv = (Xv[:, 0] + Xv[:, 1] + 0.3 * rng.randn(2000) > 0).astype(np.float32)
+    exact = _auc({}, X, y, Xv, yv)
+    quant = _auc({"use_quantized_grad": True}, X, y, Xv, yv)
+    assert abs(exact - quant) < 0.01
+    assert quant > 0.95
+
+
+def test_quantized_regression_l2(rng):
+    X = rng.randn(3000, 8).astype(np.float32)
+    y = (X[:, 0] * 2 + np.sin(X[:, 1]) + 0.1 * rng.randn(3000)).astype(
+        np.float32)
+    train = lgb.Dataset(X, label=y)
+    params = {"objective": "regression", "num_leaves": 31, "verbose": -1,
+              "use_quantized_grad": True, "num_grad_quant_bins": 60}
+    bst = lgb.train(params, train, num_boost_round=20)
+    pred = bst.predict(X)
+    resid = float(np.mean((pred - y) ** 2))
+    assert resid < 0.25 * float(np.var(y))
