@@ -1,0 +1,98 @@
+/*
+ * C API for lightgbm_tpu — the stable non-Python entry point.
+ *
+ * Mirrors the reference's exported surface (include/LightGBM/c_api.h:
+ * handles, dtype/predict-type constants, int return codes with
+ * LGBM_GetLastError) so callers written against the reference's C API
+ * can link against libltpu_capi.so instead.  The implementation embeds
+ * CPython and forwards to the lightgbm_tpu package (see
+ * lightgbm_tpu/capi.py); the embedding is an implementation detail
+ * invisible to the C caller.
+ *
+ * Thread safety: every call takes the GIL; mutating calls on one
+ * booster serialize exactly like the reference's per-booster mutex
+ * (src/c_api.cpp:84).
+ */
+#ifndef LTPU_C_API_H_
+#define LTPU_C_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+
+#define C_API_DTYPE_FLOAT32 (0)
+#define C_API_DTYPE_FLOAT64 (1)
+#define C_API_DTYPE_INT32   (2)
+#define C_API_DTYPE_INT64   (3)
+
+#define C_API_PREDICT_NORMAL     (0)
+#define C_API_PREDICT_RAW_SCORE  (1)
+#define C_API_PREDICT_LEAF_INDEX (2)
+#define C_API_PREDICT_CONTRIB    (3)
+
+const char* LGBM_GetLastError(void);
+
+int LGBM_DatasetCreateFromFile(const char* filename, const char* parameters,
+                               const DatasetHandle reference,
+                               DatasetHandle* out);
+int LGBM_DatasetCreateFromMat(const void* data, int data_type, int32_t nrow,
+                              int32_t ncol, int is_row_major,
+                              const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out);
+int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
+                         const void* field_data, int num_element, int type);
+/* out_ptr points into dataset-owned memory, valid until
+ * LGBM_DatasetFree (reference semantics). */
+int LGBM_DatasetGetField(DatasetHandle handle, const char* field_name,
+                         int* out_len, const void** out_ptr, int* out_type);
+int LGBM_DatasetGetNumData(DatasetHandle handle, int* out);
+int LGBM_DatasetGetNumFeature(DatasetHandle handle, int* out);
+int LGBM_DatasetSaveBinary(DatasetHandle handle, const char* filename);
+int LGBM_DatasetFree(DatasetHandle handle);
+
+int LGBM_BoosterCreate(const DatasetHandle train_data,
+                       const char* parameters, BoosterHandle* out);
+int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out);
+int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out);
+int LGBM_BoosterAddValidData(BoosterHandle handle,
+                             const DatasetHandle valid_data);
+int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
+int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle, const float* grad,
+                                    const float* hess, int* is_finished);
+int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
+int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len);
+int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out_iteration);
+int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out_len);
+int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
+                        double* out_results);
+int LGBM_BoosterGetEvalNames(BoosterHandle handle, int* out_len,
+                             char** out_strs);
+int LGBM_BoosterGetFeatureNames(BoosterHandle handle, int* out_len,
+                                char** out_strs);
+int LGBM_BoosterSaveModel(BoosterHandle handle, int num_iteration,
+                          const char* filename);
+int LGBM_BoosterSaveModelToString(BoosterHandle handle, int num_iteration,
+                                  int64_t buffer_len, int64_t* out_len,
+                                  char* out_str);
+int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
+                              int data_type, int32_t nrow, int32_t ncol,
+                              int is_row_major, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result);
+int LGBM_BoosterFree(BoosterHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* LTPU_C_API_H_ */

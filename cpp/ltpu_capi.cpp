@@ -1,0 +1,503 @@
+/*
+ * C API implementation: embedded-CPython shim over lightgbm_tpu.
+ *
+ * The reference implements its C API natively (src/c_api.cpp, 1572
+ * LoC) because its core is C++.  Here the core is Python/JAX, so the
+ * stable C entry embeds the interpreter once per process and forwards
+ * every call to lightgbm_tpu/capi.py, marshalling only C scalars,
+ * strings and raw buffers across the boundary.  Handles are strong
+ * PyObject references to Dataset/Booster instances.
+ *
+ * Error model mirrors the reference (c_api.h:36): functions return 0
+ * on success, -1 on failure, with the message in LGBM_GetLastError()
+ * (thread-local).
+ */
+#include "ltpu_c_api.h"
+
+#include <Python.h>
+#include <dlfcn.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+thread_local std::string g_last_error = "everything is fine";
+std::once_flag g_init_flag;
+PyObject* g_capi_module = nullptr;  // lightgbm_tpu.capi, never released
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  PyErr_NormalizeException(&type, &value, &trace);
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* msg = PyUnicode_AsUTF8(s);
+      g_last_error = msg != nullptr ? msg : "unknown python error";
+      Py_DECREF(s);
+    }
+  } else {
+    g_last_error = "unknown python error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+}
+
+/* Package root: LTPU_PACKAGE_DIR env, else the directory containing
+ * this shared library's parent (repo layout: <root>/cpp/libltpu_capi.so
+ * next to <root>/lightgbm_tpu/). */
+std::string package_root() {
+  const char* env = std::getenv("LTPU_PACKAGE_DIR");
+  if (env != nullptr && env[0] != '\0') return env;
+  Dl_info info;
+  if (dladdr(reinterpret_cast<void*>(&LGBM_GetLastError), &info) != 0 &&
+      info.dli_fname != nullptr) {
+    std::string so_path = info.dli_fname;
+    auto slash = so_path.find_last_of('/');
+    if (slash != std::string::npos) {
+      std::string dir = so_path.substr(0, slash);      // .../cpp
+      auto slash2 = dir.find_last_of('/');
+      if (slash2 != std::string::npos) return dir.substr(0, slash2);
+    }
+  }
+  return ".";
+}
+
+void initialize() {
+  bool embedded = false;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);  /* leaves this thread holding the GIL */
+    embedded = true;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* sys_path = PySys_GetObject("path");  // borrowed
+  if (sys_path != nullptr) {
+    PyObject* root = PyUnicode_FromString(package_root().c_str());
+    if (root != nullptr) {
+      PyList_Insert(sys_path, 0, root);
+      Py_DECREF(root);
+    }
+  }
+  g_capi_module = PyImport_ImportModule("lightgbm_tpu.capi");
+  if (g_capi_module == nullptr) set_error_from_python();
+  PyGILState_Release(gil);
+  if (embedded) {
+    /* release the init thread's GIL so every caller thread (including
+     * this one, via PyGILState_Ensure) can take it symmetrically */
+    PyEval_SaveThread();
+  }
+}
+
+class Gil {
+ public:
+  Gil() {
+    std::call_once(g_init_flag, initialize);
+    state_ = PyGILState_Ensure();
+  }
+  ~Gil() { PyGILState_Release(state_); }
+  bool ready() const {
+    if (g_capi_module == nullptr) {
+      g_last_error = "lightgbm_tpu.capi failed to import (set "
+                     "LTPU_PACKAGE_DIR to the package root)";
+      return false;
+    }
+    return true;
+  }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+/* Call capi.<fname>(*args); returns a NEW reference or nullptr. */
+PyObject* call(const char* fname, PyObject* args) {
+  PyObject* fn = PyObject_GetAttrString(g_capi_module, fname);
+  if (fn == nullptr) {
+    Py_XDECREF(args);
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject* res = PyObject_CallObject(fn, args);
+  Py_DECREF(fn);
+  Py_XDECREF(args);
+  if (res == nullptr) set_error_from_python();
+  return res;
+}
+
+PyObject* ref_or_none(const void* handle) {
+  if (handle == nullptr) Py_RETURN_NONE;
+  PyObject* o = const_cast<PyObject*>(static_cast<const PyObject*>(handle));
+  Py_INCREF(o);
+  return o;
+}
+
+PyObject* view(const void* data, Py_ssize_t nbytes) {
+  return PyMemoryView_FromMemory(
+      const_cast<char*>(static_cast<const char*>(data)), nbytes, PyBUF_READ);
+}
+
+int copy_bytes_out(PyObject* bytes_obj, double* out, int64_t* out_len) {
+  char* buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(bytes_obj, &buf, &n) != 0) {
+    set_error_from_python();
+    return -1;
+  }
+  std::memcpy(out, buf, static_cast<size_t>(n));
+  *out_len = static_cast<int64_t>(n) / static_cast<int64_t>(sizeof(double));
+  return 0;
+}
+
+int copy_strings_out(PyObject* list, int* out_len, char** out_strs) {
+  Py_ssize_t n = PyList_Size(list);
+  *out_len = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(list, i));
+    if (s == nullptr) {
+      set_error_from_python();
+      return -1;
+    }
+    std::strcpy(out_strs[i], s);  /* caller pre-allocates (reference ABI) */
+  }
+  return 0;
+}
+
+size_t dtype_size(int data_type) {
+  return data_type == C_API_DTYPE_FLOAT64 || data_type == C_API_DTYPE_INT64
+             ? 8 : 4;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* LGBM_GetLastError(void) { return g_last_error.c_str(); }
+
+int LGBM_DatasetCreateFromFile(const char* filename, const char* parameters,
+                               const DatasetHandle reference,
+                               DatasetHandle* out) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("dataset_from_file",
+                       Py_BuildValue("(ssN)", filename,
+                                     parameters ? parameters : "",
+                                     ref_or_none(reference)));
+  if (res == nullptr) return -1;
+  *out = res;
+  return 0;
+}
+
+int LGBM_DatasetCreateFromMat(const void* data, int data_type, int32_t nrow,
+                              int32_t ncol, int is_row_major,
+                              const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  Py_ssize_t nbytes = static_cast<Py_ssize_t>(nrow) * ncol *
+                      static_cast<Py_ssize_t>(dtype_size(data_type));
+  PyObject* res = call(
+      "dataset_from_mat",
+      Py_BuildValue("(NiiiisN)", view(data, nbytes), data_type, nrow, ncol,
+                    is_row_major, parameters ? parameters : "",
+                    ref_or_none(reference)));
+  if (res == nullptr) return -1;
+  *out = res;
+  return 0;
+}
+
+int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
+                         const void* field_data, int num_element, int type) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  Py_ssize_t nbytes =
+      static_cast<Py_ssize_t>(num_element) *
+      static_cast<Py_ssize_t>(dtype_size(type));
+  PyObject* res = call("dataset_set_field",
+                       Py_BuildValue("(NsNii)", ref_or_none(handle),
+                                     field_name, view(field_data, nbytes),
+                                     num_element, type));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_DatasetGetField(DatasetHandle handle, const char* field_name,
+                         int* out_len, const void** out_ptr,
+                         int* out_type) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("dataset_get_field",
+                       Py_BuildValue("(Ns)", ref_or_none(handle),
+                                     field_name));
+  if (res == nullptr) return -1;
+  PyObject* arr = PyTuple_GetItem(res, 0);  /* borrowed; owned by dataset */
+  *out_len = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(res, 1)));
+  *out_type = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(res, 2)));
+  *out_ptr = nullptr;
+  if (arr != Py_None && *out_len > 0) {
+    Py_buffer buf;
+    if (PyObject_GetBuffer(arr, &buf, PyBUF_SIMPLE) != 0) {
+      set_error_from_python();
+      Py_DECREF(res);
+      return -1;
+    }
+    *out_ptr = buf.buf;  /* memory outlives the view: stashed on dataset */
+    PyBuffer_Release(&buf);
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_DatasetGetNumData(DatasetHandle handle, int* out) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("dataset_num_data",
+                       Py_BuildValue("(N)", ref_or_none(handle)));
+  if (res == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_DatasetGetNumFeature(DatasetHandle handle, int* out) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("dataset_num_feature",
+                       Py_BuildValue("(N)", ref_or_none(handle)));
+  if (res == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_DatasetSaveBinary(DatasetHandle handle, const char* filename) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("dataset_save_binary",
+                       Py_BuildValue("(Ns)", ref_or_none(handle), filename));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_DatasetFree(DatasetHandle handle) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+int LGBM_BoosterCreate(const DatasetHandle train_data, const char* parameters,
+                       BoosterHandle* out) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("booster_create",
+                       Py_BuildValue("(Ns)", ref_or_none(train_data),
+                                     parameters ? parameters : ""));
+  if (res == nullptr) return -1;
+  *out = res;
+  return 0;
+}
+
+int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("booster_from_file", Py_BuildValue("(s)", filename));
+  if (res == nullptr) return -1;
+  *out = PyTuple_GetItem(res, 0);
+  Py_INCREF(static_cast<PyObject*>(*out));
+  *out_num_iterations =
+      static_cast<int>(PyLong_AsLong(PyTuple_GetItem(res, 1)));
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("booster_from_string", Py_BuildValue("(s)", model_str));
+  if (res == nullptr) return -1;
+  *out = PyTuple_GetItem(res, 0);
+  Py_INCREF(static_cast<PyObject*>(*out));
+  *out_num_iterations =
+      static_cast<int>(PyLong_AsLong(PyTuple_GetItem(res, 1)));
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_BoosterAddValidData(BoosterHandle handle,
+                             const DatasetHandle valid_data) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  static int valid_count = 0;
+  std::string name = "valid_" + std::to_string(valid_count++);
+  PyObject* res = call("booster_add_valid",
+                       Py_BuildValue("(NNs)", ref_or_none(handle),
+                                     ref_or_none(valid_data), name.c_str()));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("booster_update",
+                       Py_BuildValue("(N)", ref_or_none(handle)));
+  if (res == nullptr) return -1;
+  *is_finished = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle, const float* grad,
+                                    const float* hess, int* is_finished) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* nres = call("booster_num_data_for_custom",
+                        Py_BuildValue("(N)", ref_or_none(handle)));
+  if (nres == nullptr) return -1;
+  long n = PyLong_AsLong(nres);
+  Py_DECREF(nres);
+  Py_ssize_t nbytes = static_cast<Py_ssize_t>(n) * 4;
+  PyObject* res = call("booster_update_custom",
+                       Py_BuildValue("(NNNl)", ref_or_none(handle),
+                                     view(grad, nbytes), view(hess, nbytes),
+                                     n));
+  if (res == nullptr) return -1;
+  *is_finished = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_BoosterRollbackOneIter(BoosterHandle handle) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("booster_rollback",
+                       Py_BuildValue("(N)", ref_or_none(handle)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+#define LTPU_INT_GETTER(cname, pyname)                                   \
+  int cname(BoosterHandle handle, int* out) {                            \
+    Gil gil;                                                             \
+    if (!gil.ready()) return -1;                                         \
+    PyObject* res = call(pyname, Py_BuildValue("(N)",                    \
+                                               ref_or_none(handle)));    \
+    if (res == nullptr) return -1;                                       \
+    *out = static_cast<int>(PyLong_AsLong(res));                         \
+    Py_DECREF(res);                                                      \
+    return 0;                                                            \
+  }
+
+LTPU_INT_GETTER(LGBM_BoosterGetNumClasses, "booster_num_classes")
+LTPU_INT_GETTER(LGBM_BoosterGetCurrentIteration, "booster_current_iteration")
+LTPU_INT_GETTER(LGBM_BoosterGetNumFeature, "booster_num_feature")
+
+int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
+                        double* out_results) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("booster_eval",
+                       Py_BuildValue("(Ni)", ref_or_none(handle), data_idx));
+  if (res == nullptr) return -1;
+  int64_t n = 0;
+  int rc = copy_bytes_out(res, out_results, &n);
+  Py_DECREF(res);
+  *out_len = static_cast<int>(n);
+  return rc;
+}
+
+int LGBM_BoosterGetEvalNames(BoosterHandle handle, int* out_len,
+                             char** out_strs) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("booster_eval_names",
+                       Py_BuildValue("(N)", ref_or_none(handle)));
+  if (res == nullptr) return -1;
+  int rc = copy_strings_out(res, out_len, out_strs);
+  Py_DECREF(res);
+  return rc;
+}
+
+int LGBM_BoosterGetFeatureNames(BoosterHandle handle, int* out_len,
+                                char** out_strs) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("booster_feature_names",
+                       Py_BuildValue("(N)", ref_or_none(handle)));
+  if (res == nullptr) return -1;
+  int rc = copy_strings_out(res, out_len, out_strs);
+  Py_DECREF(res);
+  return rc;
+}
+
+int LGBM_BoosterSaveModel(BoosterHandle handle, int num_iteration,
+                          const char* filename) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("booster_save_model",
+                       Py_BuildValue("(Nis)", ref_or_none(handle),
+                                     num_iteration, filename));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_BoosterSaveModelToString(BoosterHandle handle, int num_iteration,
+                                  int64_t buffer_len, int64_t* out_len,
+                                  char* out_str) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("booster_model_to_string",
+                       Py_BuildValue("(Ni)", ref_or_none(handle),
+                                     num_iteration));
+  if (res == nullptr) return -1;
+  Py_ssize_t n = 0;
+  const char* s = PyUnicode_AsUTF8AndSize(res, &n);
+  if (s == nullptr) {
+    set_error_from_python();
+    Py_DECREF(res);
+    return -1;
+  }
+  *out_len = static_cast<int64_t>(n) + 1;
+  if (buffer_len >= *out_len) std::memcpy(out_str, s, n + 1);
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
+                              int data_type, int32_t nrow, int32_t ncol,
+                              int is_row_major, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  Py_ssize_t nbytes = static_cast<Py_ssize_t>(nrow) * ncol *
+                      static_cast<Py_ssize_t>(dtype_size(data_type));
+  PyObject* res = call(
+      "booster_predict_mat",
+      Py_BuildValue("(NNiiiiiis)", ref_or_none(handle), view(data, nbytes),
+                    data_type, nrow, ncol, is_row_major, predict_type,
+                    num_iteration, parameter ? parameter : ""));
+  if (res == nullptr) return -1;
+  int rc = copy_bytes_out(res, out_result, out_len);
+  Py_DECREF(res);
+  return rc;
+}
+
+int LGBM_BoosterFree(BoosterHandle handle) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,235 @@
+"""Python half of the C API shim (``cpp/ltpu_capi.cpp``).
+
+The reference exposes its whole framework through 58 exported C
+functions (``include/LightGBM/c_api.h``, ``src/c_api.cpp``) that the
+Python/R/SWIG bindings call.  This build inverts the stack — the
+framework IS Python/JAX — so the stable non-Python entry point is a
+C shared library embedding CPython and forwarding into this module.
+Every function here takes/returns only C-friendly values (ints, str,
+bytes, opaque object handles) so the C side stays a thin marshalling
+layer.
+
+Matrix buffers arrive as memoryviews over the caller's pointer
+(``PyMemoryView_FromMemory``); they are copied into numpy immediately —
+the C caller's buffer is never retained.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import Config
+
+# C_API_DTYPE_* (c_api.h:20-23)
+_DTYPES = {0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64}
+# C_API_PREDICT_* (c_api.h:25-28)
+_PRED_NORMAL, _PRED_RAW, _PRED_LEAF, _PRED_CONTRIB = 0, 1, 2, 3
+
+
+def _params(parameters: str) -> dict:
+    return Config.str2dict(parameters or "")
+
+
+def _mat(mv: memoryview, data_type: int, nrow: int, ncol: int,
+         is_row_major: int) -> np.ndarray:
+    dt = _DTYPES[data_type]
+    arr = np.frombuffer(mv, dtype=dt, count=nrow * ncol)
+    if is_row_major:
+        return np.array(arr.reshape(nrow, ncol))
+    return np.array(arr.reshape(ncol, nrow).T)
+
+
+# ---- dataset -------------------------------------------------------------
+
+def dataset_from_file(filename: str, parameters: str,
+                      reference: Optional[Dataset]) -> Dataset:
+    p = _params(parameters)
+    d = Dataset(filename, params=p, reference=reference)
+    d.construct()
+    return d
+
+
+def dataset_from_mat(mv: memoryview, data_type: int, nrow: int, ncol: int,
+                     is_row_major: int, parameters: str,
+                     reference: Optional[Dataset]) -> Dataset:
+    X = _mat(mv, data_type, nrow, ncol, is_row_major)
+    d = Dataset(X, params=_params(parameters), reference=reference)
+    return d
+
+
+def dataset_set_field(d: Dataset, name: str, mv: memoryview,
+                      num_element: int, data_type: int) -> None:
+    arr = np.frombuffer(mv, dtype=_DTYPES[data_type], count=num_element)
+    d.set_field(name, np.array(arr))
+
+
+def dataset_get_field(d: Dataset, name: str):
+    """(array, element count, dtype code).  The array is stashed on the
+    Dataset so the C caller's pointer stays valid until DatasetFree
+    (the reference returns pointers into dataset-owned memory too)."""
+    v = d.get_field(name)
+    if v is None:
+        return None, 0, 0
+    v = np.ascontiguousarray(v)
+    if v.dtype == np.int32:
+        code = 2
+    else:
+        v = np.ascontiguousarray(v, np.float32)
+        code = 0
+    d.__dict__.setdefault("_capi_field_bufs", {})[name] = v
+    return v, int(v.size), code
+
+
+def dataset_num_data(d: Dataset) -> int:
+    return int(d.num_data())
+
+
+def dataset_num_feature(d: Dataset) -> int:
+    return int(d.num_feature())
+
+
+def dataset_save_binary(d: Dataset, filename: str) -> None:
+    d.save_binary(filename)
+
+
+# ---- booster -------------------------------------------------------------
+
+def booster_create(train: Dataset, parameters: str) -> Booster:
+    return Booster(params=_params(parameters), train_set=train)
+
+
+def booster_from_file(filename: str) -> Tuple[Booster, int]:
+    b = Booster(model_file=filename)
+    return b, int(b.current_iteration())
+
+
+def booster_from_string(model_str: str) -> Tuple[Booster, int]:
+    b = Booster(model_str=model_str)
+    return b, int(b.current_iteration())
+
+
+def booster_add_valid(b: Booster, d: Dataset, name: str) -> None:
+    b.add_valid(d, name)
+
+
+def booster_update(b: Booster) -> int:
+    return 1 if b.update() else 0
+
+
+def booster_update_custom(b: Booster, grad_mv: memoryview,
+                          hess_mv: memoryview, n: int) -> int:
+    # buffers are (num_class * num_data,) flat, class-major like the
+    # reference's score arrays; reshape so the per-class loop in
+    # train_one_iter sees (num_class, num_data)
+    k = booster_num_classes(b)
+    grad = np.array(np.frombuffer(grad_mv, dtype=np.float32, count=n))
+    hess = np.array(np.frombuffer(hess_mv, dtype=np.float32, count=n))
+    if k > 1:
+        grad = grad.reshape(k, -1)
+        hess = hess.reshape(k, -1)
+
+    def fobj(preds, train_set):
+        return grad, hess
+    return 1 if b.update(fobj=fobj) else 0
+
+
+def booster_rollback(b: Booster) -> None:
+    b.rollback_one_iter()
+
+
+def booster_num_data_for_custom(b: Booster) -> int:
+    """Rows in the training set — the grad/hess length the C caller of
+    LGBM_BoosterUpdateOneIterCustom must supply (× num classes)."""
+    g = b._gbdt
+    n = int(g.num_data) if g is not None else 0
+    return n * booster_num_classes(b)
+
+
+def booster_num_classes(b: Booster) -> int:
+    g = b._gbdt
+    if g is None:
+        return 1
+    return int(getattr(g, "num_class", 0) or
+               getattr(g, "num_tree_per_iteration", 1))
+
+
+def booster_current_iteration(b: Booster) -> int:
+    return int(b.current_iteration())
+
+
+def booster_num_feature(b: Booster) -> int:
+    return len(b.feature_name())
+
+
+def booster_eval(b: Booster, data_idx: int) -> bytes:
+    """Metric values for data_idx (0 = train, i = i-th valid) as f64,
+    evaluated on demand like the reference's GetEvalAt."""
+    g = b._gbdt
+    if g is None:
+        return b""
+    if data_idx == 0:
+        rows = g._eval_one_set("training", g.train_score,
+                               g.train_set.metadata)
+        vals = [val for (_n, val, _hb) in _norm_rows(rows)]
+    else:
+        vals = []
+        names_seen: List[str] = []
+        for (dname, _mname, val, _hb) in b.eval_valid():
+            if dname not in names_seen:
+                names_seen.append(dname)
+            if len(names_seen) == data_idx:
+                vals.append(val)
+    return np.asarray(vals, np.float64).tobytes()
+
+
+def _norm_rows(rows) -> List[Tuple[str, float, bool]]:
+    """_eval_one_set rows are (metric_name, value, higher_better)."""
+    out = []
+    for r in rows:
+        if len(r) == 4:
+            out.append((r[1], r[2], r[3]))
+        else:
+            out.append((r[0], r[1], r[2]))
+    return out
+
+
+def booster_eval_names(b: Booster) -> List[str]:
+    return list(getattr(b, "_metric_names", []) or [])
+
+
+def booster_feature_names(b: Booster) -> List[str]:
+    return list(b.feature_name())
+
+
+def booster_save_model(b: Booster, num_iteration: int,
+                       filename: str) -> None:
+    b.save_model(filename,
+                 num_iteration=num_iteration if num_iteration > 0 else None)
+
+
+def booster_model_to_string(b: Booster, num_iteration: int) -> str:
+    return b.model_to_string(
+        num_iteration=num_iteration if num_iteration > 0 else None)
+
+
+def booster_predict_mat(b: Booster, mv: memoryview, data_type: int,
+                        nrow: int, ncol: int, is_row_major: int,
+                        predict_type: int, num_iteration: int,
+                        parameters: str) -> bytes:
+    X = _mat(mv, data_type, nrow, ncol, is_row_major)
+    ni = num_iteration if num_iteration > 0 else None
+    kw = {}
+    # str2dict values are raw strings; coerce through the registry so
+    # "pred_early_stop=false" disables rather than truthy-enables
+    coerced = Config(_params(parameters)) if parameters else None
+    for k in ("pred_early_stop", "pred_early_stop_freq",
+              "pred_early_stop_margin"):
+        if coerced is not None and k in coerced._user_set:
+            kw[k] = getattr(coerced, k)
+    out = b.predict(X, num_iteration=ni,
+                    raw_score=predict_type == _PRED_RAW,
+                    pred_leaf=predict_type == _PRED_LEAF,
+                    pred_contrib=predict_type == _PRED_CONTRIB, **kw)
+    return np.asarray(out, np.float64).reshape(-1).tobytes()

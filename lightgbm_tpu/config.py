@@ -497,12 +497,22 @@ class Config:
 
     @staticmethod
     def str2dict(text: str) -> Dict[str, Any]:
-        """Parse CLI/conf ``key=value`` lines (``Config::KV2Map``)."""
+        """Parse ``key=value`` parameters (``Config::KV2Map``).
+
+        Accepts both the conf-file form (one pair per line, spaces
+        allowed around ``=``) and the C-API/CLI string form
+        (space-separated ``k1=v1 k2=v2`` pairs on one line)."""
         out: Dict[str, Any] = {}
         for line in text.splitlines():
             line = line.split("#", 1)[0].strip()
             if not line or "=" not in line:
                 continue
-            k, v = line.split("=", 1)
-            out[k.strip()] = v.strip()
+            tokens = line.split()
+            if len(tokens) > 1 and all("=" in t for t in tokens):
+                for t in tokens:
+                    k, v = t.split("=", 1)
+                    out[k.strip()] = v.strip()
+            else:
+                k, v = line.split("=", 1)
+                out[k.strip()] = v.strip()
         return out
