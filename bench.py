@@ -162,6 +162,36 @@ def main():
             out["bins63_projected_500iter_s"] = round(per63 * n_iters, 2)
         except Exception as exc:  # the primary result must survive
             out["bins63_error"] = str(exc)[:200]
+
+    # tertiary: Epsilon-shaped wide dense data (400K x 2000,
+    # docs/GPU-Performance.rst:141 runs Epsilon on GPU) — exercises the
+    # histogram kernel's feature-chunked grid at 70x Higgs width
+    # opt-in: the wide pipeline carries ~5 min of datagen + binning +
+    # compile overhead, too heavy for the default driver budget
+    if backend != "cpu" and os.environ.get("BENCH_WIDE", "") == "1":
+        try:
+            rng = np.random.RandomState(7)
+            n_w, f_w = 400_000, 2000
+            Xw = rng.randn(n_w, f_w).astype(np.float32)
+            yw = (Xw[:, :8].sum(axis=1) + 0.5 * rng.randn(n_w) > 0
+                  ).astype(np.float32)
+            pw = dict(params, max_bin=63)
+            dw = lgb.Dataset(Xw, label=yw, params=pw)
+            dw.construct()
+            bw = lgb.Booster(params=pw, train_set=dw)
+            bw.update()
+            bw.update()
+            t0 = time.time()
+            times_w = []
+            while len(times_w) < 20 and time.time() - t0 < 60:
+                t1 = time.time()
+                bw.update()
+                times_w.append(time.time() - t1)
+            if times_w:
+                perw = sorted(times_w)[len(times_w) // 2]
+                out["epsilon_shape_iters_per_s"] = round(1.0 / perw, 4)
+        except Exception as exc:
+            out["epsilon_shape_error"] = str(exc)[:200]
     print(json.dumps(out))
 
 

@@ -406,3 +406,124 @@ void ltpu_value_to_bin(const double* vals, int64_t n, const double* ub,
 }
 
 }  // extern "C"
+
+namespace {
+
+// Whole-matrix numerical binning: one threaded call replacing the
+// per-column python loop (strided column extraction + f64 conversion +
+// int32->narrow copy per feature dominate wide datasets).  X is the
+// raw (n x f_total) row-major matrix; cols lists the used NUMERICAL
+// feature indices; bounds are concatenated per-column with ub_off
+// offsets (len n_cols+1).  out is (n x n_cols) row-major uint8
+// (out_is_u16=0) or uint16 (=1).  Categorical columns go through the
+// python path and overwrite their slice.
+// Branchless lower_bound: first index whose element is >= v.  The
+// conditional-move loop avoids the branch mispredicts that make
+// std::lower_bound ~70ns/value on random data.
+inline int64_t LowerBoundCmov(const double* ub, int64_t len, double v) {
+  const double* base = ub;
+  while (len > 1) {
+    int64_t half = len >> 1;
+    base += (base[half - 1] < v) ? half : 0;
+    len -= half;
+  }
+  return (base - ub) + (base[0] < v ? 1 : 0);
+}
+
+template <typename T, typename OutT>
+void BinMatrixCols(const T* X, int64_t n, int64_t f_total,
+                   const int32_t* cols, int64_t n_cols,
+                   const double* ub_flat, const int64_t* ub_off,
+                   const int32_t* missing_type, const int32_t* num_bin,
+                   double kzero, OutT* out, int64_t lo, int64_t hi) {
+  // column-major inner loops: per-column constants hoist and the
+  // search runs against one cache-resident bounds array at a time
+  for (int64_t j = 0; j < n_cols; ++j) {
+    const double* ub = ub_flat + ub_off[j];
+    const int64_t n_ub = ub_off[j + 1] - ub_off[j];
+    const int mt = missing_type[j];
+    const int nb = num_bin[j];
+    const int n_val = nb - 1;
+    const T* src = X + cols[j];
+    OutT* dst = out + j;
+    if (mt == 2) {  // NaN bin
+      const int64_t cap = n_val < n_ub ? n_val : n_ub;
+      for (int64_t i = lo; i < hi; ++i) {
+        double v = static_cast<double>(src[i * f_total]);
+        int64_t b;
+        if (std::isnan(v)) {
+          b = nb - 1;
+        } else {
+          int64_t idx = LowerBoundCmov(ub, cap, v);
+          b = idx < n_val - 1 ? idx : n_val - 1;
+        }
+        dst[i * n_cols] = static_cast<OutT>(b);
+      }
+    } else if (mt == 1) {  // zero bin
+      for (int64_t i = lo; i < hi; ++i) {
+        double v = static_cast<double>(src[i * f_total]);
+        int64_t b;
+        if (std::isnan(v) || std::fabs(v) <= kzero) {
+          b = nb - 1;
+        } else {
+          int64_t idx = LowerBoundCmov(ub, n_ub, v);
+          b = idx < n_val - 1 ? idx : n_val - 1;
+        }
+        dst[i * n_cols] = static_cast<OutT>(b);
+      }
+    } else {
+      for (int64_t i = lo; i < hi; ++i) {
+        double v = static_cast<double>(src[i * f_total]);
+        if (std::isnan(v)) v = 0.0;
+        int64_t idx = LowerBoundCmov(ub, n_ub, v);
+        dst[i * n_cols] =
+            static_cast<OutT>(idx < nb - 1 ? idx : nb - 1);
+      }
+    }
+  }
+}
+
+template <typename T>
+void BinMatrixImpl(const T* X, int64_t n, int64_t f_total,
+                   const int32_t* cols, int64_t n_cols,
+                   const double* ub_flat, const int64_t* ub_off,
+                   const int32_t* missing_type, const int32_t* num_bin,
+                   double kzero, int out_is_u16, void* out) {
+  ParallelFor(n, [&](int64_t lo, int64_t hi) {
+    if (out_is_u16) {
+      BinMatrixCols<T, uint16_t>(X, n, f_total, cols, n_cols, ub_flat,
+                                 ub_off, missing_type, num_bin, kzero,
+                                 static_cast<uint16_t*>(out), lo, hi);
+    } else {
+      BinMatrixCols<T, uint8_t>(X, n, f_total, cols, n_cols, ub_flat,
+                                ub_off, missing_type, num_bin, kzero,
+                                static_cast<uint8_t*>(out), lo, hi);
+    }
+  });
+}
+
+}  // namespace
+
+extern "C" {
+
+void ltpu_bin_matrix_f32(const float* X, int64_t n, int64_t f_total,
+                         const int32_t* cols, int64_t n_cols,
+                         const double* ub_flat, const int64_t* ub_off,
+                         const int32_t* missing_type,
+                         const int32_t* num_bin, double kzero,
+                         int out_is_u16, void* out) {
+  BinMatrixImpl<float>(X, n, f_total, cols, n_cols, ub_flat, ub_off,
+                       missing_type, num_bin, kzero, out_is_u16, out);
+}
+
+void ltpu_bin_matrix_f64(const double* X, int64_t n, int64_t f_total,
+                         const int32_t* cols, int64_t n_cols,
+                         const double* ub_flat, const int64_t* ub_off,
+                         const int32_t* missing_type,
+                         const int32_t* num_bin, double kzero,
+                         int out_is_u16, void* out) {
+  BinMatrixImpl<double>(X, n, f_total, cols, n_cols, ub_flat, ub_off,
+                        missing_type, num_bin, kzero, out_is_u16, out);
+}
+
+}  // extern "C"

@@ -48,14 +48,22 @@ def _to_matrix(data, feature_name="auto", categorical_feature="auto"):
             elif df[col].dtype == object:
                 Log.fatal("pandas object column %s is not supported; "
                           "use category dtype or numeric", col)
-        mat = df.values.astype(np.float64)
+        mat = df.values
+        if mat.dtype != np.float32:
+            mat = mat.astype(np.float64)
     elif hasattr(data, "toarray"):
         # scipy CSR/CSC/COO: densify (the TPU layout is dense; EFB
         # re-narrows exclusive sparse columns downstream), matching the
         # C API's CSR/CSC construction surface (c_api.h:48-232)
-        mat = np.asarray(data.toarray(), dtype=np.float64)
+        mat = np.asarray(data.toarray())
+        if mat.dtype != np.float32:
+            mat = mat.astype(np.float64)
     else:
-        mat = np.asarray(data, dtype=np.float64)
+        # float32 is kept narrow (the reference's python binding casts
+        # everything to float32, basic.py:270); other dtypes go f64
+        mat = np.asarray(data)
+        if mat.dtype != np.float32:
+            mat = np.asarray(mat, dtype=np.float64)
         if mat.ndim == 1:
             mat = mat.reshape(-1, 1)
     if feature_name != "auto" and feature_name is not None:

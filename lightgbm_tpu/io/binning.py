@@ -176,8 +176,7 @@ class BinMapper:
         if len(vals) == 0:
             self.bin_upper_bound = np.array([np.inf])
         else:
-            order = np.argsort(vals, kind="stable")
-            svals = vals[order]
+            svals = np.sort(vals)  # values only — no permutation needed
             distinct, counts = _unique_with_counts(svals)
             bounds = _find_boundaries(distinct, counts, eff_max_bin,
                                       len(vals), min_data_in_bin)
@@ -329,8 +328,14 @@ class BinMapper:
 
 
 def _unique_with_counts(sorted_vals: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-    distinct, counts = np.unique(sorted_vals, return_counts=True)
-    return distinct, counts
+    """np.unique on an ALREADY-SORTED array without the re-sort."""
+    n = len(sorted_vals)
+    if n == 0:
+        return sorted_vals, np.zeros(0, np.int64)
+    edges = np.flatnonzero(sorted_vals[1:] != sorted_vals[:-1]) + 1
+    starts = np.concatenate([[0], edges])
+    counts = np.diff(np.concatenate([starts, [n]]))
+    return sorted_vals[starts], counts
 
 
 def sample_rows(num_data: int, sample_cnt: int, seed: int) -> np.ndarray:
@@ -349,14 +354,31 @@ def find_bin_mappers(X: np.ndarray, max_bin: int, min_data_in_bin: int,
     """Build one ``BinMapper`` per column of a dense matrix."""
     num_data, num_feat = X.shape
     idx = sample_rows(num_data, sample_cnt, seed)
+    # materialize the sample once: per-feature fancy indexing into a
+    # wide row-major matrix costs O(sample × features) random reads
+    Xs = X[idx] if len(idx) < num_data else X
     cat = set(int(c) for c in categorical_features)
-    mappers = []
-    for f in range(num_feat):
+    mappers: List[Optional[BinMapper]] = [None] * num_feat
+
+    def one(f: int) -> None:
         m = BinMapper()
-        m.find_bin(X[idx, f], len(idx), max_bin, min_data_in_bin,
+        m.find_bin(Xs[:, f], Xs.shape[0], max_bin, min_data_in_bin,
                    use_missing=use_missing, zero_as_missing=zero_as_missing,
                    bin_type=BIN_CATEGORICAL if f in cat else BIN_NUMERICAL)
-        mappers.append(m)
+        mappers[f] = m
+
+    if num_feat >= 64:
+        # the heavy per-feature ops (sort, unique, boundary search)
+        # release the GIL — thread the loop like the reference's
+        # OMP-parallel FindBin (dataset_loader.cpp:791)
+        import concurrent.futures as cf
+        import os as _os
+        workers = min(16, _os.cpu_count() or 4)
+        with cf.ThreadPoolExecutor(max_workers=workers) as ex:
+            list(ex.map(one, range(num_feat)))
+    else:
+        for f in range(num_feat):
+            one(f)
     return mappers
 
 

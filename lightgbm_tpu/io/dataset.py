@@ -148,9 +148,32 @@ class TpuDataset:
         used = [i for i, m in enumerate(mappers) if not m.is_trivial]
         dtype = np.uint8 if all(mappers[i].num_bin <= 256 for i in used) \
             else np.uint16
-        binned = np.zeros((num_data, len(used)), dtype=dtype)
-        for j, f in enumerate(used):
-            binned[:, j] = mappers[f].value_to_bin(X[:, f]).astype(dtype)
+        binned = None
+        from .binning import BIN_NUMERICAL, KZERO
+        num_js = [j for j, f in enumerate(used)
+                  if mappers[f].bin_type == BIN_NUMERICAL]
+        if num_js:
+            # numerical columns take the one-pass native binner;
+            # categorical columns (rare, python dict mapping) overwrite
+            # their slices below
+            from . import native
+            binned = native.bin_matrix(
+                X, [used[j] for j in num_js],
+                [mappers[used[j]].bin_upper_bound for j in num_js],
+                [mappers[used[j]].missing_type for j in num_js],
+                [mappers[used[j]].num_bin for j in num_js], KZERO, dtype)
+        if binned is not None and len(num_js) < len(used):
+            full = np.zeros((num_data, len(used)), dtype=dtype)
+            full[:, num_js] = binned
+            binned = full
+            for j, f in enumerate(used):
+                if mappers[f].bin_type != BIN_NUMERICAL:
+                    binned[:, j] = mappers[f].value_to_bin(
+                        X[:, f]).astype(dtype)
+        if binned is None:
+            binned = np.zeros((num_data, len(used)), dtype=dtype)
+            for j, f in enumerate(used):
+                binned[:, j] = mappers[f].value_to_bin(X[:, f]).astype(dtype)
         meta = Metadata(num_data)
         meta.set_label(label if label is not None else np.zeros(num_data))
         meta.set_weight(weight)

@@ -121,6 +121,20 @@ def _bind_binning(lib):
         ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
         ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_int,
         ctypes.c_int, ctypes.c_double, ctypes.POINTER(ctypes.c_int32)]
+    if hasattr(lib, "ltpu_bin_matrix_f32"):
+        tail = [ctypes.c_int64, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_double, ctypes.c_int, ctypes.c_void_p]
+        lib.ltpu_bin_matrix_f32.restype = None
+        lib.ltpu_bin_matrix_f32.argtypes = \
+            [ctypes.POINTER(ctypes.c_float)] + tail
+        lib.ltpu_bin_matrix_f64.restype = None
+        lib.ltpu_bin_matrix_f64.argtypes = \
+            [ctypes.POINTER(ctypes.c_double)] + tail
 
 
 def find_boundaries(distinct, counts, max_bin: int, total_cnt: int,
@@ -142,6 +156,47 @@ def find_boundaries(distinct, counts, max_bin: int, total_cnt: int,
         int(min_data_in_bin), float(kzero),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
     return list(out[:nb])
+
+
+def bin_matrix(X, cols, ub_list, missing_types, num_bins,
+               kzero: float, dtype):
+    """One threaded pass binning all numerical columns of a row-major
+    float32/float64 matrix; None when the native lib is unavailable.
+
+    ``cols``: used column indices; ``ub_list``: per-used-column upper
+    bounds; ``dtype``: np.uint8 or np.uint16 for the output."""
+    lib = _load()
+    if lib is None:
+        return None
+    if not hasattr(lib, "_binning_bound"):
+        _bind_binning(lib)
+        lib._binning_bound = True
+    if not hasattr(lib, "ltpu_bin_matrix_f32"):
+        return None  # older prebuilt lib
+    if X.dtype == np.float32:
+        fn, ptr = lib.ltpu_bin_matrix_f32, ctypes.POINTER(ctypes.c_float)
+    elif X.dtype == np.float64:
+        fn, ptr = lib.ltpu_bin_matrix_f64, ctypes.POINTER(ctypes.c_double)
+    else:
+        return None
+    X = np.ascontiguousarray(X)
+    n, f_total = X.shape
+    cols = np.ascontiguousarray(cols, np.int32)
+    ub_flat = np.ascontiguousarray(np.concatenate(ub_list), np.float64)
+    ub_off = np.zeros(len(ub_list) + 1, np.int64)
+    np.cumsum([len(u) for u in ub_list], out=ub_off[1:])
+    mt = np.ascontiguousarray(missing_types, np.int32)
+    nb = np.ascontiguousarray(num_bins, np.int32)
+    out = np.empty((n, len(cols)), dtype)
+    fn(X.ctypes.data_as(ptr), n, f_total,
+       cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(cols),
+       ub_flat.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+       ub_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+       mt.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+       nb.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+       float(kzero), int(dtype == np.uint16),
+       out.ctypes.data_as(ctypes.c_void_p))
+    return out
 
 
 def value_to_bin_numerical(values, upper_bounds, missing_type: int,

@@ -86,6 +86,11 @@ def parse_file_full(path: str, header: bool = False,
                     weight_column: str = "", group_column: str = "",
                     max_probe_lines: int = 32):
     """parse_file + extracted (weight, group) columns."""
+    if str(path).startswith(("hdfs://", "s3://", "gs://")):
+        # the reference's optional HDFS VirtualFileReader
+        # (src/io/file_io.cpp:53, -DUSE_HDFS) has no TPU-image analog
+        Log.fatal("remote filesystem paths are not supported (%s); "
+                  "stage the file locally", path)
     if not os.path.exists(path):
         Log.fatal("data file %s does not exist", path)
     with open(path, "r") as f:

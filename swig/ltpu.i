@@ -1,0 +1,32 @@
+/*
+ * SWIG interface for the lightgbm_tpu C API — capability parity with
+ * the reference's swig/lightgbmlib.i (re-exports the whole C API to
+ * Java plus pointer/array helpers).
+ *
+ * Generate (Java):
+ *   swig -java -package io.ltpu -outdir java_out swig/ltpu.i
+ * then compile the generated wrapper against libltpu_capi.so.
+ */
+%module ltpulib
+
+%{
+#include "../cpp/ltpu_c_api.h"
+%}
+
+%include "stdint.i"
+%include "carrays.i"
+%include "cpointer.i"
+
+/* array/pointer helpers mirroring lightgbmlib.i:17-30 */
+%array_functions(double, doubleArray)
+%array_functions(float, floatArray)
+%array_functions(int, intArray)
+%array_functions(long, longArray)
+%pointer_functions(int, intp)
+%pointer_functions(long, longp)
+%pointer_functions(double, doublep)
+%pointer_functions(float, floatp)
+%pointer_functions(int64_t, int64_tp)
+%pointer_functions(void*, voidpp)
+
+%include "../cpp/ltpu_c_api.h"

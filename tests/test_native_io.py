@@ -78,3 +78,33 @@ def test_native_weight_group_columns(native_lib, tmp_path):
                                      weight_column="3")
     np.testing.assert_array_equal(X, Xp)
     np.testing.assert_array_equal(w, wp)
+
+
+def test_bin_matrix_matches_python_path(native_lib, rng):
+    """native.bin_matrix == per-column BinMapper.value_to_bin, incl.
+    mixed categorical + numerical and every missing type."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.io import native
+
+    n = 20_000
+    X = rng.randn(n, 8).astype(np.float32)
+    X[X > 1.8] = np.nan                      # NaN missing path
+    X[:, 2] = np.where(rng.rand(n) < 0.6, 0.0, X[:, 2])  # zero-heavy
+    X[:, 5] = rng.randint(0, 12, size=n)     # categorical
+    y = (np.nansum(X[:, :3], axis=1) > 0).astype(np.float32)
+
+    for extra in ({}, {"zero_as_missing": True}):
+        params = {"max_bin": 63, "verbose": -1,
+                  "categorical_feature": [5], **extra}
+        d1 = lgb.Dataset(X, label=y, params=params,
+                         categorical_feature=[5])
+        d1.construct()
+        saved, native._LIB = native._LIB, None
+        try:
+            d2 = lgb.Dataset(X, label=y, params=params,
+                             categorical_feature=[5])
+            d2.construct()
+        finally:
+            native._LIB = saved
+        np.testing.assert_array_equal(d1._constructed.binned,
+                                      d2._constructed.binned)
