@@ -45,6 +45,12 @@ int LGBM_DatasetCreateFromMat(const void* data, int data_type, int32_t nrow,
                               const char* parameters,
                               const DatasetHandle reference,
                               DatasetHandle* out);
+int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t nindptr, int64_t nelem,
+                              int64_t num_col, const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out);
 int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
                          const void* field_data, int num_element, int type);
 /* out_ptr points into dataset-owned memory, valid until
@@ -87,6 +93,13 @@ int LGBM_BoosterSaveModelToString(BoosterHandle handle, int num_iteration,
 int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
                               int data_type, int32_t nrow, int32_t ncol,
                               int is_row_major, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result);
+int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem,
+                              int64_t num_col, int predict_type,
                               int num_iteration, const char* parameter,
                               int64_t* out_len, double* out_result);
 int LGBM_BoosterFree(BoosterHandle handle);

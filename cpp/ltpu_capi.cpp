@@ -208,6 +208,31 @@ int LGBM_DatasetCreateFromMat(const void* data, int data_type, int32_t nrow,
   return 0;
 }
 
+int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t nindptr, int64_t nelem,
+                              int64_t num_col, const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  Py_ssize_t ip_bytes =
+      nindptr * static_cast<Py_ssize_t>(dtype_size(indptr_type));
+  Py_ssize_t dat_bytes =
+      nelem * static_cast<Py_ssize_t>(dtype_size(data_type));
+  PyObject* res = call(
+      "dataset_from_csr",
+      Py_BuildValue("(NiNNiLLLsN)", view(indptr, ip_bytes), indptr_type,
+                    view(indices, nelem * 4), view(data, dat_bytes),
+                    data_type, static_cast<long long>(nindptr),
+                    static_cast<long long>(nelem),
+                    static_cast<long long>(num_col),
+                    parameters ? parameters : "", ref_or_none(reference)));
+  if (res == nullptr) return -1;
+  *out = res;
+  return 0;
+}
+
 int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
                          const void* field_data, int num_element, int type) {
   Gil gil;
@@ -487,6 +512,34 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
       "booster_predict_mat",
       Py_BuildValue("(NNiiiiiis)", ref_or_none(handle), view(data, nbytes),
                     data_type, nrow, ncol, is_row_major, predict_type,
+                    num_iteration, parameter ? parameter : ""));
+  if (res == nullptr) return -1;
+  int rc = copy_bytes_out(res, out_result, out_len);
+  Py_DECREF(res);
+  return rc;
+}
+
+int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem,
+                              int64_t num_col, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  Py_ssize_t ip_bytes =
+      nindptr * static_cast<Py_ssize_t>(dtype_size(indptr_type));
+  Py_ssize_t dat_bytes =
+      nelem * static_cast<Py_ssize_t>(dtype_size(data_type));
+  PyObject* res = call(
+      "booster_predict_csr",
+      Py_BuildValue("(NNiNNiLLLiis)", ref_or_none(handle),
+                    view(indptr, ip_bytes), indptr_type,
+                    view(indices, nelem * 4), view(data, dat_bytes),
+                    data_type, static_cast<long long>(nindptr),
+                    static_cast<long long>(nelem),
+                    static_cast<long long>(num_col), predict_type,
                     num_iteration, parameter ? parameter : ""));
   if (res == nullptr) return -1;
   int rc = copy_bytes_out(res, out_result, out_len);

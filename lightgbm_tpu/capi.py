@@ -41,6 +41,17 @@ def _mat(mv: memoryview, data_type: int, nrow: int, ncol: int,
     return np.array(arr.reshape(ncol, nrow).T)
 
 
+def _csr(indptr_mv, indptr_type, indices_mv, data_mv, data_type,
+         nindptr: int, nelem: int, num_col: int):
+    import scipy.sparse as sp
+    ip_dt = _DTYPES[indptr_type]
+    indptr = np.frombuffer(indptr_mv, dtype=ip_dt, count=nindptr)
+    indices = np.frombuffer(indices_mv, dtype=np.int32, count=nelem)
+    data = np.frombuffer(data_mv, dtype=_DTYPES[data_type], count=nelem)
+    return sp.csr_matrix((data.copy(), indices.copy(), indptr.copy()),
+                         shape=(nindptr - 1, num_col))
+
+
 # ---- dataset -------------------------------------------------------------
 
 def dataset_from_file(filename: str, parameters: str,
@@ -57,6 +68,24 @@ def dataset_from_mat(mv: memoryview, data_type: int, nrow: int, ncol: int,
     X = _mat(mv, data_type, nrow, ncol, is_row_major)
     d = Dataset(X, params=_params(parameters), reference=reference)
     return d
+
+
+def dataset_from_csr(indptr_mv, indptr_type, indices_mv, data_mv,
+                     data_type, nindptr: int, nelem: int, num_col: int,
+                     parameters: str, reference: Optional[Dataset]
+                     ) -> Dataset:
+    m = _csr(indptr_mv, indptr_type, indices_mv, data_mv, data_type,
+             nindptr, nelem, num_col)
+    return Dataset(m, params=_params(parameters), reference=reference)
+
+
+def booster_predict_csr(b: Booster, indptr_mv, indptr_type, indices_mv,
+                        data_mv, data_type, nindptr: int, nelem: int,
+                        num_col: int, predict_type: int,
+                        num_iteration: int, parameters: str) -> bytes:
+    m = _csr(indptr_mv, indptr_type, indices_mv, data_mv, data_type,
+             nindptr, nelem, num_col)
+    return _predict(b, m, predict_type, num_iteration, parameters)
 
 
 def dataset_set_field(d: Dataset, name: str, mv: memoryview,
@@ -214,12 +243,13 @@ def booster_model_to_string(b: Booster, num_iteration: int) -> str:
         num_iteration=num_iteration if num_iteration > 0 else None)
 
 
-def booster_predict_mat(b: Booster, mv: memoryview, data_type: int,
-                        nrow: int, ncol: int, is_row_major: int,
-                        predict_type: int, num_iteration: int,
-                        parameters: str) -> bytes:
-    X = _mat(mv, data_type, nrow, ncol, is_row_major)
-    ni = num_iteration if num_iteration > 0 else None
+def _predict(b: Booster, data, predict_type: int, num_iteration: int,
+             parameters: str) -> bytes:
+    """Shared predict path for the mat/CSR entry points.
+
+    ``num_iteration <= 0`` means the full ensemble (reference C-API
+    semantics; ``Booster.predict`` treats an explicit 0/-1 the same
+    way, only ``None`` falls back to best_iteration)."""
     kw = {}
     # str2dict values are raw strings; coerce through the registry so
     # "pred_early_stop=false" disables rather than truthy-enables
@@ -228,8 +258,16 @@ def booster_predict_mat(b: Booster, mv: memoryview, data_type: int,
               "pred_early_stop_margin"):
         if coerced is not None and k in coerced._user_set:
             kw[k] = getattr(coerced, k)
-    out = b.predict(X, num_iteration=ni,
+    out = b.predict(data, num_iteration=num_iteration,
                     raw_score=predict_type == _PRED_RAW,
                     pred_leaf=predict_type == _PRED_LEAF,
                     pred_contrib=predict_type == _PRED_CONTRIB, **kw)
     return np.asarray(out, np.float64).reshape(-1).tobytes()
+
+
+def booster_predict_mat(b: Booster, mv: memoryview, data_type: int,
+                        nrow: int, ncol: int, is_row_major: int,
+                        predict_type: int, num_iteration: int,
+                        parameters: str) -> bytes:
+    X = _mat(mv, data_type, nrow, ncol, is_row_major)
+    return _predict(b, X, predict_type, num_iteration, parameters)

@@ -131,3 +131,54 @@ def test_c_program_smoke():
                          capture_output=True, text=True, timeout=280)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "CAPI_SMOKE_OK" in out.stdout
+
+
+def test_csr_create_and_predict(capi, rng):
+    """LGBM_DatasetCreateFromCSR + LGBM_BoosterPredictForCSR round-trip
+    against the dense-mat path on equivalent data."""
+    import scipy.sparse as sp
+    X = rng.randn(300, 8).astype(np.float32)
+    X[X < 0.3] = 0.0
+    y = (X[:, 0] + X[:, 1] > 0.5).astype(np.float32)
+    m = sp.csr_matrix(X)
+    indptr = m.indptr.astype(np.int32)
+    indices = m.indices.astype(np.int32)
+    data = m.data.astype(np.float64)
+
+    ds = ctypes.c_void_p()
+    _chk(capi, capi.LGBM_DatasetCreateFromCSR(
+        indptr.ctypes.data_as(ctypes.c_void_p), 2,
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        data.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(len(data)),
+        ctypes.c_int64(8), b"max_bin=63 verbose=-1", None,
+        ctypes.byref(ds)))
+    _chk(capi, capi.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 300, 0))
+    bst = ctypes.c_void_p()
+    _chk(capi, capi.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbose=-1 min_data_in_leaf=5",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(8):
+        _chk(capi, capi.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    pred_csr = np.zeros(300, np.float64)
+    plen = ctypes.c_int64()
+    _chk(capi, capi.LGBM_BoosterPredictForCSR(
+        bst, indptr.ctypes.data_as(ctypes.c_void_p), 2,
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        data.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(len(data)),
+        ctypes.c_int64(8), 0, 0, b"", ctypes.byref(plen),
+        pred_csr.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert plen.value == 300
+    pred_mat = np.zeros(300, np.float64)
+    Xd = np.ascontiguousarray(X)
+    _chk(capi, capi.LGBM_BoosterPredictForMat(
+        bst, Xd.ctypes.data_as(ctypes.c_void_p), 0, 300, 8, 1, 0, 0, b"",
+        ctypes.byref(plen), pred_mat.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_double))))
+    np.testing.assert_allclose(pred_csr, pred_mat, rtol=1e-9, atol=1e-12)
+    capi.LGBM_BoosterFree(bst)
+    capi.LGBM_DatasetFree(ds)
