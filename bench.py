@@ -135,12 +135,12 @@ def main():
         "datagen_s": round(gen_s, 2),
     }
     if iter_times:
-        # the tunneled shared chip shows 1.5-2x wall-clock swings
-        # between identical runs; the fastest iteration bounds the
-        # uncontended per-iteration cost
-        out["best_iter_s"] = round(min(iter_times), 3)
-        out["best_projected_500iter_s"] = round(
-            warmup_s + min(iter_times) * (n_iters - 2), 2)
+        # fastest iteration bounds the uncontended per-iteration cost
+        # (same contention-swing rationale as the median above)
+        best = min(iter_times)
+        out["best_iter_s"] = round(best, 3)
+        out["best_projected_s"] = round(
+            warmup_s + best * (n_iters - 2), 2)
 
     # secondary: the reference's GPU-comparison config (63 bins,
     # docs/GPU-Performance.rst:109-139) — histogram work is 4x lighter
