@@ -32,11 +32,20 @@ def train(params: Dict[str, Any], train_set: Dataset,
           callbacks: Optional[List[Callable]] = None, mesh=None) -> Booster:
     """Train a booster (``engine.py:19`` in the reference)."""
     params = dict(params)
-    for alias in ("num_boost_round", "num_iterations", "num_iteration",
-                  "num_tree", "num_trees", "num_round", "num_rounds",
-                  "n_estimators"):
-        if alias in params:
-            num_boost_round = int(params.pop(alias))
+    # canonical name first, then aliases (Config resolution order);
+    # num_boost_round is accepted for reference-python compatibility
+    _round_aliases = ("num_iterations", "num_iteration", "n_iter",
+                      "num_tree", "num_trees", "num_round", "num_rounds",
+                      "num_boost_round", "n_estimators", "max_iter")
+    _seen = [(a, params.pop(a)) for a in _round_aliases if a in params]
+    if _seen:
+        # highest-priority alias wins, like Config's alias resolution;
+        # conflicting values get the reference's "will be ignored" warning
+        num_boost_round = int(_seen[0][1])
+        for a, v in _seen[1:]:
+            if int(v) != num_boost_round:
+                Log.warning("%s is set with %s=%d, %s=%s will be ignored",
+                            _seen[0][0], _seen[0][0], num_boost_round, a, v)
     if fobj is not None:
         params["objective"] = params.get("objective", "none")
         if params["objective"] not in ("none", "custom"):

@@ -27,8 +27,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ORACLE = os.path.join(REPO, ".refbuild", "src", "lightgbm")
 EXAMPLES = "/root/reference/examples"
 
-pytestmark = pytest.mark.skipif(not os.path.exists(ORACLE),
-                                reason="oracle reference build not present")
+pytestmark = pytest.mark.skipif(
+    not (os.path.exists(ORACLE) and os.path.isdir(EXAMPLES)),
+    reason="oracle reference build or reference examples not present")
 
 
 def _oracle(exdir, *args):
@@ -162,24 +163,15 @@ def test_lambdarank_matches_oracle(tmp_path):
     m_pred = bst.predict(Xt, raw_score=True)
 
     from lightgbm_tpu.io.parser import load_query_file
+    from lightgbm_tpu.metrics import NDCGMetric
     q = load_query_file(os.path.join(exdir, "rank.test.query"))
     bounds = np.concatenate([[0], np.cumsum(q)]).astype(int)
     yt = np.asarray(yt, float)
+    metric = NDCGMetric(Config({"eval_at": [5]}))
 
     def ndcg5(scores):
-        vals = []
-        for a, b in zip(bounds[:-1], bounds[1:]):
-            rel = yt[a:b]
-            if rel.sum() <= 0 or b - a < 2:
-                continue
-            order = np.argsort(-np.asarray(scores[a:b]))
-            k = min(5, b - a)
-            gains = (2.0 ** rel - 1)
-            disc = 1.0 / np.log2(np.arange(2, k + 2))
-            dcg = float((gains[order[:k]] * disc).sum())
-            ideal = float((np.sort(gains)[::-1][:k] * disc).sum())
-            vals.append(dcg / ideal)
-        return float(np.mean(vals))
+        return metric.eval(yt, np.asarray(scores, float),
+                           query_boundaries=bounds)
 
     n_o, n_m = ndcg5(o_pred), ndcg5(m_pred)
     assert n_m >= n_o - 0.03, (n_m, n_o)
