@@ -68,7 +68,10 @@ def main():
     import lightgbm_tpu as lgb
 
     t0 = time.time()
-    X, y = make_higgs_shaped(n_rows, N_FEATURES)
+    n_hold = 200_000
+    X, y = make_higgs_shaped(n_rows + n_hold, N_FEATURES)
+    X, Xh = X[:n_rows], X[n_rows:]
+    y, yh = y[:n_rows], y[n_rows:]
     gen_s = time.time() - t0
 
     params = {
@@ -141,6 +144,18 @@ def main():
         out["best_iter_s"] = round(best, 3)
         out["best_projected_s"] = round(
             warmup_s + best * (n_iters - 2), 2)
+
+    # learning sanity at speed: AUC of the measured-iteration model on
+    # a held-out slice of the same synthetic task (not comparable to
+    # real-Higgs AUC, but catches a fast-but-wrong trainer)
+    try:
+        from lightgbm_tpu.config import Config
+        from lightgbm_tpu.metrics import AUCMetric
+        ph = booster.predict(Xh)
+        out["auc_holdout"] = round(
+            AUCMetric(Config()).eval(np.asarray(yh, np.float64), ph), 4)
+    except Exception as exc:
+        out["auc_error"] = str(exc)[:200]
 
     # secondary: the reference's GPU-comparison config (63 bins,
     # docs/GPU-Performance.rst:109-139) — histogram work is 4x lighter
