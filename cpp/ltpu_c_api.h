@@ -102,7 +102,19 @@ int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
                               int64_t num_col, int predict_type,
                               int num_iteration, const char* parameter,
                               int64_t* out_len, double* out_result);
+int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
+                              int64_t* out_len);
+int LGBM_BoosterGetPredict(BoosterHandle handle, int data_idx,
+                           int64_t* out_len, double* out_result);
 int LGBM_BoosterFree(BoosterHandle handle);
+
+/* The reference's socket-mesh bootstrap (c_api.h:816 exposes external
+ * collectives as the pluggable seam). Distribution here rides the JAX
+ * device mesh (tree_learner=data|feature|voting), so these accept the
+ * call for source compatibility and warn. */
+int LGBM_NetworkInit(const char* machines, int local_listen_port,
+                     int listen_time_out, int num_machines);
+int LGBM_NetworkFree(void);
 
 #ifdef __cplusplus
 }

@@ -17,6 +17,7 @@
 #include <Python.h>
 #include <dlfcn.h>
 
+#include <cstdio>
 #include <cstring>
 #include <mutex>
 #include <string>
@@ -547,10 +548,52 @@ int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
   return rc;
 }
 
+int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
+                              int64_t* out_len) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("booster_num_predict",
+                       Py_BuildValue("(Ni)", ref_or_none(handle),
+                                     data_idx));
+  if (res == nullptr) return -1;
+  *out_len = PyLong_AsLongLong(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_BoosterGetPredict(BoosterHandle handle, int data_idx,
+                           int64_t* out_len, double* out_result) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("booster_inner_predict",
+                       Py_BuildValue("(Ni)", ref_or_none(handle),
+                                     data_idx));
+  if (res == nullptr) return -1;
+  int rc = copy_bytes_out(res, out_result, out_len);
+  Py_DECREF(res);
+  return rc;
+}
+
 int LGBM_BoosterFree(BoosterHandle handle) {
   Gil gil;
   Py_XDECREF(static_cast<PyObject*>(handle));
   return 0;
 }
+
+int LGBM_NetworkInit(const char* machines, int local_listen_port,
+                     int listen_time_out, int num_machines) {
+  (void)machines;
+  (void)local_listen_port;
+  (void)listen_time_out;
+  if (num_machines > 1) {
+    std::fprintf(stderr,
+                 "[LightGBM-TPU] [Warning] LGBM_NetworkInit is a no-op: "
+                 "distribution uses the JAX device mesh "
+                 "(tree_learner=data|feature|voting)\n");
+  }
+  return 0;
+}
+
+int LGBM_NetworkFree(void) { return 0; }
 
 }  // extern "C"

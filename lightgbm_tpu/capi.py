@@ -224,6 +224,35 @@ def _norm_rows(rows) -> List[Tuple[str, float, bool]]:
     return out
 
 
+def _inner_scores(b: Booster, data_idx: int) -> np.ndarray:
+    """(num_class, rows) inner scores for data_idx (0 = train, i = i-th
+    valid set in add order), transformed like the reference's
+    GetPredictAt (ConvertOutput applied — probabilities for binary/
+    multiclass, raw for regression)."""
+    g = b._gbdt
+    if g is None:
+        return np.zeros((1, 0), np.float64)
+    if data_idx < 0 or data_idx > len(g.valid_sets):
+        raise ValueError(f"data_idx {data_idx} out of range "
+                         f"(0..{len(g.valid_sets)})")
+    raw = np.asarray(g.train_score if data_idx == 0
+                     else g.valid_sets[data_idx - 1].score, np.float64)
+    if g.objective is None:
+        return raw
+    out = g.objective.convert_output(raw.T if raw.shape[0] > 1
+                                     else raw[0])
+    out = np.asarray(out, np.float64)
+    return out.T if out.ndim > 1 else out[None, :]
+
+
+def booster_num_predict(b: Booster, data_idx: int) -> int:
+    return int(_inner_scores(b, data_idx).size)
+
+
+def booster_inner_predict(b: Booster, data_idx: int) -> bytes:
+    return _inner_scores(b, data_idx).reshape(-1).tobytes()
+
+
 def booster_eval_names(b: Booster) -> List[str]:
     return list(getattr(b, "_metric_names", []) or [])
 

@@ -182,3 +182,41 @@ def test_csr_create_and_predict(capi, rng):
     np.testing.assert_allclose(pred_csr, pred_mat, rtol=1e-9, atol=1e-12)
     capi.LGBM_BoosterFree(bst)
     capi.LGBM_DatasetFree(ds)
+
+
+def test_inner_predict_and_network_stub(capi, rng):
+    X = rng.randn(200, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    ds = ctypes.c_void_p()
+    _chk(capi, capi.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 0, 200, 4, 1,
+        b"max_bin=63 verbose=-1", None, ctypes.byref(ds)))
+    _chk(capi, capi.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 200, 0))
+    bst = ctypes.c_void_p()
+    _chk(capi, capi.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbose=-1 min_data_in_leaf=5",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(3):
+        _chk(capi, capi.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    n = ctypes.c_int64()
+    _chk(capi, capi.LGBM_BoosterGetNumPredict(bst, 0, ctypes.byref(n)))
+    assert n.value == 200
+    scores = np.zeros(200, np.float64)
+    _chk(capi, capi.LGBM_BoosterGetPredict(
+        bst, 0, ctypes.byref(n),
+        scores.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert n.value == 200 and np.std(scores) > 0
+    # reference GetPredictAt semantics: ConvertOutput applied (binary
+    # objective -> probabilities)
+    assert np.all((scores >= 0) & (scores <= 1))
+    bad = ctypes.c_int64()
+    assert capi.LGBM_BoosterGetNumPredict(bst, -1, ctypes.byref(bad)) != 0
+
+    # network init is an accepted no-op (mesh-based distribution)
+    _chk(capi, capi.LGBM_NetworkInit(b"127.0.0.1:121", 121, 120, 1))
+    _chk(capi, capi.LGBM_NetworkFree())
+    capi.LGBM_BoosterFree(bst)
+    capi.LGBM_DatasetFree(ds)
