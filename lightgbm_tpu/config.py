@@ -306,6 +306,12 @@ PARAMS: List[Param] = [
     _p("num_grad_quant_bins", 120, int, (),
        "quantization levels per side for use_quantized_grad",
        group="device", check=">0, <=250"),
+    _p("speculative_tolerance", 0.0, float, (),
+       "relative gain tolerance for preferring already-computed leaf "
+       "histograms in the speculative tree builder; 0 = exact "
+       "best-first order, small values (e.g. 1e-3) reduce histogram "
+       "passes on late flat-gain iterations (device learner only)",
+       group="device", check=">=0"),
 ]
 
 _PARAM_BY_NAME: Dict[str, Param] = {p.name: p for p in PARAMS}

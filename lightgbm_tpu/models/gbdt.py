@@ -211,6 +211,7 @@ class GBDT:
             quantize=(config.num_grad_quant_bins
                       if (config.use_quantized_grad and not dist_active)
                       else 0),
+            spec_tolerance=float(config.speculative_tolerance),
             # speculative child arming fills the MXU lanes (21 leaves x
             # 6 value columns, or 42 x 3 quantized); enabled on the
             # accelerator path where the batched pallas kernel exists

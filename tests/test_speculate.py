@@ -74,3 +74,37 @@ def test_multi_histogram_matches_reference(rng):
             xt, jnp.asarray(np.asarray(vals) * m), B))
         np.testing.assert_allclose(multi[w_i], single, rtol=1e-5,
                                    atol=1e-5)
+
+
+def test_spec_tolerance_quality(rng):
+    """spec_tolerance trades strict best-first order for fewer armer
+    passes; at a small tolerance the tree quality must be unchanged."""
+    import dataclasses
+    import numpy as np
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.grow import GrowParams, build_tree
+    from lightgbm_tpu.ops.split import SplitParams
+
+    N, F, B = 8192, 6, 32
+    xt = jnp.asarray(rng.randint(0, B, size=(F, N)), jnp.int32)
+    y = (np.asarray(xt[0]) + np.asarray(xt[2]) >
+         B).astype(np.float32)
+    p = y.mean()
+    grad = jnp.asarray(p - y)
+    hess = jnp.full((N,), p * (1 - p), jnp.float32)
+    ones = jnp.ones(N, jnp.float32)
+    fmask = jnp.ones(F, bool)
+    nb = jnp.full(F, B, jnp.int32)
+    mt = jnp.zeros(F, jnp.int32)
+    cat = jnp.zeros(F, bool)
+    base = GrowParams(split=SplitParams(max_bin=B, min_data_in_leaf=5),
+                      num_leaves=31, hist_impl="segsum", speculate=7)
+    tol = dataclasses.replace(base, spec_tolerance=1e-3)
+    r0 = build_tree(xt, grad, hess, ones, fmask, nb, mt, cat, params=base)
+    r1 = build_tree(xt, grad, hess, ones, fmask, nb, mt, cat, params=tol)
+    assert int(r1["n_leaves"]) == int(r0["n_leaves"])
+    # total realized gain within the tolerance budget
+    g0 = float(jnp.sum(jnp.where(r0["valid"], r0["gain"], 0.0)))
+    g1 = float(jnp.sum(jnp.where(r1["valid"], r1["gain"], 0.0)))
+    assert g1 >= g0 * (1 - 5e-3), (g1, g0)
+    assert int(r1["n_arm_passes"]) <= int(r0["n_arm_passes"])
