@@ -1,0 +1,287 @@
+# lgb.Booster: model handle + prediction, save/load/dump, importance.
+# Same surface as the upstream lightgbm R package; fresh implementation
+# over the lightgbm_tpu C API.
+
+BoosterR6 <- R6::R6Class(
+  "lgb.Booster",
+  cloneable = FALSE,
+  public = list(
+    best_iter = -1L,
+    record_evals = list(),
+
+    initialize = function(params = list(), train_set = NULL,
+                          modelfile = NULL, model_str = NULL) {
+      if (!is.null(train_set)) {
+        lgb.check.handle(train_set, "lgb.Dataset")
+        private$train_set <- train_set
+        private$handle <- .Call(LGBMR_BoosterCreate,
+                                train_set$get_handle(),
+                                lgb.params.str(params))
+        private$eval_names <- character(0L)
+        private$valid_names <- character(0L)
+      } else if (!is.null(modelfile)) {
+        private$handle <- .Call(LGBMR_BoosterCreateFromModelfile,
+                                modelfile)
+      } else if (!is.null(model_str)) {
+        private$handle <- .Call(LGBMR_BoosterLoadModelFromString,
+                                model_str)
+      } else {
+        stop("need train_set, modelfile or model_str")
+      }
+      invisible(self)
+    },
+
+    add_valid = function(data, name) {
+      lgb.check.handle(data, "lgb.Dataset")
+      .Call(LGBMR_BoosterAddValidData, private$handle,
+            data$get_handle())
+      private$valid_names <- c(private$valid_names, name)
+      invisible(self)
+    },
+
+    update = function(fobj = NULL) {
+      if (is.null(fobj)) {
+        finished <- .Call(LGBMR_BoosterUpdateOneIter, private$handle)
+      } else {
+        preds <- self$inner_predict(0L)
+        gh <- fobj(preds, private$train_set)
+        finished <- .Call(LGBMR_BoosterUpdateOneIterCustom,
+                          private$handle, as.numeric(gh$grad),
+                          as.numeric(gh$hess))
+      }
+      isTRUE(as.logical(finished))
+    },
+
+    rollback_one_iter = function() {
+      .Call(LGBMR_BoosterRollbackOneIter, private$handle)
+      invisible(self)
+    },
+
+    current_iter = function() {
+      .Call(LGBMR_BoosterGetCurrentIteration, private$handle)
+    },
+
+    eval_names = function() {
+      .Call(LGBMR_BoosterGetEvalNames, private$handle)
+    },
+
+    #' data_idx: 0 train, i the i-th valid set (add order)
+    eval = function(data_idx) {
+      vals <- .Call(LGBMR_BoosterGetEval, private$handle,
+                    as.integer(data_idx))
+      names(vals) <- self$eval_names()[seq_along(vals)]
+      vals
+    },
+
+    eval_valid = function() {
+      out <- list()
+      for (i in seq_along(private$valid_names)) {
+        out[[private$valid_names[i]]] <- self$eval(i)
+      }
+      out
+    },
+
+    inner_predict = function(data_idx) {
+      stop("inner_predict is not exposed; use predict()")
+    },
+
+    predict = function(data, num_iteration = -1L, rawscore = FALSE,
+                       predleaf = FALSE, predcontrib = FALSE,
+                       params = list()) {
+      ptype <- .PREDICT_NORMAL
+      if (rawscore) ptype <- .PREDICT_RAW
+      if (predleaf) ptype <- .PREDICT_LEAF
+      if (predcontrib) ptype <- .PREDICT_CONTRIB
+      if (is.null(num_iteration) || length(num_iteration) == 0L) {
+        num_iteration <- -1L
+      }
+      pstr <- lgb.params.str(params)
+      if (lgb.is.dgCMatrix(data)) {
+        out <- .Call(LGBMR_BoosterPredictForCSC, private$handle,
+                     data@p, data@i, data@x, nrow(data), ptype,
+                     as.integer(num_iteration), pstr)
+        n <- nrow(data)
+      } else {
+        m <- data
+        if (is.data.frame(m)) m <- as.matrix(m)
+        if (is.null(dim(m))) m <- matrix(m, nrow = 1L)
+        storage.mode(m) <- "double"
+        out <- .Call(LGBMR_BoosterPredictForMat, private$handle, m,
+                     nrow(m), ncol(m), ptype,
+                     as.integer(num_iteration), pstr)
+        n <- nrow(m)
+      }
+      per_row <- length(out) %/% n
+      if (per_row > 1L) {
+        # row-major (per-row blocks) from the C API
+        out <- matrix(out, nrow = n, ncol = per_row, byrow = TRUE)
+      }
+      out
+    },
+
+    save_model = function(filename, num_iteration = -1L) {
+      .Call(LGBMR_BoosterSaveModel, private$handle,
+            as.integer(num_iteration), filename)
+      invisible(self)
+    },
+
+    save_model_to_string = function(num_iteration = -1L) {
+      .Call(LGBMR_BoosterSaveModelToString, private$handle,
+            as.integer(num_iteration))
+    },
+
+    dump_model = function(num_iteration = -1L) {
+      .Call(LGBMR_BoosterDumpModel, private$handle,
+            as.integer(num_iteration))
+    },
+
+    feature_importance = function(num_iteration = -1L,
+                                  type = c("split", "gain")) {
+      type <- match.arg(type)
+      imp <- .Call(LGBMR_BoosterFeatureImportance, private$handle,
+                   as.integer(num_iteration),
+                   if (type == "gain") 1L else 0L)
+      names(imp) <- tryCatch(
+        private$train_set$get_colnames(),
+        error = function(e) NULL)
+      imp
+    },
+
+    num_feature = function() {
+      .Call(LGBMR_BoosterGetNumFeature, private$handle)
+    },
+
+    reset_parameter = function(params) {
+      .Call(LGBMR_BoosterResetParameter, private$handle,
+            lgb.params.str(params))
+      invisible(self)
+    }
+  ),
+  private = list(
+    handle = NULL,
+    train_set = NULL,
+    eval_names = NULL,
+    valid_names = character(0L)
+  )
+)
+
+#' Create a Booster bound to a training Dataset
+#' @param params named parameter list
+#' @param train_set lgb.Dataset
+#' @export
+lgb.Booster <- function(params = list(), train_set = NULL) {
+  BoosterR6$new(params = params, train_set = train_set)
+}
+
+#' Predict with a trained model
+#' @param object lgb.Booster
+#' @param data matrix / dgCMatrix / data.frame
+#' @param num_iteration trees to use (<=0: all)
+#' @param rawscore,predleaf,predcontrib prediction kinds
+#' @param ... extra predict params
+#' @export
+predict.lgb.Booster <- function(object, data, num_iteration = -1L,
+                                rawscore = FALSE, predleaf = FALSE,
+                                predcontrib = FALSE, ...) {
+  object$predict(data, num_iteration = num_iteration,
+                 rawscore = rawscore, predleaf = predleaf,
+                 predcontrib = predcontrib, params = list(...))
+}
+
+#' Load a model from a text file
+#' @param filename model path
+#' @param model_str alternatively, the model text
+#' @export
+lgb.load <- function(filename = NULL, model_str = NULL) {
+  BoosterR6$new(modelfile = filename, model_str = model_str)
+}
+
+#' Save a model to a text file
+#' @param booster lgb.Booster
+#' @param filename output path
+#' @param num_iteration trees to save (<=0: all)
+#' @export
+lgb.save <- function(booster, filename, num_iteration = -1L) {
+  lgb.check.handle(booster, "lgb.Booster")
+  booster$save_model(filename, num_iteration)
+}
+
+#' JSON dump of the model
+#' @param booster lgb.Booster
+#' @param num_iteration trees to dump (<=0: all)
+#' @export
+lgb.dump <- function(booster, num_iteration = -1L) {
+  lgb.check.handle(booster, "lgb.Booster")
+  booster$dump_model(num_iteration)
+}
+
+#' Feature importance table
+#' @param model lgb.Booster
+#' @param percentage scale gains to fractions
+#' @export
+lgb.importance <- function(model, percentage = TRUE) {
+  lgb.check.handle(model, "lgb.Booster")
+  gain <- model$feature_importance(type = "gain")
+  split <- model$feature_importance(type = "split")
+  if (percentage && sum(gain) > 0) {
+    gain <- gain / sum(gain)
+  }
+  nm <- names(gain)
+  if (is.null(nm)) nm <- paste0("Column_", seq_along(gain) - 1L)
+  df <- data.frame(Feature = nm, Gain = as.numeric(gain),
+                   Split = as.numeric(split),
+                   stringsAsFactors = FALSE)
+  df[order(-df$Gain), , drop = FALSE]
+}
+
+#' Flatten the model's trees to a data.frame (one row per node)
+#' @param model lgb.Booster
+#' @export
+lgb.model.dt.tree <- function(model) {
+  lgb.check.handle(model, "lgb.Booster")
+  js <- lgb.dump(model)
+  parsed <- tryCatch(
+    if (requireNamespace("jsonlite", quietly = TRUE)) {
+      jsonlite::fromJSON(js, simplifyVector = FALSE)
+    } else {
+      stop("jsonlite is required for lgb.model.dt.tree")
+    },
+    error = function(e) stop(e))
+  rows <- list()
+  walk <- function(tree_index, node, parent = NA_integer_) {
+    if (!is.null(node$split_index)) {
+      rows[[length(rows) + 1L]] <<- data.frame(
+        tree_index = tree_index, split_index = node$split_index,
+        split_feature = node$split_feature,
+        split_gain = node$split_gain, threshold = node$threshold,
+        leaf_index = NA_integer_, leaf_value = NA_real_,
+        stringsAsFactors = FALSE)
+      walk(tree_index, node$left_child, node$split_index)
+      walk(tree_index, node$right_child, node$split_index)
+    } else {
+      rows[[length(rows) + 1L]] <<- data.frame(
+        tree_index = tree_index, split_index = NA_integer_,
+        split_feature = NA_character_, split_gain = NA_real_,
+        threshold = NA_real_, leaf_index = node$leaf_index,
+        leaf_value = node$leaf_value, stringsAsFactors = FALSE)
+    }
+  }
+  for (i in seq_along(parsed$tree_info)) {
+    walk(i - 1L, parsed$tree_info[[i]]$tree_structure)
+  }
+  do.call(rbind, rows)
+}
+
+#' Extract a recorded eval series from lgb.train/lgb.cv output
+#' @param booster result of lgb.train or lgb.cv
+#' @param data_name validation set name
+#' @param eval_name metric name
+#' @export
+lgb.get.eval.result <- function(booster, data_name, eval_name) {
+  rec <- booster$record_evals
+  if (is.null(rec[[data_name]]) ||
+      is.null(rec[[data_name]][[eval_name]])) {
+    stop(sprintf("no recorded eval %s/%s", data_name, eval_name))
+  }
+  unlist(rec[[data_name]][[eval_name]]$eval)
+}

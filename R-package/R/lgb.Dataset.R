@@ -1,0 +1,290 @@
+# lgb.Dataset: training-data container.
+# Same surface as the upstream lightgbm R package (lgb.Dataset,
+# lgb.Dataset.create.valid, setinfo/getinfo, dim/dimnames); fresh
+# implementation over the lightgbm_tpu C API.
+
+DatasetR6 <- R6::R6Class(
+  "lgb.Dataset",
+  cloneable = FALSE,
+  public = list(
+    initialize = function(data, params = list(), reference = NULL,
+                          colnames = NULL, categorical_feature = NULL,
+                          label = NULL, weight = NULL, group = NULL,
+                          init_score = NULL) {
+      private$raw_data <- data
+      private$params <- params
+      private$reference <- reference
+      private$colnames <- colnames
+      private$categorical_feature <- categorical_feature
+      private$info <- list(label = label, weight = weight, group = group,
+                           init_score = init_score)
+      invisible(self)
+    },
+
+    construct = function() {
+      if (!is.null(private$handle)) {
+        return(invisible(self))
+      }
+      params <- private$params
+      if (!is.null(private$categorical_feature)) {
+        cf <- private$categorical_feature
+        if (is.character(cf)) {
+          if (is.null(private$colnames)) {
+            stop("categorical_feature by name needs colnames")
+          }
+          cf <- match(cf, private$colnames) - 1L
+        } else {
+          cf <- as.integer(cf) - 1L  # R is 1-based
+        }
+        params$categorical_feature <- cf
+      }
+      pstr <- lgb.params.str(params)
+      ref_handle <- NULL
+      if (!is.null(private$reference)) {
+        private$reference$construct()
+        ref_handle <- private$reference$.__enclos_env__$private$handle
+      }
+      data <- private$raw_data
+      if (is.character(data) && length(data) == 1L) {
+        private$handle <- .Call(LGBMR_DatasetCreateFromFile, data, pstr,
+                                ref_handle)
+      } else if (lgb.is.dgCMatrix(data)) {
+        private$handle <- .Call(LGBMR_DatasetCreateFromCSC,
+                                data@p, data@i, data@x,
+                                nrow(data), pstr, ref_handle)
+        if (is.null(private$colnames) && !is.null(colnames(data))) {
+          private$colnames <- colnames(data)
+        }
+      } else {
+        m <- data
+        if (is.data.frame(m)) {
+          m <- as.matrix(m)
+        }
+        storage.mode(m) <- "double"
+        if (is.null(private$colnames) && !is.null(colnames(m))) {
+          private$colnames <- colnames(m)
+        }
+        private$handle <- .Call(LGBMR_DatasetCreateFromMat, m,
+                                nrow(m), ncol(m), pstr, ref_handle)
+      }
+      if (!is.null(private$colnames)) {
+        .Call(LGBMR_DatasetSetFeatureNames, private$handle,
+              as.character(private$colnames))
+      }
+      for (field in names(private$info)) {
+        v <- private$info[[field]]
+        if (!is.null(v)) {
+          self$set_field(field, v)
+        }
+      }
+      invisible(self)
+    },
+
+    get_handle = function() {
+      self$construct()
+      private$handle
+    },
+
+    set_field = function(field, data) {
+      if (is.null(private$handle)) {
+        private$info[[field]] <- data
+        return(invisible(self))
+      }
+      if (field %in% c("group", "query")) {
+        data <- as.integer(data)
+      } else {
+        data <- as.numeric(data)
+      }
+      .Call(LGBMR_DatasetSetField, private$handle, field, data)
+      private$info[[field]] <- data
+      invisible(self)
+    },
+
+    get_field = function(field) {
+      if (!is.null(private$handle)) {
+        return(.Call(LGBMR_DatasetGetField, private$handle, field))
+      }
+      private$info[[field]]
+    },
+
+    num_data = function() {
+      self$construct()
+      .Call(LGBMR_DatasetGetNumData, private$handle)
+    },
+
+    num_feature = function() {
+      self$construct()
+      .Call(LGBMR_DatasetGetNumFeature, private$handle)
+    },
+
+    get_colnames = function() {
+      if (!is.null(private$handle)) {
+        return(.Call(LGBMR_DatasetGetFeatureNames, private$handle))
+      }
+      private$colnames
+    },
+
+    set_colnames = function(names) {
+      private$colnames <- as.character(names)
+      if (!is.null(private$handle)) {
+        .Call(LGBMR_DatasetSetFeatureNames, private$handle,
+              private$colnames)
+      }
+      invisible(self)
+    },
+
+    set_reference = function(reference) {
+      if (!is.null(private$handle)) {
+        stop("cannot set the reference after construction")
+      }
+      private$reference <- reference
+      invisible(self)
+    },
+
+    set_categorical = function(categorical_feature) {
+      if (!is.null(private$handle)) {
+        stop("cannot change categorical features after construction")
+      }
+      private$categorical_feature <- categorical_feature
+      invisible(self)
+    },
+
+    update_params = function(params) {
+      private$params <- modifyList(private$params, params)
+      if (!is.null(private$handle)) {
+        .Call(LGBMR_DatasetUpdateParam, private$handle,
+              lgb.params.str(params))
+      }
+      invisible(self)
+    },
+
+    save_binary = function(fname) {
+      self$construct()
+      .Call(LGBMR_DatasetSaveBinary, private$handle, fname)
+      invisible(self)
+    },
+
+    subset = function(idx, params = list()) {
+      self$construct()
+      handle <- .Call(LGBMR_DatasetGetSubset, private$handle,
+                      as.integer(idx), lgb.params.str(params))
+      sub <- DatasetR6$new(data = NULL, params = private$params)
+      sub$.__enclos_env__$private$handle <- handle
+      sub
+    },
+
+    create_valid = function(data, label = NULL, weight = NULL,
+                            group = NULL, init_score = NULL,
+                            params = list()) {
+      DatasetR6$new(data = data,
+                    params = modifyList(private$params, params),
+                    reference = self, label = label, weight = weight,
+                    group = group, init_score = init_score)
+    }
+  ),
+  private = list(
+    raw_data = NULL,
+    params = list(),
+    reference = NULL,
+    colnames = NULL,
+    categorical_feature = NULL,
+    info = list(),
+    handle = NULL
+  )
+)
+
+#' Create a lightgbm_tpu Dataset
+#'
+#' @param data matrix, dgCMatrix, data.frame or path to a data file
+#' @param params named list of dataset parameters (max_bin, ...)
+#' @param reference train Dataset whose bin boundaries to reuse
+#' @param colnames feature names
+#' @param categorical_feature indices (1-based) or names
+#' @param label,weight,group,init_score per-row fields
+#' @param ... extra fields passed to setinfo
+#' @export
+lgb.Dataset <- function(data, params = list(), reference = NULL,
+                        colnames = NULL, categorical_feature = NULL,
+                        label = NULL, weight = NULL, group = NULL,
+                        init_score = NULL, ...) {
+  extra <- list(...)
+  ds <- DatasetR6$new(data = data, params = params, reference = reference,
+                      colnames = colnames,
+                      categorical_feature = categorical_feature,
+                      label = label, weight = weight, group = group,
+                      init_score = init_score)
+  for (field in names(extra)) {
+    ds$set_field(field, extra[[field]])
+  }
+  ds
+}
+
+#' Validation Dataset aligned with a training Dataset's bins
+#' @param dataset the training lgb.Dataset
+#' @param data raw validation data
+#' @param ... fields (label, weight, group, init_score)
+#' @export
+lgb.Dataset.create.valid <- function(dataset, data, ...) {
+  lgb.check.handle(dataset, "lgb.Dataset")
+  do.call(dataset$create_valid, c(list(data = data), list(...)))
+}
+
+#' Force Dataset construction (binning)
+#' @param dataset lgb.Dataset
+#' @export
+lgb.Dataset.construct <- function(dataset) {
+  lgb.check.handle(dataset, "lgb.Dataset")
+  dataset$construct()
+}
+
+#' Save a Dataset's binned form to a binary file
+#' @param dataset lgb.Dataset
+#' @param fname output path
+#' @export
+lgb.Dataset.save <- function(dataset, fname) {
+  lgb.check.handle(dataset, "lgb.Dataset")
+  dataset$save_binary(fname)
+}
+
+#' @export
+lgb.Dataset.set.categorical <- function(dataset, categorical_feature) {
+  lgb.check.handle(dataset, "lgb.Dataset")
+  dataset$set_categorical(categorical_feature)
+}
+
+#' @export
+lgb.Dataset.set.reference <- function(dataset, reference) {
+  lgb.check.handle(dataset, "lgb.Dataset")
+  dataset$set_reference(reference)
+}
+
+#' Set a per-row information field (label, weight, group, init_score)
+#' @param dataset lgb.Dataset
+#' @param name field name
+#' @param info values
+#' @param ... unused
+#' @export
+setinfo <- function(dataset, name, info, ...) {
+  lgb.check.handle(dataset, "lgb.Dataset")
+  dataset$set_field(name, info)
+}
+
+#' Get a per-row information field
+#' @param dataset lgb.Dataset
+#' @param name field name
+#' @param ... unused
+#' @export
+getinfo <- function(dataset, name, ...) {
+  lgb.check.handle(dataset, "lgb.Dataset")
+  dataset$get_field(name)
+}
+
+#' @export
+dim.lgb.Dataset <- function(x) {
+  c(x$num_data(), x$num_feature())
+}
+
+#' @export
+dimnames.lgb.Dataset <- function(x) {
+  list(NULL, x$get_colnames())
+}

@@ -1,0 +1,117 @@
+# lgb.cv: k-fold cross-validation.
+# Same contract as the upstream lightgbm R package (stratified folds
+# for binary labels, per-fold boosters trained in lockstep, mean/sd
+# eval records); fresh implementation.
+
+#' K-fold cross validation
+#'
+#' @param params named parameter list
+#' @param data lgb.Dataset (raw data must be subsettable)
+#' @param nrounds boosting iterations
+#' @param nfold number of folds
+#' @param stratified stratify folds by binary label
+#' @param folds optional explicit list of test-index vectors
+#' @param early_stopping_rounds stop when the mean of the first metric
+#'   stops improving
+#' @param eval_freq evaluate every this many iterations
+#' @param verbose <=0 silences the eval lines
+#' @param seed fold shuffling seed
+#' @export
+lgb.cv <- function(params = list(), data, nrounds = 100L, nfold = 5L,
+                   stratified = TRUE, folds = NULL,
+                   early_stopping_rounds = NULL, eval_freq = 1L,
+                   verbose = 1L, seed = 0L) {
+  lgb.check.handle(data, "lgb.Dataset")
+  data$construct()
+  n <- data$num_data()
+  label <- data$get_field("label")
+
+  if (is.null(folds)) {
+    set.seed(seed)
+    if (stratified && !is.null(label) &&
+        length(unique(label)) <= 2L) {
+      pos <- which(label > 0)
+      neg <- which(label <= 0)
+      assign_folds <- function(idx) {
+        split(sample(idx), rep_len(seq_len(nfold), length(idx)))
+      }
+      fp <- assign_folds(pos)
+      fn <- assign_folds(neg)
+      folds <- lapply(seq_len(nfold),
+                      function(k) sort(c(fp[[k]], fn[[k]])))
+    } else {
+      perm <- sample(n)
+      folds <- split(perm, rep_len(seq_len(nfold), n))
+    }
+  }
+
+  boosters <- list()
+  for (k in seq_along(folds)) {
+    test_idx <- folds[[k]]
+    train_idx <- setdiff(seq_len(n), test_idx)
+    dtrain <- data$subset(train_idx)
+    dtest <- data$subset(test_idx)
+    bst <- BoosterR6$new(params = params, train_set = dtrain)
+    bst$add_valid(dtest, "valid")
+    boosters[[k]] <- bst
+  }
+
+  higher_better <- function(metric) {
+    any(startsWith(metric, c("auc", "ndcg", "map")))
+  }
+  record <- list()
+  best_score <- NA_real_
+  best_iter <- -1L
+  since_best <- 0L
+  out <- list(record_evals = list(), boosters = boosters,
+              best_iter = -1L)
+  class(out) <- "lgb.CVBooster"
+  for (i in seq_len(nrounds)) {
+    for (bst in boosters) {
+      bst$update()
+    }
+    if (i %% eval_freq == 0L || i == nrounds) {
+      evals <- lapply(boosters, function(b) b$eval(1L))
+      mnames <- names(evals[[1L]])
+      for (mname in mnames) {
+        vals <- vapply(evals, function(e) e[[mname]], numeric(1L))
+        key <- mname
+        out$record_evals[["valid"]][[key]]$eval <-
+          c(out$record_evals[["valid"]][[key]]$eval, mean(vals))
+        out$record_evals[["valid"]][[key]]$eval_err <-
+          c(out$record_evals[["valid"]][[key]]$eval_err, stats::sd(vals))
+      }
+      if (verbose > 0L) {
+        line <- paste(vapply(mnames, function(mname) {
+          vals <- vapply(evals, function(e) e[[mname]], numeric(1L))
+          sprintf("%s:%g+%g", mname, mean(vals), stats::sd(vals))
+        }, character(1L)), collapse = "  ")
+        message(sprintf("[%d] cv %s", i, line))
+      }
+      if (!is.null(early_stopping_rounds) && length(mnames) > 0L) {
+        vals <- vapply(evals, function(e) e[[mnames[1L]]], numeric(1L))
+        score <- mean(vals)
+        hb <- higher_better(mnames[1L])
+        improved <- is.na(best_score) ||
+          (hb && score > best_score) || (!hb && score < best_score)
+        if (improved) {
+          best_score <- score
+          best_iter <- i
+          since_best <- 0L
+        } else {
+          since_best <- since_best + eval_freq
+        }
+        if (since_best >= early_stopping_rounds) {
+          if (verbose > 0L) {
+            message(sprintf("cv early stopping at %d (best %d: %g)",
+                            i, best_iter, best_score))
+          }
+          out$best_iter <- best_iter
+          return(out)
+        }
+      }
+    }
+  }
+  out$best_iter <- if (best_iter > 0L) best_iter else nrounds
+  out
+}

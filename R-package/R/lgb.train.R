@@ -1,0 +1,99 @@
+# lgb.train / lightgbm: the training loops.
+# Same contract as the upstream lightgbm R package (valids,
+# eval recording, early stopping on the first validation metric);
+# fresh implementation.
+
+#' Train a gradient boosting model
+#'
+#' @param params named parameter list (objective, num_leaves, ...)
+#' @param data training lgb.Dataset
+#' @param nrounds boosting iterations
+#' @param valids named list of validation lgb.Datasets
+#' @param early_stopping_rounds stop when the first valid's first
+#'   metric has not improved in this many rounds
+#' @param eval_freq evaluate/print every this many iterations
+#' @param verbose <=0 silences the eval lines
+#' @param record keep eval history in `$record_evals`
+#' @export
+lgb.train <- function(params = list(), data, nrounds = 100L,
+                      valids = list(), early_stopping_rounds = NULL,
+                      eval_freq = 1L, verbose = 1L, record = TRUE) {
+  lgb.check.handle(data, "lgb.Dataset")
+  booster <- BoosterR6$new(params = params, train_set = data)
+  for (name in names(valids)) {
+    booster$add_valid(valids[[name]], name)
+  }
+  higher_better <- function(metric) {
+    any(startsWith(metric, c("auc", "ndcg", "map")))
+  }
+  best_score <- NA_real_
+  best_iter <- -1L
+  since_best <- 0L
+  for (i in seq_len(nrounds)) {
+    finished <- booster$update()
+    if (length(valids) > 0L && (i %% eval_freq == 0L || i == nrounds)) {
+      for (vi in seq_along(valids)) {
+        vals <- booster$eval(vi)
+        vname <- names(valids)[vi]
+        if (record) {
+          for (mname in names(vals)) {
+            cur <- booster$record_evals[[vname]][[mname]]$eval
+          booster$record_evals[[vname]][[mname]]$eval <-
+              c(cur, vals[[mname]])
+          }
+        }
+        if (verbose > 0L) {
+          msg <- paste(sprintf("%s %s:%g", vname, names(vals), vals),
+                       collapse = "  ")
+          message(sprintf("[%d] %s", i, msg))
+        }
+        if (!is.null(early_stopping_rounds) && vi == 1L &&
+            length(vals) > 0L) {
+          score <- vals[[1L]]
+          hb <- higher_better(names(vals)[1L])
+          improved <- is.na(best_score) ||
+            (hb && score > best_score) || (!hb && score < best_score)
+          if (improved) {
+            best_score <- score
+            best_iter <- i
+            since_best <- 0L
+          } else {
+            since_best <- since_best + eval_freq
+          }
+          if (since_best >= early_stopping_rounds) {
+            if (verbose > 0L) {
+              message(sprintf(
+                "early stopping at %d (best %d: %g)", i, best_iter,
+                best_score))
+            }
+            booster$best_iter <- best_iter
+            return(booster)
+          }
+        }
+      }
+    }
+    if (finished) {
+      break
+    }
+  }
+  booster$best_iter <- if (best_iter > 0L) best_iter else
+    booster$current_iter()
+  booster
+}
+
+#' Simple training entry point (label + matrix in one call)
+#' @param data matrix / dgCMatrix / lgb.Dataset
+#' @param label labels when data is raw
+#' @param params named parameter list
+#' @param nrounds boosting iterations
+#' @param ... forwarded to lgb.train
+#' @export
+lightgbm <- function(data, label = NULL, params = list(),
+                     nrounds = 100L, ...) {
+  if (!inherits(data, "lgb.Dataset")) {
+    data <- lgb.Dataset(data, label = label, params = params)
+  } else if (!is.null(label)) {
+    setinfo(data, "label", label)
+  }
+  lgb.train(params = params, data = data, nrounds = nrounds, ...)
+}

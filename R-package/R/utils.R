@@ -1,0 +1,45 @@
+# Shared helpers for the lightgbm_tpu R interface.
+# Mirrors the upstream lightgbm R package's parameter handling contract
+# (key=value space-joined strings across the C boundary); written fresh
+# for this framework.
+
+.PREDICT_NORMAL <- 0L
+.PREDICT_RAW <- 1L
+.PREDICT_LEAF <- 2L
+.PREDICT_CONTRIB <- 3L
+
+#' Render a named params list to the C API's "k1=v1 k2=v2" string.
+#' Vectors become comma-joined values (eval_at=1,3,5); logicals map to
+#' true/false.
+#' @noRd
+lgb.params.str <- function(params) {
+  if (is.null(params) || length(params) == 0L) {
+    return("")
+  }
+  if (is.null(names(params)) || any(names(params) == "")) {
+    stop("params must be a fully named list")
+  }
+  one <- function(key) {
+    val <- params[[key]]
+    if (is.logical(val)) {
+      val <- tolower(as.character(val))
+    }
+    paste0(key, "=", paste(as.character(val), collapse = ","))
+  }
+  paste(vapply(names(params), one, character(1L)), collapse = " ")
+}
+
+#' @noRd
+lgb.check.handle <- function(x, cls) {
+  if (!inherits(x, cls)) {
+    stop(sprintf("expected a %s, got %s", cls, paste(class(x),
+                                                     collapse = "/")))
+  }
+  invisible(x)
+}
+
+#' Is `m` a dgCMatrix (column-sparse) from the Matrix package?
+#' @noRd
+lgb.is.dgCMatrix <- function(m) {
+  isTRUE(class(m)[1L] == "dgCMatrix")
+}

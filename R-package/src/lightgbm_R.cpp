@@ -1,0 +1,492 @@
+/*
+ * R glue for lightgbm_tpu: .Call wrappers over the C API
+ * (cpp/ltpu_c_api.h), the role src/lightgbm_R.cpp plays in the
+ * reference R package — written fresh for this framework.
+ *
+ * Handles are R external pointers with finalizers calling
+ * LGBM_DatasetFree / LGBM_BoosterFree; every entry point converts
+ * R vectors to the C API's buffers and raises R errors carrying
+ * LGBM_GetLastError() on failure.
+ *
+ * Build: R CMD SHLIB against libltpu_capi.so (see Makevars).  The
+ * image this framework is developed in has no R toolchain; the file
+ * compiles against R >= 3.4 headers.
+ */
+#include <R.h>
+#include <Rinternals.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "../../cpp/ltpu_c_api.h"
+
+namespace {
+
+[[noreturn]] void fail() { Rf_error("lightgbm_tpu: %s", LGBM_GetLastError()); }
+
+void check(int rc) {
+  if (rc != 0) fail();
+}
+
+/* ---- handle plumbing ------------------------------------------- */
+
+void dataset_finalizer(SEXP ptr) {
+  void* h = R_ExternalPtrAddr(ptr);
+  if (h != nullptr) {
+    LGBM_DatasetFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+void booster_finalizer(SEXP ptr) {
+  void* h = R_ExternalPtrAddr(ptr);
+  if (h != nullptr) {
+    LGBM_BoosterFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+SEXP wrap_handle(void* h, R_CFinalizer_t fin) {
+  SEXP ptr = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ptr, fin, TRUE);
+  UNPROTECT(1);
+  return ptr;
+}
+
+void* unwrap(SEXP ptr) {
+  void* h = R_ExternalPtrAddr(ptr);
+  if (h == nullptr) Rf_error("lightgbm_tpu: handle is NULL (freed?)");
+  return h;
+}
+
+std::string as_string(SEXP s) {
+  return std::string(CHAR(STRING_ELT(s, 0)));
+}
+
+}  // namespace
+
+extern "C" {
+
+/* ---- dataset ---------------------------------------------------- */
+
+SEXP LGBMR_DatasetCreateFromFile(SEXP filename, SEXP parameters,
+                                 SEXP reference) {
+  DatasetHandle ref =
+      Rf_isNull(reference) ? nullptr : unwrap(reference);
+  DatasetHandle out = nullptr;
+  check(LGBM_DatasetCreateFromFile(as_string(filename).c_str(),
+                                   as_string(parameters).c_str(), ref,
+                                   &out));
+  return wrap_handle(out, dataset_finalizer);
+}
+
+/* data: numeric vector, column-major (an R matrix's layout). */
+SEXP LGBMR_DatasetCreateFromMat(SEXP data, SEXP nrow, SEXP ncol,
+                                SEXP parameters, SEXP reference) {
+  DatasetHandle ref =
+      Rf_isNull(reference) ? nullptr : unwrap(reference);
+  DatasetHandle out = nullptr;
+  check(LGBM_DatasetCreateFromMat(REAL(data), C_API_DTYPE_FLOAT64,
+                                  Rf_asInteger(nrow), Rf_asInteger(ncol),
+                                  /*is_row_major=*/0,
+                                  as_string(parameters).c_str(), ref,
+                                  &out));
+  return wrap_handle(out, dataset_finalizer);
+}
+
+/* dgCMatrix slots: p (col_ptr), i (indices), x (values). */
+SEXP LGBMR_DatasetCreateFromCSC(SEXP col_ptr, SEXP indices, SEXP values,
+                                SEXP nrow, SEXP parameters,
+                                SEXP reference) {
+  DatasetHandle ref =
+      Rf_isNull(reference) ? nullptr : unwrap(reference);
+  DatasetHandle out = nullptr;
+  check(LGBM_DatasetCreateFromCSC(
+      INTEGER(col_ptr), C_API_DTYPE_INT32, INTEGER(indices), REAL(values),
+      C_API_DTYPE_FLOAT64, Rf_xlength(col_ptr), Rf_xlength(values),
+      Rf_asInteger(nrow), as_string(parameters).c_str(), ref, &out));
+  return wrap_handle(out, dataset_finalizer);
+}
+
+SEXP LGBMR_DatasetGetSubset(SEXP handle, SEXP indices, SEXP parameters) {
+  /* R is 1-based; the C API takes 0-based row ids */
+  R_xlen_t n = Rf_xlength(indices);
+  std::vector<int32_t> idx(n);
+  const int* src = INTEGER(indices);
+  for (R_xlen_t i = 0; i < n; ++i) idx[i] = src[i] - 1;
+  DatasetHandle out = nullptr;
+  check(LGBM_DatasetGetSubset(unwrap(handle), idx.data(),
+                              static_cast<int32_t>(n),
+                              as_string(parameters).c_str(), &out));
+  return wrap_handle(out, dataset_finalizer);
+}
+
+SEXP LGBMR_DatasetSetField(SEXP handle, SEXP field, SEXP data) {
+  std::string name = as_string(field);
+  R_xlen_t n = Rf_xlength(data);
+  if (name == "group" || name == "query") {
+    std::vector<int32_t> buf(n);
+    const int* src = INTEGER(data);
+    std::copy(src, src + n, buf.begin());
+    check(LGBM_DatasetSetField(unwrap(handle), name.c_str(), buf.data(),
+                               static_cast<int>(n), C_API_DTYPE_INT32));
+  } else if (name == "init_score") {
+    check(LGBM_DatasetSetField(unwrap(handle), name.c_str(), REAL(data),
+                               static_cast<int>(n), C_API_DTYPE_FLOAT64));
+  } else {
+    std::vector<float> buf(n);
+    const double* src = REAL(data);
+    for (R_xlen_t i = 0; i < n; ++i) buf[i] = static_cast<float>(src[i]);
+    check(LGBM_DatasetSetField(unwrap(handle), name.c_str(), buf.data(),
+                               static_cast<int>(n), C_API_DTYPE_FLOAT32));
+  }
+  return R_NilValue;
+}
+
+SEXP LGBMR_DatasetGetField(SEXP handle, SEXP field) {
+  int out_len = 0, out_type = 0;
+  const void* ptr = nullptr;
+  check(LGBM_DatasetGetField(unwrap(handle), as_string(field).c_str(),
+                             &out_len, &ptr, &out_type));
+  if (ptr == nullptr || out_len == 0) return R_NilValue;
+  SEXP out;
+  if (out_type == C_API_DTYPE_INT32) {
+    out = PROTECT(Rf_allocVector(INTSXP, out_len));
+    std::memcpy(INTEGER(out), ptr, sizeof(int32_t) * out_len);
+  } else if (out_type == C_API_DTYPE_FLOAT64) {
+    out = PROTECT(Rf_allocVector(REALSXP, out_len));
+    std::memcpy(REAL(out), ptr, sizeof(double) * out_len);
+  } else {
+    out = PROTECT(Rf_allocVector(REALSXP, out_len));
+    const float* f = static_cast<const float*>(ptr);
+    double* d = REAL(out);
+    for (int i = 0; i < out_len; ++i) d[i] = f[i];
+  }
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBMR_DatasetGetNumData(SEXP handle) {
+  int out = 0;
+  check(LGBM_DatasetGetNumData(unwrap(handle), &out));
+  return Rf_ScalarInteger(out);
+}
+
+SEXP LGBMR_DatasetGetNumFeature(SEXP handle) {
+  int out = 0;
+  check(LGBM_DatasetGetNumFeature(unwrap(handle), &out));
+  return Rf_ScalarInteger(out);
+}
+
+SEXP LGBMR_DatasetSetFeatureNames(SEXP handle, SEXP names) {
+  R_xlen_t n = Rf_xlength(names);
+  std::vector<std::string> storage(n);
+  std::vector<const char*> ptrs(n);
+  for (R_xlen_t i = 0; i < n; ++i) {
+    storage[i] = CHAR(STRING_ELT(names, i));
+    ptrs[i] = storage[i].c_str();
+  }
+  check(LGBM_DatasetSetFeatureNames(unwrap(handle), ptrs.data(),
+                                    static_cast<int>(n)));
+  return R_NilValue;
+}
+
+SEXP LGBMR_DatasetGetFeatureNames(SEXP handle) {
+  int nf = 0;
+  check(LGBM_DatasetGetNumFeature(unwrap(handle), &nf));
+  std::vector<std::vector<char>> bufs(nf, std::vector<char>(256, '\0'));
+  std::vector<char*> ptrs(nf);
+  for (int i = 0; i < nf; ++i) ptrs[i] = bufs[i].data();
+  int n = 0;
+  check(LGBM_DatasetGetFeatureNames(unwrap(handle), ptrs.data(), &n));
+  SEXP out = PROTECT(Rf_allocVector(STRSXP, n));
+  for (int i = 0; i < n; ++i) {
+    SET_STRING_ELT(out, i, Rf_mkChar(ptrs[i]));
+  }
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBMR_DatasetSaveBinary(SEXP handle, SEXP filename) {
+  check(LGBM_DatasetSaveBinary(unwrap(handle),
+                               as_string(filename).c_str()));
+  return R_NilValue;
+}
+
+SEXP LGBMR_DatasetUpdateParam(SEXP handle, SEXP parameters) {
+  check(LGBM_DatasetUpdateParam(unwrap(handle),
+                                as_string(parameters).c_str()));
+  return R_NilValue;
+}
+
+/* ---- booster ---------------------------------------------------- */
+
+SEXP LGBMR_BoosterCreate(SEXP train, SEXP parameters) {
+  BoosterHandle out = nullptr;
+  check(LGBM_BoosterCreate(unwrap(train), as_string(parameters).c_str(),
+                           &out));
+  return wrap_handle(out, booster_finalizer);
+}
+
+SEXP LGBMR_BoosterCreateFromModelfile(SEXP filename) {
+  BoosterHandle out = nullptr;
+  int iters = 0;
+  check(LGBM_BoosterCreateFromModelfile(as_string(filename).c_str(),
+                                        &iters, &out));
+  return wrap_handle(out, booster_finalizer);
+}
+
+SEXP LGBMR_BoosterLoadModelFromString(SEXP model_str) {
+  BoosterHandle out = nullptr;
+  int iters = 0;
+  check(LGBM_BoosterLoadModelFromString(as_string(model_str).c_str(),
+                                        &iters, &out));
+  return wrap_handle(out, booster_finalizer);
+}
+
+SEXP LGBMR_BoosterAddValidData(SEXP handle, SEXP valid) {
+  check(LGBM_BoosterAddValidData(unwrap(handle), unwrap(valid)));
+  return R_NilValue;
+}
+
+SEXP LGBMR_BoosterResetTrainingData(SEXP handle, SEXP train) {
+  check(LGBM_BoosterResetTrainingData(unwrap(handle), unwrap(train)));
+  return R_NilValue;
+}
+
+SEXP LGBMR_BoosterResetParameter(SEXP handle, SEXP parameters) {
+  check(LGBM_BoosterResetParameter(unwrap(handle),
+                                   as_string(parameters).c_str()));
+  return R_NilValue;
+}
+
+SEXP LGBMR_BoosterUpdateOneIter(SEXP handle) {
+  int finished = 0;
+  check(LGBM_BoosterUpdateOneIter(unwrap(handle), &finished));
+  return Rf_ScalarLogical(finished);
+}
+
+SEXP LGBMR_BoosterUpdateOneIterCustom(SEXP handle, SEXP grad, SEXP hess) {
+  R_xlen_t n = Rf_xlength(grad);
+  std::vector<float> g(n), h(n);
+  const double* gs = REAL(grad);
+  const double* hs = REAL(hess);
+  for (R_xlen_t i = 0; i < n; ++i) {
+    g[i] = static_cast<float>(gs[i]);
+    h[i] = static_cast<float>(hs[i]);
+  }
+  int finished = 0;
+  check(LGBM_BoosterUpdateOneIterCustom(unwrap(handle), g.data(),
+                                        h.data(), &finished));
+  return Rf_ScalarLogical(finished);
+}
+
+SEXP LGBMR_BoosterRollbackOneIter(SEXP handle) {
+  check(LGBM_BoosterRollbackOneIter(unwrap(handle)));
+  return R_NilValue;
+}
+
+SEXP LGBMR_BoosterGetCurrentIteration(SEXP handle) {
+  int out = 0;
+  check(LGBM_BoosterGetCurrentIteration(unwrap(handle), &out));
+  return Rf_ScalarInteger(out);
+}
+
+SEXP LGBMR_BoosterGetNumClasses(SEXP handle) {
+  int out = 0;
+  check(LGBM_BoosterGetNumClasses(unwrap(handle), &out));
+  return Rf_ScalarInteger(out);
+}
+
+SEXP LGBMR_BoosterGetEvalNames(SEXP handle) {
+  int cnt = 0;
+  check(LGBM_BoosterGetEvalCounts(unwrap(handle), &cnt));
+  std::vector<std::vector<char>> bufs(cnt > 0 ? cnt : 1,
+                                      std::vector<char>(256, '\0'));
+  std::vector<char*> ptrs(bufs.size());
+  for (size_t i = 0; i < bufs.size(); ++i) ptrs[i] = bufs[i].data();
+  int n = 0;
+  check(LGBM_BoosterGetEvalNames(unwrap(handle), &n, ptrs.data()));
+  SEXP out = PROTECT(Rf_allocVector(STRSXP, n));
+  for (int i = 0; i < n; ++i) SET_STRING_ELT(out, i, Rf_mkChar(ptrs[i]));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBMR_BoosterGetEval(SEXP handle, SEXP data_idx) {
+  int cnt = 0;
+  check(LGBM_BoosterGetEvalCounts(unwrap(handle), &cnt));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, cnt));
+  int n = 0;
+  check(LGBM_BoosterGetEval(unwrap(handle), Rf_asInteger(data_idx), &n,
+                            REAL(out)));
+  SEXP trimmed = out;
+  if (n != cnt) {
+    trimmed = PROTECT(Rf_lengthgets(out, n));
+    UNPROTECT(1);
+  }
+  UNPROTECT(1);
+  return trimmed;
+}
+
+SEXP LGBMR_BoosterPredictForMat(SEXP handle, SEXP data, SEXP nrow,
+                                SEXP ncol, SEXP predict_type,
+                                SEXP num_iteration, SEXP parameter) {
+  int nr = Rf_asInteger(nrow);
+  int pt = Rf_asInteger(predict_type);
+  int ni = Rf_asInteger(num_iteration);
+  int64_t len = 0;
+  check(LGBM_BoosterCalcNumPredict(unwrap(handle), nr, pt, ni, &len));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, len));
+  int64_t got = 0;
+  check(LGBM_BoosterPredictForMat(unwrap(handle), REAL(data),
+                                  C_API_DTYPE_FLOAT64, nr,
+                                  Rf_asInteger(ncol), /*row major=*/0, pt,
+                                  ni, as_string(parameter).c_str(), &got,
+                                  REAL(out)));
+  if (got != len) {
+    SEXP trimmed = PROTECT(Rf_lengthgets(out, got));
+    UNPROTECT(2);
+    return trimmed;
+  }
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBMR_BoosterPredictForCSC(SEXP handle, SEXP col_ptr, SEXP indices,
+                                SEXP values, SEXP nrow, SEXP predict_type,
+                                SEXP num_iteration, SEXP parameter) {
+  int nr = Rf_asInteger(nrow);
+  int pt = Rf_asInteger(predict_type);
+  int ni = Rf_asInteger(num_iteration);
+  int64_t len = 0;
+  check(LGBM_BoosterCalcNumPredict(unwrap(handle), nr, pt, ni, &len));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, len));
+  int64_t got = 0;
+  check(LGBM_BoosterPredictForCSC(
+      unwrap(handle), INTEGER(col_ptr), C_API_DTYPE_INT32,
+      INTEGER(indices), REAL(values), C_API_DTYPE_FLOAT64,
+      Rf_xlength(col_ptr), Rf_xlength(values), nr, pt, ni,
+      as_string(parameter).c_str(), &got, REAL(out)));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBMR_BoosterSaveModel(SEXP handle, SEXP num_iteration,
+                            SEXP filename) {
+  check(LGBM_BoosterSaveModel(unwrap(handle), 0,
+                              Rf_asInteger(num_iteration),
+                              as_string(filename).c_str()));
+  return R_NilValue;
+}
+
+SEXP LGBMR_BoosterSaveModelToString(SEXP handle, SEXP num_iteration) {
+  int64_t len = 0;
+  check(LGBM_BoosterSaveModelToString(unwrap(handle), 0,
+                                      Rf_asInteger(num_iteration), 0,
+                                      &len, nullptr));
+  std::vector<char> buf(len);
+  int64_t got = 0;
+  check(LGBM_BoosterSaveModelToString(unwrap(handle), 0,
+                                      Rf_asInteger(num_iteration), len,
+                                      &got, buf.data()));
+  return Rf_mkString(buf.data());
+}
+
+SEXP LGBMR_BoosterDumpModel(SEXP handle, SEXP num_iteration) {
+  int64_t len = 0;
+  check(LGBM_BoosterDumpModel(unwrap(handle), 0,
+                              Rf_asInteger(num_iteration), 0, &len,
+                              nullptr));
+  std::vector<char> buf(len);
+  int64_t got = 0;
+  check(LGBM_BoosterDumpModel(unwrap(handle), 0,
+                              Rf_asInteger(num_iteration), len, &got,
+                              buf.data()));
+  return Rf_mkString(buf.data());
+}
+
+SEXP LGBMR_BoosterFeatureImportance(SEXP handle, SEXP num_iteration,
+                                    SEXP importance_type) {
+  int nf = 0;
+  check(LGBM_BoosterGetNumFeature(unwrap(handle), &nf));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, nf));
+  check(LGBM_BoosterFeatureImportance(unwrap(handle),
+                                      Rf_asInteger(num_iteration),
+                                      Rf_asInteger(importance_type),
+                                      REAL(out)));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBMR_BoosterGetNumFeature(SEXP handle) {
+  int out = 0;
+  check(LGBM_BoosterGetNumFeature(unwrap(handle), &out));
+  return Rf_ScalarInteger(out);
+}
+
+/* ---- registration ----------------------------------------------- */
+
+static const R_CallMethodDef kCallMethods[] = {
+    {"LGBMR_DatasetCreateFromFile",
+     (DL_FUNC)&LGBMR_DatasetCreateFromFile, 3},
+    {"LGBMR_DatasetCreateFromMat", (DL_FUNC)&LGBMR_DatasetCreateFromMat,
+     5},
+    {"LGBMR_DatasetCreateFromCSC", (DL_FUNC)&LGBMR_DatasetCreateFromCSC,
+     6},
+    {"LGBMR_DatasetGetSubset", (DL_FUNC)&LGBMR_DatasetGetSubset, 3},
+    {"LGBMR_DatasetSetField", (DL_FUNC)&LGBMR_DatasetSetField, 3},
+    {"LGBMR_DatasetGetField", (DL_FUNC)&LGBMR_DatasetGetField, 2},
+    {"LGBMR_DatasetGetNumData", (DL_FUNC)&LGBMR_DatasetGetNumData, 1},
+    {"LGBMR_DatasetGetNumFeature", (DL_FUNC)&LGBMR_DatasetGetNumFeature,
+     1},
+    {"LGBMR_DatasetSetFeatureNames",
+     (DL_FUNC)&LGBMR_DatasetSetFeatureNames, 2},
+    {"LGBMR_DatasetGetFeatureNames",
+     (DL_FUNC)&LGBMR_DatasetGetFeatureNames, 1},
+    {"LGBMR_DatasetSaveBinary", (DL_FUNC)&LGBMR_DatasetSaveBinary, 2},
+    {"LGBMR_DatasetUpdateParam", (DL_FUNC)&LGBMR_DatasetUpdateParam, 2},
+    {"LGBMR_BoosterCreate", (DL_FUNC)&LGBMR_BoosterCreate, 2},
+    {"LGBMR_BoosterCreateFromModelfile",
+     (DL_FUNC)&LGBMR_BoosterCreateFromModelfile, 1},
+    {"LGBMR_BoosterLoadModelFromString",
+     (DL_FUNC)&LGBMR_BoosterLoadModelFromString, 1},
+    {"LGBMR_BoosterAddValidData", (DL_FUNC)&LGBMR_BoosterAddValidData, 2},
+    {"LGBMR_BoosterResetTrainingData",
+     (DL_FUNC)&LGBMR_BoosterResetTrainingData, 2},
+    {"LGBMR_BoosterResetParameter",
+     (DL_FUNC)&LGBMR_BoosterResetParameter, 2},
+    {"LGBMR_BoosterUpdateOneIter", (DL_FUNC)&LGBMR_BoosterUpdateOneIter,
+     1},
+    {"LGBMR_BoosterUpdateOneIterCustom",
+     (DL_FUNC)&LGBMR_BoosterUpdateOneIterCustom, 3},
+    {"LGBMR_BoosterRollbackOneIter",
+     (DL_FUNC)&LGBMR_BoosterRollbackOneIter, 1},
+    {"LGBMR_BoosterGetCurrentIteration",
+     (DL_FUNC)&LGBMR_BoosterGetCurrentIteration, 1},
+    {"LGBMR_BoosterGetNumClasses", (DL_FUNC)&LGBMR_BoosterGetNumClasses,
+     1},
+    {"LGBMR_BoosterGetEvalNames", (DL_FUNC)&LGBMR_BoosterGetEvalNames, 1},
+    {"LGBMR_BoosterGetEval", (DL_FUNC)&LGBMR_BoosterGetEval, 2},
+    {"LGBMR_BoosterPredictForMat", (DL_FUNC)&LGBMR_BoosterPredictForMat,
+     7},
+    {"LGBMR_BoosterPredictForCSC", (DL_FUNC)&LGBMR_BoosterPredictForCSC,
+     8},
+    {"LGBMR_BoosterSaveModel", (DL_FUNC)&LGBMR_BoosterSaveModel, 3},
+    {"LGBMR_BoosterSaveModelToString",
+     (DL_FUNC)&LGBMR_BoosterSaveModelToString, 2},
+    {"LGBMR_BoosterDumpModel", (DL_FUNC)&LGBMR_BoosterDumpModel, 2},
+    {"LGBMR_BoosterFeatureImportance",
+     (DL_FUNC)&LGBMR_BoosterFeatureImportance, 3},
+    {"LGBMR_BoosterGetNumFeature", (DL_FUNC)&LGBMR_BoosterGetNumFeature,
+     1},
+    {nullptr, nullptr, 0}};
+
+void R_init_lightgbm_R(DllInfo* dll) {
+  R_registerRoutines(dll, nullptr, kCallMethods, nullptr, nullptr);
+  R_useDynamicSymbols(dll, FALSE);
+}
+
+}  // extern "C"

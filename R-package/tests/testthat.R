@@ -1,0 +1,4 @@
+library(testthat)
+library(lightgbm.tpu)
+
+test_check("lightgbm.tpu")

@@ -1,0 +1,99 @@
+# End-to-end tests over the C API.  They skip when the shared library
+# stack is unavailable (this framework's dev image has no R toolchain;
+# see README.md for the build recipe).
+
+skip_if_no_backend <- function() {
+  ok <- tryCatch({
+    d <- lgb.Dataset(matrix(rnorm(40), ncol = 2L),
+                     label = rep(c(0, 1), 10L),
+                     params = list(min_data_in_bin = 1L, verbose = -1L))
+    lgb.Dataset.construct(d)
+    TRUE
+  }, error = function(e) FALSE)
+  if (!ok) {
+    skip("libltpu_capi.so backend unavailable")
+  }
+}
+
+make_toy <- function(n = 500L) {
+  set.seed(1L)
+  x <- matrix(rnorm(n * 4L), ncol = 4L)
+  y <- as.numeric(x[, 1L] + 0.5 * x[, 2L] + rnorm(n, sd = 0.1) > 0)
+  list(x = x, y = y)
+}
+
+test_that("dataset roundtrip", {
+  skip_if_no_backend()
+  toy <- make_toy()
+  d <- lgb.Dataset(toy$x, label = toy$y, params = list(verbose = -1L))
+  expect_equal(dim(d), c(500L, 4L))
+  expect_equal(getinfo(d, "label"), toy$y, tolerance = 1e-6)
+})
+
+test_that("train / predict / eval / early stop", {
+  skip_if_no_backend()
+  toy <- make_toy()
+  train_idx <- 1:400
+  dtrain <- lgb.Dataset(toy$x[train_idx, ], label = toy$y[train_idx],
+                        params = list(verbose = -1L))
+  dvalid <- lgb.Dataset.create.valid(dtrain, toy$x[-train_idx, ],
+                                     label = toy$y[-train_idx])
+  bst <- lgb.train(params = list(objective = "binary", metric = "auc",
+                                 num_leaves = 7L, verbose = -1L),
+                   data = dtrain, nrounds = 20L,
+                   valids = list(valid = dvalid),
+                   early_stopping_rounds = 10L, verbose = 0L)
+  expect_gt(bst$best_iter, 0L)
+  auc <- lgb.get.eval.result(bst, "valid", "auc")
+  expect_gt(max(auc), 0.9)
+  p <- predict(bst, toy$x[-train_idx, ])
+  expect_length(p, 100L)
+  expect_true(all(p >= 0 & p <= 1))
+})
+
+test_that("save / load / importance / dump", {
+  skip_if_no_backend()
+  toy <- make_toy()
+  d <- lgb.Dataset(toy$x, label = toy$y, params = list(verbose = -1L))
+  bst <- lgb.train(params = list(objective = "binary", num_leaves = 7L,
+                                 verbose = -1L),
+                   data = d, nrounds = 8L, verbose = 0L)
+  f <- tempfile(fileext = ".txt")
+  lgb.save(bst, f)
+  bst2 <- lgb.load(f)
+  p1 <- predict(bst, toy$x)
+  p2 <- predict(bst2, toy$x)
+  expect_equal(p1, p2, tolerance = 1e-10)
+  imp <- lgb.importance(bst)
+  expect_true(all(c("Feature", "Gain", "Split") %in% names(imp)))
+  expect_gt(sum(imp$Split), 0)
+  js <- lgb.dump(bst)
+  expect_true(grepl("tree_info", js, fixed = TRUE))
+})
+
+test_that("sparse dgCMatrix input", {
+  skip_if_no_backend()
+  skip_if_not_installed("Matrix")
+  toy <- make_toy()
+  xs <- toy$x
+  xs[abs(xs) < 0.5] <- 0
+  sm <- Matrix::Matrix(xs, sparse = TRUE)
+  d <- lgb.Dataset(sm, label = toy$y, params = list(verbose = -1L))
+  bst <- lgb.train(params = list(objective = "binary", num_leaves = 7L,
+                                 verbose = -1L),
+                   data = d, nrounds = 5L, verbose = 0L)
+  p_sparse <- predict(bst, sm)
+  p_dense <- predict(bst, as.matrix(sm))
+  expect_equal(p_sparse, p_dense, tolerance = 1e-10)
+})
+
+test_that("cv runs and records", {
+  skip_if_no_backend()
+  toy <- make_toy()
+  d <- lgb.Dataset(toy$x, label = toy$y, params = list(verbose = -1L))
+  cv <- lgb.cv(params = list(objective = "binary", metric = "auc",
+                             num_leaves = 7L, verbose = -1L),
+               data = d, nrounds = 5L, nfold = 3L, verbose = 0L)
+  expect_length(cv$boosters, 3L)
+  expect_gte(length(cv$record_evals$valid$auc$eval), 1L)
+})

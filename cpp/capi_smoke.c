@@ -84,7 +84,7 @@ int main(void) {
   }
 
   const char* model_path = "/tmp/capi_smoke_model.txt";
-  CHECK(LGBM_BoosterSaveModel(bst, 0, model_path));
+  CHECK(LGBM_BoosterSaveModel(bst, 0, 0, model_path));
   BoosterHandle bst2 = NULL;
   int iters = 0;
   CHECK(LGBM_BoosterCreateFromModelfile(model_path, &iters, &bst2));

@@ -2,12 +2,12 @@
  * C API for lightgbm_tpu — the stable non-Python entry point.
  *
  * Mirrors the reference's exported surface (include/LightGBM/c_api.h:
- * handles, dtype/predict-type constants, int return codes with
- * LGBM_GetLastError) so callers written against the reference's C API
- * can link against libltpu_capi.so instead.  The implementation embeds
- * CPython and forwards to the lightgbm_tpu package (see
- * lightgbm_tpu/capi.py); the embedding is an implementation detail
- * invisible to the C caller.
+ * all 58 exports, handles, dtype/predict-type constants, int return
+ * codes with LGBM_GetLastError) so callers written against the
+ * reference's C API can link against libltpu_capi.so instead.  The
+ * implementation embeds CPython and forwards to the lightgbm_tpu
+ * package (see lightgbm_tpu/capi.py); the embedding is an
+ * implementation detail invisible to the C caller.
  *
  * Thread safety: every call takes the GIL; mutating calls on one
  * booster serialize exactly like the reference's per-booster mutex
@@ -37,20 +37,67 @@ typedef void* BoosterHandle;
 
 const char* LGBM_GetLastError(void);
 
+/* ---- dataset construction -------------------------------------- */
 int LGBM_DatasetCreateFromFile(const char* filename, const char* parameters,
                                const DatasetHandle reference,
                                DatasetHandle* out);
+/* Bin mappers from a per-column value sample; rows then stream in via
+ * LGBM_DatasetPushRows* (c_api.h:65). */
+int LGBM_DatasetCreateFromSampledColumn(double** sample_data,
+                                        int** sample_indices, int32_t ncol,
+                                        const int* num_per_col,
+                                        int32_t num_sample_row,
+                                        int32_t num_total_row,
+                                        const char* parameters,
+                                        DatasetHandle* out);
+/* Empty dataset aligned with `reference`; rows stream in via
+ * LGBM_DatasetPushRows* (c_api.h:81). */
+int LGBM_DatasetCreateByReference(const DatasetHandle reference,
+                                  int64_t num_total_row,
+                                  DatasetHandle* out);
+/* Push a row block; when start_row + nrow == num_total_row the dataset
+ * finishes loading (c_api.h:95/116). */
+int LGBM_DatasetPushRows(DatasetHandle dataset, const void* data,
+                         int data_type, int32_t nrow, int32_t ncol,
+                         int32_t start_row);
+int LGBM_DatasetPushRowsByCSR(DatasetHandle dataset, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem,
+                              int64_t num_col, int64_t start_row);
 int LGBM_DatasetCreateFromMat(const void* data, int data_type, int32_t nrow,
                               int32_t ncol, int is_row_major,
                               const char* parameters,
                               const DatasetHandle reference,
                               DatasetHandle* out);
+int LGBM_DatasetCreateFromMats(int32_t nmat, const void** data,
+                               int data_type, int32_t* nrow, int32_t ncol,
+                               int is_row_major, const char* parameters,
+                               const DatasetHandle reference,
+                               DatasetHandle* out);
 int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
                               const int32_t* indices, const void* data,
                               int data_type, int64_t nindptr, int64_t nelem,
                               int64_t num_col, const char* parameters,
                               const DatasetHandle reference,
                               DatasetHandle* out);
+int LGBM_DatasetCreateFromCSC(const void* col_ptr, int col_ptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t ncol_ptr, int64_t nelem,
+                              int64_t num_row, const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out);
+int LGBM_DatasetGetSubset(const DatasetHandle handle,
+                          const int32_t* used_row_indices,
+                          int32_t num_used_row_indices,
+                          const char* parameters, DatasetHandle* out);
+int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
+                                const char** feature_names,
+                                int num_feature_names);
+/* feature_names pre-allocated by caller (reference ABI). */
+int LGBM_DatasetGetFeatureNames(DatasetHandle handle, char** feature_names,
+                                int* num_feature_names);
+int LGBM_DatasetUpdateParam(DatasetHandle handle, const char* parameters);
 int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
                          const void* field_data, int num_element, int type);
 /* out_ptr points into dataset-owned memory, valid until
@@ -62,6 +109,7 @@ int LGBM_DatasetGetNumFeature(DatasetHandle handle, int* out);
 int LGBM_DatasetSaveBinary(DatasetHandle handle, const char* filename);
 int LGBM_DatasetFree(DatasetHandle handle);
 
+/* ---- booster ---------------------------------------------------- */
 int LGBM_BoosterCreate(const DatasetHandle train_data,
                        const char* parameters, BoosterHandle* out);
 int LGBM_BoosterCreateFromModelfile(const char* filename,
@@ -70,31 +118,53 @@ int LGBM_BoosterCreateFromModelfile(const char* filename,
 int LGBM_BoosterLoadModelFromString(const char* model_str,
                                     int* out_num_iterations,
                                     BoosterHandle* out);
+int LGBM_BoosterFree(BoosterHandle handle);
+/* Shuffle whole iterations in [start_iter, end_iter) (c_api.h:385). */
+int LGBM_BoosterShuffleModels(BoosterHandle handle, int start_iter,
+                              int end_iter);
+/* Merge other_handle's trees into handle, other's first (c_api.h:393). */
+int LGBM_BoosterMerge(BoosterHandle handle, BoosterHandle other_handle);
 int LGBM_BoosterAddValidData(BoosterHandle handle,
                              const DatasetHandle valid_data);
+int LGBM_BoosterResetTrainingData(BoosterHandle handle,
+                                  const DatasetHandle train_data);
+int LGBM_BoosterResetParameter(BoosterHandle handle,
+                               const char* parameters);
+int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len);
 int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
+/* Refit leaf values to the supplied per-tree leaf assignments using
+ * the training set's gradients (c_api.h:446). */
+int LGBM_BoosterRefit(BoosterHandle handle, const int32_t* leaf_preds,
+                      int32_t nrow, int32_t ncol);
 int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle, const float* grad,
                                     const float* hess, int* is_finished);
 int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
-int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len);
 int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out_iteration);
-int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out_len);
-int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
-                        double* out_results);
+int LGBM_BoosterNumModelPerIteration(BoosterHandle handle,
+                                     int* out_tree_per_iteration);
+int LGBM_BoosterNumberOfTotalModel(BoosterHandle handle, int* out_models);
+int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out_len);
 int LGBM_BoosterGetEvalNames(BoosterHandle handle, int* out_len,
                              char** out_strs);
 int LGBM_BoosterGetFeatureNames(BoosterHandle handle, int* out_len,
                                 char** out_strs);
-int LGBM_BoosterSaveModel(BoosterHandle handle, int num_iteration,
-                          const char* filename);
-int LGBM_BoosterSaveModelToString(BoosterHandle handle, int num_iteration,
-                                  int64_t buffer_len, int64_t* out_len,
-                                  char* out_str);
-int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
-                              int data_type, int32_t nrow, int32_t ncol,
-                              int is_row_major, int predict_type,
-                              int num_iteration, const char* parameter,
-                              int64_t* out_len, double* out_result);
+int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out_len);
+int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
+                        double* out_results);
+int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
+                              int64_t* out_len);
+int LGBM_BoosterGetPredict(BoosterHandle handle, int data_idx,
+                           int64_t* out_len, double* out_result);
+/* Parse data_filename, predict, write tab-joined rows to
+ * result_filename (c_api.h:577). */
+int LGBM_BoosterPredictForFile(BoosterHandle handle,
+                               const char* data_filename,
+                               int data_has_header, int predict_type,
+                               int num_iteration, const char* parameter,
+                               const char* result_filename);
+int LGBM_BoosterCalcNumPredict(BoosterHandle handle, int num_row,
+                               int predict_type, int num_iteration,
+                               int64_t* out_len);
 int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
                               int indptr_type, const int32_t* indices,
                               const void* data, int data_type,
@@ -102,19 +172,49 @@ int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
                               int64_t num_col, int predict_type,
                               int num_iteration, const char* parameter,
                               int64_t* out_len, double* out_result);
-int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
-                              int64_t* out_len);
-int LGBM_BoosterGetPredict(BoosterHandle handle, int data_idx,
-                           int64_t* out_len, double* out_result);
-int LGBM_BoosterFree(BoosterHandle handle);
+int LGBM_BoosterPredictForCSC(BoosterHandle handle, const void* col_ptr,
+                              int col_ptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t ncol_ptr, int64_t nelem,
+                              int64_t num_row, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result);
+int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
+                              int data_type, int32_t nrow, int32_t ncol,
+                              int is_row_major, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result);
+int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, const char* filename);
+int LGBM_BoosterSaveModelToString(BoosterHandle handle, int start_iteration,
+                                  int num_iteration, int64_t buffer_len,
+                                  int64_t* out_len, char* out_str);
+/* JSON model dump (c_api.h:751). */
+int LGBM_BoosterDumpModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, int64_t buffer_len,
+                          int64_t* out_len, char* out_str);
+int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double* out_val);
+int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double val);
+/* importance_type: 0 split counts, 1 total gain (c_api.h:792). */
+int LGBM_BoosterFeatureImportance(BoosterHandle handle, int num_iteration,
+                                  int importance_type, double* out_results);
 
-/* The reference's socket-mesh bootstrap (c_api.h:816 exposes external
- * collectives as the pluggable seam). Distribution here rides the JAX
- * device mesh (tree_learner=data|feature|voting), so these accept the
- * call for source compatibility and warn. */
+/* ---- network ---------------------------------------------------- */
+/* Reference socket-mesh bootstrap (c_api.h:805).  Here it joins the
+ * JAX distributed runtime (jax.distributed + a global device mesh).
+ * num_machines>1 with an unresolvable topology FAILS (-1) — never a
+ * silent single-node fallback. */
 int LGBM_NetworkInit(const char* machines, int local_listen_port,
                      int listen_time_out, int num_machines);
 int LGBM_NetworkFree(void);
+/* External-collective injection (c_api.h:816).  Unsupported by design:
+ * collectives are XLA programs, not host callbacks — always fails with
+ * an explanatory LGBM_GetLastError. */
+int LGBM_NetworkInitWithFunctions(int num_machines, int rank,
+                                  void* reduce_scatter_ext_fun,
+                                  void* allgather_ext_fun);
 
 #ifdef __cplusplus
 }

@@ -234,6 +234,192 @@ int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
   return 0;
 }
 
+int LGBM_DatasetCreateFromCSC(const void* col_ptr, int col_ptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t ncol_ptr, int64_t nelem,
+                              int64_t num_row, const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  Py_ssize_t cp_bytes =
+      ncol_ptr * static_cast<Py_ssize_t>(dtype_size(col_ptr_type));
+  Py_ssize_t dat_bytes =
+      nelem * static_cast<Py_ssize_t>(dtype_size(data_type));
+  PyObject* res = call(
+      "dataset_from_csc",
+      Py_BuildValue("(NiNNiLLLsN)", view(col_ptr, cp_bytes), col_ptr_type,
+                    view(indices, nelem * 4), view(data, dat_bytes),
+                    data_type, static_cast<long long>(ncol_ptr),
+                    static_cast<long long>(nelem),
+                    static_cast<long long>(num_row),
+                    parameters ? parameters : "", ref_or_none(reference)));
+  if (res == nullptr) return -1;
+  *out = res;
+  return 0;
+}
+
+int LGBM_DatasetCreateFromMats(int32_t nmat, const void** data,
+                               int data_type, int32_t* nrow, int32_t ncol,
+                               int is_row_major, const char* parameters,
+                               const DatasetHandle reference,
+                               DatasetHandle* out) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* mats = PyList_New(nmat);
+  PyObject* rows = PyList_New(nmat);
+  for (int32_t i = 0; i < nmat; ++i) {
+    Py_ssize_t nbytes = static_cast<Py_ssize_t>(nrow[i]) * ncol *
+                        static_cast<Py_ssize_t>(dtype_size(data_type));
+    PyList_SetItem(mats, i, view(data[i], nbytes));
+    PyList_SetItem(rows, i, PyLong_FromLong(nrow[i]));
+  }
+  PyObject* res = call(
+      "dataset_from_mats",
+      Py_BuildValue("(NNiiisN)", mats, rows, data_type, ncol, is_row_major,
+                    parameters ? parameters : "", ref_or_none(reference)));
+  if (res == nullptr) return -1;
+  *out = res;
+  return 0;
+}
+
+int LGBM_DatasetCreateFromSampledColumn(double** sample_data,
+                                        int** sample_indices, int32_t ncol,
+                                        const int* num_per_col,
+                                        int32_t num_sample_row,
+                                        int32_t num_total_row,
+                                        const char* parameters,
+                                        DatasetHandle* out) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* samples = PyList_New(ncol);
+  PyObject* sidx = PyList_New(ncol);
+  PyObject* counts = PyList_New(ncol);
+  for (int32_t j = 0; j < ncol; ++j) {
+    Py_ssize_t n = num_per_col[j];
+    PyList_SetItem(samples, j, view(sample_data[j], n * 8));
+    PyList_SetItem(sidx, j, view(sample_indices[j], n * 4));
+    PyList_SetItem(counts, j, PyLong_FromLong(num_per_col[j]));
+  }
+  PyObject* res = call(
+      "dataset_from_sampled_column",
+      Py_BuildValue("(NNiNiis)", samples, sidx, ncol, counts,
+                    num_sample_row, num_total_row,
+                    parameters ? parameters : ""));
+  if (res == nullptr) return -1;
+  *out = res;
+  return 0;
+}
+
+int LGBM_DatasetCreateByReference(const DatasetHandle reference,
+                                  int64_t num_total_row,
+                                  DatasetHandle* out) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("dataset_create_by_reference",
+                       Py_BuildValue("(NL)", ref_or_none(reference),
+                                     static_cast<long long>(num_total_row)));
+  if (res == nullptr) return -1;
+  *out = res;
+  return 0;
+}
+
+int LGBM_DatasetPushRows(DatasetHandle dataset, const void* data,
+                         int data_type, int32_t nrow, int32_t ncol,
+                         int32_t start_row) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  Py_ssize_t nbytes = static_cast<Py_ssize_t>(nrow) * ncol *
+                      static_cast<Py_ssize_t>(dtype_size(data_type));
+  PyObject* res = call("dataset_push_rows",
+                       Py_BuildValue("(NNiiii)", ref_or_none(dataset),
+                                     view(data, nbytes), data_type, nrow,
+                                     ncol, start_row));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_DatasetPushRowsByCSR(DatasetHandle dataset, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem,
+                              int64_t num_col, int64_t start_row) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  Py_ssize_t ip_bytes =
+      nindptr * static_cast<Py_ssize_t>(dtype_size(indptr_type));
+  Py_ssize_t dat_bytes =
+      nelem * static_cast<Py_ssize_t>(dtype_size(data_type));
+  PyObject* res = call(
+      "dataset_push_rows_by_csr",
+      Py_BuildValue("(NNiNNiLLLL)", ref_or_none(dataset),
+                    view(indptr, ip_bytes), indptr_type,
+                    view(indices, nelem * 4), view(data, dat_bytes),
+                    data_type, static_cast<long long>(nindptr),
+                    static_cast<long long>(nelem),
+                    static_cast<long long>(num_col),
+                    static_cast<long long>(start_row)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_DatasetGetSubset(const DatasetHandle handle,
+                          const int32_t* used_row_indices,
+                          int32_t num_used_row_indices,
+                          const char* parameters, DatasetHandle* out) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call(
+      "dataset_get_subset",
+      Py_BuildValue("(NNis)", ref_or_none(handle),
+                    view(used_row_indices, num_used_row_indices * 4),
+                    num_used_row_indices, parameters ? parameters : ""));
+  if (res == nullptr) return -1;
+  *out = res;
+  return 0;
+}
+
+int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
+                                const char** feature_names,
+                                int num_feature_names) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* names = PyList_New(num_feature_names);
+  for (int i = 0; i < num_feature_names; ++i) {
+    PyList_SetItem(names, i, PyUnicode_FromString(feature_names[i]));
+  }
+  PyObject* res = call("dataset_set_feature_names",
+                       Py_BuildValue("(NN)", ref_or_none(handle), names));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_DatasetGetFeatureNames(DatasetHandle handle, char** feature_names,
+                                int* num_feature_names) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("dataset_get_feature_names",
+                       Py_BuildValue("(N)", ref_or_none(handle)));
+  if (res == nullptr) return -1;
+  int rc = copy_strings_out(res, num_feature_names, feature_names);
+  Py_DECREF(res);
+  return rc;
+}
+
+int LGBM_DatasetUpdateParam(DatasetHandle handle, const char* parameters) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("dataset_update_param",
+                       Py_BuildValue("(Ns)", ref_or_none(handle),
+                                     parameters ? parameters : ""));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
 int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
                          const void* field_data, int num_element, int type) {
   Gil gil;
@@ -361,11 +547,71 @@ int LGBM_BoosterAddValidData(BoosterHandle handle,
                              const DatasetHandle valid_data) {
   Gil gil;
   if (!gil.ready()) return -1;
-  static int valid_count = 0;
-  std::string name = "valid_" + std::to_string(valid_count++);
-  PyObject* res = call("booster_add_valid",
-                       Py_BuildValue("(NNs)", ref_or_none(handle),
-                                     ref_or_none(valid_data), name.c_str()));
+  /* name by THIS booster's valid-set count (valid_1 is every booster's
+   * first valid set), not a process-global counter */
+  PyObject* res = call("booster_add_valid_auto",
+                       Py_BuildValue("(NN)", ref_or_none(handle),
+                                     ref_or_none(valid_data)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_BoosterShuffleModels(BoosterHandle handle, int start_iter,
+                              int end_iter) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("booster_shuffle_models",
+                       Py_BuildValue("(Nii)", ref_or_none(handle),
+                                     start_iter, end_iter));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_BoosterMerge(BoosterHandle handle, BoosterHandle other_handle) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("booster_merge",
+                       Py_BuildValue("(NN)", ref_or_none(handle),
+                                     ref_or_none(other_handle)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_BoosterResetTrainingData(BoosterHandle handle,
+                                  const DatasetHandle train_data) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("booster_reset_training_data",
+                       Py_BuildValue("(NN)", ref_or_none(handle),
+                                     ref_or_none(train_data)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_BoosterResetParameter(BoosterHandle handle,
+                               const char* parameters) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("booster_reset_parameter",
+                       Py_BuildValue("(Ns)", ref_or_none(handle),
+                                     parameters ? parameters : ""));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_BoosterRefit(BoosterHandle handle, const int32_t* leaf_preds,
+                      int32_t nrow, int32_t ncol) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  Py_ssize_t nbytes = static_cast<Py_ssize_t>(nrow) * ncol * 4;
+  PyObject* res = call("booster_refit",
+                       Py_BuildValue("(NNii)", ref_or_none(handle),
+                                     view(leaf_preds, nbytes), nrow, ncol));
   if (res == nullptr) return -1;
   Py_DECREF(res);
   return 0;
@@ -427,6 +673,11 @@ int LGBM_BoosterRollbackOneIter(BoosterHandle handle) {
 LTPU_INT_GETTER(LGBM_BoosterGetNumClasses, "booster_num_classes")
 LTPU_INT_GETTER(LGBM_BoosterGetCurrentIteration, "booster_current_iteration")
 LTPU_INT_GETTER(LGBM_BoosterGetNumFeature, "booster_num_feature")
+LTPU_INT_GETTER(LGBM_BoosterNumModelPerIteration,
+                "booster_num_model_per_iteration")
+LTPU_INT_GETTER(LGBM_BoosterNumberOfTotalModel,
+                "booster_number_of_total_model")
+LTPU_INT_GETTER(LGBM_BoosterGetEvalCounts, "booster_eval_counts")
 
 int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
                         double* out_results) {
@@ -466,27 +717,23 @@ int LGBM_BoosterGetFeatureNames(BoosterHandle handle, int* out_len,
   return rc;
 }
 
-int LGBM_BoosterSaveModel(BoosterHandle handle, int num_iteration,
-                          const char* filename) {
+int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, const char* filename) {
   Gil gil;
   if (!gil.ready()) return -1;
   PyObject* res = call("booster_save_model",
-                       Py_BuildValue("(Nis)", ref_or_none(handle),
-                                     num_iteration, filename));
+                       Py_BuildValue("(Niis)", ref_or_none(handle),
+                                     start_iteration, num_iteration,
+                                     filename));
   if (res == nullptr) return -1;
   Py_DECREF(res);
   return 0;
 }
 
-int LGBM_BoosterSaveModelToString(BoosterHandle handle, int num_iteration,
-                                  int64_t buffer_len, int64_t* out_len,
-                                  char* out_str) {
-  Gil gil;
-  if (!gil.ready()) return -1;
-  PyObject* res = call("booster_model_to_string",
-                       Py_BuildValue("(Ni)", ref_or_none(handle),
-                                     num_iteration));
-  if (res == nullptr) return -1;
+namespace {
+/* shared copy-out for the three model-text exports */
+int string_result_out(PyObject* res, int64_t buffer_len, int64_t* out_len,
+                      char* out_str) {
   Py_ssize_t n = 0;
   const char* s = PyUnicode_AsUTF8AndSize(res, &n);
   if (s == nullptr) {
@@ -498,6 +745,130 @@ int LGBM_BoosterSaveModelToString(BoosterHandle handle, int num_iteration,
   if (buffer_len >= *out_len) std::memcpy(out_str, s, n + 1);
   Py_DECREF(res);
   return 0;
+}
+}  // namespace
+
+int LGBM_BoosterSaveModelToString(BoosterHandle handle, int start_iteration,
+                                  int num_iteration, int64_t buffer_len,
+                                  int64_t* out_len, char* out_str) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("booster_model_to_string",
+                       Py_BuildValue("(Nii)", ref_or_none(handle),
+                                     start_iteration, num_iteration));
+  if (res == nullptr) return -1;
+  return string_result_out(res, buffer_len, out_len, out_str);
+}
+
+int LGBM_BoosterDumpModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, int64_t buffer_len,
+                          int64_t* out_len, char* out_str) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("booster_dump_model",
+                       Py_BuildValue("(Nii)", ref_or_none(handle),
+                                     start_iteration, num_iteration));
+  if (res == nullptr) return -1;
+  return string_result_out(res, buffer_len, out_len, out_str);
+}
+
+int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double* out_val) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("booster_get_leaf_value",
+                       Py_BuildValue("(Nii)", ref_or_none(handle),
+                                     tree_idx, leaf_idx));
+  if (res == nullptr) return -1;
+  *out_val = PyFloat_AsDouble(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double val) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("booster_set_leaf_value",
+                       Py_BuildValue("(Niid)", ref_or_none(handle),
+                                     tree_idx, leaf_idx, val));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_BoosterFeatureImportance(BoosterHandle handle, int num_iteration,
+                                  int importance_type,
+                                  double* out_results) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("booster_feature_importance",
+                       Py_BuildValue("(Nii)", ref_or_none(handle),
+                                     num_iteration, importance_type));
+  if (res == nullptr) return -1;
+  int64_t n = 0;
+  int rc = copy_bytes_out(res, out_results, &n);
+  Py_DECREF(res);
+  return rc;
+}
+
+int LGBM_BoosterCalcNumPredict(BoosterHandle handle, int num_row,
+                               int predict_type, int num_iteration,
+                               int64_t* out_len) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("booster_calc_num_predict",
+                       Py_BuildValue("(Niii)", ref_or_none(handle),
+                                     num_row, predict_type, num_iteration));
+  if (res == nullptr) return -1;
+  *out_len = PyLong_AsLongLong(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_BoosterPredictForFile(BoosterHandle handle,
+                               const char* data_filename,
+                               int data_has_header, int predict_type,
+                               int num_iteration, const char* parameter,
+                               const char* result_filename) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call(
+      "booster_predict_for_file",
+      Py_BuildValue("(Nsiiiss)", ref_or_none(handle), data_filename,
+                    data_has_header, predict_type, num_iteration,
+                    parameter ? parameter : "", result_filename));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_BoosterPredictForCSC(BoosterHandle handle, const void* col_ptr,
+                              int col_ptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t ncol_ptr, int64_t nelem,
+                              int64_t num_row, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  Py_ssize_t cp_bytes =
+      ncol_ptr * static_cast<Py_ssize_t>(dtype_size(col_ptr_type));
+  Py_ssize_t dat_bytes =
+      nelem * static_cast<Py_ssize_t>(dtype_size(data_type));
+  PyObject* res = call(
+      "booster_predict_csc",
+      Py_BuildValue("(NNiNNiLLLiis)", ref_or_none(handle),
+                    view(col_ptr, cp_bytes), col_ptr_type,
+                    view(indices, nelem * 4), view(data, dat_bytes),
+                    data_type, static_cast<long long>(ncol_ptr),
+                    static_cast<long long>(nelem),
+                    static_cast<long long>(num_row), predict_type,
+                    num_iteration, parameter ? parameter : ""));
+  if (res == nullptr) return -1;
+  int rc = copy_bytes_out(res, out_result, out_len);
+  Py_DECREF(res);
+  return rc;
 }
 
 int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
@@ -582,18 +953,41 @@ int LGBM_BoosterFree(BoosterHandle handle) {
 
 int LGBM_NetworkInit(const char* machines, int local_listen_port,
                      int listen_time_out, int num_machines) {
-  (void)machines;
-  (void)local_listen_port;
-  (void)listen_time_out;
-  if (num_machines > 1) {
-    std::fprintf(stderr,
-                 "[LightGBM-TPU] [Warning] LGBM_NetworkInit is a no-op: "
-                 "distribution uses the JAX device mesh "
-                 "(tree_learner=data|feature|voting)\n");
-  }
+  if (num_machines <= 1) return 0;
+  Gil gil;
+  if (!gil.ready()) return -1;
+  /* joins the JAX distributed runtime; raises (-> -1) when the
+   * topology cannot be resolved — never a silent single-node run */
+  PyObject* res = call("network_init",
+                       Py_BuildValue("(siii)", machines ? machines : "",
+                                     local_listen_port, listen_time_out,
+                                     num_machines));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
   return 0;
 }
 
-int LGBM_NetworkFree(void) { return 0; }
+int LGBM_NetworkFree(void) {
+  Gil gil;
+  if (!gil.ready()) return -1;
+  PyObject* res = call("network_free", Py_BuildValue("()"));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int LGBM_NetworkInitWithFunctions(int num_machines, int rank,
+                                  void* reduce_scatter_ext_fun,
+                                  void* allgather_ext_fun) {
+  (void)rank;
+  (void)reduce_scatter_ext_fun;
+  (void)allgather_ext_fun;
+  if (num_machines <= 1) return 0;
+  g_last_error =
+      "LGBM_NetworkInitWithFunctions is unsupported: collectives are "
+      "XLA programs on the device mesh, not host callbacks; use "
+      "LGBM_NetworkInit (machines=...) / jax.distributed instead";
+  return -1;
+}
 
 }  // extern "C"

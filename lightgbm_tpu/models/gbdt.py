@@ -197,7 +197,14 @@ class GBDT:
                 cat_smooth=config.cat_smooth,
                 min_data_per_group=config.min_data_per_group,
                 monotone=monotone,
-                penalty=penalty),
+                penalty=penalty,
+                # static dataset facts: trace-time dead-branch removal
+                # in the split scan (no cat -> no bin sorts, no missing
+                # -> one threshold direction)
+                any_cat=bool(any(m.bin_type == BIN_CATEGORICAL
+                                 for m in mappers)),
+                any_missing=bool(any(m.missing_type != 0
+                                     for m in mappers))),
             num_leaves=config.num_leaves,
             max_depth=config.max_depth,
             hist_impl="pallas" if use_pallas else "segsum",
@@ -212,13 +219,19 @@ class GBDT:
                       if (config.use_quantized_grad and not dist_active)
                       else 0),
             spec_tolerance=float(config.speculative_tolerance),
+            # wave growth (wave_splits): top-W splits applied per loop
+            # step from one batched pass; rides the speculative kernel
+            wave=bool(config.wave_splits and not dist_active and
+                      use_pool and not forced),
             # speculative child arming fills the MXU lanes (21 leaves x
             # 6 value columns, or 42 x 3 quantized); enabled on the
-            # accelerator path where the batched pallas kernel exists
+            # accelerator path where the batched pallas kernel exists,
+            # or anywhere when wave growth asks for it
             speculate=(min(multi_width(config.use_quantized_grad),
                            config.num_leaves)
-                       if (use_pallas and not dist_active and use_pool
-                           and not forced) else 0))
+                       if ((use_pallas or config.wave_splits) and
+                           not dist_active and use_pool and not forced)
+                       else 0))
 
         # parallel tree learner over the device mesh
         # (tree_learner={data,feature,voting}, tree_learner.cpp:9-33)
@@ -235,9 +248,10 @@ class GBDT:
             xt = train_set.binned.T  # (F, N) narrow uint8/16
         col_pad = 0 if self._bundles is not None else self._F_pad - F
         xt = np.pad(xt, ((0, col_pad), (0, self._n_pad - n)))
-        # ship the NARROW dtype over the host->device link (it can be
-        # the bottleneck) and widen on device
-        self._xt = jnp.asarray(xt).astype(jnp.int32)
+        # NARROW dtype end to end: host->device link (14 MB/s tunnel)
+        # AND device residency (uint8 = 295 MB at bench shape vs 1.18 GB
+        # int32); the pallas kernels and routing selects widen per tile
+        self._xt = jnp.asarray(xt)
         self._base_mask = jnp.asarray(
             np.pad(np.ones(n, np.float32), (0, self._n_pad - n)))
         if self._F_pad != F:
@@ -260,6 +274,7 @@ class GBDT:
         self._score = jnp.asarray(score)
         self._rng_feature = np.random.RandomState(
             config.feature_fraction_seed & 0x7FFFFFFF)
+        self._rec_layout = None  # lazy: packed split-record fetch plan
         self._quant_key = (jax.random.PRNGKey(
             config.data_random_seed & 0x7FFFFFFF)
             if self.grow_params.quantize else None)
@@ -356,7 +371,7 @@ class GBDT:
                 xtv = binned.binned.T  # (F, rows) narrow dtype
                 xtv = np.pad(xtv,
                              ((0, self._F_pad - xtv.shape[0]), (0, 0)))
-            vs.xt = jnp.asarray(xtv).astype(jnp.int32)
+            vs.xt = jnp.asarray(xtv)  # narrow dtype on device
         self.valid_sets.append(vs)
 
     # ------------------------------------------------------------------
@@ -455,20 +470,23 @@ class GBDT:
     def _train_one_tree(self, grad, hess, bag, init_score: float) -> Tree:
         import jax
         import jax.numpy as jnp
+        from ..utils.profiling import timed
 
         n, n_pad = self.num_data, self._n_pad
-        gp = jnp.pad(grad.astype(jnp.float32), (0, n_pad - n))
-        hp = jnp.pad(hess.astype(jnp.float32), (0, n_pad - n))
-        mask = self._base_mask
-        if bag is not None:
-            # weights scale grad/hess (GOSS/MVS upweighting); the count
-            # channel stays presence-based like the reference's subsets
-            w = jnp.pad(jnp.asarray(bag, jnp.float32).reshape(-1),
-                        (0, n_pad - n))
-            gp = gp * w
-            hp = hp * w
-            mask = mask * (w > 0)
-        fmask = self._feature_fraction_mask()
+        with timed("tree/prep"):
+            gp = jnp.pad(grad.astype(jnp.float32), (0, n_pad - n))
+            hp = jnp.pad(hess.astype(jnp.float32), (0, n_pad - n))
+            mask = self._base_mask
+            if bag is not None:
+                # weights scale grad/hess (GOSS/MVS upweighting); the
+                # count channel stays presence-based like the
+                # reference's subsets
+                w = jnp.pad(jnp.asarray(bag, jnp.float32).reshape(-1),
+                            (0, n_pad - n))
+                gp = gp * w
+                hp = hp * w
+                mask = mask * (w > 0)
+            fmask = self._feature_fraction_mask()
 
         recs = None
         if self.num_features == 0:
@@ -480,22 +498,25 @@ class GBDT:
                 # fresh stochastic-rounding randomness per tree
                 kw["quant_key"] = jax.random.fold_in(
                     self._quant_key, len(self.models))
-            if self._bundle_maps is not None:
-                rec = self._build_tree(self._xt, gp, hp, mask, fmask,
-                                       self._num_bins, self._missing_type,
-                                       self._is_cat, self.grow_params,
-                                       bundle_maps=self._bundle_maps, **kw)
-            else:
-                rec = self._build_tree(self._xt, gp, hp, mask, fmask,
-                                       self._num_bins, self._missing_type,
-                                       self._is_cat, self.grow_params, **kw)
-            # ONE device->host transfer per tree: every record except
-            # the (N,) leaf assignment (which stays on device for the
-            # score update) — host round-trips are ~100ms through a
-            # remote tunnel, so they must not multiply
-            recs = jax.device_get({k: v for k, v in rec.items()
-                                   if k != "leaf_idx"})
+            with timed("tree/dispatch"):
+                if self._bundle_maps is not None:
+                    rec = self._build_tree(
+                        self._xt, gp, hp, mask, fmask, self._num_bins,
+                        self._missing_type, self._is_cat, self.grow_params,
+                        bundle_maps=self._bundle_maps, **kw)
+                else:
+                    rec = self._build_tree(
+                        self._xt, gp, hp, mask, fmask, self._num_bins,
+                        self._missing_type, self._is_cat, self.grow_params,
+                        **kw)
+            with timed("tree/fetch"):
+                # one packed device->host transfer per tree; doubles as
+                # the device sync (tunnel round-trips cost ~120ms, so a
+                # separate 1-element sync fetch would double the toll)
+                recs = self._fetch_records(rec)
             n_leaves = int(recs["n_leaves"])
+            if "n_arm_passes" in recs:
+                self.last_arm_passes = int(recs["n_arm_passes"])
 
         if n_leaves <= 1:
             # constant tree holding the init score (gbdt.cpp:380-397)
@@ -511,7 +532,8 @@ class GBDT:
                 self._train_leaf_idx.append(None)
             return tree
 
-        tree = self._records_to_tree(recs)
+        with timed("tree/to_tree"):
+            tree = self._records_to_tree(recs)
         if self._track_train_leaf:
             # compact dtype ON DEVICE: leaf ids fit uint8/16 and the
             # device->host link is slow, so never ship int32
@@ -520,33 +542,64 @@ class GBDT:
                 np.asarray(rec["leaf_idx"][:n].astype(dt)))
         # leaf renewal hook (RenewTreeOutput) — objective-specific
         if self.objective is not None:
-            self.objective.renew_tree_output(
-                tree, self._score, rec["leaf_idx"][:n], mask)
+            with timed("tree/renew"):
+                self.objective.renew_tree_output(
+                    tree, self._score, rec["leaf_idx"][:n], mask)
         tree.apply_shrinkage(self.shrinkage_rate)
-        # train-score update via the leaf assignment from the build
-        vals = jnp.asarray(tree.leaf_value[:self.config.num_leaves],
-                           jnp.float32)
-        vals = jnp.pad(vals, (0, max(0,
-                                     self.config.num_leaves - vals.shape[0])))
-        tree_idx = len(self.models) % self.num_tree_per_iteration
-        self._score = self._score.at[tree_idx].add(
-            jnp.take(vals, rec["leaf_idx"][:n]))
+        with timed("tree/score_update"):
+            # train-score update via the leaf assignment from the build
+            vals = jnp.asarray(tree.leaf_value[:self.config.num_leaves],
+                               jnp.float32)
+            vals = jnp.pad(
+                vals, (0, max(0, self.config.num_leaves - vals.shape[0])))
+            tree_idx = len(self.models) % self.num_tree_per_iteration
+            self._score = self._score.at[tree_idx].add(
+                jnp.take(vals, rec["leaf_idx"][:n]))
         # valid scores: device split-record replay when the binned
         # matrix is resident, host traversal fallback otherwise
         from ..ops.grow import route_rows
-        for vs in self.valid_sets:
-            if vs.xt is not None:
-                li = route_rows(vs.xt, rec["leaf"], rec["feature"],
-                                rec["left_mask"], rec["valid"],
-                                self.config.num_leaves,
-                                bundle_maps=self._bundle_maps)
-                vs.score[tree_idx] += np.asarray(jnp.take(vals, li),
-                                                 np.float64)
-            else:
-                vs.score[tree_idx] += tree.predict(vs.raw)
+        with timed("tree/valid"):
+            for vs in self.valid_sets:
+                if vs.xt is not None:
+                    li = route_rows(vs.xt, rec["leaf"], rec["feature"],
+                                    rec["left_mask"], rec["valid"],
+                                    self.config.num_leaves,
+                                    bundle_maps=self._bundle_maps)
+                    vs.score[tree_idx] += np.asarray(jnp.take(vals, li),
+                                                     np.float64)
+                else:
+                    vs.score[tree_idx] += tree.predict(vs.raw)
         if abs(init_score) > _KEPS:
             tree.add_bias(init_score)
         return tree
+
+    # ------------------------------------------------------------------
+    def _fetch_records(self, rec):
+        """ONE device->host transfer per tree: every split record except
+        the (N,) leaf assignment (which stays on device for the score
+        update), concatenated into a single f32 buffer on device —
+        ``device_get`` on a dict pays one ~10ms tunnel round-trip PER
+        array, and the records hold ~15.  All record values (leaf ids,
+        bins, gains, stats, flag bits) are exactly representable in f32.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        keys = [k for k in sorted(rec) if k != "leaf_idx"]
+        if self._rec_layout is None or \
+                [k for k, _, _ in self._rec_layout] != keys:
+            self._rec_layout = [
+                (k, tuple(rec[k].shape), np.dtype(rec[k].dtype))
+                for k in keys]
+            self._rec_pack = jax.jit(lambda r: jnp.concatenate(
+                [r[k].astype(jnp.float32).reshape(-1) for k in keys]))
+        flat = np.asarray(self._rec_pack({k: rec[k] for k in keys}))
+        out, off = {}, 0
+        for k, shp, dt in self._rec_layout:
+            size = int(np.prod(shp)) if shp else 1
+            out[k] = flat[off:off + size].reshape(shp).astype(dt)
+            off += size
+        return out
 
     # ------------------------------------------------------------------
     def _records_to_tree(self, rec) -> Tree:
@@ -604,6 +657,14 @@ class GBDT:
             node = tree.num_leaves - 2
             pg, ph = ls[0] + rs[0], ls[1] + rs[1]
             tree.internal_value[node] = out(pg, ph)
+        if "leaf_stats_exact" in rec:
+            # quantized training: renew leaf outputs from the
+            # full-precision per-leaf sums (RenewIntGradTreeOutput) so
+            # leaf values carry no stochastic-rounding noise
+            ex = np.asarray(rec["leaf_stats_exact"], np.float64)
+            for leaf in range(tree.num_leaves):
+                if leaf < len(ex) and ex[leaf, 2] > 0:
+                    tree.leaf_value[leaf] = out(ex[leaf, 0], ex[leaf, 1])
         return tree
 
     # ------------------------------------------------------------------
@@ -746,7 +807,6 @@ class GBDT:
         structure, recompute each leaf's output from the new data's
         gradient statistics at that leaf, and blend
         ``decay_rate*old + (1-decay_rate)*new``."""
-        from ..ops.split import EPS
         if self.objective is None:
             Log.fatal("refit requires a built-in objective")
         X = np.ascontiguousarray(np.asarray(X, np.float64))
@@ -762,11 +822,34 @@ class GBDT:
         # stateful over Metadata)
         objective = create_objective(self.config.objective, self.config)
         objective.init(meta, n)
-        k = max(self.num_tree_per_iteration, 1)
         # per-tree leaf assignment of the new data (rows, n_trees)
         leaf_pred = np.stack([t.predict_leaf_index(X)
                               for t in self.models], axis=1)
+        self._refit_core(leaf_pred, objective, n, decay_rate)
+
+    def refit_leaf_preds(self, leaf_pred: np.ndarray,
+                         decay_rate: float = 0.9) -> None:
+        """C-API refit (``LGBM_BoosterRefit``, ``c_api.h:446``): leaf
+        assignments are supplied by the caller and the gradients come
+        from the TRAINING set's objective (``GBDT::RefitTree``)."""
+        if self.objective is None:
+            Log.fatal("refit requires a built-in objective")
+        if self.train_set is None:
+            Log.fatal("refit by leaf predictions needs the training set")
+        n = self.num_data
+        leaf_pred = np.asarray(leaf_pred, np.int32).reshape(n, -1)
+        if leaf_pred.shape[1] != len(self.models):
+            Log.fatal("leaf_preds has %d columns but the model has %d "
+                      "trees", leaf_pred.shape[1], len(self.models))
+        objective = create_objective(self.config.objective, self.config)
+        objective.init(self.train_set.metadata, n)
+        self._refit_core(leaf_pred, objective, n, decay_rate)
+
+    def _refit_core(self, leaf_pred: np.ndarray, objective, n: int,
+                    decay_rate: float) -> None:
+        from ..ops.split import EPS
         import jax.numpy as jnp
+        k = max(self.num_tree_per_iteration, 1)
         score = jnp.zeros((k, n), jnp.float32)
         cfg = self.config
         n_iters = len(self.models) // k
@@ -792,6 +875,37 @@ class GBDT:
                                         + (1.0 - decay_rate) * new_out)
                 score = score.at[tree_id].add(
                     jnp.asarray(tree.leaf_value[lp], jnp.float32))
+
+    def merge_from(self, other: "GBDT") -> None:
+        """Merge another booster's trees in FRONT of this one's
+        (``GBDT::MergeFrom``, ``src/boosting/gbdt.h:54``) — the parallel
+        model-merge workflow's primitive.  Scores become stale relative
+        to the merged ensemble, matching the reference (which also only
+        splices the model list)."""
+        import copy
+        if other.num_tree_per_iteration != self.num_tree_per_iteration:
+            Log.fatal("cannot merge boosters with different "
+                      "num_tree_per_iteration")
+        self.models = [copy.deepcopy(t) for t in other.models] + self.models
+        self.iter = len(self.models) // max(self.num_tree_per_iteration, 1)
+
+    def shuffle_models(self, start_iter: int = 0,
+                       end_iter: int = -1) -> None:
+        """Permute whole iterations in [start_iter, end_iter)
+        (``GBDT::ShuffleModels``, ``src/boosting/gbdt.h:73``; fixed seed
+        17 like the reference's ``Random tmp_rand(17)``)."""
+        k = max(self.num_tree_per_iteration, 1)
+        total_iter = len(self.models) // k
+        start_iter = max(0, start_iter)
+        end_iter = total_iter if end_iter <= 0 else min(total_iter,
+                                                        end_iter)
+        idx = np.arange(total_iter)
+        rng = np.random.RandomState(17)
+        span = idx[start_iter:end_iter]
+        rng.shuffle(span)
+        idx[start_iter:end_iter] = span
+        self.models = [self.models[i * k + j] for i in idx
+                       for j in range(k)]
 
     def rollback_one_iter(self) -> None:
         """Undo the last iteration (``GBDT::RollbackOneIter``) using the

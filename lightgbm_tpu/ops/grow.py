@@ -97,6 +97,15 @@ class GrowParams:
     # from 6 value columns to 3 and the speculative pass packs 42
     # leaves per matmul).  Serial learner only.
     quantize: int = 0
+    # wave growth: apply the top-W splittable leaves per loop step in
+    # ONE batched histogram pass instead of one leaf per step.  The
+    # split criterion per leaf is unchanged (greedy max-gain); only the
+    # ORDER differs from strict best-first (bulk-synchronous waves, the
+    # same deviation class as spec_tolerance).  Cuts the sequential
+    # loop from num_leaves-1 iterations to ~log2(W)+num_leaves/W and
+    # the histogram passes to one per wave.  Requires speculate>1
+    # (the batched kernel); serial learner only.
+    wave: bool = False
     # >0: relative gain tolerance for preferring an already-ARMED leaf
     # over a fresh unarmed one when their best gains are within
     # tol*|best|.  Late boosting iterations have near-flat gains and
@@ -288,8 +297,9 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
 
     # speculative child arming (serial only): one batched pass fills
     # the MXU lanes with up to `speculate` smaller-child histograms
-    W_spec = p.speculate if (kind == "serial" and p.use_hist_pool and
-                             not p.forced and p.speculate > 1) else 0
+    W_spec = min(p.speculate, L) if (kind == "serial" and p.use_hist_pool
+                                     and not p.forced and p.speculate > 1
+                                     ) else 0
     do_spec = W_spec > 1
     if do_spec:
         base_vals = jnp.stack([grad * sample_mask, hess * sample_mask,
@@ -351,6 +361,25 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
                             min_output=mn, max_output=mx)
         b["feature"] = elected[b["feature"]]
         return b
+
+    def child_bounds(ls, rs, mn_p, mx_p, feat, cat_flag):
+        """Monotone child output-bound propagation
+        (``serial_tree_learner.cpp:767-777``): a numerical split on a
+        monotone feature pins the children on either side of
+        ``mid = (left_output + right_output) / 2``.  Elementwise — the
+        same code serves the scalar serial split and the (W,)-batched
+        wave.  Returns (l_min, l_max, r_min, r_max)."""
+        l1_, l2_, mds_ = sp.lambda_l1, sp.lambda_l2, sp.max_delta_step
+        lo = jnp.clip(leaf_output(ls[..., 0], ls[..., 1], l1_, l2_, mds_),
+                      mn_p, mx_p)
+        ro = jnp.clip(leaf_output(rs[..., 0], rs[..., 1], l1_, l2_, mds_),
+                      mn_p, mx_p)
+        mid = 0.5 * (lo + ro)
+        mono_f = mono_g[feat]
+        up = (mono_f > 0) & ~cat_flag
+        dn = (mono_f < 0) & ~cat_flag
+        return (jnp.where(dn, mid, mn_p), jnp.where(up, mid, mx_p),
+                jnp.where(up, mid, mn_p), jnp.where(dn, mid, mx_p))
 
     def goes_left_of(feat, left_mask_row):
         """Row routing for the winning split.  For data/voting/serial the
@@ -438,12 +467,14 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         # parent-minus-smaller-child subtraction trick
         state["hist"] = jnp.zeros((L, F_hist, B, 3),
                                   jnp.float32).at[0].set(root_hist)
-    if do_spec:
+    use_wave = p.wave and do_spec and kind == "serial" and not p.forced
+    if do_spec and not use_wave:
         # smaller-child histograms keyed by PARENT leaf; slot L is the
         # write target for unused arming lanes
         state["armed"] = jnp.zeros(L + 1, bool)
         state["armed_hist"] = jnp.zeros((L + 1, F_hist, B, 3),
                                         jnp.float32)
+    if do_spec:
         state["n_arm_passes"] = jnp.int32(0)
     if has_mono:
         # per-leaf inherited output bounds (LeafSplits min/max
@@ -572,25 +603,9 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
 
             depth = st["leaf_depth"][l] + 1
             if has_mono:
-                # child bound propagation
-                # (serial_tree_learner.cpp:767-777): a numerical split
-                # on a monotone feature pins the children on either
-                # side of mid = (left_output + right_output) / 2
-                mn_p, mx_p = st["leaf_min"][l], st["leaf_max"][l]
-                l1_, l2_, mds_ = sp.lambda_l1, sp.lambda_l2, \
-                    sp.max_delta_step
-                lo = jnp.clip(leaf_output(left_stats[0], left_stats[1],
-                                          l1_, l2_, mds_), mn_p, mx_p)
-                ro = jnp.clip(leaf_output(right_stats[0], right_stats[1],
-                                          l1_, l2_, mds_), mn_p, mx_p)
-                mid = 0.5 * (lo + ro)
-                mono_f = mono_g[feat]
-                up = (mono_f > 0) & ~cand["is_cat"]
-                dn = (mono_f < 0) & ~cand["is_cat"]
-                l_min = jnp.where(dn, mid, mn_p)
-                l_max = jnp.where(up, mid, mx_p)
-                r_min = jnp.where(up, mid, mn_p)
-                r_max = jnp.where(dn, mid, mx_p)
+                l_min, l_max, r_min, r_max = child_bounds(
+                    left_stats, right_stats, st["leaf_min"][l],
+                    st["leaf_max"][l], feat, cand["is_cat"])
             else:
                 l_min = l_max = r_min = r_max = None
 
@@ -661,7 +676,200 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         st2["n_leaves"] = st2["n_leaves"] + valid.astype(jnp.int32)
         return st2
 
-    state = jax.lax.fori_loop(0, L - 1, body, state)
+    # ---- wave growth ------------------------------------------------
+    # One loop step = one batched histogram pass + up to W_spec splits.
+    # Each lane w handles one splittable leaf: its cached best split is
+    # applied, its smaller child's histogram comes from lane w of the
+    # multi-pass, the larger child by subtraction, and both children's
+    # best splits are found by ONE vmapped scan over all 2W children.
+    # Greedy per-leaf split choice is identical to best-first; only the
+    # split ORDER is bulk-synchronous.
+    def wave_cond(st):
+        return (st["n_leaves"] < L) & (jnp.max(st["best_gain"]) > 0)
+
+    def wave_body(st):
+        W = W_spec
+        t0 = st["n_leaves"] - 1           # next free split-record slot
+        remaining = (L - 1) - t0
+        topg, ids = jax.lax.top_k(st["best_gain"], W)
+        w_ar = jnp.arange(W, dtype=jnp.int32)
+        # top_k sorts descending, so valid lanes form a prefix and the
+        # record slots t0..t0+K-1 stay contiguous
+        valid_w = (topg > 0) & (w_ar < remaining)
+        ids_leaf = jnp.where(valid_w, ids, L)       # scatter-dummy: OOB
+        t_j = t0 + w_ar
+        ids_rec = jnp.where(valid_w, t_j, L - 1)    # OOB for (L-1,) recs
+        new_ids = t_j + 1
+        new_leaf = jnp.where(valid_w, new_ids, L)
+
+        feat_w = st["best_feature"][ids]
+        thr_w = st["best_threshold"][ids]
+        dl_w = st["best_default_left"][ids]
+        cat_w = st["best_is_cat"][ids]
+        mask_w = st["best_left_mask"][ids]          # (W, B)
+        lstat_w = st["best_left_stats"][ids]        # (W, 3)
+        pstat_w = st["leaf_stats"][ids]
+        rstat_w = pstat_w - lstat_w
+        small_left_w = lstat_w[:, 2] <= rstat_w[:, 2]
+
+        # ---- gather-free row routing --------------------------------
+        # XLA's (N,)-element gather runs at well under 1 GB/s on TPU
+        # (measured: a single table[leaf_idx] take costs ~60-90 ms at
+        # bench shape), so every per-row lookup below is an unrolled
+        # select-chain against scalars — XLA fuses the whole block into
+        # one streaming pass over leaf_idx and the xt rows.
+        li = st["leaf_idx"]
+        w_row = jnp.full(N, -1, jnp.int32)
+        for w in range(W):                          # leaf -> lane
+            w_row = jnp.where(li == ids_leaf[w], jnp.int32(w), w_row)
+        in_wave = w_row >= 0
+
+        # route every in-wave row through ITS leaf's split
+        if p.bundled:
+            col_of_lane = bm_group[feat_w]
+            fb_w = bm_from[feat_w]                  # (W, B)
+            lane_mask = jnp.take_along_axis(mask_w, fb_w, axis=1)
+        else:
+            col_of_lane = feat_w
+            lane_mask = mask_w
+        nw = (B + 31) // 32
+        bits = jnp.pad(lane_mask.astype(jnp.uint32),
+                       ((0, 0), (0, nw * 32 - B)))
+        words = jnp.sum(bits.reshape(W, nw, 32) <<
+                        jnp.arange(32, dtype=jnp.uint32)[None, None, :],
+                        axis=2)                     # (W, nw)
+        csel = jnp.zeros(N, jnp.int32)              # lane -> column id
+        for w in range(W):
+            csel = jnp.where(w_row == w, col_of_lane[w], csel)
+        col = jnp.zeros(N, jnp.int32)               # per-row split bin
+        for g in range(G_cols):
+            col = jnp.where(csel == g, xt[g].astype(jnp.int32), col)
+        hi = col >> 5
+        wd = jnp.zeros(N, jnp.uint32)               # per-row mask word
+        for w in range(W):
+            for h in range(nw):
+                wd = jnp.where((w_row == w) & (hi == h), words[w, h], wd)
+        goes_left = in_wave & \
+            (((wd >> (col & 31).astype(jnp.uint32)) & 1) > 0)
+
+        small_left_row = jnp.zeros(N, bool)
+        new_id_row = jnp.zeros(N, jnp.int32)
+        for w in range(W):
+            lane = w_row == w
+            small_left_row = jnp.where(lane, small_left_w[w],
+                                       small_left_row)
+            new_id_row = jnp.where(lane, new_ids[w], new_id_row)
+
+        to_small = goes_left == small_left_row
+        sel = jnp.where(in_wave & to_small, w_row, jnp.int32(-1))
+        hist_small = multi_hist(sel)                # (W, F_hist, B, 3)
+
+        leaf_idx = jnp.where(in_wave & ~goes_left, new_id_row, li)
+
+        hist_parent = st["hist"][ids]
+        hist_large = hist_parent - hist_small
+        sl4 = small_left_w[:, None, None, None]
+        hist_l = jnp.where(sl4, hist_small, hist_large)
+        hist_r = jnp.where(sl4, hist_large, hist_small)
+
+        depth_w = st["leaf_depth"][ids] + 1
+        if has_mono:
+            l_min, l_max, r_min, r_max = child_bounds(
+                lstat_w, rstat_w, st["leaf_min"][ids],
+                st["leaf_max"][ids], feat_w, cat_w)
+            ch_mn = jnp.concatenate([l_min, r_min])
+            ch_mx = jnp.concatenate([l_max, r_max])
+
+        # children best splits: ONE vmapped scan over all 2W children
+        ch_hist = jnp.concatenate([hist_l, hist_r], axis=0)
+        ch_stats = jnp.concatenate([lstat_w, rstat_w], axis=0)
+        ch_depth = jnp.concatenate([depth_w, depth_w])
+
+        def child_best(h, s, mn, mx):
+            return find_best_split(expand(h, s), s, nb_l, mt_l, cat_l,
+                                   fmask_l, sp, monotone=mono_l,
+                                   penalty=pen_l, min_output=mn,
+                                   max_output=mx)
+
+        if has_mono:
+            bests = jax.vmap(child_best)(ch_hist, ch_stats, ch_mn, ch_mx)
+        else:
+            bests = jax.vmap(lambda h, s: child_best(h, s, None, None))(
+                ch_hist, ch_stats)
+        allowed = (p.max_depth <= 0) | (ch_depth < p.max_depth)
+        bests["gain"] = jnp.where(allowed, bests["gain"], NEG_INF)
+        # materialization fence: without it XLA fuses the vmapped scan's
+        # output selects into the state scatters and (observed on the
+        # CPU backend) the default-left stats/flag pair comes out of
+        # DIFFERENT recomputations — leaf stats then disagree with the
+        # recorded mask.  The barrier pins `bests` to single values.
+        bests = jax.lax.optimization_barrier(bests)
+        import os as _os
+        if _os.environ.get("LTPU_DEBUG_GROW"):
+            st = dict(st)
+            st["dbg_bests_left_stats"] = bests["left_stats"]
+            st["dbg_bests_dl"] = bests["default_left"]
+
+        # invalid lanes scatter to index L (leaf arrays) / L-1 (record
+        # arrays) which are OUT OF BOUNDS — mode="drop" is essential:
+        # the default promise_in_bounds CLAMPS, silently corrupting the
+        # last real slot
+        ch_ids = jnp.concatenate([ids_leaf, new_leaf])
+        st = dict(st)
+        st["leaf_idx"] = leaf_idx
+        st["hist"] = st["hist"].at[ids_leaf].set(hist_l, mode="drop") \
+                               .at[new_leaf].set(hist_r, mode="drop")
+        st["leaf_stats"] = st["leaf_stats"].at[ch_ids].set(
+            ch_stats, mode="drop")
+        st["leaf_depth"] = st["leaf_depth"].at[ch_ids].set(
+            ch_depth, mode="drop")
+        if has_mono:
+            st["leaf_min"] = st["leaf_min"].at[ch_ids].set(
+                ch_mn, mode="drop")
+            st["leaf_max"] = st["leaf_max"].at[ch_ids].set(
+                ch_mx, mode="drop")
+            st["rec_left_min"] = st["rec_left_min"].at[ids_rec].set(
+                l_min, mode="drop")
+            st["rec_left_max"] = st["rec_left_max"].at[ids_rec].set(
+                l_max, mode="drop")
+            st["rec_right_min"] = st["rec_right_min"].at[ids_rec].set(
+                r_min, mode="drop")
+            st["rec_right_max"] = st["rec_right_max"].at[ids_rec].set(
+                r_max, mode="drop")
+        for key, src in (("best_gain", "gain"),
+                         ("best_feature", "feature"),
+                         ("best_threshold", "threshold"),
+                         ("best_default_left", "default_left"),
+                         ("best_is_cat", "is_cat"),
+                         ("best_left_mask", "left_mask"),
+                         ("best_left_stats", "left_stats")):
+            arr = st[key]
+            st[key] = arr.at[ch_ids].set(bests[src].astype(arr.dtype),
+                                         mode="drop")
+        for key, val in (("rec_leaf", ids), ("rec_feature", feat_w),
+                         ("rec_threshold", thr_w),
+                         ("rec_default_left", dl_w),
+                         ("rec_is_cat", cat_w), ("rec_gain", topg),
+                         ("rec_left_stats", lstat_w),
+                         ("rec_right_stats", rstat_w),
+                         ("rec_left_mask", mask_w),
+                         ("rec_valid", valid_w)):
+            st[key] = st[key].at[ids_rec].set(
+                val.astype(st[key].dtype), mode="drop")
+        st["n_leaves"] = st["n_leaves"] + \
+            jnp.sum(valid_w.astype(jnp.int32))
+        st["n_arm_passes"] = st["n_arm_passes"] + 1
+        return st
+
+    if use_wave:
+        import os as _os
+        if _os.environ.get("LTPU_DEBUG_GROW"):
+            state["dbg_bests_left_stats"] = jnp.zeros((2 * W_spec, 3),
+                                                      jnp.float32)
+            state["dbg_bests_dl"] = jnp.zeros(2 * W_spec, bool)
+        state = jax.lax.while_loop(wave_cond, wave_body, state)
+    else:
+        state = jax.lax.fori_loop(0, L - 1, body, state)
 
     leaf_values = leaf_output(state["leaf_stats"][:, 0],
                               state["leaf_stats"][:, 1],
@@ -677,6 +885,30 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
                   "rec_right_min", "rec_right_max")}
     if do_spec:
         extra["n_arm_passes"] = state["n_arm_passes"]
+    import os as _os
+    if _os.environ.get("LTPU_DEBUG_GROW"):
+        # debug-only: expose the per-leaf best-split cache
+        for k in ("best_gain", "best_feature", "best_threshold",
+                  "best_default_left", "best_left_mask",
+                  "best_left_stats"):
+            extra["dbg_" + k] = state[k]
+        if "hist" in state:
+            extra["dbg_hist"] = state["hist"]
+        for k in state:
+            if k.startswith("dbg_"):
+                extra[k] = state[k]
+    if p.quantize:
+        # leaf-output renewal from FULL-PRECISION gradient sums — the
+        # quantized-training leaf refit (RenewIntGradTreeOutput,
+        # src/treelearner/gradient_discretizer.cpp): leaf sums of the
+        # pre-quantization grad/hess via one single-"feature" histogram
+        # pass keyed by the final leaf assignment
+        from .histogram import histogram
+        ex = histogram(state["leaf_idx"][None, :],
+                       jnp.stack([g_w, h_w, sample_mask], axis=-1),
+                       max_bin=L, impl=p.hist_impl,
+                       rows_per_block=p.rows_per_block)
+        extra["leaf_stats_exact"] = ex[0, :L]
     return {
         **extra,
         "leaf": state["rec_leaf"],

@@ -73,10 +73,14 @@ def _tile(b_pad: int, f_pad: int, cols: int, rows_per_block: int
           ) -> Tuple[int, int]:
     """(features-per-chunk, rows-per-tile) under the VMEM budget:
     one-hot (FC, B, T) bf16 + accumulator (FC*B, cols) f32.  Measured
-    on v5e: larger row tiles win (fewer accumulator revisits), then
-    larger feature chunks."""
-    budget = 20 * 1024 * 1024
-    for fc, t in ((32, 2048), (16, 2048), (32, 1024), (16, 1024),
+    on v5e: larger row tiles win (fewer accumulator revisits and fewer
+    grid steps — per-step overhead is material at 5000+ steps), then
+    larger feature chunks.  The budget leaves half of the ~128 MB VMEM
+    for pipelining headroom."""
+    budget = 56 * 1024 * 1024
+    for fc, t in ((32, 16384), (32, 8192), (16, 16384), (32, 4096),
+                  (16, 8192), (8, 16384), (32, 2048), (16, 4096),
+                  (8, 8192), (16, 2048), (32, 1024), (16, 1024),
                   (8, 2048), (32, 512), (16, 512), (8, 1024), (8, 512),
                   (8, 256)):
         if f_pad % fc or t % rows_per_block and rows_per_block % t:
@@ -125,7 +129,7 @@ def _hist_kernel(x_ref, v_ref, out_ref, *, b_pad: int, cols: int,
         out_ref[...] = jnp.zeros_like(out_ref)
 
     FC, T = x_ref.shape
-    x = x_ref[...]  # (FC, T)
+    x = x_ref[...].astype(jnp.int32)  # (FC, T); widen narrow storage
     v = v_ref[...]  # (3, T) f32
     rhs = (v if exact else _split_hi_lo(v)).astype(jnp.bfloat16)
     onehot = (x[:, None, :] ==
@@ -155,7 +159,9 @@ def histogram_pallas(bins_t: jax.Array, vals: jax.Array, max_bin: int,
     f_pad = (f + 7) // 8 * 8
     fc, t = _tile(b_pad, f_pad, cols, rows_per_block)
     assert n % t == 0, (n, t)
-    xt = bins_t.astype(jnp.int32)  # (F, N)
+    # keep the device matrix in its NARROW storage dtype (uint8 at
+    # <=256 bins: 4x less HBM than int32); the kernel widens per tile
+    xt = bins_t
     if f_pad != f:
         xt = jnp.pad(xt, ((0, f_pad - f), (0, 0)))
     vt = vals.astype(jnp.float32).T  # (3, N)
@@ -219,7 +225,7 @@ def _hist_kernel_multi(x_ref, v_ref, s_ref, out_ref, *, b_pad: int,
         out_ref[...] = jnp.zeros_like(out_ref)
 
     FC, T = x_ref.shape
-    x = x_ref[...]
+    x = x_ref[...].astype(jnp.int32)
     v = v_ref[...]                      # (3, T)
     sel = s_ref[...]                    # (1, T)
     cols = 3 if exact else 6
@@ -259,7 +265,7 @@ def histogram_pallas_multi(bins_t: jax.Array, vals: jax.Array,
     f_pad = (f + 7) // 8 * 8
     fc, t = _tile(b_pad, f_pad, 128, rows_per_block)
     assert n % t == 0, (n, t)
-    xt = bins_t.astype(jnp.int32)
+    xt = bins_t                              # narrow storage dtype
     if f_pad != f:
         xt = jnp.pad(xt, ((0, f_pad - f), (0, 0)))
     vt = vals.astype(jnp.float32).T          # (3, N)

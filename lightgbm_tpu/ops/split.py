@@ -48,6 +48,12 @@ class SplitParams:
     min_data_per_group: int = 100
     monotone: Tuple[int, ...] = ()   # -1/0/+1 per feature (config.h:357)
     penalty: Tuple[float, ...] = ()  # feature_contri gain multipliers
+    # static dataset facts that let the scan drop whole branches at
+    # trace time: no categorical feature -> no per-leaf bin sorts, no
+    # missing values anywhere -> single-direction threshold scan.
+    # Defaults are the conservative "might have them".
+    any_cat: bool = True
+    any_missing: bool = True
 
     @property
     def has_monotone(self) -> bool:
@@ -139,18 +145,27 @@ def find_best_split(hist: jax.Array, parent: jax.Array,
     gain_shift = parent_gain + p.min_gain_to_split
 
     jidx = jnp.arange(B, dtype=jnp.int32)
-    has_missing = missing_type != 0
-    nv = num_bins - has_missing.astype(jnp.int32)  # value bins per feature
+    if p.any_missing:
+        has_missing = missing_type != 0
+        nv = num_bins - has_missing.astype(jnp.int32)  # value bins
+    else:
+        has_missing = jnp.zeros_like(missing_type, dtype=bool)
+        nv = num_bins
     in_value = jidx[None, :] < nv[:, None]
     hv = hist * in_value[..., None]
     # missing-bin stats (last bin when feature has a missing bin)
-    miss = jnp.take_along_axis(
-        hist, (num_bins - 1)[:, None, None].astype(jnp.int32), axis=1
-    )[:, 0, :] * has_missing[:, None]  # (F, 3)
+    if p.any_missing:
+        miss = jnp.take_along_axis(
+            hist, (num_bins - 1)[:, None, None].astype(jnp.int32), axis=1
+        )[:, 0, :] * has_missing[:, None]  # (F, 3)
+    else:
+        miss = jnp.zeros((F, 3), hist.dtype)
 
     # ---------------- numerical: prefix thresholds, two directions ----
     cum = jnp.cumsum(hv, axis=1)  # (F, B, 3): left side for thr=j
-    cand_ok = (jidx[None, :] <= nv[:, None] - 2) & ~is_cat[:, None]
+    cand_ok = jidx[None, :] <= nv[:, None] - 2
+    if p.any_cat:
+        cand_ok = cand_ok & ~is_cat[:, None]
 
     mono_col = None if monotone is None else monotone[:, None]
 
@@ -165,18 +180,55 @@ def find_best_split(hist: jax.Array, parent: jax.Array,
         return jnp.where(ok, g, NEG_INF), L
 
     g_r, L_r = scan_dir(False)
-    g_l, L_l = scan_dir(True)
-    # when the feature has no missing data both scans coincide; prefer
-    # default-right (use_na_as_missing=false) like the reference
-    no_miss = miss[:, 2] <= 0
-    g_l = jnp.where(no_miss[:, None], NEG_INF, g_l)
-    num_gain = jnp.maximum(g_r, g_l)  # (F, B)
-    num_dir_left = g_l > g_r
+    if p.any_missing:
+        g_l, L_l = scan_dir(True)
+        # when the feature has no missing data both scans coincide;
+        # prefer default-right (use_na_as_missing=false) like the
+        # reference
+        no_miss = miss[:, 2] <= 0
+        g_l = jnp.where(no_miss[:, None], NEG_INF, g_l)
+        num_gain = jnp.maximum(g_r, g_l)  # (F, B)
+        num_dir_left = g_l > g_r
+    else:
+        L_l = L_r
+        num_gain = g_r
+        num_dir_left = jnp.zeros_like(g_r, dtype=bool)
 
     # ---------------- categorical one-vs-other -----------------------
     # bin 0 is the other/unseen catch-all (no real category id) — it can
     # never be in the left set, so train-time routing matches the
     # category-bitset model semantics where unseen goes right
+    if not p.any_cat:
+        # no categorical features: the numerical scan is the answer
+        all_gain = num_gain
+        if penalty is not None:
+            all_gain = jnp.where(all_gain > 0.5 * NEG_INF,
+                                 all_gain * penalty[:, None], all_gain)
+        all_gain = jnp.where(feature_mask[:, None], all_gain, NEG_INF)
+        best_per_f = jnp.max(all_gain, axis=1)
+        best_j = jnp.argmax(all_gain, axis=1).astype(jnp.int32)
+        f_star = jnp.argmax(best_per_f).astype(jnp.int32)
+        j_star = best_j[f_star]
+        dir_left = num_dir_left[f_star, j_star]
+        left_stats = jnp.where(dir_left, L_l[f_star, j_star],
+                               L_r[f_star, j_star])
+        nb_f = num_bins[f_star]
+        nv_f = nv[f_star]
+        left_mask = (jidx <= j_star) & (jidx < nv_f)
+        if p.any_missing:
+            left_mask = left_mask | \
+                (dir_left & has_missing[f_star] & (jidx == nb_f - 1))
+        return {
+            "gain": best_per_f[f_star],
+            "feature": f_star,
+            "threshold": j_star,
+            "default_left": dir_left,
+            "is_cat": jnp.asarray(False),
+            "left_mask": left_mask,
+            "left_stats": left_stats,
+            "per_feature_gain": best_per_f,
+        }
+
     not_other = jidx[None, :] > 0
     onehot_ok = is_cat[:, None] & (nv <= p.max_cat_to_onehot)[:, None] & \
         in_value & not_other

@@ -23,12 +23,29 @@ AXIS_NAME = "shard"
 
 def resolve_num_shards(config, mesh=None) -> int:
     """How many ways to shard: an explicit mesh wins; otherwise all
-    local devices, capped by ``num_machines`` when the user set it."""
+    GLOBAL devices, capped by ``num_machines`` when the user set it.
+
+    When the config carries a reference-style multi-machine topology
+    (``machines=`` + ``num_machines>1``, ``config.h:729-744``) and the
+    distributed runtime is not up yet, it is initialized here — after
+    which ``jax.devices()`` spans every machine.  Initialization
+    failures raise; a silent single-node fallback would train at the
+    wrong scale."""
     import jax
     if mesh is not None:
         return int(np.prod(mesh.devices.shape))
+    machines = getattr(config, "machines", "")
+    if not machines and getattr(config, "machine_list_filename", ""):
+        with open(config.machine_list_filename) as f:
+            machines = f.read()  # newline-separated host:port lines
+    if config.num_machines > 1 and machines:
+        from .distributed import init_from_machines, is_initialized
+        if not is_initialized() and jax.process_count() == 1:
+            init_from_machines(machines, config.local_listen_port,
+                               config.time_out, config.num_machines)
     n = len(jax.devices())
-    if config.num_machines > 1:
+    if config.num_machines > 1 and jax.process_count() == 1:
+        # single-process mesh emulation: num_machines caps the shards
         n = min(n, config.num_machines)
     return n
 

@@ -100,7 +100,7 @@ def test_ctypes_roundtrip(capi, rng):
 
     # save / reload / predict equality
     path = "/tmp/test_capi_model.txt"
-    _chk(capi, capi.LGBM_BoosterSaveModel(bst, 0, path.encode()))
+    _chk(capi, capi.LGBM_BoosterSaveModel(bst, 0, 0, path.encode()))
     bst2 = ctypes.c_void_p()
     iters = ctypes.c_int()
     _chk(capi, capi.LGBM_BoosterCreateFromModelfile(
@@ -181,6 +181,270 @@ def test_csr_create_and_predict(capi, rng):
             ctypes.POINTER(ctypes.c_double))))
     np.testing.assert_allclose(pred_csr, pred_mat, rtol=1e-9, atol=1e-12)
     capi.LGBM_BoosterFree(bst)
+    capi.LGBM_DatasetFree(ds)
+
+
+def _make_booster(capi, X, y, params=b"objective=binary num_leaves=7 "
+                                    b"verbose=-1 min_data_in_leaf=5",
+                  iters=5):
+    n, f = X.shape
+    ds = ctypes.c_void_p()
+    _chk(capi, capi.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 0, n, f, 1,
+        b"max_bin=63 verbose=-1", None, ctypes.byref(ds)))
+    _chk(capi, capi.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), n, 0))
+    bst = ctypes.c_void_p()
+    _chk(capi, capi.LGBM_BoosterCreate(ds, params, ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(iters):
+        _chk(capi, capi.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+    return ds, bst
+
+
+def test_csc_create(capi, rng):
+    """LGBM_DatasetCreateFromCSC trains equivalently to the dense mat."""
+    import scipy.sparse as sp
+    X = rng.randn(300, 6).astype(np.float64)
+    X[np.abs(X) < 0.4] = 0.0
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+    m = sp.csc_matrix(X)
+    colptr = m.indptr.astype(np.int32)
+    indices = m.indices.astype(np.int32)
+    data = m.data.astype(np.float64)
+    ds = ctypes.c_void_p()
+    _chk(capi, capi.LGBM_DatasetCreateFromCSC(
+        colptr.ctypes.data_as(ctypes.c_void_p), 2,
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        data.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(colptr)), ctypes.c_int64(len(data)),
+        ctypes.c_int64(300), b"max_bin=63 verbose=-1", None,
+        ctypes.byref(ds)))
+    nd, nf = ctypes.c_int(), ctypes.c_int()
+    _chk(capi, capi.LGBM_DatasetGetNumData(ds, ctypes.byref(nd)))
+    _chk(capi, capi.LGBM_DatasetGetNumFeature(ds, ctypes.byref(nf)))
+    assert (nd.value, nf.value) == (300, 6)
+    _chk(capi, capi.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 300, 0))
+    bst = ctypes.c_void_p()
+    _chk(capi, capi.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbose=-1 min_data_in_leaf=5",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(5):
+        _chk(capi, capi.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+    # CSC predict == dense predict
+    pred_csc = np.zeros(300, np.float64)
+    plen = ctypes.c_int64()
+    _chk(capi, capi.LGBM_BoosterPredictForCSC(
+        bst, colptr.ctypes.data_as(ctypes.c_void_p), 2,
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        data.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(colptr)), ctypes.c_int64(len(data)),
+        ctypes.c_int64(300), 0, 0, b"", ctypes.byref(plen),
+        pred_csc.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    Xd = np.ascontiguousarray(X)
+    pred_mat = np.zeros(300, np.float64)
+    _chk(capi, capi.LGBM_BoosterPredictForMat(
+        bst, Xd.ctypes.data_as(ctypes.c_void_p), 1, 300, 6, 1, 0, 0, b"",
+        ctypes.byref(plen), pred_mat.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_double))))
+    np.testing.assert_allclose(pred_csc, pred_mat, rtol=1e-9, atol=1e-12)
+    capi.LGBM_BoosterFree(bst)
+    capi.LGBM_DatasetFree(ds)
+
+
+def test_push_rows_streaming(capi, rng):
+    """CreateByReference + PushRows chunked construction matches a
+    one-shot dataset built from the same rows."""
+    X = rng.randn(400, 5).astype(np.float64)
+    y = (X[:, 0] > 0).astype(np.float32)
+    ref = ctypes.c_void_p()
+    _chk(capi, capi.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, 400, 5, 1,
+        b"max_bin=63 verbose=-1", None, ctypes.byref(ref)))
+    _chk(capi, capi.LGBM_DatasetSetField(
+        ref, b"label", y.ctypes.data_as(ctypes.c_void_p), 400, 0))
+
+    ds = ctypes.c_void_p()
+    _chk(capi, capi.LGBM_DatasetCreateByReference(
+        ref, ctypes.c_int64(400), ctypes.byref(ds)))
+    for lo in range(0, 400, 150):
+        hi = min(lo + 150, 400)
+        block = np.ascontiguousarray(X[lo:hi])
+        _chk(capi, capi.LGBM_DatasetPushRows(
+            ds, block.ctypes.data_as(ctypes.c_void_p), 1, hi - lo, 5, lo))
+    _chk(capi, capi.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 400, 0))
+    n = ctypes.c_int()
+    _chk(capi, capi.LGBM_DatasetGetNumData(ds, ctypes.byref(n)))
+    assert n.value == 400
+    # trains to the same model as the one-shot reference dataset
+    out = []
+    for handle in (ref, ds):
+        bst = ctypes.c_void_p()
+        _chk(capi, capi.LGBM_BoosterCreate(
+            handle, b"objective=binary num_leaves=7 verbose=-1 "
+                    b"min_data_in_leaf=5", ctypes.byref(bst)))
+        fin = ctypes.c_int()
+        for _ in range(5):
+            _chk(capi, capi.LGBM_BoosterUpdateOneIter(bst,
+                                                      ctypes.byref(fin)))
+        ln = ctypes.c_int64()
+        buf = ctypes.create_string_buffer(1 << 20)
+        _chk(capi, capi.LGBM_BoosterSaveModelToString(
+            bst, 0, 0, ctypes.c_int64(len(buf)), ctypes.byref(ln), buf))
+        out.append(buf.value)
+        capi.LGBM_BoosterFree(bst)
+    assert out[0] == out[1]
+    capi.LGBM_DatasetFree(ds)
+    capi.LGBM_DatasetFree(ref)
+
+
+def test_booster_merge_and_leaf_values(capi, rng):
+    X = rng.randn(300, 5).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    ds1, bst1 = _make_booster(capi, X, y, iters=3)
+    ds2, bst2 = _make_booster(capi, X, y, iters=2)
+    total = ctypes.c_int()
+    _chk(capi, capi.LGBM_BoosterNumberOfTotalModel(bst1,
+                                                   ctypes.byref(total)))
+    assert total.value == 3
+    _chk(capi, capi.LGBM_BoosterMerge(bst1, bst2))
+    _chk(capi, capi.LGBM_BoosterNumberOfTotalModel(bst1,
+                                                   ctypes.byref(total)))
+    assert total.value == 5
+    k = ctypes.c_int()
+    _chk(capi, capi.LGBM_BoosterNumModelPerIteration(bst1, ctypes.byref(k)))
+    assert k.value == 1
+    # leaf get/set round-trip
+    v = ctypes.c_double()
+    _chk(capi, capi.LGBM_BoosterGetLeafValue(bst1, 0, 1, ctypes.byref(v)))
+    _chk(capi, capi.LGBM_BoosterSetLeafValue(bst1, 0, 1,
+                                             ctypes.c_double(0.625)))
+    _chk(capi, capi.LGBM_BoosterGetLeafValue(bst1, 0, 1, ctypes.byref(v)))
+    assert v.value == 0.625
+    assert capi.LGBM_BoosterGetLeafValue(bst1, 0, 10_000,
+                                         ctypes.byref(v)) != 0
+    for h in (bst1, bst2):
+        capi.LGBM_BoosterFree(h)
+    for h in (ds1, ds2):
+        capi.LGBM_DatasetFree(h)
+
+
+def test_predict_for_file_and_dump(capi, rng, tmp_path):
+    X = rng.randn(200, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    ds, bst = _make_booster(capi, X, y, iters=4)
+    data_path = str(tmp_path / "pred_in.tsv")
+    np.savetxt(data_path, np.column_stack([np.zeros(200), X]),
+               delimiter="\t", fmt="%.6f")
+    out_path = str(tmp_path / "pred_out.txt")
+    _chk(capi, capi.LGBM_BoosterPredictForFile(
+        bst, data_path.encode(), 0, 0, 0, b"", out_path.encode()))
+    got = np.loadtxt(out_path)
+    pred = np.zeros(200, np.float64)
+    plen = ctypes.c_int64()
+    _chk(capi, capi.LGBM_BoosterPredictForMat(
+        bst, X.ctypes.data_as(ctypes.c_void_p), 0, 200, 4, 1, 0, 0, b"",
+        ctypes.byref(plen), pred.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_double))))
+    np.testing.assert_allclose(got, pred, rtol=1e-4, atol=1e-6)
+
+    # CalcNumPredict agrees with actual predict sizes
+    n_out = ctypes.c_int64()
+    _chk(capi, capi.LGBM_BoosterCalcNumPredict(bst, 200, 0, 0,
+                                               ctypes.byref(n_out)))
+    assert n_out.value == 200
+    _chk(capi, capi.LGBM_BoosterCalcNumPredict(bst, 200, 2, 0,
+                                               ctypes.byref(n_out)))
+    assert n_out.value == 200 * 4
+
+    # JSON dump parses and matches tree count
+    import json
+    ln = ctypes.c_int64()
+    buf = ctypes.create_string_buffer(1 << 22)
+    _chk(capi, capi.LGBM_BoosterDumpModel(
+        bst, 0, 0, ctypes.c_int64(len(buf)), ctypes.byref(ln), buf))
+    model = json.loads(buf.value.decode())
+    assert len(model["tree_info"]) == 4
+
+    # feature importance: f64 per feature
+    imp = np.zeros(4, np.float64)
+    _chk(capi, capi.LGBM_BoosterFeatureImportance(
+        bst, 0, 0, imp.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert imp.sum() > 0
+    capi.LGBM_BoosterFree(bst)
+    capi.LGBM_DatasetFree(ds)
+
+
+def test_refit_reset_subset_and_names(capi, rng):
+    X = rng.randn(300, 5).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    ds, bst = _make_booster(capi, X, y, iters=3)
+
+    # feature names round-trip
+    names = (ctypes.c_char_p * 5)(b"a", b"b", b"c", b"d", b"e")
+    _chk(capi, capi.LGBM_DatasetSetFeatureNames(ds, names, 5))
+    bufs = [ctypes.create_string_buffer(64) for _ in range(5)]
+    arr = (ctypes.c_char_p * 5)(*[ctypes.addressof(b) for b in bufs])
+    n = ctypes.c_int()
+    _chk(capi, capi.LGBM_DatasetGetFeatureNames(ds, arr, ctypes.byref(n)))
+    assert n.value == 5 and bufs[0].value == b"a"
+    _chk(capi, capi.LGBM_DatasetUpdateParam(ds, b"verbose=-1"))
+
+    # subset keeps features, slices rows
+    idx = np.arange(0, 300, 2, dtype=np.int32)
+    sub = ctypes.c_void_p()
+    _chk(capi, capi.LGBM_DatasetGetSubset(
+        ds, idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(idx),
+        b"", ctypes.byref(sub)))
+    nd = ctypes.c_int()
+    _chk(capi, capi.LGBM_DatasetGetNumData(sub, ctypes.byref(nd)))
+    assert nd.value == 150
+
+    # refit with self leaf assignments keeps predictions finite
+    import lightgbm_tpu as lgb_mod
+    leaf = np.zeros((300, 3), np.int32)
+    ln = ctypes.c_int64()
+    buf = ctypes.create_string_buffer(1 << 20)
+    _chk(capi, capi.LGBM_BoosterSaveModelToString(
+        bst, 0, 0, ctypes.c_int64(len(buf)), ctypes.byref(ln), buf))
+    pyb = lgb_mod.Booster(model_str=buf.value.decode())
+    leaf = pyb.predict(X, pred_leaf=True).astype(np.int32)
+    _chk(capi, capi.LGBM_BoosterRefit(
+        bst, np.ascontiguousarray(leaf).ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int32)), 300, leaf.shape[1]))
+
+    # reset parameter: learning_rate change survives, model kept
+    _chk(capi, capi.LGBM_BoosterResetParameter(
+        bst, b"learning_rate=0.2 verbose=-1"))
+    total = ctypes.c_int()
+    _chk(capi, capi.LGBM_BoosterNumberOfTotalModel(bst,
+                                                   ctypes.byref(total)))
+    assert total.value == 3
+    fin = ctypes.c_int()
+    _chk(capi, capi.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+    _chk(capi, capi.LGBM_BoosterNumberOfTotalModel(bst,
+                                                   ctypes.byref(total)))
+    assert total.value == 4
+
+    # eval names/counts stay in lockstep (buffer-sizing contract)
+    cnt = ctypes.c_int()
+    _chk(capi, capi.LGBM_BoosterGetEvalCounts(bst, ctypes.byref(cnt)))
+    nbufs = [ctypes.create_string_buffer(64) for _ in range(max(cnt.value,
+                                                                1))]
+    narr = (ctypes.c_char_p * len(nbufs))(
+        *[ctypes.addressof(b) for b in nbufs])
+    ncount = ctypes.c_int()
+    _chk(capi, capi.LGBM_BoosterGetEvalNames(bst, ctypes.byref(ncount),
+                                             narr))
+    assert ncount.value == cnt.value
+
+    # NetworkInitWithFunctions is an explicit error, not a silent no-op
+    assert capi.LGBM_NetworkInitWithFunctions(2, 0, None, None) != 0
+    capi.LGBM_BoosterFree(bst)
+    capi.LGBM_DatasetFree(sub)
     capi.LGBM_DatasetFree(ds)
 
 

@@ -1,0 +1,87 @@
+"""Booster reset / merge / subset semantics (LGBM_BoosterReset*,
+LGBM_BoosterMerge, LGBM_DatasetGetSubset analogs on the Python
+surface)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _toy(rng, n=600):
+    X = rng.randn(n, 5).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    return X, y
+
+
+def test_reset_parameter_keeps_model_and_valids(rng):
+    X, y = _toy(rng)
+    p = {"objective": "binary", "metric": "auc", "num_leaves": 7,
+         "verbose": -1, "min_data_in_leaf": 5}
+    d = lgb.Dataset(X[:500], label=y[:500], params=p)
+    bst = lgb.Booster(params=p, train_set=d)
+    bst.add_valid(d.create_valid(X[500:], label=y[500:]), "v0")
+    for _ in range(3):
+        bst.update()
+    assert len(bst.eval_valid()) >= 1
+    bst.reset_parameter({"learning_rate": 0.2})
+    # model kept, valid sets still registered and evaluable
+    assert bst.num_trees() == 3
+    rows = bst.eval_valid()
+    assert rows and rows[0][0] == "v0"
+    bst.update()
+    assert bst.num_trees() == 4
+
+
+def test_reset_training_data(rng):
+    X, y = _toy(rng)
+    p = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+         "min_data_in_leaf": 5}
+    d1 = lgb.Dataset(X[:300], label=y[:300], params=p)
+    d2 = lgb.Dataset(X[300:], label=y[300:], params=p)
+    bst = lgb.Booster(params=p, train_set=d1)
+    for _ in range(2):
+        bst.update()
+    bst.reset_training_data(d2)
+    assert bst.num_trees() == 2
+    bst.update()
+    assert bst.num_trees() == 3
+    pred = bst.predict(X)
+    assert np.isfinite(pred).all()
+
+
+def test_merge_and_shuffle(rng):
+    X, y = _toy(rng)
+    p = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+         "min_data_in_leaf": 5}
+
+    def train(k):
+        d = lgb.Dataset(X, label=y, params=p)
+        b = lgb.Booster(params=p, train_set=d)
+        for _ in range(k):
+            b.update()
+        return b
+
+    b1, b2 = train(3), train(2)
+    p1 = b1.predict(X, raw_score=True)
+    p2 = b2.predict(X, raw_score=True)
+    b1.merge(b2)
+    assert b1.num_trees() == 5
+    # merged ensemble = sum of both (other's trees spliced in front)
+    pm = b1.predict(X, raw_score=True)
+    np.testing.assert_allclose(pm, p1 + p2, rtol=1e-6, atol=1e-9)
+    before = b1.predict(X, raw_score=True)
+    b1.shuffle_models()
+    # permuting iteration order never changes the additive ensemble
+    np.testing.assert_allclose(b1.predict(X, raw_score=True), before,
+                               rtol=1e-6, atol=1e-9)
+
+
+def test_subset_shares_parent_bins(rng):
+    X, y = _toy(rng)
+    p = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+         "min_data_in_leaf": 5}
+    d = lgb.Dataset(X, label=y, params=p)
+    d.construct()
+    sub = d.subset(np.arange(0, 600, 2))
+    sub.construct()
+    # identical mappers: subset rows bin exactly as in the parent
+    assert d._constructed.check_align(sub._constructed)

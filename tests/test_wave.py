@@ -1,0 +1,111 @@
+"""Wave growth (wave_splits): bulk-synchronous top-W splitting.
+
+The wave path must produce self-consistent trees (recorded leaf stats
+== stats of the rows actually routed there) and match serial quality.
+The self-consistency check is the regression net for two subtle bugs
+found during bring-up: JAX scatters CLAMP out-of-bounds dummy indices
+by default (mode="drop" required), and the vmapped child split-search
+needs an optimization barrier so its outputs aren't refused into
+disagreeing recomputations.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.ops.grow import GrowParams, build_tree
+from lightgbm_tpu.ops.split import SplitParams
+
+
+def _data(with_missing=True):
+    rng = np.random.RandomState(1)
+    N, F = 8192, 6
+    bins = rng.randint(0, 13, size=(F, N)).astype(np.int32)
+    nbins = np.full(F, 14, np.int32)
+    mt = np.zeros(F, np.int32)
+    if with_missing:
+        bins[rng.random_sample((F, N)) < 0.1] = 13
+        mt[:] = 2
+    grad = rng.randn(N).astype(np.float32)
+    hess = np.ones(N, np.float32)
+    return bins, nbins, mt, grad, hess
+
+
+@pytest.mark.parametrize("L,W", [(3, 2), (16, 8), (31, 21)])
+@pytest.mark.parametrize("with_missing", [False, True])
+def test_wave_self_consistent(L, W, with_missing):
+    bins, nbins, mt, grad, hess = _data(with_missing)
+    N, F = bins.shape[1], bins.shape[0]
+    p = GrowParams(split=SplitParams(max_bin=16, min_data_in_leaf=5,
+                                     min_sum_hessian_in_leaf=1e-3),
+                   num_leaves=L, hist_impl="segsum", wave=True, speculate=W)
+    rec = build_tree(jnp.asarray(bins), jnp.asarray(grad),
+                     jnp.asarray(hess), jnp.ones(N, jnp.float32),
+                     jnp.ones(F, bool), jnp.asarray(nbins),
+                     jnp.asarray(mt), jnp.zeros(F, bool), p)
+    li = np.asarray(rec["leaf_idx"])
+    ls = np.asarray(rec["leaf_stats"])
+    nl = int(rec["n_leaves"])
+    assert nl == L
+    for leaf in range(nl):
+        rows = li == leaf
+        assert abs(rows.sum() - ls[leaf, 2]) < 0.5, leaf
+        assert abs(grad[rows].sum() - ls[leaf, 0]) < 1e-2, leaf
+    # record slots are contiguous valid then invalid
+    valid = np.asarray(rec["valid"])
+    k = valid.sum()
+    assert valid[:k].all() and not valid[k:].any()
+
+
+def test_wave_matches_serial_auc():
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.metrics import AUCMetric
+
+    rng = np.random.RandomState(0)
+    n = 12000
+    X = rng.randn(n, 8).astype(np.float32)
+    X[rng.random_sample((n, 8)) < 0.05] = np.nan
+    logit = np.nan_to_num(X[:, 0]) * 1.2 - 0.8 * np.nan_to_num(X[:, 1])
+    y = (rng.random_sample(n) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+    Xh, yh = X[9000:], y[9000:]
+    Xt, yt = X[:9000], y[:9000]
+    aucs = {}
+    for wave in (False, True):
+        p = {"objective": "binary", "num_leaves": 31, "verbose": -1,
+             "metric": "None", "wave_splits": wave, "min_data_in_leaf": 20}
+        d = lgb.Dataset(Xt, label=yt, params=p)
+        d.construct()
+        b = lgb.Booster(params=p, train_set=d)
+        for _ in range(12):
+            b.update()
+        aucs[wave] = AUCMetric(Config()).eval(np.asarray(yh, np.float64),
+                                              b.predict(Xh))
+    assert abs(aucs[True] - aucs[False]) < 0.02, aucs
+
+
+def test_quantized_leaf_renewal():
+    # quantized mode renews leaf outputs from full-precision sums
+    # (RenewIntGradTreeOutput): a 1-tree L2 model's leaf values must
+    # equal the exact per-leaf label mean despite quantized histograms
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(3)
+    n = 6000
+    X = rng.randn(n, 5).astype(np.float32)
+    y = (X[:, 0] + 0.5 * rng.randn(n)).astype(np.float32)
+    p = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+         "metric": "None", "use_quantized_grad": True,
+         "learning_rate": 1.0, "lambda_l2": 0.0,
+         "boost_from_average": False, "min_data_in_leaf": 20}
+    d = lgb.Dataset(X, label=y, params=p)
+    d.construct()
+    b = lgb.Booster(params=p, train_set=d)
+    b.update()
+    tree = b._gbdt.models[0]
+    pred_leaf = tree.predict_leaf_index(np.asarray(X, np.float64))
+    for leaf in np.unique(pred_leaf):
+        m = pred_leaf == leaf
+        expect = float(y[m].mean())   # -G/H with g=-y, h=1
+        got = tree.leaf_value[leaf]
+        assert abs(got - expect) < 5e-3 * max(1.0, abs(expect)), \
+            (leaf, got, expect)

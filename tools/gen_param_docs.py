@@ -1,0 +1,20 @@
+"""Regenerate docs/Parameters.md from the config registry."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lightgbm_tpu.config import param_docs  # noqa: E402
+
+HEADER = (
+    "# Parameters\n\n"
+    "Single-sourced from the registry in `lightgbm_tpu/config.py` (the "
+    "reference generates Parameters.rst from config.h the same way); "
+    "regenerate with `python tools/gen_param_docs.py`.\n\n"
+)
+
+out = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "docs", "Parameters.md")
+with open(out, "w") as f:
+    f.write(HEADER + param_docs())
+print("wrote", out)
