@@ -17,7 +17,6 @@ BoosterR6 <- R6::R6Class(
         private$handle <- .Call(LGBMR_BoosterCreate,
                                 train_set$get_handle(),
                                 lgb.params.str(params))
-        private$eval_names <- character(0L)
         private$valid_names <- character(0L)
       } else if (!is.null(modelfile)) {
         private$handle <- .Call(LGBMR_BoosterCreateFromModelfile,
@@ -81,8 +80,11 @@ BoosterR6 <- R6::R6Class(
       out
     },
 
+    #' Raw inner score of dataset `data_idx` (0 = train, i = i-th
+    #' valid set) — the custom-objective gradient input.
     inner_predict = function(data_idx) {
-      stop("inner_predict is not exposed; use predict()")
+      .Call(LGBMR_BoosterGetPredict, private$handle,
+            as.integer(data_idx))
     },
 
     predict = function(data, num_iteration = -1L, rawscore = FALSE,
@@ -160,7 +162,6 @@ BoosterR6 <- R6::R6Class(
   private = list(
     handle = NULL,
     train_set = NULL,
-    eval_names = NULL,
     valid_names = character(0L)
   )
 )

@@ -37,8 +37,13 @@ lgb.cv <- function(params = list(), data, nrounds = 100L, nfold = 5L,
       }
       fp <- assign_folds(pos)
       fn <- assign_folds(neg)
+      pick <- function(lst, k) {
+        # a class with fewer members than nfold yields fewer chunks;
+        # missing chunks contribute no rows rather than erroring
+        if (k <= length(lst)) lst[[k]] else integer(0L)
+      }
       folds <- lapply(seq_len(nfold),
-                      function(k) sort(c(fp[[k]], fn[[k]])))
+                      function(k) sort(c(pick(fp, k), pick(fn, k))))
     } else {
       perm <- sample(n)
       folds <- split(perm, rep_len(seq_len(nfold), n))

@@ -427,6 +427,20 @@ SEXP LGBMR_BoosterGetNumFeature(SEXP handle) {
   return Rf_ScalarInteger(out);
 }
 
+/* Raw inner score of a registered dataset (0 = train): the custom-
+ * objective gradient input. */
+SEXP LGBMR_BoosterGetPredict(SEXP handle, SEXP data_idx) {
+  int64_t n = 0;
+  check(LGBM_BoosterGetNumPredict(unwrap(handle),
+                                  Rf_asInteger(data_idx), &n));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, n));
+  int64_t got = 0;
+  check(LGBM_BoosterGetPredict(unwrap(handle), Rf_asInteger(data_idx),
+                               &got, REAL(out)));
+  UNPROTECT(1);
+  return out;
+}
+
 /* ---- registration ----------------------------------------------- */
 
 static const R_CallMethodDef kCallMethods[] = {
@@ -482,9 +496,10 @@ static const R_CallMethodDef kCallMethods[] = {
      (DL_FUNC)&LGBMR_BoosterFeatureImportance, 3},
     {"LGBMR_BoosterGetNumFeature", (DL_FUNC)&LGBMR_BoosterGetNumFeature,
      1},
+    {"LGBMR_BoosterGetPredict", (DL_FUNC)&LGBMR_BoosterGetPredict, 2},
     {nullptr, nullptr, 0}};
 
-void R_init_lightgbm_R(DllInfo* dll) {
+void R_init_lightgbmtpu(DllInfo* dll) {
   R_registerRoutines(dll, nullptr, kCallMethods, nullptr, nullptr);
   R_useDynamicSymbols(dll, FALSE);
 }

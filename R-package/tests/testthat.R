@@ -1,4 +1,4 @@
 library(testthat)
-library(lightgbm.tpu)
+library(lightgbmtpu)
 
-test_check("lightgbm.tpu")
+test_check("lightgbmtpu")

@@ -6,22 +6,31 @@ classification, 500 iterations, num_leaves=255, max_bin=255,
 learning_rate=0.1, min_sum_hessian_in_leaf=100.  The reference's
 baseline on 2x E5-2670v3 is 238.5 s (``BASELINE.md``).
 
+Variants (each trained for the SAME number of measured iterations, so
+the reported holdout AUCs are iteration-matched):
+
+- ``wave255``  — PRIMARY: wave growth + quantized histograms at the
+  reference's 255-bin config (this framework's best settings at the
+  reference's bin resolution, the way the reference's own numbers use
+  its best settings).
+- ``exact255`` — strict best-first serial growth, same split semantics
+  as the reference CPU learner (the AUC anchor).
+- ``wave63``   — the reference's GPU-comparison config
+  (``docs/GPU-Performance.rst:109-139`` benches 63 bins at documented
+  near-identical AUC).
+- ``wave15``   — optional (BENCH_15=1), the GPU doc's speed-leaning
+  15-bin point.
+
 The dataset is synthetic (deterministic seed) since the real Higgs data
 is not available in this image; shapes, cardinalities and the training
 configuration match the published experiment, so the wall-clock is
-comparable even though the AUC is not.
+comparable even though the absolute AUC is not.
 
-Emits the result as a JSON line right after the primary measurement
-and RE-EMITS it enriched after each optional secondary — the last
-line printed is always the most complete parsable result, and a
-timeout mid-secondary still leaves the primary on stdout:
+Emits the result as a JSON line after the primary measurement and
+RE-EMITS it enriched after each variant — the last line printed is
+always the most complete parsable result:
   {"metric": "higgs_shape_train_time_500iter", "value": <s>, "unit": "s",
-   "vs_baseline": <value / 238.5>, ...extras}
-
-When the full 500 iterations exceed the time budget
-(``BENCH_TIME_BUDGET_S``, default 240 s), the steady-state
-per-iteration time (post-compile) is measured and projected to 500
-iterations; ``measured_iters`` says how many real iterations ran.
+   "vs_baseline": <value / 238.5>, ..., "phases": {...}}
 """
 import json
 import os
@@ -32,6 +41,7 @@ BASELINE_S = 238.5   # Higgs 500 iters, reference CPU (Experiments.rst:104)
 N_ROWS = 10_500_000
 N_FEATURES = 28
 N_ITERS = 500
+WARMUP = 2           # first two updates carry the XLA compiles
 
 
 def make_higgs_shaped(n_rows, n_features, seed=0):
@@ -54,11 +64,59 @@ def make_higgs_shaped(n_rows, n_features, seed=0):
     return X, y
 
 
+def run_variant(lgb, params, train, n_meas, auc_fn, profiling=None):
+    """Train WARMUP + n_meas iterations; return timing + AUC stats."""
+    booster = lgb.Booster(params=params, train_set=train)
+    t0 = time.time()
+    for _ in range(WARMUP):
+        booster.update()
+    warmup_s = time.time() - t0
+    if profiling is not None:
+        profiling.reset()
+    times = []
+    arm = []
+    g = booster._gbdt
+    for _ in range(n_meas):
+        t1 = time.time()
+        booster.update()
+        times.append(time.time() - t1)
+        if hasattr(g, "last_arm_passes"):
+            arm.append(g.last_arm_passes)
+    ts = sorted(times)
+    median = ts[len(ts) // 2]
+    out = {
+        "iters_per_s": round(1.0 / median, 4),
+        "projected_500iter_s": round(warmup_s + median *
+                                     (N_ITERS - WARMUP), 2),
+        "best_iter_s": round(ts[0], 3),
+        "best_projected_s": round(warmup_s + ts[0] * (N_ITERS - WARMUP),
+                                  2),
+        "measured_iters": n_meas + WARMUP,
+        "warmup_compile_s": round(warmup_s, 2),
+        "auc_holdout": auc_fn(booster),
+    }
+    if arm:
+        out["hist_passes_per_tree"] = round(
+            sorted(arm)[len(arm) // 2] + 1, 1)  # + root pass
+    if profiling is not None:
+        tot, _ = profiling.get("tree/build")
+        phases = {}
+        for name in ("boosting/gradients", "tree/prep", "tree/dispatch",
+                     "tree/fetch", "tree/to_tree", "tree/renew",
+                     "tree/score_update", "tree/valid"):
+            t, c = profiling.get(name)
+            if c:
+                phases[name.split("/")[-1]] = round(t / c * 1e3, 1)
+        if phases:
+            out["phase_ms_per_iter"] = phases
+    return out
+
+
 def main():
     t_start = time.time()
     budget = float(os.environ.get("BENCH_TIME_BUDGET_S", "240"))
     n_rows = int(os.environ.get("BENCH_ROWS", str(N_ROWS)))
-    n_iters = int(os.environ.get("BENCH_ITERS", str(N_ITERS)))
+    n_meas = int(os.environ.get("BENCH_MEAS_ITERS", "20"))
 
     import jax
     backend = jax.default_backend()
@@ -66,9 +124,13 @@ def main():
         # CPU smoke mode: tiny shapes so the harness stays runnable
         # anywhere; the recorded number is only meaningful on TPU
         n_rows = min(n_rows, 200_000)
+        n_meas = min(n_meas, 5)
 
     import numpy as np
     import lightgbm_tpu as lgb
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.metrics import AUCMetric
+    from lightgbm_tpu.utils import profiling
 
     t0 = time.time()
     n_hold = 200_000
@@ -77,7 +139,7 @@ def main():
     y, yh = y[:n_rows], y[n_rows:]
     gen_s = time.time() - t0
 
-    params = {
+    base_params = {
         "objective": "binary",
         "num_leaves": 255,
         "max_bin": 255,
@@ -87,148 +149,103 @@ def main():
         "verbose": -1,
         "metric": "None",
     }
-    t0 = time.time()
-    train = lgb.Dataset(X, label=y, params=params)
-    train.construct()
-    bin_s = time.time() - t0
+    fast = {"wave_splits": True, "use_quantized_grad": True}
 
-    booster = lgb.Booster(params=params, train_set=train)
-    # warmup: the first TWO iterations carry XLA compiles (the second
-    # retraces with non-constant score inputs)
-    t0 = time.time()
-    booster.update()
-    booster.update()
-    warmup_s = time.time() - t0
-
-    iters_done = 2
-    t_steady = time.time()
-    iter_times = []
-    while iters_done < n_iters and (time.time() - t_steady) < budget:
-        t1 = time.time()
-        booster.update()
-        iter_times.append(time.time() - t1)
-        iters_done += 1
-    steady_s = time.time() - t_steady
-    if not iter_times:
-        # budget too small for a single steady iteration: fall back to
-        # the (compile-inclusive, pessimistic) warmup rate rather than
-        # fabricating a near-zero per-iteration time
-        per_iter = warmup_s / 2
-    else:
-        # median resists the shared-device contention spikes seen on
-        # tunneled TPU runs (2x swings between identical runs)
-        per_iter = sorted(iter_times)[len(iter_times) // 2]
-    if iters_done >= n_iters:
-        total_s = warmup_s + steady_s
-        projected = False
-    else:
-        # charge the warmup compiles once, steady rate for the rest
-        total_s = warmup_s + per_iter * (n_iters - 2)
-        projected = True
-
-    out = {
-        "metric": "higgs_shape_train_time_500iter",
-        "value": round(total_s, 2),
-        "unit": "s",
-        "vs_baseline": round(total_s / BASELINE_S, 4),
-        "backend": backend,
-        "rows": n_rows,
-        "iters_per_s": round(1.0 / per_iter, 4),
-        "measured_iters": iters_done,
-        "projected": projected,
-        "warmup_compile_s": round(warmup_s, 2),
-        "binning_s": round(bin_s, 2),
-        "datagen_s": round(gen_s, 2),
-    }
-    if iter_times:
-        # fastest iteration bounds the uncontended per-iteration cost
-        # (same contention-swing rationale as the median above)
-        best = min(iter_times)
-        out["best_iter_s"] = round(best, 3)
-        out["best_projected_s"] = round(
-            warmup_s + best * (n_iters - 2), 2)
-
-    # learning sanity at speed: AUC of the measured-iteration model on
-    # a held-out slice of the same synthetic task (not comparable to
-    # real-Higgs AUC, but catches a fast-but-wrong trainer)
-    from lightgbm_tpu.config import Config
-    from lightgbm_tpu.metrics import AUCMetric
-
-    def _holdout_auc(bst):
+    def auc_fn(bst):
         return round(AUCMetric(Config()).eval(
             np.asarray(yh, np.float64), bst.predict(Xh)), 4)
 
-    try:
-        out["auc_holdout"] = _holdout_auc(booster)
-    except Exception as exc:
-        out["auc_error"] = str(exc)[:200]
+    trains = {}
+
+    def train_for(max_bin):
+        if max_bin not in trains:
+            t1 = time.time()
+            p = dict(base_params, max_bin=max_bin)
+            d = lgb.Dataset(X, label=y, params=p)
+            d.construct()
+            trains[max_bin] = (d, time.time() - t1)
+        return trains[max_bin][0]
+
+    out = {
+        "metric": "higgs_shape_train_time_500iter",
+        "unit": "s",
+        "backend": backend,
+        "rows": n_rows,
+        "projected": True,
+        "datagen_s": round(gen_s, 2),
+    }
+
+    # ---- PRIMARY: wave + quantized at the reference's 255 bins ------
+    train255 = train_for(255)
+    out["binning_s"] = round(trains[255][1], 2)
+    res = run_variant(lgb, dict(base_params, **fast), train255, n_meas,
+                      auc_fn, profiling)
+    out.update({f"wave255_{k}": v for k, v in res.items()
+                if k not in ("phase_ms_per_iter",)})
+    out["phase_ms_per_iter"] = res.get("phase_ms_per_iter", {})
+    out["value"] = res["projected_500iter_s"]
+    out["vs_baseline"] = round(res["projected_500iter_s"] / BASELINE_S, 4)
+    out["iters_per_s"] = res["iters_per_s"]
+    out["measured_iters"] = res["measured_iters"]
+    out["auc_holdout"] = res["auc_holdout"]
     print(json.dumps(out), flush=True)
 
-    # secondary: speculative_tolerance=0.25 — near-tie split-order
-    # relaxation that recovers the histogram-pass floor on late
-    # flat-gain iterations (measured: identical holdout AUC, ~1.7x
-    # throughput at 2M rows); exact best-first stays the primary
-    if backend != "cpu" and os.environ.get("BENCH_SKIP_TOL", "") != "1":
+    # ---- exact best-first at 255 bins: the AUC anchor ---------------
+    if os.environ.get("BENCH_SKIP_EXACT", "") != "1" and \
+            time.time() - t_start < 3 * budget:
         try:
-            ptol = dict(params, speculative_tolerance=0.25)
-            btol = lgb.Booster(params=ptol, train_set=train)
-            btol.update()
-            btol.update()  # compiles
-            t0 = time.time()
-            times_t = []
-            while len(times_t) < 30 and time.time() - t0 < 60:
-                t1 = time.time()
-                btol.update()
-                times_t.append(time.time() - t1)
-            if times_t:
-                pert = sorted(times_t)[len(times_t) // 2]
-                out["tol25_iters_per_s"] = round(1.0 / pert, 4)
-                # same basis as the primary projection: compile charged
-                # once, steady rate for the rest
-                out["tol25_projected_500iter_s"] = round(
-                    warmup_s + pert * (n_iters - 2), 2)
-                out["tol25_measured_iters"] = len(times_t) + 2
-                # NOTE: trained for tol25_measured_iters only — compare
-                # against auc_holdout at similar iteration counts, not
-                # a full-budget primary run
-                out["tol25_auc_holdout"] = _holdout_auc(btol)
-        except Exception as exc:
-            out["tol25_error"] = str(exc)[:200]
+            res = run_variant(lgb, base_params, train255, n_meas, auc_fn)
+            out.update({f"exact255_{k}": v for k, v in res.items()})
+            # iteration-matched quality delta of the wave redesign
+            out["wave_vs_exact_auc_delta"] = round(
+                out["wave255_auc_holdout"] - res["auc_holdout"], 4)
+        except Exception as exc:  # the primary result must survive
+            out["exact255_error"] = str(exc)[:200]
         print(json.dumps(out), flush=True)
 
-    # secondary: the reference's GPU-comparison config (63 bins,
-    # docs/GPU-Performance.rst:109-139) — histogram work is 4x lighter
-    # at documented near-identical AUC
-    # the secondary needs ~2 compiles + rebinning + 90s of iterations;
-    # skip when the primary already blew the overall budget twice over
-    spent = time.time() - t_start
-    if backend != "cpu" and os.environ.get("BENCH_SKIP_63", "") != "1" \
-            and spent < 3 * budget + 300:
+    # ---- the reference's GPU-comparison config: 63 bins -------------
+    if os.environ.get("BENCH_SKIP_63", "") != "1" and \
+            time.time() - t_start < 4 * budget:
         try:
-            params63 = dict(params, max_bin=63)
-            train63 = lgb.Dataset(X, label=y, params=params63)
-            train63.construct()
-            b63 = lgb.Booster(params=params63, train_set=train63)
-            b63.update()
-            b63.update()  # compiles
-            t0 = time.time()
-            times63 = []
-            while len(times63) < 40 and time.time() - t0 < 75:
-                t1 = time.time()
-                b63.update()
-                times63.append(time.time() - t1)
-            per63 = sorted(times63)[len(times63) // 2] if times63 \
-                else float("inf")
-            out["bins63_iters_per_s"] = round(1.0 / per63, 4)
-            out["bins63_projected_500iter_s"] = round(per63 * n_iters, 2)
-        except Exception as exc:  # the primary result must survive
-            out["bins63_error"] = str(exc)[:200]
+            train63 = train_for(63)
+            res = run_variant(lgb, dict(base_params, max_bin=63, **fast),
+                              train63, n_meas, auc_fn)
+            out.update({f"wave63_{k}": v for k, v in res.items()})
+            out["bins63_projected_500iter_s"] = \
+                res["projected_500iter_s"]
+            out["bins63_vs_baseline"] = round(
+                res["projected_500iter_s"] / BASELINE_S, 4)
+        except Exception as exc:
+            out["wave63_error"] = str(exc)[:200]
+        print(json.dumps(out), flush=True)
 
-    # tertiary: Epsilon-shaped wide dense data (400K x 2000,
-    # docs/GPU-Performance.rst:141 runs Epsilon on GPU) — exercises the
-    # histogram kernel's feature-chunked grid at 70x Higgs width
-    # opt-in: the wide pipeline carries ~5 min of datagen + binning +
-    # compile overhead, too heavy for the default driver budget
+    # ---- optional: 15 bins (GPU doc's speed-leaning point) ----------
+    if os.environ.get("BENCH_15", "") == "1":
+        try:
+            train15 = train_for(15)
+            res = run_variant(lgb, dict(base_params, max_bin=15, **fast),
+                              train15, n_meas, auc_fn)
+            out.update({f"wave15_{k}": v for k, v in res.items()})
+        except Exception as exc:
+            out["wave15_error"] = str(exc)[:200]
+
+    # ---- optional: GOSS sampling overhead (device-side masks) -------
+    if os.environ.get("BENCH_GOSS", "") == "1":
+        try:
+            res = run_variant(
+                lgb, dict(base_params, boosting="goss", **fast),
+                train255, n_meas, auc_fn)
+            out.update({f"goss255_{k}": v for k, v in res.items()})
+            out["goss_vs_gbdt_iter_ratio"] = round(
+                out["wave255_iters_per_s"] / max(res["iters_per_s"],
+                                                 1e-9), 3)
+        except Exception as exc:
+            out["goss_error"] = str(exc)[:200]
+
+    # ---- optional: Epsilon-shaped wide data (400K x 2000) -----------
+    # exercises the histogram kernel's feature-chunked grid at 70x
+    # Higgs width plus the chunked sparse ingest path when scipy input
+    # is used (docs/GPU-Performance.rst:141)
     if backend != "cpu" and os.environ.get("BENCH_WIDE", "") == "1":
         try:
             rng = np.random.RandomState(7)
@@ -236,7 +253,7 @@ def main():
             Xw = rng.randn(n_w, f_w).astype(np.float32)
             yw = (Xw[:, :8].sum(axis=1) + 0.5 * rng.randn(n_w) > 0
                   ).astype(np.float32)
-            pw = dict(params, max_bin=63)
+            pw = dict(base_params, max_bin=63, **fast)
             dw = lgb.Dataset(Xw, label=yw, params=pw)
             dw.construct()
             bw = lgb.Booster(params=pw, train_set=dw)

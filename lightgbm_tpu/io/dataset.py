@@ -182,6 +182,66 @@ class TpuDataset:
         return cls(mappers, binned, meta, feature_names)
 
     # ------------------------------------------------------------------
+    @classmethod
+    def from_sparse(cls, X_sp, label, config, weight=None, group=None,
+                    init_score=None, feature_names=None,
+                    categorical_features: Sequence[int] = (),
+                    mappers: Optional[List[BinMapper]] = None
+                    ) -> "TpuDataset":
+        """Bin a scipy CSR/CSC matrix WITHOUT densifying the raw values
+        (the reference keeps sparse features delta-encoded,
+        ``src/io/sparse_bin.hpp:17``, and bins from sampled non-zeros).
+
+        Mappers come from per-column non-zero samples (zeros implied by
+        the gap between nnz and the sample size —
+        ``BinMapper.find_bin``'s sparse contract); the binned matrix is
+        then filled column-by-column from the CSC slices.  Host peak
+        memory ≈ the binned (N, F) uint8 matrix + one raw column, ~2x
+        the binned size — an Epsilon-shaped 400K x 2000 CSR costs
+        ~1.6 GB here instead of the 6.4 GB f64 densify."""
+        from .binning import BIN_NUMERICAL, sample_rows
+        X = X_sp.tocsc()
+        num_data, num_feat = X.shape
+        cat = set(int(c) for c in categorical_features)
+        if mappers is None:
+            sample_cnt = min(config.bin_construct_sample_cnt, num_data)
+            idx = np.sort(sample_rows(num_data, sample_cnt,
+                                      config.data_random_seed))
+            Xs = X_sp.tocsr()[idx].tocsc()
+            mappers = []
+            for j in range(num_feat):
+                vals = np.asarray(
+                    Xs.data[Xs.indptr[j]:Xs.indptr[j + 1]], np.float64)
+                m = BinMapper()
+                m.find_bin(vals, len(idx), config.max_bin,
+                           min_data_in_bin=config.min_data_in_bin,
+                           use_missing=config.use_missing,
+                           zero_as_missing=config.zero_as_missing,
+                           bin_type=BIN_CATEGORICAL if j in cat
+                           else BIN_NUMERICAL)
+                mappers.append(m)
+        used = [i for i, m in enumerate(mappers) if not m.is_trivial]
+        dtype = np.uint8 if all(mappers[i].num_bin <= 256 for i in used) \
+            else np.uint16
+        binned = np.empty((num_data, len(used)), dtype=dtype)
+        for jj, f in enumerate(used):
+            m = mappers[f]
+            zero_bin = int(np.asarray(m.value_to_bin(
+                np.zeros(1))).reshape(-1)[0])
+            binned[:, jj] = zero_bin
+            lo, hi = X.indptr[f], X.indptr[f + 1]
+            if hi > lo:
+                rows = X.indices[lo:hi]
+                vals = np.asarray(X.data[lo:hi], np.float64)
+                binned[rows, jj] = m.value_to_bin(vals).astype(dtype)
+        meta = Metadata(num_data)
+        meta.set_label(label if label is not None else np.zeros(num_data))
+        meta.set_weight(weight)
+        meta.set_query(group)
+        meta.set_init_score(init_score)
+        return cls(mappers, binned, meta, feature_names)
+
+    # ------------------------------------------------------------------
     def device_binned(self):
         """The binned matrix as a device array (cached)."""
         import jax.numpy as jnp

@@ -43,25 +43,41 @@ class GOSS(GBDT):
         Log.info("Using GOSS")
 
     def _bagging_mask(self, grad=None, hess=None):
+        """Device GOSS mask: the top set is everything above the
+        ``top_rate``-quantile of |g*h| (one device sort, no host
+        round-trip), the rest is a Bernoulli sample at ``other_rate``'s
+        expected size — same expected composition and upweighting as
+        the reference's exact argsort + without-replacement choice, in
+        O(sort) device work instead of a full-N host argsort per
+        iteration."""
         if grad is None:
             return None
+        import jax
+        import jax.numpy as jnp
         cfg = self.config
         n = self.num_data
-        gh = np.sum(np.abs(np.asarray(grad) * np.asarray(hess)),
-                    axis=0)[:n]
+        gh = jnp.sum(jnp.abs(grad * hess), axis=0)[:n]
         top_k = max(int(n * cfg.top_rate), 1)
         other_k = int(n * cfg.other_rate)
-        order = np.argsort(-gh, kind="stable")
-        w = np.zeros(n, np.float32)
-        w[order[:top_k]] = 1.0
-        rest = order[top_k:]
-        if other_k > 0 and len(rest):
-            rng = np.random.RandomState(
-                (cfg.bagging_seed + self.iter) & 0x7FFFFFFF)
-            take = min(other_k, len(rest))
-            pick = rng.choice(len(rest), size=take, replace=False)
-            w[rest[pick]] = (n - top_k) / float(other_k)
-        return w
+        thr = -jnp.sort(-gh)[top_k - 1]
+        key = jax.random.fold_in(self._bag_key, self.iter)
+        ku, kt = jax.random.split(key)
+        # tie-safe top set: strictly-greater rows always kept, rows AT
+        # the threshold admitted at the rate that fills top_k in
+        # expectation — a plain gh >= thr would keep EVERY tied row
+        # (e.g. the whole dataset when >top_rate of |g*h| is 0)
+        gt = gh > thr
+        tie = gh == thr
+        n_gt = jnp.sum(gt)
+        n_tie = jnp.maximum(jnp.sum(tie), 1)
+        p_tie = jnp.clip((top_k - n_gt) / n_tie, 0.0, 1.0)
+        topm = gt | (tie & (jax.random.uniform(kt, (n,)) < p_tie))
+        u = jax.random.uniform(ku, (n,))
+        n_rest = max(n - top_k, 1)
+        pick = (~topm) & (u < other_k / n_rest)
+        amp = (n - top_k) / float(max(other_k, 1))
+        return jnp.where(topm, 1.0,
+                         jnp.where(pick, amp, 0.0)).astype(jnp.float32)
 
 
 class MVS(GBDT):
@@ -76,44 +92,42 @@ class MVS(GBDT):
         Log.info("Using MVS")
 
     @staticmethod
-    def _threshold(scores: np.ndarray, target: float) -> float:
+    def _threshold_device(s, target: float):
         """Smallest mu with sum(min(1, s/mu)) <= target (expected
         sample size).  Closed form over the descending order statistic
-        (equivalent to the reference's recursive partition)."""
-        s = np.sort(scores)[::-1].astype(np.float64)
-        n = len(s)
-        if target >= n:
-            return float(s[-1]) if n else 1.0
-        suffix = np.cumsum(s[::-1])[::-1]  # suffix[i] = sum(s[i:])
-        idx = np.arange(n, dtype=np.float64)
-        with np.errstate(divide="ignore", invalid="ignore"):
-            est = idx + suffix / np.maximum(s, 1e-35)
+        (equivalent to the reference's recursive partition), as device
+        ops: one sort + one cumsum."""
+        import jax.numpy as jnp
+        n = s.shape[0]
+        s_desc = -jnp.sort(-s)
+        suffix = jnp.cumsum(s_desc[::-1])[::-1]  # suffix[i] = sum(s[i:])
+        idx = jnp.arange(n, dtype=jnp.float32)
+        est = idx + suffix / jnp.maximum(s_desc, 1e-35)
         # est is nondecreasing; first position whose estimate exceeds
         # the target brackets the threshold
-        over = np.nonzero(est > target)[0]
-        if len(over) == 0:
-            return float(s[-1])
-        i = int(over[0])
-        denom = max(target - i, 1e-10)
-        return float(suffix[i] / denom)
+        over = est > target
+        i = jnp.argmax(over)
+        mu_in = suffix[i] / jnp.maximum(target - i.astype(jnp.float32),
+                                        1e-10)
+        return jnp.where(jnp.any(over), mu_in, s_desc[-1])
 
     def _bagging_mask(self, grad=None, hess=None):
         if grad is None:
             return None
+        import jax
+        import jax.numpy as jnp
         cfg = self.config
         if cfg.bagging_fraction >= 1.0:
             return None
         n = self.num_data
-        gh = np.sum(np.abs(np.asarray(grad) * np.asarray(hess)),
-                    axis=0)[:n]
-        s = np.sqrt(gh * gh + float(cfg.var_weight))
-        mu = self._threshold(s, cfg.bagging_fraction * n)
-        rng = np.random.RandomState(
-            (cfg.bagging_seed + self.iter) & 0x7FFFFFFF)
-        prob = np.minimum(s / max(mu, 1e-35), 1.0)
-        keep = rng.random_sample(n) < prob
-        w = np.where(keep, 1.0 / np.maximum(prob, 1e-35), 0.0)
-        return w.astype(np.float32)
+        gh = jnp.sum(jnp.abs(grad * hess), axis=0)[:n]
+        s = jnp.sqrt(gh * gh + jnp.float32(cfg.var_weight))
+        mu = self._threshold_device(s, cfg.bagging_fraction * n)
+        key = jax.random.fold_in(self._bag_key, self.iter)
+        prob = jnp.minimum(s / jnp.maximum(mu, 1e-35), 1.0)
+        keep = jax.random.uniform(key, (n,)) < prob
+        return jnp.where(keep, 1.0 / jnp.maximum(prob, 1e-35),
+                         0.0).astype(jnp.float32)
 
 
 class DART(GBDT):
@@ -238,6 +252,9 @@ class DART(GBDT):
             self.models.pop()
             if self._train_leaf_idx:
                 self._train_leaf_idx.pop()
+            for vs in self.valid_sets:
+                if vs.leaf_idx_per_tree:
+                    vs.leaf_idx_per_tree.pop()
         self.iter -= 1
         self._dart_undo = None
 
@@ -258,11 +275,22 @@ class DART(GBDT):
                 # train score: net change is -(1-scale) x original
                 self._score = self._score.at[kk].add(
                     self._train_contrib(mi))
-                # valid scores: subtract the same (1-scale) slice
+                # valid scores: subtract the same (1-scale) slice via
+                # the stored per-tree leaf tables (a numpy lookup, not
+                # an O(rows x depth) host tree walk per drop)
                 if self.valid_sets:
                     factor = (1.0 - scale) / scale
                     for vs in self.valid_sets:
-                        vs.score[kk] -= tree.predict(vs.raw) * factor
+                        la = vs.leaf_idx_per_tree[mi] \
+                            if mi < len(vs.leaf_idx_per_tree) else None
+                        if la is None:
+                            contrib = tree.leaf_value[0] \
+                                if tree.num_leaves <= 1 else \
+                                tree.predict(vs.raw)
+                        else:
+                            contrib = tree.leaf_value[
+                                la.astype(np.int32)]
+                        vs.score[kk] -= contrib * factor
             if not cfg.uniform_drop:
                 unit = (k + 1.0) if not cfg.xgboost_dart_mode else (k + lr)
                 self.sum_weight -= self.tree_weight[i] / unit

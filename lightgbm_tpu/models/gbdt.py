@@ -41,6 +41,10 @@ class ValidSet:
     metadata: Metadata
     score: np.ndarray = None  # accumulated raw score
     xt: object = None        # device (F_pad, rows) binned matrix, or None
+    # per-tree leaf assignment (uint8/16), kept only when the boosting
+    # mode tracks train leaves (DART): drop/renormalize replays become
+    # numpy leaf-table lookups instead of per-tree host traversals
+    leaf_idx_per_tree: list = dataclasses.field(default_factory=list)
 
     def __post_init__(self):
         if self.score is None:
@@ -275,6 +279,12 @@ class GBDT:
         self._rng_feature = np.random.RandomState(
             config.feature_fraction_seed & 0x7FFFFFFF)
         self._rec_layout = None  # lazy: packed split-record fetch plan
+        # sampling-mask randomness lives ON DEVICE (bagging/GOSS/MVS
+        # masks are computed in jitted ops; a host mask would ship
+        # 4N bytes through the ~14 MB/s tunnel every iteration)
+        self._bag_key = jax.random.PRNGKey(config.bagging_seed &
+                                           0x7FFFFFFF)
+        self._label_pos = None  # lazy device label>0 (pos/neg bagging)
         self._quant_key = (jax.random.PRNGKey(
             config.data_random_seed & 0x7FFFFFFF)
             if self.grow_params.quantize else None)
@@ -362,8 +372,16 @@ class GBDT:
             vs.score += np.asarray(metadata.init_score).reshape(
                 vs.score.shape[0], -1)
         # replay existing model (continue-train case)
+        dt_leaf = np.uint8 if self.config.num_leaves <= 256 else np.uint16
         for i, tree in enumerate(self.models):
-            vs.score[i % self.num_tree_per_iteration] += tree.predict(raw)
+            if self._track_train_leaf:
+                la = tree.predict_leaf_index(raw).astype(dt_leaf)
+                vs.leaf_idx_per_tree.append(la)
+                vs.score[i % self.num_tree_per_iteration] += \
+                    tree.leaf_value[la.astype(np.int32)]
+            else:
+                vs.score[i % self.num_tree_per_iteration] += \
+                    tree.predict(raw)
         if binned is not None and self.num_features > 0:
             if self._bundles is not None:
                 xtv = self._bundles.bundle_matrix(binned.binned).T
@@ -392,7 +410,11 @@ class GBDT:
         non-0/1 weights rescale grad/hess, counts stay presence-based).
         Base class: bernoulli bagging every ``bagging_freq`` iterations
         (``GBDT::Bagging``, ``gbdt.cpp:182``); GOSS/MVS override using
-        the gradient magnitudes."""
+        the gradient magnitudes.  Returns a DEVICE (N,) f32 vector —
+        mask generation is jitted device work (a host mask means a 4N-
+        byte upload per iteration through the tunnel)."""
+        import jax
+        import jax.numpy as jnp
         cfg = self.config
         pos_neg = (cfg.pos_bagging_fraction < 1.0 or
                    cfg.neg_bagging_fraction < 1.0)
@@ -400,19 +422,21 @@ class GBDT:
                 (cfg.bagging_fraction >= 1.0 and not pos_neg):
             return None
         if self.iter % cfg.bagging_freq == 0:
-            rng = np.random.RandomState(
-                (cfg.bagging_seed + self.iter) & 0x7FFFFFFF)
-            u = rng.random_sample(self.num_data)
+            key = jax.random.fold_in(self._bag_key, self.iter)
+            u = jax.random.uniform(key, (self.num_data,))
             if pos_neg:
                 # class-stratified bagging: positives/negatives sampled
                 # at their own fractions
-                pos = np.asarray(
-                    self.train_set.metadata.label)[:self.num_data] > 0
-                mask = np.where(pos, u < cfg.pos_bagging_fraction,
-                                u < cfg.neg_bagging_fraction
-                                ).astype(np.float32)
+                if self._label_pos is None:
+                    self._label_pos = jnp.asarray(np.asarray(
+                        self.train_set.metadata.label
+                    )[:self.num_data] > 0)
+                mask = jnp.where(self._label_pos,
+                                 u < cfg.pos_bagging_fraction,
+                                 u < cfg.neg_bagging_fraction
+                                 ).astype(jnp.float32)
             else:
-                mask = (u < cfg.bagging_fraction).astype(np.float32)
+                mask = (u < cfg.bagging_fraction).astype(jnp.float32)
             self._cached_bag = mask
         return getattr(self, "_cached_bag", None)
 
@@ -530,6 +554,8 @@ class GBDT:
                     vs.score[tree_idx] += out
             if self._track_train_leaf:
                 self._train_leaf_idx.append(None)
+                for vs in self.valid_sets:
+                    vs.leaf_idx_per_tree.append(None)
             return tree
 
         with timed("tree/to_tree"):
@@ -558,6 +584,7 @@ class GBDT:
         # valid scores: device split-record replay when the binned
         # matrix is resident, host traversal fallback otherwise
         from ..ops.grow import route_rows
+        dt_leaf = np.uint8 if self.config.num_leaves <= 256 else np.uint16
         with timed("tree/valid"):
             for vs in self.valid_sets:
                 if vs.xt is not None:
@@ -565,10 +592,26 @@ class GBDT:
                                     rec["left_mask"], rec["valid"],
                                     self.config.num_leaves,
                                     bundle_maps=self._bundle_maps)
-                    vs.score[tree_idx] += np.asarray(jnp.take(vals, li),
-                                                     np.float64)
+                    if self._track_train_leaf:
+                        # DART drops/renormalizations replay per-tree
+                        # valid contributions from this table instead
+                        # of host tree traversals
+                        la = np.asarray(li.astype(dt_leaf))
+                        vs.leaf_idx_per_tree.append(la)
+                        vs.score[tree_idx] += tree.leaf_value[
+                            la.astype(np.int32)]
+                    else:
+                        vs.score[tree_idx] += np.asarray(
+                            jnp.take(vals, li), np.float64)
                 else:
-                    vs.score[tree_idx] += tree.predict(vs.raw)
+                    if self._track_train_leaf:
+                        la = tree.predict_leaf_index(vs.raw).astype(
+                            dt_leaf)
+                        vs.leaf_idx_per_tree.append(la)
+                        vs.score[tree_idx] += tree.leaf_value[
+                            la.astype(np.int32)]
+                    else:
+                        vs.score[tree_idx] += tree.predict(vs.raw)
         if abs(init_score) > _KEPS:
             tree.add_bias(init_score)
         return tree
@@ -918,4 +961,8 @@ class GBDT:
         self._prev_score = None
         for _ in range(self.num_tree_per_iteration):
             self.models.pop()
+            if self._track_train_leaf:
+                for vs in self.valid_sets:
+                    if vs.leaf_idx_per_tree:
+                        vs.leaf_idx_per_tree.pop()
         self.iter -= 1

@@ -69,31 +69,57 @@ def _pad_bins(max_bin: int) -> int:
     return (max_bin + 15) // 16 * 16
 
 
-def _tile(b_pad: int, f_pad: int, cols: int, rows_per_block: int
-          ) -> Tuple[int, int]:
-    """(features-per-chunk, rows-per-tile) under the VMEM budget:
-    one-hot (FC, B, T) bf16 + accumulator (FC*B, cols) f32.  Measured
-    on v5e: larger row tiles win (fewer accumulator revisits and fewer
-    grid steps — per-step overhead is material at 5000+ steps), then
-    larger feature chunks.  The budget leaves half of the ~128 MB VMEM
-    for pipelining headroom."""
+def _tile(b_pad: int, f: int, cols: int, rows_per_block: int
+          ) -> Tuple[int, int, int]:
+    """(padded features, features-per-chunk, rows-per-tile).
+
+    The pass is MXU-STREAM bound: cost ∝ f_pad * b_pad * N (the one-hot
+    rows fed through the systolic array), so the FIRST objective is the
+    smallest f_pad with a legal chunking (fc divides f_pad, fc*b_pad a
+    multiple of the 128-lane tile) — e.g. 28 features stay 28 at 64
+    bins (28*64 = 14*128) instead of padding to 32 and paying +14%.
+    Then prefer large row tiles (fewer grid steps / accumulator
+    revisits) under a VMEM budget of one-hot (FC, B, T) bf16 +
+    accumulator (FC*B, cols) f32 + double-buffered inputs."""
     budget = 56 * 1024 * 1024
-    for fc, t in ((32, 16384), (32, 8192), (16, 16384), (32, 4096),
-                  (16, 8192), (8, 16384), (32, 2048), (16, 4096),
-                  (8, 8192), (16, 2048), (32, 1024), (16, 1024),
-                  (8, 2048), (32, 512), (16, 512), (8, 1024), (8, 512),
-                  (8, 256)):
-        if f_pad % fc or t % rows_per_block and rows_per_block % t:
-            continue
-        t_eff = min(t, rows_per_block)
-        vmem = b_pad * (fc * t_eff * 2 + fc * cols * 4) \
-            + fc * t_eff * 4 * 2
-        if vmem <= budget:
-            return fc, t_eff
-    # fallback must keep t dividing the caller's row-padding quantum
+    for f_pad in range(max(f, 2), f + 9):
+        best = None
+        for fc in range(f_pad, 0, -1):
+            # legal Mosaic block: fc the full feature dim or a multiple
+            # of the 8-sublane tile; fc*b_pad on the 128-lane grid
+            if f_pad % fc or (fc * b_pad) % 128 or \
+                    (fc != f_pad and fc % 8):
+                continue
+            for t in (16384, 8192, 4096, 2048, 1024, 512, 256):
+                if t % rows_per_block and rows_per_block % t:
+                    continue
+                t_eff = min(t, rows_per_block)
+                vmem = b_pad * (fc * t_eff * 2 + fc * cols * 4) \
+                    + fc * t_eff * 4 * 2
+                if vmem > budget:
+                    continue
+                cand = (fc * t_eff, t_eff, fc)
+                if best is None or cand > best:
+                    best = cand
+                break  # largest feasible t for this fc
+        if best is not None:
+            return f_pad, best[2], best[1]
+    # fallback: classic 8-feature chunks, smallest tile
+    f_pad = (f + 7) // 8 * 8
     if rows_per_block % 256 == 0:
-        return 8, 256
-    return 8, rows_per_block
+        return f_pad, 8, 256
+    return f_pad, 8, rows_per_block
+
+
+def _compiler_params():
+    """Raise Mosaic's scoped-VMEM ceiling (default ~16-32 MB) so the
+    large one-hot row tiles the tiler picks actually compile; v5e has
+    128 MB of VMEM."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+        return pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+    except Exception:  # pragma: no cover - older pallas versions
+        return None
 
 
 def _split_hi_lo(v: jax.Array) -> jax.Array:
@@ -156,8 +182,7 @@ def histogram_pallas(bins_t: jax.Array, vals: jax.Array, max_bin: int,
     f, n = bins_t.shape
     b_pad = _pad_bins(max_bin)
     cols = 3 if exact else 6
-    f_pad = (f + 7) // 8 * 8
-    fc, t = _tile(b_pad, f_pad, cols, rows_per_block)
+    f_pad, fc, t = _tile(b_pad, f, cols, rows_per_block)
     assert n % t == 0, (n, t)
     # keep the device matrix in its NARROW storage dtype (uint8 at
     # <=256 bins: 4x less HBM than int32); the kernel widens per tile
@@ -176,6 +201,7 @@ def histogram_pallas(bins_t: jax.Array, vals: jax.Array, max_bin: int,
         ],
         out_specs=pl.BlockSpec((fc * b_pad, cols), lambda j, i: (j, 0)),
         out_shape=jax.ShapeDtypeStruct((f_pad * b_pad, cols), jnp.float32),
+        compiler_params=_compiler_params(),
     )(xt, vt)
     if not exact:
         out = out[:, :3] + out[:, 3:]  # hi + lo passes
@@ -262,8 +288,7 @@ def histogram_pallas_multi(bins_t: jax.Array, vals: jax.Array,
     cols = 3 if exact else 6
     W = width
     assert W * cols <= 128, (W, cols)
-    f_pad = (f + 7) // 8 * 8
-    fc, t = _tile(b_pad, f_pad, 128, rows_per_block)
+    f_pad, fc, t = _tile(b_pad, f, 128, rows_per_block)
     assert n % t == 0, (n, t)
     xt = bins_t                              # narrow storage dtype
     if f_pad != f:
@@ -283,6 +308,7 @@ def histogram_pallas_multi(bins_t: jax.Array, vals: jax.Array,
         out_specs=pl.BlockSpec((fc * b_pad, 128), lambda j, i: (j, 0)),
         out_shape=jax.ShapeDtypeStruct((f_pad * b_pad, 128),
                                        jnp.float32),
+        compiler_params=_compiler_params(),
     )(xt, vt, st)
     out = out[:, :cols * W].reshape(f_pad, b_pad, W, cols)
     if not exact:

