@@ -118,7 +118,9 @@ class Dataset:
         label, weight, group = self.label, self.weight, self.group
 
         if isinstance(self.data, (str, os.PathLike)):
-            path = str(self.data)
+            from .utils.file_io import is_remote, localize
+            remote = is_remote(str(self.data))
+            path = localize(str(self.data))
             if TpuDataset.is_binary_file(path):
                 self._constructed = TpuDataset.load_binary(path)
                 self.raw_mat = None
@@ -133,10 +135,13 @@ class Dataset:
                 weight = w
             if g is not None and group is None:
                 group = g
-            sw = load_float_file(path + ".weight")
+            # sidecar files ride next to the data; remote datasets skip
+            # the probe (a missing remote sidecar is indistinguishable
+            # from a fetch failure)
+            sw = None if remote else load_float_file(path + ".weight")
             if sw is not None and weight is None:
                 weight = sw
-            sq = load_query_file(path + ".query")
+            sq = None if remote else load_query_file(path + ".query")
             if sq is not None and group is None:
                 group = sq
             # initscore_filename overrides the ``<data>.init`` sidecar
@@ -145,7 +150,8 @@ class Dataset:
             init_path = ""
             if self.reference is None:
                 init_path = getattr(cfg, "initscore_filename", "")
-            si = load_float_file(init_path or path + ".init")
+            si = load_float_file(init_path) if init_path else \
+                (None if remote else load_float_file(path + ".init"))
             if si is not None and self.init_score is None:
                 self.init_score = si
             cat_idx = []
@@ -154,11 +160,19 @@ class Dataset:
         elif hasattr(self.data, "tocsc") and self.used_indices is None:
             # scipy sparse: chunked CSC binning, no f64 densify (the
             # round-2 verdict's Bosch/Epsilon-scale memory hazard)
-            cat_idx = []
-            if self.categorical_feature not in ("auto", None):
-                cat_idx = [int(c) for c in self.categorical_feature]
             names = self.feature_name \
                 if self.feature_name not in ("auto", None) else None
+            cat_idx = []
+            if self.categorical_feature not in ("auto", None):
+                for c in self.categorical_feature:
+                    if isinstance(c, str):
+                        # name resolution mirrors the dense _to_matrix
+                        if names is None or c not in names:
+                            Log.fatal("categorical feature name %s not "
+                                      "found", c)
+                        cat_idx.append(names.index(c))
+                    else:
+                        cat_idx.append(int(c))
             mappers = None
             if self.reference is not None:
                 self.reference.construct()
@@ -219,7 +233,19 @@ class Dataset:
 
     def save_binary(self, filename: str) -> "Dataset":
         self.construct()
-        self._constructed.save_binary(str(filename))
+        from .utils.file_io import is_remote
+        filename = str(filename)
+        if is_remote(filename):
+            import shutil
+            import tempfile
+            from .utils.file_io import open_output
+            with tempfile.NamedTemporaryFile(suffix=".bin") as tmp:
+                self._constructed.save_binary(tmp.name)
+                with open(tmp.name, "rb") as src, \
+                        open_output(filename, "wb") as dst:
+                    shutil.copyfileobj(src, dst)
+        else:
+            self._constructed.save_binary(filename)
         return self
 
     # ---- field access -------------------------------------------------
@@ -362,7 +388,8 @@ class Booster:
             self._valid_names: List[str] = []
         elif model_file is not None or model_str is not None:
             if model_file is not None:
-                with open(model_file) as f:
+                from .utils.file_io import localize
+                with open(localize(str(model_file))) as f:
                     model_str = f.read()
             self._load_from_string(model_str)
         else:
@@ -613,7 +640,8 @@ class Booster:
     def save_model(self, filename: str,
                    num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> "Booster":
-        with open(filename, "w") as f:
+        from .utils.file_io import open_output
+        with open_output(str(filename)) as f:
             f.write(self.model_to_string(num_iteration, start_iteration))
         return self
 

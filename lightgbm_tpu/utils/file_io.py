@@ -1,0 +1,129 @@
+"""Virtual file IO: local paths plus remote schemes.
+
+Reference: ``src/io/file_io.cpp:53-70`` routes paths through
+``VirtualFileReader/Writer`` with an HDFS implementation behind
+``USE_HDFS`` (libhdfs).  Here remote files are MATERIALIZED to local
+temporaries on read and uploaded on write-close — the framework's
+readers (native text parser, numpy, binary dataset cache) all want
+local random access, and a one-shot copy through the ``hadoop`` CLI
+(or ``pyarrow``'s HadoopFileSystem when importable) avoids binding
+libhdfs.  Unsupported schemes fail loudly with the recipe.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import tempfile
+from contextlib import contextmanager
+from typing import Iterator
+
+from .log import Log
+
+__all__ = ["is_remote", "localize", "open_output"]
+
+_REMOTE_SCHEMES = ("hdfs://", "viewfs://")
+
+
+def is_remote(path) -> bool:
+    return isinstance(path, str) and path.startswith(_REMOTE_SCHEMES)
+
+
+def _hadoop_cli():
+    return shutil.which("hadoop") or shutil.which("hdfs")
+
+
+def _pyarrow_hdfs():
+    """pyarrow's generic FileSystem.from_uri — returns (fs, inner
+    path); the Hadoop filesystem resolves from the hdfs:// scheme."""
+    try:
+        from pyarrow import fs as pafs
+        return pafs.FileSystem.from_uri
+    except Exception:
+        return None
+
+
+_local_cache: dict = {}
+
+
+def _cleanup_localized() -> None:  # pragma: no cover - exit hook
+    for p in _local_cache.values():
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+    _local_cache.clear()
+
+
+def localize(path: str) -> str:
+    """A local path with the file's contents; the input itself when it
+    is already local.  Remote fetches are cached per URI and the
+    temporaries are removed at process exit."""
+    if not is_remote(path):
+        return path
+    cached = _local_cache.get(path)
+    if cached is not None and os.path.exists(cached):
+        return cached
+    if not _local_cache:
+        import atexit
+        atexit.register(_cleanup_localized)
+    tmp = tempfile.NamedTemporaryFile(
+        prefix="ltpu_remote_", suffix="_" + os.path.basename(path),
+        delete=False)
+    tmp.close()
+    cli = _hadoop_cli()
+    if cli is not None:
+        res = subprocess.run([cli, "fs" if cli.endswith("hadoop")
+                              else "dfs", "-get", "-f", path, tmp.name],
+                             capture_output=True, text=True)
+        if res.returncode != 0:
+            Log.fatal("failed to fetch %s: %s", path, res.stderr.strip())
+        _local_cache[path] = tmp.name
+        return tmp.name
+    from_uri = _pyarrow_hdfs()
+    if from_uri is not None:
+        fs, inner = from_uri(path)
+        with fs.open_input_stream(inner) as src, \
+                open(tmp.name, "wb") as dst:
+            shutil.copyfileobj(src, dst)
+        _local_cache[path] = tmp.name
+        return tmp.name
+    Log.fatal("remote path %s needs a 'hadoop' CLI on PATH or pyarrow "
+              "with HDFS support; neither is available", path)
+
+
+@contextmanager
+def open_output(path: str, mode: str = "w") -> Iterator:
+    """Open ``path`` for writing; remote targets are written locally
+    and uploaded on close (``VirtualFileWriter`` contract)."""
+    if not is_remote(path):
+        with open(path, mode) as f:
+            yield f
+        return
+    tmp = tempfile.NamedTemporaryFile(prefix="ltpu_out_", delete=False)
+    tmp.close()
+    try:
+        with open(tmp.name, mode) as f:
+            yield f
+        cli = _hadoop_cli()
+        if cli is None:
+            from_uri = _pyarrow_hdfs()
+            if from_uri is None:
+                Log.fatal("remote path %s needs a 'hadoop' CLI on PATH "
+                          "or pyarrow with HDFS support", path)
+            fs, inner = from_uri(path)
+            with open(tmp.name, "rb") as src, \
+                    fs.open_output_stream(inner) as dst:
+                shutil.copyfileobj(src, dst)
+        else:
+            res = subprocess.run(
+                [cli, "fs" if cli.endswith("hadoop") else "dfs", "-put",
+                 "-f", tmp.name, path], capture_output=True, text=True)
+            if res.returncode != 0:
+                Log.fatal("failed to upload %s: %s", path,
+                          res.stderr.strip())
+    finally:
+        try:
+            os.unlink(tmp.name)
+        except OSError:
+            pass
