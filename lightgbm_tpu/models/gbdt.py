@@ -186,6 +186,22 @@ class GBDT:
             Log.info("histogram pool (%.0f MB) exceeds budget; "
                      "recomputing child histograms", pool_bytes / 1e6)
 
+        any_cat = bool(any(m.bin_type == BIN_CATEGORICAL
+                           for m in mappers))
+        any_missing = bool(any(m.missing_type != 0 for m in mappers))
+        wave_on = bool(config.wave_splits and not dist_active and
+                       use_pool and not forced)
+        # two-column quantized passes (W=64): legal only when the count
+        # channel is provably redundant (GrowParams.two_col contract).
+        # Missing values also gate it off: the default-direction test
+        # reads the missing bin's count, and a hess copy can quantize
+        # to zero there even when missing rows exist.
+        two_col = bool(
+            config.use_quantized_grad and wave_on and
+            self._bundles is None and not any_cat and not any_missing and
+            config.min_data_in_leaf <= 1 and
+            config.min_sum_hessian_in_leaf > 0)
+        self._counts_proxy = two_col
         self.grow_params = GrowParams(
             split=SplitParams(
                 max_bin=self.max_bin,
@@ -205,10 +221,9 @@ class GBDT:
                 # static dataset facts: trace-time dead-branch removal
                 # in the split scan (no cat -> no bin sorts, no missing
                 # -> one threshold direction)
-                any_cat=bool(any(m.bin_type == BIN_CATEGORICAL
-                                 for m in mappers)),
-                any_missing=bool(any(m.missing_type != 0
-                                     for m in mappers))),
+                any_cat=any_cat,
+                any_missing=any_missing,
+                counts_proxy=two_col),
             num_leaves=config.num_leaves,
             max_depth=config.max_depth,
             hist_impl="pallas" if use_pallas else "segsum",
@@ -225,14 +240,14 @@ class GBDT:
             spec_tolerance=float(config.speculative_tolerance),
             # wave growth (wave_splits): top-W splits applied per loop
             # step from one batched pass; rides the speculative kernel
-            wave=bool(config.wave_splits and not dist_active and
-                      use_pool and not forced),
+            wave=wave_on,
+            two_col=two_col,
             # speculative child arming fills the MXU lanes (21 leaves x
-            # 6 value columns, or 42 x 3 quantized); enabled on the
-            # accelerator path where the batched pallas kernel exists,
-            # or anywhere when wave growth asks for it
-            speculate=(min(multi_width(config.use_quantized_grad),
-                           config.num_leaves)
+            # 6 value columns, 42 x 3 quantized, 64 x 2 two-column);
+            # enabled on the accelerator path where the batched pallas
+            # kernel exists, or anywhere when wave growth asks for it
+            speculate=(min(multi_width(config.use_quantized_grad,
+                                       two_col), config.num_leaves)
                        if ((use_pallas or config.wave_splits) and
                            not dist_active and use_pool and not forced)
                        else 0))
@@ -708,6 +723,25 @@ class GBDT:
             for leaf in range(tree.num_leaves):
                 if leaf < len(ex) and ex[leaf, 2] > 0:
                     tree.leaf_value[leaf] = out(ex[leaf, 0], ex[leaf, 1])
+            if getattr(self, "_counts_proxy", False):
+                # two-column passes record hess sums in the count slots;
+                # restore REAL counts: leaves from the exact renewal
+                # sums, internal nodes by one REVERSE-id sweep (a
+                # child's node id always exceeds its parent's, so its
+                # count is ready first; no recursion — chain-shaped
+                # trees can exceed Python's recursion limit)
+                for leaf in range(tree.num_leaves):
+                    if leaf < len(ex):
+                        tree.leaf_count[leaf] = int(round(ex[leaf, 2]))
+
+                def child_count(c):
+                    return tree.leaf_count[~c] if c < 0 else \
+                        tree.internal_count[c]
+
+                for node in range(tree.num_leaves - 2, -1, -1):
+                    tree.internal_count[node] = \
+                        child_count(tree.left_child[node]) + \
+                        child_count(tree.right_child[node])
         return tree
 
     # ------------------------------------------------------------------

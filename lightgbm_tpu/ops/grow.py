@@ -106,6 +106,19 @@ class GrowParams:
     # the histogram passes to one per wave.  Requires speculate>1
     # (the batched kernel); serial learner only.
     wave: bool = False
+    # two-column quantized passes: accumulate only (grad, hess) so the
+    # 128 MXU lanes fit W=64 leaves per pass (10 passes per 255-leaf
+    # tree instead of 12).  The histogram count channel becomes a HESS
+    # COPY; legal only when the count channel is provably redundant —
+    # min_data_in_leaf <= 1 and min_sum_hessian_in_leaf > 0 (a side
+    # with hess_sum >= msh > 0 necessarily holds a row), no
+    # categorical features (their scans read counts), no bundling
+    # (FixHistogram reads counts), no missing values (the default-
+    # direction test reads the missing bin's count, and a hess copy
+    # can quantize to zero there).  Real per-leaf counts are restored
+    # on the host from the full-precision renewal stats.  Requires
+    # quantize>0 and the wave path; the driver gates all of this.
+    two_col: bool = False
     # >0: relative gain tolerance for preferring an already-ARMED leaf
     # over a fresh unarmed one when their best gains are within
     # tol*|best|.  Late boosting iterations have near-flat gains and
@@ -211,6 +224,9 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
 
     assert p.quantize == 0 or kind == "serial", \
         "quantized histograms are supported by the serial learner only"
+    assert not p.two_col or (p.quantize > 0 and p.wave and
+                             not p.bundled and p.split.counts_proxy), \
+        "two_col requires quantized wave growth with counts_proxy"
     hist_scale = None
     if p.quantize:
         # stochastic rounding to ±quantize integer levels; sample_mask
@@ -225,7 +241,10 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         sh = jnp.maximum(jnp.max(jnp.abs(h_w)), jnp.float32(1e-30)) / q
         grad = jnp.floor(g_w / sg + jax.random.uniform(kg, grad.shape))
         hess = jnp.floor(h_w / sh + jax.random.uniform(kh, hess.shape))
-        hist_scale = jnp.stack([sg, sh, jnp.float32(1.0)])
+        # two_col: the count channel is a hess copy and must dequantize
+        # with the hess scale to stay in one unit system
+        hist_scale = jnp.stack([sg, sh,
+                                sh if p.two_col else jnp.float32(1.0)])
 
     # static per-feature monotone directions / gain penalties; the
     # tuples are GLOBAL (padded) feature descriptors
@@ -289,6 +308,10 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         h = _hist(xt, vals, p)
         if hist_scale is not None:
             h = h * hist_scale  # dequantize: ints -> gradient units
+        if p.two_col:
+            # hess-as-count everywhere, so pool subtraction stays in
+            # one unit system (see GrowParams.two_col)
+            h = jnp.concatenate([h[..., :2], h[..., 1:2]], axis=-1)
         if kind == "data":
             # HistogramBinEntry::SumReducer over the wire becomes one
             # XLA reduce-scatter over the feature dimension
@@ -309,9 +332,11 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
             if p.hist_impl == "pallas":
                 h = histogram_pallas_multi(xt, base_vals, sel, B, W_spec,
                                            p.rows_per_block,
-                                           exact=p.quantize > 0)
+                                           exact=p.quantize > 0,
+                                           two_col=p.two_col)
             else:
-                h = histogram_segsum_multi(xt, base_vals, sel, B, W_spec)
+                h = histogram_segsum_multi(xt, base_vals, sel, B, W_spec,
+                                           two_col=p.two_col)
             return h if hist_scale is None else h * hist_scale
 
     def global_stats(local):
@@ -409,9 +434,11 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
     # ---- init: root ------------------------------------------------
     leaf_idx = jnp.zeros(N, dtype=jnp.int32)
     root_hist = masked_hist(leaf_idx, 0)
+    root_count = jnp.sum(hess * sample_mask) if p.two_col \
+        else jnp.sum(sample_mask)
     root_stats = global_stats(jnp.stack([jnp.sum(grad * sample_mask),
                                          jnp.sum(hess * sample_mask),
-                                         jnp.sum(sample_mask)]))
+                                         root_count]))
     if hist_scale is not None:
         # keep root stats in the same (dequantized) units as the
         # histograms so subtraction and FixHistogram stay consistent

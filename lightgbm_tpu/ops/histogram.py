@@ -45,9 +45,13 @@ __all__ = ["histogram", "histogram_segsum", "histogram_pallas",
            "multi_width"]
 
 
-def multi_width(exact: bool) -> int:
+def multi_width(exact: bool, two_col: bool = False) -> int:
     """Leaves per speculative pass: 6 columns each (hi/lo) fills the
-    128-lane MXU tile at 21; exact 3-column values fit 42."""
+    128-lane MXU tile at 21; exact 3-column values fit 42; dropping
+    the count column (provably redundant when min_data_in_leaf<=1 and
+    min_sum_hessian>0 — see GrowParams.two_col) fits 64."""
+    if two_col:
+        return 64
     return 42 if exact else 21
 
 
@@ -231,7 +235,7 @@ def histogram(bins_t: jax.Array, vals: jax.Array, max_bin: int,
 
 
 def _hist_kernel_multi(x_ref, v_ref, s_ref, out_ref, *, b_pad: int,
-                       width: int, exact: bool):
+                       width: int, exact: bool, two_col: bool = False):
     """Multi-leaf variant: one pass accumulates histograms for up to
     ``width`` row-disjoint subsets (the speculative child-arming pass).
 
@@ -240,9 +244,9 @@ def _hist_kernel_multi(x_ref, v_ref, s_ref, out_ref, *, b_pad: int,
     beyond cols*width are zero padding.
 
     The rhs grows from cols to cols*width columns, filling the MXU lane
-    dimension (126/128 at width 21×6 or 42×3) that the single-leaf pass
-    leaves ~95% idle — a batched pass costs barely more than a
-    single-leaf one.
+    dimension (126/128 at width 21×6 or 42×3, 128/128 at 64×2) that the
+    single-leaf pass leaves ~95% idle — a batched pass costs barely
+    more than a single-leaf one.
     """
     import jax.experimental.pallas as pl
 
@@ -254,8 +258,12 @@ def _hist_kernel_multi(x_ref, v_ref, s_ref, out_ref, *, b_pad: int,
     x = x_ref[...].astype(jnp.int32)
     v = v_ref[...]                      # (3, T)
     sel = s_ref[...]                    # (1, T)
-    cols = 3 if exact else 6
-    valsc = v if exact else _split_hi_lo(v)            # (cols, T) f32
+    if two_col:
+        cols = 2
+        valsc = v[:2]                   # grad, hess only
+    else:
+        cols = 3 if exact else 6
+        valsc = v if exact else _split_hi_lo(v)        # (cols, T) f32
     sel_oh = (sel == jax.lax.broadcasted_iota(
         jnp.int32, (width, T), 0)).astype(jnp.float32)  # (W, T)
     rhs = (sel_oh[:, None, :] * valsc[None, :, :]).reshape(
@@ -271,21 +279,26 @@ def _hist_kernel_multi(x_ref, v_ref, s_ref, out_ref, *, b_pad: int,
 
 
 @functools.partial(jax.jit, static_argnames=("max_bin", "width",
-                                             "rows_per_block", "exact"))
+                                             "rows_per_block", "exact",
+                                             "two_col"))
 def histogram_pallas_multi(bins_t: jax.Array, vals: jax.Array,
                            sel: jax.Array, max_bin: int, width: int,
                            rows_per_block: int = 1024,
-                           exact: bool = False) -> jax.Array:
+                           exact: bool = False,
+                           two_col: bool = False) -> jax.Array:
     """Batched histogram over ``width`` disjoint row subsets.
 
     bins_t (F, N) ints; vals (N, 3) f32; sel (N,) int32 subset id per
-    row (-1 = no subset).  Returns (width, F, B, 3).
+    row (-1 = no subset).  Returns (width, F, B, 3).  With ``two_col``
+    only grad/hess are accumulated (64 leaves per pass) and the count
+    channel is a COPY of the hess channel — callers must run under the
+    gate that makes counts redundant (see GrowParams.two_col).
     """
     import jax.experimental.pallas as pl
 
     f, n = bins_t.shape
     b_pad = _pad_bins(max_bin)
-    cols = 3 if exact else 6
+    cols = 2 if two_col else (3 if exact else 6)
     W = width
     assert W * cols <= 128, (W, cols)
     f_pad, fc, t = _tile(b_pad, f, 128, rows_per_block)
@@ -298,7 +311,7 @@ def histogram_pallas_multi(bins_t: jax.Array, vals: jax.Array,
 
     out = pl.pallas_call(
         functools.partial(_hist_kernel_multi, b_pad=b_pad, width=W,
-                          exact=exact),
+                          exact=exact, two_col=two_col),
         grid=(f_pad // fc, n // t),
         in_specs=[
             pl.BlockSpec((fc, t), lambda j, i: (j, i)),
@@ -311,18 +324,25 @@ def histogram_pallas_multi(bins_t: jax.Array, vals: jax.Array,
         compiler_params=_compiler_params(),
     )(xt, vt, st)
     out = out[:, :cols * W].reshape(f_pad, b_pad, W, cols)
-    if not exact:
+    if two_col:
+        # count := hess copy keeps every downstream shape at (..., 3);
+        # the gate guarantees nothing reads it as a real count
+        out = jnp.concatenate([out, out[..., 1:2]], axis=-1)
+    elif not exact:
         out = out[..., :3] + out[..., 3:]    # hi + lo
     return jnp.moveaxis(out[:f, :max_bin], 2, 0)   # (W, F, B, 3)
 
 
 def histogram_segsum_multi(bins_t: jax.Array, vals: jax.Array,
-                           sel: jax.Array, max_bin: int, width: int
-                           ) -> jax.Array:
+                           sel: jax.Array, max_bin: int, width: int,
+                           two_col: bool = False) -> jax.Array:
     """jnp reference for :func:`histogram_pallas_multi` (CPU/tests)."""
     f, n = bins_t.shape
     outs = []
     for w in range(width):
         m = (sel == w).astype(vals.dtype)[:, None]
         outs.append(histogram_segsum(bins_t, vals * m, max_bin))
-    return jnp.stack(outs)
+    out = jnp.stack(outs)
+    if two_col:
+        out = jnp.concatenate([out[..., :2], out[..., 1:2]], axis=-1)
+    return out

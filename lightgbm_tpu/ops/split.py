@@ -54,6 +54,12 @@ class SplitParams:
     # Defaults are the conservative "might have them".
     any_cat: bool = True
     any_missing: bool = True
+    # the histogram count channel is a HESS COPY, not a real count
+    # (two-column quantized passes).  Only legal when
+    # min_data_in_leaf <= 1 and min_sum_hessian_in_leaf > 0: a side
+    # with hess_sum >= msh > 0 necessarily holds >= 1 row, so the
+    # count constraint is implied and never read.
+    counts_proxy: bool = False
 
     @property
     def has_monotone(self) -> bool:
@@ -111,6 +117,12 @@ def _split_gain(gl, hl, gr, hr, l1, l2, mds, mn=None, mx=None, mono=None):
 
 def _constraints(L, R, p: SplitParams, min_data_override=None):
     """min_data / min_sum_hessian feasibility of a candidate."""
+    if p.counts_proxy:
+        # counts channel is a hess copy (see SplitParams.counts_proxy);
+        # the gate guarantees the count constraint is implied by the
+        # hessian one
+        msh = max(p.min_sum_hessian_in_leaf, EPS)
+        return (L[..., 1] >= msh) & (R[..., 1] >= msh)
     min_data = p.min_data_in_leaf if min_data_override is None \
         else min_data_override
     return ((L[..., 2] >= max(min_data, 1)) &

@@ -83,6 +83,42 @@ def test_wave_matches_serial_auc():
     assert abs(aucs[True] - aucs[False]) < 0.02, aucs
 
 
+def test_two_col_counts_and_auc():
+    # two-column quantized passes (W=64, count channel = hess copy):
+    # the gate (min_data_in_leaf<=1, msh>0, no cats) activates it, the
+    # model's leaf/internal counts are restored exactly from the
+    # renewal sums, and quality matches the 3-column path
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.metrics import AUCMetric
+
+    rng = np.random.RandomState(5)
+    n = 12000
+    X = rng.randn(n, 6).astype(np.float32)
+    y = (X[:, 0] - 0.7 * X[:, 1] + 0.3 * rng.randn(n) > 0).astype(
+        np.float32)
+    Xh, yh = X[9000:], y[9000:]
+    Xt, yt = X[:9000], y[:9000]
+    aucs = {}
+    for min_data in (20, 0):  # 20 blocks the two_col gate, 0 opens it
+        p = {"objective": "binary", "num_leaves": 31, "verbose": -1,
+             "metric": "None", "wave_splits": True,
+             "use_quantized_grad": True, "min_data_in_leaf": min_data}
+        d = lgb.Dataset(Xt, label=yt, params=p)
+        d.construct()
+        b = lgb.Booster(params=p, train_set=d)
+        assert b._gbdt._counts_proxy == (min_data == 0)
+        for _ in range(10):
+            b.update()
+        for t in b._gbdt.models:
+            assert int(t.leaf_count[:t.num_leaves].sum()) == 9000
+            if t.num_leaves > 1:
+                assert int(t.internal_count[0]) == 9000
+        aucs[min_data] = AUCMetric(Config()).eval(
+            np.asarray(yh, np.float64), b.predict(Xh))
+    assert abs(aucs[0] - aucs[20]) < 0.02, aucs
+
+
 def test_quantized_leaf_renewal():
     # quantized mode renews leaf outputs from full-precision sums
     # (RenewIntGradTreeOutput): a 1-tree L2 model's leaf values must
