@@ -272,8 +272,7 @@ def main():
             for lo in range(0, n_w, chunk_w):
                 hi = min(lo + chunk_w, n_w)
                 blk = rng.randn(hi - lo, f_w).astype(np.float32)
-                blk[rng.random_sample((hi - lo, f_w)).astype(np.float32)
-                    >= 0.25] = 0.0
+                blk[rng.random_sample((hi - lo, f_w)) >= 0.25] = 0.0
                 Xw[lo:hi] = blk
             yw = (Xw[:, :8].sum(axis=1) + 0.5 * rng.randn(n_w) > 0
                   ).astype(np.float32)

@@ -160,12 +160,17 @@ class DART(GBDT):
     # -- per-tree train contribution from the stored leaf assignment --
     def _train_contrib(self, model_idx: int):
         import jax.numpy as jnp
+        from ..ops.lookup import take_small
         tree = self.models[model_idx]
         la = self._train_leaf_idx[model_idx]
         if la is None:
             return jnp.float32(tree.leaf_value[0])
-        vals = jnp.asarray(tree.leaf_value[:tree.num_leaves], jnp.float32)
-        return jnp.take(vals, jnp.asarray(la, jnp.int32))
+        # pad the table to a STABLE shape (num_leaves) — the lookup
+        # kernel's unrolled select-chain compiles per table length
+        L = self.config.num_leaves
+        vals = np.zeros(L, np.float32)
+        vals[:tree.num_leaves] = tree.leaf_value[:tree.num_leaves]
+        return take_small(jnp.asarray(vals), jnp.asarray(la, jnp.int32))
 
     def _select_drops(self) -> None:
         cfg = self.config

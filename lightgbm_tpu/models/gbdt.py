@@ -588,14 +588,18 @@ class GBDT:
                     tree, self._score, rec["leaf_idx"][:n], mask)
         tree.apply_shrinkage(self.shrinkage_rate)
         with timed("tree/score_update"):
-            # train-score update via the leaf assignment from the build
+            # train-score update via the leaf assignment from the build;
+            # the (N,) table lookup runs as the select-chain kernel (an
+            # XLA gather here costs ~150 ms per iteration at bench
+            # shape — ops/lookup.py)
+            from ..ops.lookup import take_small
             vals = jnp.asarray(tree.leaf_value[:self.config.num_leaves],
                                jnp.float32)
             vals = jnp.pad(
                 vals, (0, max(0, self.config.num_leaves - vals.shape[0])))
             tree_idx = len(self.models) % self.num_tree_per_iteration
             self._score = self._score.at[tree_idx].add(
-                jnp.take(vals, rec["leaf_idx"][:n]))
+                take_small(vals, rec["leaf_idx"][:n]))
         # valid scores: device split-record replay when the binned
         # matrix is resident, host traversal fallback otherwise
         from ..ops.grow import route_rows
@@ -617,7 +621,7 @@ class GBDT:
                             la.astype(np.int32)]
                     else:
                         vs.score[tree_idx] += np.asarray(
-                            jnp.take(vals, li), np.float64)
+                            take_small(vals, li), np.float64)
                 else:
                     if self._track_train_leaf:
                         la = tree.predict_leaf_index(vs.raw).astype(

@@ -1,0 +1,66 @@
+"""Small-table row lookup: ``vals[leaf_idx]`` for (N,) indices.
+
+XLA's gather lowers this to ~sub-GB/s element loads on TPU — measured
+160-200 ms for 10.5M rows from a 255-entry table, a hidden tax on
+EVERY boosting iteration's score update (the reference's
+``ScoreUpdater::AddScore`` is a trivial indexed add on CPU,
+``score_updater.hpp:17``).  The Pallas kernel instead streams the index
+vector once and resolves each row with an unrolled select-chain against
+the table's scalars — pure VPU work, ~2-3 orders faster.
+
+Gated to tables ≤ 512 entries (the unroll is the table size); larger
+tables fall back to ``jnp.take``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["take_small", "MAX_LOOKUP_TABLE"]
+
+MAX_LOOKUP_TABLE = 512
+
+
+def _lookup_kernel(idx_ref, vals_ref, out_ref, *, table: int):
+    idx = idx_ref[...]                       # (1, T) int32
+    acc = jnp.zeros_like(out_ref)            # (1, T) f32
+    for l in range(table):
+        acc = jnp.where(idx == l, vals_ref[0, l], acc)
+    out_ref[...] = acc
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def _take_small_pallas(vals: jax.Array, idx: jax.Array,
+                       block: int = 16384) -> jax.Array:
+    import jax.experimental.pallas as pl
+
+    (L,) = vals.shape
+    n = idx.shape[0]
+    n_pad = (n + block - 1) // block * block
+    ix = idx.astype(jnp.int32)
+    if n_pad != n:
+        ix = jnp.pad(ix, (0, n_pad - n))
+    Lp = (L + 127) // 128 * 128
+    vt = jnp.pad(vals.astype(jnp.float32), (0, Lp - L))[None, :]
+
+    out = pl.pallas_call(
+        functools.partial(_lookup_kernel, table=L),
+        grid=(n_pad // block,),
+        in_specs=[
+            pl.BlockSpec((1, block), lambda i: (0, i)),
+            pl.BlockSpec((1, Lp), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+    )(ix[None, :], vt)
+    return out[0, :n]
+
+
+def take_small(vals: jax.Array, idx: jax.Array) -> jax.Array:
+    """``vals[idx]`` with the TPU-friendly kernel when applicable."""
+    if (vals.ndim == 1 and vals.shape[0] <= MAX_LOOKUP_TABLE and
+            jax.default_backend() not in ("cpu",)):
+        return _take_small_pallas(vals, idx)
+    return jnp.take(vals, idx)
