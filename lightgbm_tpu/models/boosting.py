@@ -140,6 +140,7 @@ class DART(GBDT):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self._track_train_leaf = True
+        self._pipeline_enabled = False  # drops need the host tree
         self._rng_drop = np.random.RandomState(
             self.config.drop_seed & 0x7FFFFFFF)
         self.tree_weight: List[float] = []
@@ -165,9 +166,10 @@ class DART(GBDT):
         la = self._train_leaf_idx[model_idx]
         if la is None:
             return jnp.float32(tree.leaf_value[0])
-        # pad the table to a STABLE shape (num_leaves) — the lookup
-        # kernel's unrolled select-chain compiles per table length
-        L = self.config.num_leaves
+        # pad the table to a STABLE shape — the lookup kernel's
+        # unrolled select-chain compiles per table length; seeded trees
+        # from a donor model may exceed the current num_leaves
+        L = max(self.config.num_leaves, tree.num_leaves)
         vals = np.zeros(L, np.float32)
         vals[:tree.num_leaves] = tree.leaf_value[:tree.num_leaves]
         return take_small(jnp.asarray(vals), jnp.asarray(la, jnp.int32))
@@ -315,6 +317,7 @@ class RF(GBDT):
             Log.fatal("random forest requires bagging "
                       "(bagging_freq > 0, 0 < bagging_fraction < 1)")
         self.average_output = True
+        self._pipeline_enabled = False  # averaged-score updates
         self.shrinkage_rate = 1.0
         if self.objective is None:
             Log.fatal("rf does not support a custom objective")

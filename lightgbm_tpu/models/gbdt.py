@@ -57,6 +57,23 @@ class GBDT:
     """Gradient Boosting Decision Tree driver (single class for now;
     multiclass lands with the multiclass objective)."""
 
+    @property
+    def models(self) -> List[Tree]:
+        """The tree list.  Pipelined boosting defers the host
+        materialization of the newest tree by one iteration (its
+        records fetch hides behind the next tree's device build); ANY
+        reader flushes first, so the list is always complete from the
+        outside."""
+        if getattr(self, "_pending", None) is not None:
+            self._flush_pending()
+        return self._models
+
+    @models.setter
+    def models(self, value: List[Tree]) -> None:
+        if getattr(self, "_pending", None) is not None:
+            self._flush_pending()
+        self._models = list(value)
+
     def __init__(self, config: Config, train_set: TpuDataset,
                  objective: Optional[Objective],
                  metrics: Sequence[Metric] = (), mesh=None):
@@ -70,7 +87,11 @@ class GBDT:
         self.train_set = train_set
         self.objective = objective
         self.metrics = list(metrics)
-        self.models: List[Tree] = []
+        self._models: List[Tree] = []
+        self._pending = None        # in-flight tree (pipelined boosting)
+        self._stop_flag = False
+        self._pipeline_enabled = True  # DART/RF opt out
+        self._trees_dispatched = 0  # quantization PRNG stream position
         self.iter = 0
         self.num_class = max(config.num_class, 1)
         self.num_tree_per_iteration = 1
@@ -456,12 +477,156 @@ class GBDT:
         return getattr(self, "_cached_bag", None)
 
     # ------------------------------------------------------------------
+    def _pipeline_ok(self) -> bool:
+        """Pipelined boosting applies when nothing needs the host tree
+        within the iteration: single tree per iteration, no validation
+        scoring, no per-tree leaf tracking (DART) and no objective leaf
+        renewal hook — then the newest tree's record fetch can hide
+        behind the NEXT tree's device build."""
+        return (self._pipeline_enabled and
+                self.num_tree_per_iteration == 1 and
+                not self.valid_sets and not self._track_train_leaf and
+                self.objective is not None and self.num_features > 0 and
+                type(self.objective).renew_tree_output
+                is Objective.renew_tree_output)
+
+    def _dispatch_build(self, grad_k, hess_k, bag):
+        """Pad + bag-weight one class's gradients, draw the feature
+        mask and dispatch the jitted tree build.  Returns (device
+        record dict, sample mask) — shared by the classic and
+        pipelined iteration paths."""
+        import jax
+        import jax.numpy as jnp
+        from ..utils.profiling import timed
+
+        n, n_pad = self.num_data, self._n_pad
+        with timed("tree/prep"):
+            gp = jnp.pad(grad_k.astype(jnp.float32), (0, n_pad - n))
+            hp = jnp.pad(hess_k.astype(jnp.float32), (0, n_pad - n))
+            mask = self._base_mask
+            if bag is not None:
+                # weights scale grad/hess (GOSS/MVS upweighting); the
+                # count channel stays presence-based like the
+                # reference's subsets
+                w = jnp.pad(jnp.asarray(bag, jnp.float32).reshape(-1),
+                            (0, n_pad - n))
+                gp = gp * w
+                hp = hp * w
+                mask = mask * (w > 0)
+            fmask = self._feature_fraction_mask()
+        kw = {}
+        if self.grow_params.quantize:
+            # fresh stochastic-rounding randomness per tree
+            kw["quant_key"] = jax.random.fold_in(
+                self._quant_key, self._trees_dispatched)
+        self._trees_dispatched += 1
+        with timed("tree/dispatch"):
+            if self._bundle_maps is not None:
+                rec = self._build_tree(
+                    self._xt, gp, hp, mask, fmask, self._num_bins,
+                    self._missing_type, self._is_cat, self.grow_params,
+                    bundle_maps=self._bundle_maps, **kw)
+            else:
+                rec = self._build_tree(
+                    self._xt, gp, hp, mask, fmask, self._num_bins,
+                    self._missing_type, self._is_cat, self.grow_params,
+                    **kw)
+        return rec, mask
+
+    def _materialize_pending(self) -> bool:
+        """Fetch + host-materialize the in-flight tree; returns True
+        when it could not split (the stop signal)."""
+        pending, self._pending = self._pending, None
+        rec = pending["rec"]
+        recs = self._fetch_records(rec)
+        if "n_arm_passes" in recs:
+            self.last_arm_passes = int(recs["n_arm_passes"])
+        n_leaves = int(recs["n_leaves"])
+        if n_leaves <= 1:
+            tree = Tree(2)
+            tree.leaf_value[0] = pending["init_score"]
+            if abs(pending["init_score"]) > _KEPS:
+                self._score = self._score.at[0].add(
+                    pending["init_score"])
+            self._models.append(tree)
+            return True
+        tree = self._records_to_tree(recs)
+        tree.apply_shrinkage(pending["lr"])
+        if abs(pending["init_score"]) > _KEPS:
+            tree.add_bias(pending["init_score"])
+        self._models.append(tree)
+        return False
+
+    def _flush_pending(self) -> None:
+        if self._pending is not None:
+            if self._materialize_pending():
+                self._stop_flag = True
+
+    def _train_one_iter_pipelined(self) -> bool:
+        """Pipelined iteration: device work for tree t is dispatched
+        (build + score update from the build's own final leaf values)
+        BEFORE tree t-1's records are fetched, so the ~one-RTT fetch
+        rides under device compute.  The materialized model trails the
+        device state by one tree inside the loop; the ``models``
+        property flushes, so every external reader sees the full list.
+        Stop detection trails by one iteration (the stopping run gains
+        one constant tree)."""
+        import jax
+        import jax.numpy as jnp
+        from ..ops.lookup import take_small
+        from ..utils.profiling import timed
+
+        if self._stop_flag:
+            return True
+        self._prev_score = self._score
+        self._prev_valid_scores = []
+        init_score = 0.0
+        if (self.iter == 0 and self.config.boost_from_average and
+                not self._models and self._pending is None and
+                self.train_set.metadata.init_score is None):
+            init = self.objective.boost_from_score(0)
+            if abs(init) > _KEPS:
+                init_score = init
+                self._score = self._score.at[0].add(init)
+                Log.info("Start training from score %f", init)
+        with timed("boosting/gradients"):
+            grad, hess = self.objective.get_gradients(self._score)
+        grad = jnp.atleast_2d(grad)
+        hess = jnp.atleast_2d(hess)
+        bag = self._bagging_mask(grad, hess)
+        n = self.num_data
+        rec, _ = self._dispatch_build(grad[0], hess[0], bag)
+        with timed("tree/score_update"):
+            vals = rec["leaf_values_final"] * \
+                jnp.float32(self.shrinkage_rate)
+            self._score = self._score.at[0].add(
+                take_small(vals, rec["leaf_idx"][:n]))
+        prev_stop = False
+        if self._pending is not None:
+            with timed("tree/fetch"):
+                prev_stop = self._materialize_pending()
+        self._pending = {"rec": rec, "init_score": init_score,
+                         "lr": self.shrinkage_rate}
+        self.iter += 1
+        if prev_stop:
+            self._stop_flag = True
+            self._flush_pending()
+            Log.warning("Stopped training because there are no more "
+                        "leaves that meet the split requirements")
+            return True
+        return False
+
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration; returns True when training should stop
         (no splittable leaf)."""
         import jax.numpy as jnp
 
+        if grad is None and self._pipeline_ok():
+            return self._train_one_iter_pipelined()
+        self._flush_pending()
+        if self._stop_flag:
+            return True
         self._prev_score = self._score  # snapshot for rollback (immutable)
         self._prev_valid_scores = [vs.score.copy() for vs in self.valid_sets]
         init_scores = [0.0] * self.num_tree_per_iteration
@@ -507,47 +672,17 @@ class GBDT:
         return False
 
     def _train_one_tree(self, grad, hess, bag, init_score: float) -> Tree:
-        import jax
         import jax.numpy as jnp
         from ..utils.profiling import timed
 
-        n, n_pad = self.num_data, self._n_pad
-        with timed("tree/prep"):
-            gp = jnp.pad(grad.astype(jnp.float32), (0, n_pad - n))
-            hp = jnp.pad(hess.astype(jnp.float32), (0, n_pad - n))
-            mask = self._base_mask
-            if bag is not None:
-                # weights scale grad/hess (GOSS/MVS upweighting); the
-                # count channel stays presence-based like the
-                # reference's subsets
-                w = jnp.pad(jnp.asarray(bag, jnp.float32).reshape(-1),
-                            (0, n_pad - n))
-                gp = gp * w
-                hp = hp * w
-                mask = mask * (w > 0)
-            fmask = self._feature_fraction_mask()
-
+        n = self.num_data
         recs = None
         if self.num_features == 0:
             rec = None
             n_leaves = 1
+            mask = self._base_mask
         else:
-            kw = {}
-            if self.grow_params.quantize:
-                # fresh stochastic-rounding randomness per tree
-                kw["quant_key"] = jax.random.fold_in(
-                    self._quant_key, len(self.models))
-            with timed("tree/dispatch"):
-                if self._bundle_maps is not None:
-                    rec = self._build_tree(
-                        self._xt, gp, hp, mask, fmask, self._num_bins,
-                        self._missing_type, self._is_cat, self.grow_params,
-                        bundle_maps=self._bundle_maps, **kw)
-                else:
-                    rec = self._build_tree(
-                        self._xt, gp, hp, mask, fmask, self._num_bins,
-                        self._missing_type, self._is_cat, self.grow_params,
-                        **kw)
+            rec, mask = self._dispatch_build(grad, hess, bag)
             with timed("tree/fetch"):
                 # one packed device->host transfer per tree; doubles as
                 # the device sync (tunnel round-trips cost ~120ms, so a
@@ -863,6 +998,7 @@ class GBDT:
         # refit) must not corrupt the donor booster's trees
         self.models = [copy.deepcopy(t) for t in models]
         self.iter = len(models) // max(self.num_tree_per_iteration, 1)
+        self._trees_dispatched = len(models)
         if raw is None:
             Log.fatal("continue-training requires the training set's raw "
                       "matrix (free_raw_data=False)")
@@ -993,6 +1129,11 @@ class GBDT:
         pre-iteration score snapshot taken in :meth:`train_one_iter`."""
         if self.iter <= 0 or self._prev_score is None:
             return
+        # materialize any in-flight tree FIRST: its flush mutates score
+        # (init-score bias) and may set the stop flag — both must land
+        # before the rollback restores/clears them
+        self._flush_pending()
+        self._stop_flag = False  # the popped tree may have set it
         self._score = self._prev_score
         for vs, snap in zip(self.valid_sets, self._prev_valid_scores):
             vs.score = snap

@@ -917,6 +917,13 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
     if has_mono:
         leaf_values = jnp.clip(leaf_values, state["leaf_min"],
                                state["leaf_max"])
+    # score-ready values: what the host-side tree will predict after
+    # renewal + the no-split gate — lets the driver update the training
+    # score WITHOUT waiting for the host materialization (pipelined
+    # boosting).  Mirrors gbdt._records_to_tree exactly: quantized mode
+    # renews from the full-precision sums; an unsplit tree contributes
+    # nothing.
+    leaf_values_final = leaf_values
     extra = {}
     if has_mono:
         extra = {k: state[k] for k in
@@ -948,6 +955,11 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
                        max_bin=L, impl=p.hist_impl,
                        rows_per_block=p.rows_per_block)
         extra["leaf_stats_exact"] = ex[0, :L]
+        leaf_values_final = jnp.where(
+            ex[0, :L, 2] > 0,
+            leaf_output(ex[0, :L, 0], ex[0, :L, 1], sp.lambda_l1,
+                        sp.lambda_l2, sp.max_delta_step),
+            leaf_values_final)
     return {
         **extra,
         "leaf": state["rec_leaf"],
@@ -962,6 +974,8 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         "valid": state["rec_valid"],
         "leaf_idx": state["leaf_idx"],
         "leaf_values": leaf_values,
+        "leaf_values_final": jnp.where(state["n_leaves"] > 1,
+                                       leaf_values_final, 0.0),
         "leaf_stats": state["leaf_stats"],
         "n_leaves": state["n_leaves"],
     }

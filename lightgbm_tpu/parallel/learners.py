@@ -114,7 +114,8 @@ class DistributedBuilder:
         out_specs = {k: R for k in (
             "leaf", "feature", "threshold", "default_left", "is_cat",
             "gain", "left_stats", "right_stats", "left_mask", "valid",
-            "leaf_values", "leaf_stats", "n_leaves")}
+            "leaf_values", "leaf_values_final", "leaf_stats",
+            "n_leaves")}
         if self.params.split.has_monotone:
             for k in ("rec_left_min", "rec_left_max",
                       "rec_right_min", "rec_right_max"):
