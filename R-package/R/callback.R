@@ -1,0 +1,106 @@
+# Training callbacks (reference: R-package/R/callback.R).
+# Fresh implementation of the upstream callback-environment protocol:
+# each callback is a function(env) where env is an environment with
+# model, iteration, begin_iteration, end_iteration and eval_list
+# (list of list(data_name, name, value, higher_better)).  Callbacks
+# with attr "is_pre_iteration" run before the boosting update.
+
+#' @noRd
+cb.is.pre.iteration <- function(cb) {
+  isTRUE(attr(cb, "is_pre_iteration"))
+}
+
+#' Print evaluation results every \code{period} iterations
+#' @param period print cadence
+#' @export
+cb.print.evaluation <- function(period = 1L) {
+  callback <- function(env) {
+    if (period <= 0L || length(env$eval_list) == 0L) return(invisible())
+    i <- env$iteration
+    if (i %% period == 0L || i == env$begin_iteration ||
+        i == env$end_iteration) {
+      msg <- paste(vapply(env$eval_list, function(e) {
+        sprintf("%s's %s:%g", e$data_name, e$name, e$value)
+      }, character(1L)), collapse = "  ")
+      message(sprintf("[%d]  %s", i, msg))
+    }
+    invisible()
+  }
+  attr(callback, "name") <- "cb.print.evaluation"
+  callback
+}
+
+#' Record evaluation results into \code{model$record_evals}
+#' @export
+cb.record.evaluation <- function() {
+  callback <- function(env) {
+    for (e in env$eval_list) {
+      cur <- env$model$record_evals[[e$data_name]][[e$name]]$eval
+      env$model$record_evals[[e$data_name]][[e$name]]$eval <-
+        c(cur, e$value)
+    }
+    invisible()
+  }
+  attr(callback, "name") <- "cb.record.evaluation"
+  callback
+}
+
+#' Reset parameters during training
+#' @param new_params named list; each entry is either a vector of
+#'   per-iteration values or a \code{function(iteration, nrounds)}
+#' @export
+cb.reset.parameter <- function(new_params) {
+  if (is.null(names(new_params)) || any(names(new_params) == "")) {
+    stop("new_params must be a fully named list")
+  }
+  callback <- function(env) {
+    i <- env$iteration - env$begin_iteration + 1L
+    n <- env$end_iteration - env$begin_iteration + 1L
+    upd <- list()
+    for (nm in names(new_params)) {
+      spec <- new_params[[nm]]
+      upd[[nm]] <- if (is.function(spec)) spec(i, n) else
+        spec[[min(i, length(spec))]]
+    }
+    env$model$reset_parameter(upd)
+    invisible()
+  }
+  attr(callback, "name") <- "cb.reset.parameter"
+  attr(callback, "is_pre_iteration") <- TRUE
+  callback
+}
+
+#' Early stopping on the first metric of the first validation set
+#' @param stopping_rounds rounds without improvement before stopping
+#' @param verbose announce the stop
+#' @export
+cb.early.stop <- function(stopping_rounds, verbose = TRUE) {
+  best_score <- NA_real_
+  best_iter <- -1L
+  callback <- function(env) {
+    if (length(env$eval_list) == 0L) return(invisible())
+    e <- env$eval_list[[1L]]
+    improved <- is.na(best_score) ||
+      (e$higher_better && e$value > best_score) ||
+      (!e$higher_better && e$value < best_score)
+    if (improved) {
+      best_score <<- e$value
+      best_iter <<- env$iteration
+      # record on every improvement so best_iter is right even when
+      # the patience never fires before nrounds runs out
+      env$model$best_iter <- best_iter
+    }
+    # patience is counted in ITERATIONS (not evaluation events), so
+    # eval_freq does not scale the effective patience
+    if (env$iteration - best_iter >= stopping_rounds) {
+      if (verbose) {
+        message(sprintf("early stopping at %d (best %d: %g)",
+                        env$iteration, best_iter, best_score))
+      }
+      env$met_early_stop <- TRUE
+    }
+    invisible()
+  }
+  attr(callback, "name") <- "cb.early.stop"
+  callback
+}

@@ -216,63 +216,6 @@ lgb.dump <- function(booster, num_iteration = -1L) {
   booster$dump_model(num_iteration)
 }
 
-#' Feature importance table
-#' @param model lgb.Booster
-#' @param percentage scale gains to fractions
-#' @export
-lgb.importance <- function(model, percentage = TRUE) {
-  lgb.check.handle(model, "lgb.Booster")
-  gain <- model$feature_importance(type = "gain")
-  split <- model$feature_importance(type = "split")
-  if (percentage && sum(gain) > 0) {
-    gain <- gain / sum(gain)
-  }
-  nm <- names(gain)
-  if (is.null(nm)) nm <- paste0("Column_", seq_along(gain) - 1L)
-  df <- data.frame(Feature = nm, Gain = as.numeric(gain),
-                   Split = as.numeric(split),
-                   stringsAsFactors = FALSE)
-  df[order(-df$Gain), , drop = FALSE]
-}
-
-#' Flatten the model's trees to a data.frame (one row per node)
-#' @param model lgb.Booster
-#' @export
-lgb.model.dt.tree <- function(model) {
-  lgb.check.handle(model, "lgb.Booster")
-  js <- lgb.dump(model)
-  parsed <- tryCatch(
-    if (requireNamespace("jsonlite", quietly = TRUE)) {
-      jsonlite::fromJSON(js, simplifyVector = FALSE)
-    } else {
-      stop("jsonlite is required for lgb.model.dt.tree")
-    },
-    error = function(e) stop(e))
-  rows <- list()
-  walk <- function(tree_index, node, parent = NA_integer_) {
-    if (!is.null(node$split_index)) {
-      rows[[length(rows) + 1L]] <<- data.frame(
-        tree_index = tree_index, split_index = node$split_index,
-        split_feature = node$split_feature,
-        split_gain = node$split_gain, threshold = node$threshold,
-        leaf_index = NA_integer_, leaf_value = NA_real_,
-        stringsAsFactors = FALSE)
-      walk(tree_index, node$left_child, node$split_index)
-      walk(tree_index, node$right_child, node$split_index)
-    } else {
-      rows[[length(rows) + 1L]] <<- data.frame(
-        tree_index = tree_index, split_index = NA_integer_,
-        split_feature = NA_character_, split_gain = NA_real_,
-        threshold = NA_real_, leaf_index = node$leaf_index,
-        leaf_value = node$leaf_value, stringsAsFactors = FALSE)
-    }
-  }
-  for (i in seq_along(parsed$tree_info)) {
-    walk(i - 1L, parsed$tree_info[[i]]$tree_structure)
-  }
-  do.call(rbind, rows)
-}
-
 #' Extract a recorded eval series from lgb.train/lgb.cv output
 #' @param booster result of lgb.train or lgb.cv
 #' @param data_name validation set name

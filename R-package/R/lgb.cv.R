@@ -61,9 +61,7 @@ lgb.cv <- function(params = list(), data, nrounds = 100L, nfold = 5L,
     boosters[[k]] <- bst
   }
 
-  higher_better <- function(metric) {
-    any(startsWith(metric, c("auc", "ndcg", "map")))
-  }
+  higher_better <- lgb.metric.higher.better
   record <- list()
   best_score <- NA_real_
   best_iter <- -1L

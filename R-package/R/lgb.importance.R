@@ -1,0 +1,33 @@
+# Feature-importance table (reference: R-package/R/lgb.importance.R).
+# Fresh implementation over the lightgbm_tpu C API.
+
+#' Feature importance table
+#'
+#' Gain, split-count and cover-free frequency per feature, sorted by
+#' gain, mirroring the upstream \code{lgb.importance} columns
+#' (Feature, Gain, Frequency — Cover is undefined for this framework's
+#' device trees and is reported as the split share).
+#'
+#' @param model lgb.Booster
+#' @param percentage rescale Gain/Frequency to fractions of their sums
+#' @export
+lgb.importance <- function(model, percentage = TRUE) {
+  lgb.check.handle(model, "lgb.Booster")
+  gain <- model$feature_importance(type = "gain")
+  split <- model$feature_importance(type = "split")
+  nm <- names(gain)
+  freq <- as.numeric(split)
+  gain <- as.numeric(gain)
+  if (percentage) {
+    if (sum(gain) > 0) gain <- gain / sum(gain)
+    if (sum(freq) > 0) freq <- freq / sum(freq)
+  }
+  if (is.null(nm)) nm <- paste0("Column_", seq_along(gain) - 1L)
+  df <- data.frame(Feature = nm, Gain = gain,
+                   Cover = freq, Frequency = freq,
+                   Split = as.numeric(split),
+                   stringsAsFactors = FALSE)
+  df <- df[order(-df$Gain), , drop = FALSE]
+  rownames(df) <- NULL
+  df
+}

@@ -1,0 +1,54 @@
+# Per-prediction feature contributions
+# (reference: R-package/R/lgb.interprete.R).  The upstream walks tree
+# paths per row; here contributions come from the C API's SHAP
+# prediction (predcontrib) — same quantity, computed on device.
+
+#' Compute feature contributions of individual predictions
+#'
+#' For each requested row, the per-feature contribution to the raw
+#' score (TreeSHAP), as a data.frame of Feature / Contribution sorted
+#' by absolute contribution.  Multiclass models get one Contribution
+#' column per class (Contribution_0, ...).
+#'
+#' @param model lgb.Booster
+#' @param data matrix or dgCMatrix the model can predict on
+#' @param idxset integer vector of row indices to explain
+#' @param num_iteration trees to use (NULL or <=0: all)
+#' @return list of data.frames, one per element of idxset
+#' @export
+lgb.interprete <- function(model, data, idxset,
+                           num_iteration = NULL) {
+  lgb.check.handle(model, "lgb.Booster")
+  if (is.null(num_iteration)) num_iteration <- -1L
+  rows <- data[idxset, , drop = FALSE]
+  contrib <- model$predict(rows, num_iteration = num_iteration,
+                           predcontrib = TRUE)
+  if (is.null(dim(contrib))) {
+    contrib <- matrix(contrib, nrow = length(idxset), byrow = TRUE)
+  }
+  ncol_data <- ncol(rows)
+  num_class <- ncol(contrib) %/% (ncol_data + 1L)
+  feat <- colnames(rows)
+  if (is.null(feat)) feat <- paste0("Column_", seq_len(ncol_data) - 1L)
+  out <- vector("list", length(idxset))
+  for (i in seq_along(idxset)) {
+    per_class <- lapply(seq_len(num_class) - 1L, function(k) {
+      block <- contrib[i, k * (ncol_data + 1L) + seq_len(ncol_data)]
+      as.numeric(block)
+    })
+    df <- data.frame(Feature = feat, stringsAsFactors = FALSE)
+    if (num_class == 1L) {
+      df$Contribution <- per_class[[1L]]
+      df <- df[order(-abs(df$Contribution)), , drop = FALSE]
+    } else {
+      for (k in seq_len(num_class)) {
+        df[[paste0("Contribution_", k - 1L)]] <- per_class[[k]]
+      }
+      tot <- rowSums(abs(as.matrix(df[, -1L, drop = FALSE])))
+      df <- df[order(-tot), , drop = FALSE]
+    }
+    rownames(df) <- NULL
+    out[[i]] <- df
+  }
+  out
+}

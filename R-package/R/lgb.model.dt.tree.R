@@ -1,0 +1,74 @@
+# Model-to-table flattening (reference: R-package/R/lgb.model.dt.tree.R).
+# Fresh implementation over this package's JSON dump.
+
+#' Parse a lgb.Booster model into a per-node table
+#'
+#' One row per tree node with the upstream column contract:
+#' tree_index, depth, split_index, split_feature, node_parent,
+#' leaf_index, leaf_parent, split_gain, threshold, decision_type,
+#' default_left, internal_value, internal_count, leaf_value,
+#' leaf_count.
+#'
+#' @param model lgb.Booster
+#' @param num_iteration trees to include (<=0 or NULL: all)
+#' @export
+lgb.model.dt.tree <- function(model, num_iteration = NULL) {
+  lgb.check.handle(model, "lgb.Booster")
+  if (is.null(num_iteration)) num_iteration <- -1L
+  js <- lgb.dump(model, num_iteration)
+  if (!requireNamespace("jsonlite", quietly = TRUE)) {
+    stop("jsonlite is required for lgb.model.dt.tree")
+  }
+  parsed <- jsonlite::fromJSON(js, simplifyVector = FALSE)
+  feat_names <- unlist(parsed$feature_names)
+  rows <- list()
+  walk <- function(tree_index, node, parent = NA_integer_, depth = 0L) {
+    if (!is.null(node$split_index)) {
+      fid <- node$split_feature
+      fname <- if (!is.null(feat_names) &&
+                   fid + 1L <= length(feat_names)) {
+        feat_names[fid + 1L]
+      } else {
+        paste0("Column_", fid)
+      }
+      thr <- node$threshold
+      if (length(thr) > 1L) thr <- paste(unlist(thr), collapse = "||")
+      rows[[length(rows) + 1L]] <<- data.frame(
+        tree_index = tree_index, depth = depth,
+        split_index = node$split_index, split_feature = fname,
+        node_parent = parent, leaf_index = NA_integer_,
+        leaf_parent = NA_integer_,
+        split_gain = as.numeric(node$split_gain),
+        threshold = as.character(thr),
+        decision_type = node$decision_type,
+        default_left = isTRUE(node$default_left),
+        internal_value = as.numeric(node$internal_value),
+        internal_count = as.integer(node$internal_count),
+        leaf_value = NA_real_, leaf_count = NA_integer_,
+        stringsAsFactors = FALSE)
+      walk(tree_index, node$left_child, node$split_index, depth + 1L)
+      walk(tree_index, node$right_child, node$split_index, depth + 1L)
+    } else {
+      rows[[length(rows) + 1L]] <<- data.frame(
+        tree_index = tree_index, depth = depth,
+        split_index = NA_integer_, split_feature = NA_character_,
+        node_parent = NA_integer_,
+        leaf_index = if (is.null(node$leaf_index)) 0L else
+          node$leaf_index,
+        leaf_parent = parent, split_gain = NA_real_,
+        threshold = NA_character_, decision_type = NA_character_,
+        default_left = NA,
+        internal_value = NA_real_, internal_count = NA_integer_,
+        leaf_value = as.numeric(node$leaf_value),
+        leaf_count = if (is.null(node$leaf_count)) NA_integer_ else
+          as.integer(node$leaf_count),
+        stringsAsFactors = FALSE)
+    }
+  }
+  for (i in seq_along(parsed$tree_info)) {
+    walk(i - 1L, parsed$tree_info[[i]]$tree_structure)
+  }
+  out <- do.call(rbind, rows)
+  rownames(out) <- NULL
+  out
+}

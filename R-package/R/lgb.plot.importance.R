@@ -1,0 +1,32 @@
+# Importance bar plot (reference: R-package/R/lgb.plot.importance.R).
+# Base-graphics implementation (no ggplot dependency).
+
+#' Plot feature importance as a horizontal bar chart
+#'
+#' @param tree_imp output of \code{lgb.importance}
+#' @param top_n features to show
+#' @param measure one of "Gain", "Cover", "Frequency"
+#' @param left_margin plot left margin (feature-name room)
+#' @param cex text size passed to barplot
+#' @return invisibly, the plotted subset of tree_imp
+#' @export
+lgb.plot.importance <- function(tree_imp, top_n = 10L,
+                                measure = "Gain",
+                                left_margin = 10L, cex = NULL) {
+  if (!measure %in% c("Gain", "Cover", "Frequency")) {
+    stop("measure must be one of Gain / Cover / Frequency")
+  }
+  if (!is.data.frame(tree_imp) || is.null(tree_imp[[measure]])) {
+    stop("tree_imp must be the output of lgb.importance")
+  }
+  top_n <- min(top_n, nrow(tree_imp))
+  imp <- tree_imp[order(-tree_imp[[measure]]), , drop = FALSE]
+  imp <- imp[seq_len(top_n), , drop = FALSE]
+  op <- graphics::par(mar = c(3, left_margin, 2, 1))
+  on.exit(graphics::par(op))
+  graphics::barplot(rev(imp[[measure]]),
+                    names.arg = rev(imp$Feature), horiz = TRUE,
+                    las = 1, main = "Feature importance",
+                    xlab = measure, cex.names = cex)
+  invisible(imp)
+}

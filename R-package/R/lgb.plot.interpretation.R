@@ -1,0 +1,45 @@
+# Contribution bar plot
+# (reference: R-package/R/lgb.plot.interpretation.R).  Base-graphics
+# implementation over lgb.interprete output.
+
+#' Plot one prediction's feature contributions
+#'
+#' @param tree_interpretation one element of \code{lgb.interprete}'s
+#'   result (a data.frame with Feature + Contribution column(s))
+#' @param top_n features to show
+#' @param cols plot grid columns for multiclass models
+#' @param left_margin plot left margin (feature-name room)
+#' @param cex text size passed to barplot
+#' @export
+lgb.plot.interpretation <- function(tree_interpretation, top_n = 10L,
+                                    cols = 1L, left_margin = 10L,
+                                    cex = NULL) {
+  if (!is.data.frame(tree_interpretation)) {
+    stop("tree_interpretation must be a data.frame from lgb.interprete")
+  }
+  contrib_cols <- setdiff(colnames(tree_interpretation), "Feature")
+  num_class <- length(contrib_cols)
+  plot_one <- function(colname, title) {
+    vals <- tree_interpretation[[colname]]
+    ord <- order(-abs(vals))[seq_len(min(top_n, length(vals)))]
+    v <- vals[ord]
+    f <- tree_interpretation$Feature[ord]
+    graphics::barplot(rev(v), names.arg = rev(f), horiz = TRUE,
+                      las = 1, main = title,
+                      col = ifelse(rev(v) >= 0, "forestgreen",
+                                   "firebrick"),
+                      xlab = "Contribution", cex.names = cex)
+  }
+  op <- graphics::par(
+    mar = c(3, left_margin, 2, 1),
+    mfrow = c(ceiling(num_class / cols), min(cols, num_class)))
+  on.exit(graphics::par(op))
+  if (num_class == 1L) {
+    plot_one(contrib_cols[1L], "Feature contribution")
+  } else {
+    for (k in seq_along(contrib_cols)) {
+      plot_one(contrib_cols[k], sprintf("Class %d", k - 1L))
+    }
+  }
+  invisible(NULL)
+}

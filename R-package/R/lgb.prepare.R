@@ -1,0 +1,24 @@
+# Dataset preparation: factors/characters -> numeric codes
+# (reference: R-package/R/lgb.prepare.R).  Fresh implementation in
+# base R; works on data.frame and data.table alike (columns are
+# replaced in a shallow copy, no by-reference mutation).
+
+#' Convert factor and character columns to numeric codes
+#'
+#' Returns the dataset with every factor/character column replaced by
+#' its numeric level code (1-based, NA preserved), ready for
+#' \code{as.matrix} + \code{lgb.Dataset}.  Use
+#' \code{lgb.prepare_rules} to make the encoding reusable on other
+#' datasets.
+#'
+#' @param data data.frame (or data.table) to prepare
+#' @export
+lgb.prepare <- function(data) {
+  out <- as.data.frame(data, stringsAsFactors = FALSE)
+  for (j in seq_along(out)) {
+    col <- out[[j]]
+    if (is.character(col)) col <- factor(col)
+    if (is.factor(col)) out[[j]] <- as.numeric(col)
+  }
+  out
+}

@@ -1,0 +1,21 @@
+# Dataset preparation: factors/characters -> integer codes
+# (reference: R-package/R/lgb.prepare2.R — the integer-output variant
+# of lgb.prepare, a half-memory option for integer-tolerant pipelines).
+
+#' Convert factor and character columns to integer codes
+#'
+#' Same as \code{lgb.prepare} but emits \code{integer} codes instead
+#' of \code{numeric}.  Use \code{lgb.prepare_rules2} for a reusable
+#' encoding.
+#'
+#' @param data data.frame (or data.table) to prepare
+#' @export
+lgb.prepare2 <- function(data) {
+  out <- as.data.frame(data, stringsAsFactors = FALSE)
+  for (j in seq_along(out)) {
+    col <- out[[j]]
+    if (is.character(col)) col <- factor(col)
+    if (is.factor(col)) out[[j]] <- as.integer(col)
+  }
+  out
+}

@@ -1,0 +1,46 @@
+# Reusable dataset preparation rules
+# (reference: R-package/R/lgb.prepare_rules.R).  Fresh base-R
+# implementation.
+
+#' Convert factor/character columns to numeric codes with reusable
+#' rules
+#'
+#' First call: pass \code{rules = NULL}; returns \code{list(data,
+#' rules)} where rules maps each converted column's levels to codes.
+#' Later calls: pass the returned rules to apply the SAME encoding to
+#' another dataset (unseen levels become NA, exactly like upstream).
+#'
+#' @param data data.frame (or data.table) to prepare
+#' @param rules previously returned rules, or NULL to learn them
+#' @return list(data = converted data, rules = encoding rules)
+#' @export
+lgb.prepare_rules <- function(data, rules = NULL) {
+  .lgb_prepare_rules_impl(data, rules, as.numeric)
+}
+
+#' @noRd
+.lgb_prepare_rules_impl <- function(data, rules, cast) {
+  out <- as.data.frame(data, stringsAsFactors = FALSE)
+  learned <- if (is.null(rules)) list() else rules
+  if (is.null(rules)) {
+    for (j in seq_along(out)) {
+      col <- out[[j]]
+      if (is.character(col)) col <- factor(col)
+      if (is.factor(col)) {
+        lv <- levels(col)
+        codes <- seq_along(lv)
+        names(codes) <- lv
+        learned[[colnames(out)[j]]] <- codes
+        out[[j]] <- cast(col)
+      }
+    }
+  } else {
+    for (nm in names(learned)) {
+      if (is.null(out[[nm]])) next
+      col <- as.character(out[[nm]])
+      mapped <- learned[[nm]][col]       # unseen level -> NA
+      out[[nm]] <- cast(unname(mapped))
+    }
+  }
+  list(data = out, rules = learned)
+}

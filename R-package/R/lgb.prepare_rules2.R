@@ -1,0 +1,16 @@
+# Reusable dataset preparation rules, integer output
+# (reference: R-package/R/lgb.prepare_rules2.R).
+
+#' Convert factor/character columns to integer codes with reusable
+#' rules
+#'
+#' Integer-output variant of \code{lgb.prepare_rules}; same rules
+#' object contract (unseen levels become NA).
+#'
+#' @param data data.frame (or data.table) to prepare
+#' @param rules previously returned rules, or NULL to learn them
+#' @return list(data = converted data, rules = encoding rules)
+#' @export
+lgb.prepare_rules2 <- function(data, rules = NULL) {
+  .lgb_prepare_rules_impl(data, rules, as.integer)
+}

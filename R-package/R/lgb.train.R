@@ -1,7 +1,12 @@
 # lgb.train / lightgbm: the training loops.
-# Same contract as the upstream lightgbm R package (valids,
+# Same contract as the upstream lightgbm R package (valids, callbacks,
 # eval recording, early stopping on the first validation metric);
 # fresh implementation.
+
+#' @noRd
+lgb.metric.higher.better <- function(metric) {
+  any(startsWith(metric, c("auc", "ndcg", "map")))
+}
 
 #' Train a gradient boosting model
 #'
@@ -14,70 +19,70 @@
 #' @param eval_freq evaluate/print every this many iterations
 #' @param verbose <=0 silences the eval lines
 #' @param record keep eval history in `$record_evals`
+#' @param callbacks list of callback functions (see
+#'   \code{cb.print.evaluation}, \code{cb.record.evaluation},
+#'   \code{cb.reset.parameter}, \code{cb.early.stop}); merged with the
+#'   ones implied by the arguments above
 #' @export
 lgb.train <- function(params = list(), data, nrounds = 100L,
                       valids = list(), early_stopping_rounds = NULL,
-                      eval_freq = 1L, verbose = 1L, record = TRUE) {
+                      eval_freq = 1L, verbose = 1L, record = TRUE,
+                      callbacks = list()) {
   lgb.check.handle(data, "lgb.Dataset")
   booster <- BoosterR6$new(params = params, train_set = data)
   for (name in names(valids)) {
     booster$add_valid(valids[[name]], name)
   }
-  higher_better <- function(metric) {
-    any(startsWith(metric, c("auc", "ndcg", "map")))
+  if (verbose > 0L && length(valids) > 0L) {
+    callbacks <- c(callbacks, list(cb.print.evaluation(eval_freq)))
   }
-  best_score <- NA_real_
-  best_iter <- -1L
-  since_best <- 0L
+  if (record && length(valids) > 0L) {
+    callbacks <- c(callbacks, list(cb.record.evaluation()))
+  }
+  if (!is.null(early_stopping_rounds) && early_stopping_rounds > 0L &&
+      length(valids) > 0L) {
+    callbacks <- c(callbacks,
+                   list(cb.early.stop(early_stopping_rounds,
+                                      verbose = verbose > 0L)))
+  }
+  pre <- Filter(cb.is.pre.iteration, callbacks)
+  post <- Filter(function(cb) !cb.is.pre.iteration(cb), callbacks)
+
+  env <- new.env(parent = emptyenv())
+  env$model <- booster
+  env$begin_iteration <- 1L
+  env$end_iteration <- nrounds
+  env$met_early_stop <- FALSE
   for (i in seq_len(nrounds)) {
+    env$iteration <- i
+    env$eval_list <- list()
+    for (cb in pre) cb(env)
     finished <- booster$update()
-    if (length(valids) > 0L && (i %% eval_freq == 0L || i == nrounds)) {
+    if (length(valids) > 0L && (i %% eval_freq == 0L ||
+                                i == nrounds)) {
+      evals <- list()
       for (vi in seq_along(valids)) {
         vals <- booster$eval(vi)
-        vname <- names(valids)[vi]
-        if (record) {
-          for (mname in names(vals)) {
-            cur <- booster$record_evals[[vname]][[mname]]$eval
-          booster$record_evals[[vname]][[mname]]$eval <-
-              c(cur, vals[[mname]])
-          }
-        }
-        if (verbose > 0L) {
-          msg <- paste(sprintf("%s %s:%g", vname, names(vals), vals),
-                       collapse = "  ")
-          message(sprintf("[%d] %s", i, msg))
-        }
-        if (!is.null(early_stopping_rounds) && vi == 1L &&
-            length(vals) > 0L) {
-          score <- vals[[1L]]
-          hb <- higher_better(names(vals)[1L])
-          improved <- is.na(best_score) ||
-            (hb && score > best_score) || (!hb && score < best_score)
-          if (improved) {
-            best_score <- score
-            best_iter <- i
-            since_best <- 0L
-          } else {
-            since_best <- since_best + eval_freq
-          }
-          if (since_best >= early_stopping_rounds) {
-            if (verbose > 0L) {
-              message(sprintf(
-                "early stopping at %d (best %d: %g)", i, best_iter,
-                best_score))
-            }
-            booster$best_iter <- best_iter
-            return(booster)
-          }
+        for (mname in names(vals)) {
+          evals[[length(evals) + 1L]] <- list(
+            data_name = names(valids)[vi], name = mname,
+            value = vals[[mname]],
+            higher_better = lgb.metric.higher.better(mname))
         }
       }
+      env$eval_list <- evals
+    }
+    for (cb in post) cb(env)
+    if (env$met_early_stop) {
+      return(booster)
     }
     if (finished) {
       break
     }
   }
-  booster$best_iter <- if (best_iter > 0L) best_iter else
-    booster$current_iter()
+  if (booster$best_iter <= 0L) {
+    booster$best_iter <- booster$current_iter()
+  }
   booster
 }
 

@@ -1,0 +1,33 @@
+# Package unloader (reference: R-package/R/lgb.unloader.R).
+
+#' Unload the lightgbmtpu package and free its boosters
+#'
+#' Detaches and unloads the package's namespace and shared library —
+#' needed before reinstalling in a live R session.  With
+#' \code{wipe = TRUE} also removes lgb.Booster / lgb.Dataset objects
+#' from \code{envir}.
+#'
+#' @param restore re-attach the package afterwards
+#' @param wipe remove booster/dataset objects from envir first
+#' @param envir environment to scan when wiping
+#' @export
+lgb.unloader <- function(restore = TRUE, wipe = FALSE,
+                         envir = .GlobalEnv) {
+  if (wipe) {
+    objs <- ls(envir = envir)
+    drop <- objs[vapply(objs, function(nm) {
+      inherits(get(nm, envir = envir),
+               c("lgb.Booster", "lgb.Dataset", "lgb.CVBooster"))
+    }, logical(1L))]
+    if (length(drop)) rm(list = drop, envir = envir)
+    gc()
+  }
+  if ("package:lightgbmtpu" %in% search()) {
+    detach("package:lightgbmtpu", unload = TRUE)
+  }
+  try(unloadNamespace("lightgbmtpu"), silent = TRUE)
+  if (restore) {
+    library(lightgbmtpu)
+  }
+  invisible(NULL)
+}

@@ -1,0 +1,24 @@
+# RDS restore for Boosters
+# (reference: R-package/R/readRDS.lgb.Booster.R).
+
+#' Load a lgb.Booster saved by \code{saveRDS.lgb.Booster}
+#'
+#' @param file path written by \code{saveRDS.lgb.Booster}
+#' @param refhook forwarded to \code{readRDS}
+#' @return a live lgb.Booster with best_iter / record_evals restored
+#' @export
+readRDS.lgb.Booster <- function(file, refhook = NULL) {
+  payload <- readRDS(file, refhook = refhook)
+  if (!inherits(payload, "lgb.Booster.rds") ||
+      is.null(payload$model_str)) {
+    stop("file was not written by saveRDS.lgb.Booster")
+  }
+  booster <- lgb.load(model_str = payload$model_str)
+  if (!is.null(payload$best_iter)) {
+    booster$best_iter <- payload$best_iter
+  }
+  if (!is.null(payload$record_evals)) {
+    booster$record_evals <- payload$record_evals
+  }
+  booster
+}

@@ -1,0 +1,29 @@
+# RDS persistence for Boosters
+# (reference: R-package/R/saveRDS.lgb.Booster.R).  A Booster's handle
+# is a process-local external pointer; saving attaches the model text
+# so the object survives serialization.
+
+#' Save a lgb.Booster (or any object containing one) with RDS
+#'
+#' The model is serialized to its text representation alongside the R
+#' object, so \code{readRDS.lgb.Booster} can restore a working handle.
+#'
+#' @param object lgb.Booster to save
+#' @param file target path
+#' @param ascii,version,compress,refhook forwarded to \code{saveRDS}
+#' @param raw keep the model text in the object (always TRUE here; the
+#'   argument exists for upstream signature compatibility)
+#' @export
+saveRDS.lgb.Booster <- function(object, file, ascii = FALSE,
+                                version = NULL, compress = TRUE,
+                                refhook = NULL, raw = TRUE) {
+  lgb.check.handle(object, "lgb.Booster")
+  payload <- list(
+    model_str = object$save_model_to_string(-1L),
+    best_iter = object$best_iter,
+    record_evals = object$record_evals)
+  class(payload) <- "lgb.Booster.rds"
+  saveRDS(payload, file = file, ascii = ascii, version = version,
+          compress = compress, refhook = refhook)
+  invisible(object)
+}

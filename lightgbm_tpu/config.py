@@ -320,6 +320,17 @@ PARAMS: List[Param] = [
        "order — cuts the sequential growth loop from num_leaves-1 steps "
        "to ~log2(K)+num_leaves/K (device serial learner only)",
        group="device"),
+    _p("hist_refinement", True, bool, ("coarse_to_fine",),
+       "coarse-to-fine histograms on the wave path: a cheap coarse pass "
+       "(bins collapsed 16-to-1) locates the best split region per "
+       "(leaf, feature) and one narrow windowed pass resolves it at "
+       "fine resolution — ~2x faster histograms at 255 bins.  Split "
+       "choice is exact whenever the best fine threshold lies in the "
+       "refine window (2 coarse bins around the best coarse boundary). "
+       "Auto-disabled for categorical features, missing values, EFB "
+       "bundles, or max_bin<128 (below that the per-pass fixed cost "
+       "outweighs the stream saving)",
+       group="device"),
 ]
 
 _PARAM_BY_NAME: Dict[str, Param] = {p.name: p for p in PARAMS}

@@ -43,9 +43,11 @@ import jax
 import jax.numpy as jnp
 
 from .histogram import (histogram_pallas, histogram_pallas_multi,
-                        histogram_segsum, histogram_segsum_multi)
-from .split import (NEG_INF, SplitParams, eval_forced_split,
-                    find_best_split, leaf_output)
+                        histogram_pallas_multi_win, histogram_segsum,
+                        histogram_segsum_multi, histogram_segsum_multi_win)
+from .split import (NEG_INF, SplitParams, choose_window,
+                    eval_forced_split, find_best_split,
+                    find_best_split_c2f, leaf_output)
 
 __all__ = ["DistConfig", "GrowParams", "build_tree"]
 
@@ -119,6 +121,20 @@ class GrowParams:
     # on the host from the full-precision renewal stats.  Requires
     # quantize>0 and the wave path; the driver gates all of this.
     two_col: bool = False
+    # >0: coarse-to-fine histogram refinement on the wave path.  Each
+    # wave runs a COARSE pass (fine bins collapsed 2^refine_shift-to-1,
+    # streaming B/2^shift one-hot rows) for BOTH children of every
+    # split, then one WINDOWED pass resolving only the 2 coarse bins
+    # straddling each (child, feature)'s best coarse boundary at fine
+    # resolution — ~0.21x the MXU stream of a full 255-bin pass (the
+    # driver only enables it at max_bin >= 128, where the stream saving
+    # beats the doubled per-pass fixed cost — see models/gbdt.py).
+    # Histogram-subtraction and the (L, F, B, 3) pool are
+    # dropped (children built directly; the pool would be coarse-only
+    # anyway).  Split choice is exact whenever the best fine threshold
+    # lies in the chosen window (see ops/split.py).  Requires the wave
+    # path, numerical features only, no missing values, no bundling.
+    refine_shift: int = 0
     # >0: relative gain tolerance for preferring an already-ARMED leaf
     # over a fresh unarmed one when their best gains are within
     # tol*|best|.  Late boosting iterations have near-flat gains and
@@ -324,6 +340,12 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
                                      and not p.forced and p.speculate > 1
                                      ) else 0
     do_spec = W_spec > 1
+    use_wave = p.wave and do_spec and kind == "serial" and not p.forced
+    use_c2f = use_wave and p.refine_shift > 0
+    if use_c2f:
+        assert not sp.any_cat and not sp.any_missing and not p.bundled, \
+            "coarse-to-fine refinement requires numerical features " \
+            "without missing values and no bundling"
     if do_spec:
         base_vals = jnp.stack([grad * sample_mask, hess * sample_mask,
                                sample_mask], axis=-1)
@@ -338,6 +360,46 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
                 h = histogram_segsum_multi(xt, base_vals, sel, B, W_spec,
                                            two_col=p.two_col)
             return h if hist_scale is None else h * hist_scale
+    if use_c2f:
+        c2f_shift = p.refine_shift
+        Bc_c2f = ((B - 1) >> c2f_shift) + 1
+        R_c2f = 2 << c2f_shift       # 2 coarse bins at fine resolution
+
+        def multi_hist_coarse(sel):
+            if p.hist_impl == "pallas":
+                h = histogram_pallas_multi(xt, base_vals, sel, Bc_c2f,
+                                           W_spec, p.rows_per_block,
+                                           exact=p.quantize > 0,
+                                           two_col=p.two_col,
+                                           shift=c2f_shift)
+            else:
+                h = histogram_segsum_multi(xt, base_vals, sel, Bc_c2f,
+                                           W_spec, two_col=p.two_col,
+                                           shift=c2f_shift)
+            return h if hist_scale is None else h * hist_scale
+
+        def multi_hist_win(sel, lo_all):
+            if p.hist_impl == "pallas":
+                h = histogram_pallas_multi_win(xt, base_vals, sel, lo_all,
+                                               R_c2f, W_spec,
+                                               p.rows_per_block,
+                                               exact=p.quantize > 0,
+                                               two_col=p.two_col)
+            else:
+                h = histogram_segsum_multi_win(xt, base_vals, sel, lo_all,
+                                               R_c2f, W_spec,
+                                               two_col=p.two_col)
+            return h if hist_scale is None else h * hist_scale
+
+        def c2f_window(c, s, mn, mx):
+            return choose_window(c, s, nb_l, sp, c2f_shift, mono_l,
+                                 mn, mx)
+
+        def c2f_best(c, wh, lo, s, mn, mx):
+            return find_best_split_c2f(c, wh, lo, s, nb_l, fmask_l, sp,
+                                       c2f_shift, monotone=mono_l,
+                                       penalty=pen_l, min_output=mn,
+                                       max_output=mx)
 
     def global_stats(local):
         if kind in ("data", "voting"):
@@ -433,7 +495,6 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
 
     # ---- init: root ------------------------------------------------
     leaf_idx = jnp.zeros(N, dtype=jnp.int32)
-    root_hist = masked_hist(leaf_idx, 0)
     root_count = jnp.sum(hess * sample_mask) if p.two_col \
         else jnp.sum(sample_mask)
     root_stats = global_stats(jnp.stack([jnp.sum(grad * sample_mask),
@@ -445,8 +506,22 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         root_stats = root_stats * hist_scale
     root_mn = -BIG if has_mono else None
     root_mx = BIG if has_mono else None
-    root_best = best_of(root_hist, root_stats, jnp.int32(0),
-                        root_mn, root_mx)
+    if use_c2f:
+        # coarse + windowed refine for the root too — no full-
+        # resolution pass anywhere on the c2f path
+        sel0 = jnp.zeros(N, jnp.int32)
+        root_coarse = multi_hist_coarse(sel0)[0]
+        root_win_lo = c2f_window(root_coarse, root_stats,
+                                 root_mn, root_mx)
+        lo0 = jnp.zeros((W_spec, F_hist), jnp.int32).at[0].set(
+            root_win_lo)
+        root_winh = multi_hist_win(sel0, lo0)[0]
+        root_best = c2f_best(root_coarse, root_winh, root_win_lo,
+                             root_stats, root_mn, root_mx)
+    else:
+        root_hist = masked_hist(leaf_idx, 0)
+        root_best = best_of(root_hist, root_stats, jnp.int32(0),
+                            root_mn, root_mx)
 
     n_forced = min(len(p.forced), L - 1)
     if n_forced:
@@ -489,12 +564,12 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         "rec_valid": jnp.zeros(L - 1, bool),
         "n_leaves": jnp.int32(1),
     }
-    if p.use_hist_pool:
+    if p.use_hist_pool and not use_c2f:
         # the HistogramPool analog: per-leaf histograms enabling the
-        # parent-minus-smaller-child subtraction trick
+        # parent-minus-smaller-child subtraction trick (the c2f wave
+        # builds both children directly and keeps no pool)
         state["hist"] = jnp.zeros((L, F_hist, B, 3),
                                   jnp.float32).at[0].set(root_hist)
-    use_wave = p.wave and do_spec and kind == "serial" and not p.forced
     if do_spec and not use_wave:
         # smaller-child histograms keyed by PARENT leaf; slot L is the
         # write target for unused arming lanes
@@ -714,6 +789,51 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
     def wave_cond(st):
         return (st["n_leaves"] < L) & (jnp.max(st["best_gain"]) > 0)
 
+    def commit_wave(st, ids_leaf, new_leaf, ids_rec, bests, ch_stats,
+                    ch_depth, recs, valid_w, mono_vals=None):
+        """Shared state-commit tail of the wave bodies: scatter the
+        children's stats/depth/best-split caches and the wave's split
+        records.  Invalid lanes carry OUT-OF-BOUNDS indices and rely on
+        mode="drop" (the default promise_in_bounds CLAMPS and corrupts
+        the last real slot)."""
+        ch_ids = jnp.concatenate([ids_leaf, new_leaf])
+        st = dict(st)
+        st["leaf_stats"] = st["leaf_stats"].at[ch_ids].set(
+            ch_stats, mode="drop")
+        st["leaf_depth"] = st["leaf_depth"].at[ch_ids].set(
+            ch_depth, mode="drop")
+        if mono_vals is not None:
+            ch_mn, ch_mx, l_min, l_max, r_min, r_max = mono_vals
+            st["leaf_min"] = st["leaf_min"].at[ch_ids].set(
+                ch_mn, mode="drop")
+            st["leaf_max"] = st["leaf_max"].at[ch_ids].set(
+                ch_mx, mode="drop")
+            st["rec_left_min"] = st["rec_left_min"].at[ids_rec].set(
+                l_min, mode="drop")
+            st["rec_left_max"] = st["rec_left_max"].at[ids_rec].set(
+                l_max, mode="drop")
+            st["rec_right_min"] = st["rec_right_min"].at[ids_rec].set(
+                r_min, mode="drop")
+            st["rec_right_max"] = st["rec_right_max"].at[ids_rec].set(
+                r_max, mode="drop")
+        for key, src in (("best_gain", "gain"),
+                         ("best_feature", "feature"),
+                         ("best_threshold", "threshold"),
+                         ("best_default_left", "default_left"),
+                         ("best_is_cat", "is_cat"),
+                         ("best_left_mask", "left_mask"),
+                         ("best_left_stats", "left_stats")):
+            arr = st[key]
+            st[key] = arr.at[ch_ids].set(bests[src].astype(arr.dtype),
+                                         mode="drop")
+        for key, val in recs:
+            st[key] = st[key].at[ids_rec].set(
+                val.astype(st[key].dtype), mode="drop")
+        st["n_leaves"] = st["n_leaves"] + \
+            jnp.sum(valid_w.astype(jnp.int32))
+        st["n_arm_passes"] = st["n_arm_passes"] + 1
+        return st
+
     def wave_body(st):
         W = W_spec
         t0 = st["n_leaves"] - 1           # next free split-record slot
@@ -849,64 +969,136 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
             st["dbg_bests_left_stats"] = bests["left_stats"]
             st["dbg_bests_dl"] = bests["default_left"]
 
-        # invalid lanes scatter to index L (leaf arrays) / L-1 (record
-        # arrays) which are OUT OF BOUNDS — mode="drop" is essential:
-        # the default promise_in_bounds CLAMPS, silently corrupting the
-        # last real slot
-        ch_ids = jnp.concatenate([ids_leaf, new_leaf])
         st = dict(st)
         st["leaf_idx"] = leaf_idx
         st["hist"] = st["hist"].at[ids_leaf].set(hist_l, mode="drop") \
                                .at[new_leaf].set(hist_r, mode="drop")
-        st["leaf_stats"] = st["leaf_stats"].at[ch_ids].set(
-            ch_stats, mode="drop")
-        st["leaf_depth"] = st["leaf_depth"].at[ch_ids].set(
-            ch_depth, mode="drop")
+        mono_vals = (ch_mn, ch_mx, l_min, l_max, r_min, r_max) \
+            if has_mono else None
+        recs = (("rec_leaf", ids), ("rec_feature", feat_w),
+                ("rec_threshold", thr_w), ("rec_default_left", dl_w),
+                ("rec_is_cat", cat_w), ("rec_gain", topg),
+                ("rec_left_stats", lstat_w),
+                ("rec_right_stats", rstat_w),
+                ("rec_left_mask", mask_w), ("rec_valid", valid_w))
+        return commit_wave(st, ids_leaf, new_leaf, ids_rec, bests,
+                           ch_stats, ch_depth, recs, valid_w, mono_vals)
+
+    # ---- coarse-to-fine wave ----------------------------------------
+    # One loop step = one COARSE pass (both children of the top-W
+    # splits, built directly — no subtraction, no pool) + one WINDOWED
+    # refine pass, then the c2f split search per child.  W is half the
+    # lane budget because both children occupy lanes.
+    def wave_body_c2f(st):
+        W = W_spec // 2
+        W2 = 2 * W
+        t0 = st["n_leaves"] - 1
+        remaining = (L - 1) - t0
+        topg, ids = jax.lax.top_k(st["best_gain"], W)
+        w_ar = jnp.arange(W, dtype=jnp.int32)
+        valid_w = (topg > 0) & (w_ar < remaining)
+        ids_leaf = jnp.where(valid_w, ids, L)
+        t_j = t0 + w_ar
+        ids_rec = jnp.where(valid_w, t_j, L - 1)
+        new_ids = t_j + 1
+        new_leaf = jnp.where(valid_w, new_ids, L)
+
+        feat_w = st["best_feature"][ids]
+        thr_w = st["best_threshold"][ids]
+        dl_w = st["best_default_left"][ids]
+        cat_w = st["best_is_cat"][ids]
+        mask_w = st["best_left_mask"][ids]
+        lstat_w = st["best_left_stats"][ids]
+        pstat_w = st["leaf_stats"][ids]
+        rstat_w = pstat_w - lstat_w
+
+        # gather-free routing (see wave_body); the c2f gate guarantees
+        # numerical-only splits, so goes-left is a threshold compare
+        li = st["leaf_idx"]
+        w_row = jnp.full(N, -1, jnp.int32)
+        for w in range(W):
+            w_row = jnp.where(li == ids_leaf[w], jnp.int32(w), w_row)
+        in_wave = w_row >= 0
+        csel = jnp.zeros(N, jnp.int32)
+        thr_row = jnp.zeros(N, jnp.int32)
+        new_id_row = jnp.zeros(N, jnp.int32)
+        for w in range(W):
+            lane = w_row == w
+            csel = jnp.where(lane, feat_w[w], csel)
+            thr_row = jnp.where(lane, thr_w[w], thr_row)
+            new_id_row = jnp.where(lane, new_ids[w], new_id_row)
+        col = jnp.zeros(N, jnp.int32)
+        for g in range(G_cols):
+            col = jnp.where(csel == g, xt[g].astype(jnp.int32), col)
+        goes_left = in_wave & (col <= thr_row)
+
+        # child subsets: left child of lane w -> slot w, right -> W + w
+        sel = jnp.where(in_wave,
+                        w_row + W * (~goes_left).astype(jnp.int32),
+                        jnp.int32(-1))
+        coarse = multi_hist_coarse(sel)[:W2]     # (2W, F, Bc, 3)
+
+        leaf_idx = jnp.where(in_wave & ~goes_left, new_id_row, li)
+
+        ch_stats = jnp.concatenate([lstat_w, rstat_w], axis=0)  # (2W, 3)
+        depth_w = st["leaf_depth"][ids] + 1
+        ch_depth = jnp.concatenate([depth_w, depth_w])
         if has_mono:
-            st["leaf_min"] = st["leaf_min"].at[ch_ids].set(
-                ch_mn, mode="drop")
-            st["leaf_max"] = st["leaf_max"].at[ch_ids].set(
-                ch_mx, mode="drop")
-            st["rec_left_min"] = st["rec_left_min"].at[ids_rec].set(
-                l_min, mode="drop")
-            st["rec_left_max"] = st["rec_left_max"].at[ids_rec].set(
-                l_max, mode="drop")
-            st["rec_right_min"] = st["rec_right_min"].at[ids_rec].set(
-                r_min, mode="drop")
-            st["rec_right_max"] = st["rec_right_max"].at[ids_rec].set(
-                r_max, mode="drop")
-        for key, src in (("best_gain", "gain"),
-                         ("best_feature", "feature"),
-                         ("best_threshold", "threshold"),
-                         ("best_default_left", "default_left"),
-                         ("best_is_cat", "is_cat"),
-                         ("best_left_mask", "left_mask"),
-                         ("best_left_stats", "left_stats")):
-            arr = st[key]
-            st[key] = arr.at[ch_ids].set(bests[src].astype(arr.dtype),
-                                         mode="drop")
-        for key, val in (("rec_leaf", ids), ("rec_feature", feat_w),
-                         ("rec_threshold", thr_w),
-                         ("rec_default_left", dl_w),
-                         ("rec_is_cat", cat_w), ("rec_gain", topg),
-                         ("rec_left_stats", lstat_w),
-                         ("rec_right_stats", rstat_w),
-                         ("rec_left_mask", mask_w),
-                         ("rec_valid", valid_w)):
-            st[key] = st[key].at[ids_rec].set(
-                val.astype(st[key].dtype), mode="drop")
-        st["n_leaves"] = st["n_leaves"] + \
-            jnp.sum(valid_w.astype(jnp.int32))
-        st["n_arm_passes"] = st["n_arm_passes"] + 1
+            l_min, l_max, r_min, r_max = child_bounds(
+                lstat_w, rstat_w, st["leaf_min"][ids],
+                st["leaf_max"][ids], feat_w, cat_w)
+            ch_mn = jnp.concatenate([l_min, r_min])
+            ch_mx = jnp.concatenate([l_max, r_max])
+            win_lo = jax.vmap(c2f_window)(coarse, ch_stats, ch_mn, ch_mx)
+        else:
+            win_lo = jax.vmap(
+                lambda c, s: c2f_window(c, s, None, None))(
+                    coarse, ch_stats)            # (2W, F)
+        lo_all = jnp.zeros((W_spec, F_hist), jnp.int32).at[:W2].set(
+            win_lo)
+        winh = multi_hist_win(sel, lo_all)[:W2]  # (2W, F, R, 3)
+
+        if has_mono:
+            bests = jax.vmap(c2f_best)(coarse, winh, win_lo, ch_stats,
+                                       ch_mn, ch_mx)
+        else:
+            bests = jax.vmap(
+                lambda c, wh, lo, s: c2f_best(c, wh, lo, s, None, None))(
+                    coarse, winh, win_lo, ch_stats)
+        allowed = (p.max_depth <= 0) | (ch_depth < p.max_depth)
+        bests["gain"] = jnp.where(allowed, bests["gain"], NEG_INF)
+        # same materialization fence as wave_body
+        bests = jax.lax.optimization_barrier(bests)
+        import os as _os
+        if _os.environ.get("LTPU_DEBUG_GROW"):
+            st = dict(st)
+            st["dbg_bests_left_stats"] = bests["left_stats"]
+            st["dbg_bests_dl"] = bests["default_left"]
+
+        st = dict(st)
+        st["leaf_idx"] = leaf_idx
+        mono_vals = (ch_mn, ch_mx, l_min, l_max, r_min, r_max) \
+            if has_mono else None
+        recs = (("rec_leaf", ids), ("rec_feature", feat_w),
+                ("rec_threshold", thr_w), ("rec_default_left", dl_w),
+                ("rec_is_cat", cat_w), ("rec_gain", topg),
+                ("rec_left_stats", lstat_w),
+                ("rec_right_stats", rstat_w),
+                ("rec_left_mask", mask_w), ("rec_valid", valid_w))
+        st = commit_wave(st, ids_leaf, new_leaf, ids_rec, bests,
+                         ch_stats, ch_depth, recs, valid_w, mono_vals)
+        st["n_arm_passes"] = st["n_arm_passes"] + 1  # coarse + refine
         return st
 
     if use_wave:
         import os as _os
         if _os.environ.get("LTPU_DEBUG_GROW"):
-            state["dbg_bests_left_stats"] = jnp.zeros((2 * W_spec, 3),
+            n_dbg = 2 * (W_spec // 2) if use_c2f else 2 * W_spec
+            state["dbg_bests_left_stats"] = jnp.zeros((n_dbg, 3),
                                                       jnp.float32)
-            state["dbg_bests_dl"] = jnp.zeros(2 * W_spec, bool)
-        state = jax.lax.while_loop(wave_cond, wave_body, state)
+            state["dbg_bests_dl"] = jnp.zeros(n_dbg, bool)
+        state = jax.lax.while_loop(
+            wave_cond, wave_body_c2f if use_c2f else wave_body, state)
     else:
         state = jax.lax.fori_loop(0, L - 1, body, state)
 

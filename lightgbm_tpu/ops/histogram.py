@@ -42,6 +42,7 @@ import jax.numpy as jnp
 
 __all__ = ["histogram", "histogram_segsum", "histogram_pallas",
            "histogram_segsum_multi", "histogram_pallas_multi",
+           "histogram_segsum_multi_win", "histogram_pallas_multi_win",
            "multi_width"]
 
 
@@ -68,9 +69,10 @@ def histogram_segsum(bins_t: jax.Array, vals: jax.Array, max_bin: int
 
 
 def _pad_bins(max_bin: int) -> int:
-    # multiple of 16 keeps FC*B a multiple of 128 for FC ∈ {8,16,32};
-    # padded bins hold no rows and are sliced off on exit
-    return (max_bin + 15) // 16 * 16
+    # multiple of 8: the tiler below only accepts (fc, b_pad) pairs with
+    # fc*b_pad on the 128-lane grid, so 8-bin coarse histograms pair with
+    # fc=16/32 chunks; padded bins hold no rows and are sliced off on exit
+    return (max_bin + 7) // 8 * 8
 
 
 def _tile(b_pad: int, f: int, cols: int, rows_per_block: int
@@ -108,11 +110,15 @@ def _tile(b_pad: int, f: int, cols: int, rows_per_block: int
                 break  # largest feasible t for this fc
         if best is not None:
             return f_pad, best[2], best[1]
-    # fallback: classic 8-feature chunks, smallest tile
-    f_pad = (f + 7) // 8 * 8
+    # fallback: smallest legal chunk — fc*b_pad on the 128-lane grid
+    # AND fc on the 8-sublane grid (lcm of both constraints)
+    import math
+    fc = 128 // math.gcd(b_pad, 128)
+    fc = fc * 8 // math.gcd(fc, 8)
+    f_pad = (f + fc - 1) // fc * fc
     if rows_per_block % 256 == 0:
-        return f_pad, 8, 256
-    return f_pad, 8, rows_per_block
+        return f_pad, fc, 256
+    return f_pad, fc, rows_per_block
 
 
 def _compiler_params():
@@ -235,7 +241,8 @@ def histogram(bins_t: jax.Array, vals: jax.Array, max_bin: int,
 
 
 def _hist_kernel_multi(x_ref, v_ref, s_ref, out_ref, *, b_pad: int,
-                       width: int, exact: bool, two_col: bool = False):
+                       width: int, exact: bool, two_col: bool = False,
+                       shift: int = 0):
     """Multi-leaf variant: one pass accumulates histograms for up to
     ``width`` row-disjoint subsets (the speculative child-arming pass).
 
@@ -256,6 +263,10 @@ def _hist_kernel_multi(x_ref, v_ref, s_ref, out_ref, *, b_pad: int,
 
     FC, T = x_ref.shape
     x = x_ref[...].astype(jnp.int32)
+    if shift:
+        # coarse pass: bins collapsed 2^shift-to-1 on the fly — the
+        # coarse-to-fine first stage streams b_pad/2^shift one-hot rows
+        x = x >> shift
     v = v_ref[...]                      # (3, T)
     sel = s_ref[...]                    # (1, T)
     if two_col:
@@ -280,12 +291,13 @@ def _hist_kernel_multi(x_ref, v_ref, s_ref, out_ref, *, b_pad: int,
 
 @functools.partial(jax.jit, static_argnames=("max_bin", "width",
                                              "rows_per_block", "exact",
-                                             "two_col"))
+                                             "two_col", "shift"))
 def histogram_pallas_multi(bins_t: jax.Array, vals: jax.Array,
                            sel: jax.Array, max_bin: int, width: int,
                            rows_per_block: int = 1024,
                            exact: bool = False,
-                           two_col: bool = False) -> jax.Array:
+                           two_col: bool = False,
+                           shift: int = 0) -> jax.Array:
     """Batched histogram over ``width`` disjoint row subsets.
 
     bins_t (F, N) ints; vals (N, 3) f32; sel (N,) int32 subset id per
@@ -293,6 +305,10 @@ def histogram_pallas_multi(bins_t: jax.Array, vals: jax.Array,
     only grad/hess are accumulated (64 leaves per pass) and the count
     channel is a COPY of the hess channel — callers must run under the
     gate that makes counts redundant (see GrowParams.two_col).
+
+    With ``shift`` > 0 the stored fine bins are collapsed ``2^shift``-
+    to-1 in the kernel (coarse-to-fine first stage); ``max_bin`` is
+    then the COARSE bin count.
     """
     import jax.experimental.pallas as pl
 
@@ -311,7 +327,7 @@ def histogram_pallas_multi(bins_t: jax.Array, vals: jax.Array,
 
     out = pl.pallas_call(
         functools.partial(_hist_kernel_multi, b_pad=b_pad, width=W,
-                          exact=exact, two_col=two_col),
+                          exact=exact, two_col=two_col, shift=shift),
         grid=(f_pad // fc, n // t),
         in_specs=[
             pl.BlockSpec((fc, t), lambda j, i: (j, i)),
@@ -335,13 +351,152 @@ def histogram_pallas_multi(bins_t: jax.Array, vals: jax.Array,
 
 def histogram_segsum_multi(bins_t: jax.Array, vals: jax.Array,
                            sel: jax.Array, max_bin: int, width: int,
-                           two_col: bool = False) -> jax.Array:
+                           two_col: bool = False,
+                           shift: int = 0) -> jax.Array:
     """jnp reference for :func:`histogram_pallas_multi` (CPU/tests)."""
     f, n = bins_t.shape
+    if shift:
+        bins_t = bins_t.astype(jnp.int32) >> shift
     outs = []
     for w in range(width):
         m = (sel == w).astype(vals.dtype)[:, None]
         outs.append(histogram_segsum(bins_t, vals * m, max_bin))
+    out = jnp.stack(outs)
+    if two_col:
+        out = jnp.concatenate([out[..., :2], out[..., 1:2]], axis=-1)
+    return out
+
+
+# ---- coarse-to-fine refine stage -----------------------------------
+#
+# The multi-leaf pass is MXU-stream bound: cost ∝ f_pad·b_pad·N
+# regardless of output width, so at 255 bins nearly the whole stream is
+# zeros.  The coarse-to-fine scheme replaces one full-resolution pass
+# with (a) a coarse pass (``shift`` above, b_pad/2^shift one-hot rows)
+# and (b) THIS windowed pass: per (leaf, feature) only a 2-coarse-bin
+# window of R fine bins around the best coarse boundary is resolved,
+# streaming R ≪ b_pad one-hot rows.  The per-row window start
+# ``win_lo[leaf, feature]`` would be an (N,)-element gather (measured
+# 60-90 ms at bench shape — poison); instead the kernel resolves it as
+# a tiny (FC, W) × (W, T) matmul against the already-built subset
+# one-hot — ~3% of the pass FLOPs, on the MXU.
+
+
+def _hist_kernel_multi_win(x_ref, v_ref, s_ref, lo_ref, out_ref, *,
+                           r_pad: int, width: int, exact: bool,
+                           two_col: bool):
+    """Windowed refine step: accumulate (leaf, feature)-windowed fine
+    histograms.  x_ref (FC, T) bins; v_ref (3, T); s_ref (1, T) subset
+    selector in [-1, width); lo_ref (width, FC) per-(subset, feature)
+    fine-bin window starts; out_ref (FC*R, 128)."""
+    import jax.experimental.pallas as pl
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    FC, T = x_ref.shape
+    x = x_ref[...].astype(jnp.int32)
+    v = v_ref[...]                      # (3, T)
+    sel = s_ref[...]                    # (1, T)
+    if two_col:
+        cols = 2
+        valsc = v[:2]
+    else:
+        cols = 3 if exact else 6
+        valsc = v if exact else _split_hi_lo(v)
+    sel_oh = (sel == jax.lax.broadcasted_iota(
+        jnp.int32, (width, T), 0)).astype(jnp.float32)  # (W, T)
+    # per-row window start: lo[sel[t], f] via MXU instead of a gather
+    lo = lo_ref[...].astype(jnp.float32)                # (W, FC)
+    lo_pr = jax.lax.dot_general(
+        lo.T, sel_oh, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)             # (FC, T)
+    rbin = x - lo_pr.astype(jnp.int32)
+    rhs = (sel_oh[:, None, :] * valsc[None, :, :]).reshape(
+        width * cols, T).astype(jnp.bfloat16)
+    rhs = jnp.pad(rhs, ((0, 128 - width * cols), (0, 0)))
+    # out-of-window rows (rbin outside [0, r_pad)) match no iota column
+    onehot = (rbin[:, None, :] ==
+              jax.lax.broadcasted_iota(jnp.int32, (FC, r_pad, T), 1)
+              ).astype(jnp.bfloat16)
+    acc = jax.lax.dot_general(
+        onehot.reshape(FC * r_pad, T), rhs.T, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    out_ref[...] += acc
+
+
+@functools.partial(jax.jit, static_argnames=("r_bins", "width",
+                                             "rows_per_block", "exact",
+                                             "two_col"))
+def histogram_pallas_multi_win(bins_t: jax.Array, vals: jax.Array,
+                               sel: jax.Array, win_lo: jax.Array,
+                               r_bins: int, width: int,
+                               rows_per_block: int = 1024,
+                               exact: bool = False,
+                               two_col: bool = False) -> jax.Array:
+    """Windowed multi-subset histogram: per (subset, feature) only the
+    fine bins in [win_lo, win_lo + r_bins) are accumulated, at relative
+    positions.  win_lo (width, F) int32.  Returns (width, F, R, 3)."""
+    import jax.experimental.pallas as pl
+
+    f, n = bins_t.shape
+    r_pad = _pad_bins(r_bins)
+    cols = 2 if two_col else (3 if exact else 6)
+    W = width
+    assert W * cols <= 128, (W, cols)
+    f_pad, fc, t = _tile(r_pad, f, 128, rows_per_block)
+    assert n % t == 0, (n, t)
+    xt = bins_t
+    if f_pad != f:
+        xt = jnp.pad(xt, ((0, f_pad - f), (0, 0)))
+    vt = vals.astype(jnp.float32).T          # (3, N)
+    st = sel.astype(jnp.int32)[None, :]      # (1, N)
+    lo = win_lo.astype(jnp.int32)
+    if f_pad != f:
+        lo = jnp.pad(lo, ((0, 0), (0, f_pad - f)))
+
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel_multi_win, r_pad=r_pad, width=W,
+                          exact=exact, two_col=two_col),
+        grid=(f_pad // fc, n // t),
+        in_specs=[
+            pl.BlockSpec((fc, t), lambda j, i: (j, i)),
+            pl.BlockSpec((3, t), lambda j, i: (0, i)),
+            pl.BlockSpec((1, t), lambda j, i: (0, i)),
+            pl.BlockSpec((W, fc), lambda j, i: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((fc * r_pad, 128), lambda j, i: (j, 0)),
+        out_shape=jax.ShapeDtypeStruct((f_pad * r_pad, 128),
+                                       jnp.float32),
+        compiler_params=_compiler_params(),
+    )(xt, vt, st, lo)
+    out = out[:, :cols * W].reshape(f_pad, r_pad, W, cols)
+    if two_col:
+        out = jnp.concatenate([out, out[..., 1:2]], axis=-1)
+    elif not exact:
+        out = out[..., :3] + out[..., 3:]
+    return jnp.moveaxis(out[:f, :r_bins], 2, 0)    # (W, F, R, 3)
+
+
+def histogram_segsum_multi_win(bins_t: jax.Array, vals: jax.Array,
+                               sel: jax.Array, win_lo: jax.Array,
+                               r_bins: int, width: int,
+                               two_col: bool = False) -> jax.Array:
+    """jnp reference for :func:`histogram_pallas_multi_win`."""
+    f, n = bins_t.shape
+    x = bins_t.astype(jnp.int32)
+    outs = []
+    for w in range(width):
+        rbin = x - win_lo[w][:, None]                  # (F, N)
+        in_win = (rbin >= 0) & (rbin < r_bins)
+        m = (sel == w)[None, :] & in_win
+        ids = jnp.where(m, rbin, r_bins) + \
+            jnp.arange(f, dtype=jnp.int32)[:, None] * (r_bins + 1)
+        flat = jax.ops.segment_sum(
+            jnp.broadcast_to(vals[None, :, :], (f, n, 3)).reshape(-1, 3),
+            ids.reshape(-1), num_segments=f * (r_bins + 1))
+        outs.append(flat.reshape(f, r_bins + 1, 3)[:, :r_bins])
     out = jnp.stack(outs)
     if two_col:
         out = jnp.concatenate([out[..., :2], out[..., 1:2]], axis=-1)

@@ -352,6 +352,129 @@ def find_best_split(hist: jax.Array, parent: jax.Array,
     }
 
 
+# ---- coarse-to-fine split search -----------------------------------
+#
+# The histogram pass cost is ∝ padded-bin-count (see ops/histogram.py),
+# so the split search can run on (a) a COARSE histogram (fine bins
+# collapsed 2^shift-to-1) plus (b) a narrow fine WINDOW of r_bins
+# around the most promising coarse boundary.  Candidate thresholds are
+# the coarse boundaries (exact: a coarse boundary IS a fine threshold)
+# plus every fine threshold inside the window (exact: coarse prefix at
+# the window start + fine prefix within).  The search is exact whenever
+# the best fine threshold falls inside the chosen window; the window
+# heuristic (2 coarse bins straddling the best coarse boundary) is
+# validated empirically in tests/test_c2f.py and by the bench AUC
+# anchor.  Numerical features without missing values only — the driver
+# gates it (models/gbdt.py).
+
+
+def _c2f_coarse_scan(coarse: jax.Array, parent: jax.Array,
+                     num_bins: jax.Array, params: SplitParams,
+                     shift: int, monotone=None, min_output=None,
+                     max_output=None):
+    """Gains at the coarse boundaries.  coarse (F, Bc, 3) dequantized;
+    returns (gains (F, Bc), L (F, Bc, 3), thr_fine (Bc,))."""
+    p = params
+    F, Bc, _ = coarse.shape
+    l1, l2, mds = p.lambda_l1, p.lambda_l2, p.max_delta_step
+    parent_gain = leaf_gain(parent[0], parent[1], l1, l2, mds)
+    gain_shift = parent_gain + p.min_gain_to_split
+    cum = jnp.cumsum(coarse, axis=1)                  # (F, Bc, 3)
+    thr_fine = ((jnp.arange(Bc, dtype=jnp.int32) + 1) << shift) - 1
+    ok = thr_fine[None, :] <= num_bins[:, None] - 2
+    L = cum
+    R = parent[None, None, :] - L
+    mono_col = None if monotone is None else monotone[:, None]
+    g = (_split_gain(L[..., 0], L[..., 1] + EPS,
+                     R[..., 0], R[..., 1] + EPS, l1, l2, mds,
+                     min_output, max_output, mono_col) - gain_shift)
+    ok = ok & _constraints(L, R, p)
+    return jnp.where(ok, g, NEG_INF), L, thr_fine
+
+
+def choose_window(coarse: jax.Array, parent: jax.Array,
+                  num_bins: jax.Array, params: SplitParams, shift: int,
+                  monotone=None, min_output=None, max_output=None
+                  ) -> jax.Array:
+    """Pick the per-feature refine window start (fine-bin id, coarse-
+    aligned): the 2 coarse bins straddling the best coarse boundary."""
+    g, _, _ = _c2f_coarse_scan(coarse, parent, num_bins, params, shift,
+                               monotone, min_output, max_output)
+    Bc = coarse.shape[1]
+    c_star = jnp.argmax(g, axis=1).astype(jnp.int32)        # (F,)
+    win_c = jnp.clip(c_star, 0, max(Bc - 2, 0))
+    return win_c << shift
+
+
+@functools.partial(jax.jit, static_argnames=("params", "shift"))
+def find_best_split_c2f(coarse: jax.Array, win: jax.Array,
+                        win_lo: jax.Array, parent: jax.Array,
+                        num_bins: jax.Array, feature_mask: jax.Array,
+                        params: SplitParams, shift: int, monotone=None,
+                        penalty=None, min_output=None, max_output=None):
+    """Best split from a coarse histogram + fine refine window.
+
+    coarse (F, Bc, 3); win (F, R, 3) fine bins at positions
+    [win_lo, win_lo + R); win_lo (F,) int32 coarse-aligned; parent (3,).
+    Same record contract as :func:`find_best_split`, numerical splits
+    without missing values only (default_left always False).
+    """
+    p = params
+    F, Bc, _ = coarse.shape
+    R_w = win.shape[1]
+    B = p.max_bin
+    l1, l2, mds = p.lambda_l1, p.lambda_l2, p.max_delta_step
+    mn, mx = min_output, max_output
+    g_c, L_c, thr_c = _c2f_coarse_scan(coarse, parent, num_bins, p,
+                                       shift, monotone, mn, mx)
+    parent_gain = leaf_gain(parent[0], parent[1], l1, l2, mds)
+    gain_shift = parent_gain + p.min_gain_to_split
+
+    # fine candidates: exact prefix = coarse prefix before the window
+    # (win_lo is coarse-aligned) + fine prefix within the window
+    cum_c = jnp.cumsum(coarse, axis=1)
+    cpad = jnp.concatenate([jnp.zeros((F, 1, 3), coarse.dtype), cum_c],
+                           axis=1)
+    win_c0 = (win_lo >> shift).astype(jnp.int32)
+    base = jnp.take_along_axis(cpad, win_c0[:, None, None],
+                               axis=1)                   # (F, 1, 3)
+    L_f = base + jnp.cumsum(win, axis=1)                 # (F, R, 3)
+    thr_f = win_lo[:, None] + jnp.arange(R_w, dtype=jnp.int32)[None, :]
+    ok_f = thr_f <= num_bins[:, None] - 2
+    R_side = parent[None, None, :] - L_f
+    mono_col = None if monotone is None else monotone[:, None]
+    g_f = (_split_gain(L_f[..., 0], L_f[..., 1] + EPS,
+                       R_side[..., 0], R_side[..., 1] + EPS, l1, l2, mds,
+                       mn, mx, mono_col) - gain_shift)
+    g_f = jnp.where(ok_f & _constraints(L_f, R_side, p), g_f, NEG_INF)
+
+    all_gain = jnp.concatenate([g_c, g_f], axis=1)       # (F, Bc+R)
+    all_thr = jnp.concatenate(
+        [jnp.broadcast_to(thr_c[None, :], (F, Bc)), thr_f], axis=1)
+    all_L = jnp.concatenate([L_c, L_f], axis=1)
+    if penalty is not None:
+        all_gain = jnp.where(all_gain > 0.5 * NEG_INF,
+                             all_gain * penalty[:, None], all_gain)
+    all_gain = jnp.where(feature_mask[:, None], all_gain, NEG_INF)
+    best_per_f = jnp.max(all_gain, axis=1)
+    best_k = jnp.argmax(all_gain, axis=1).astype(jnp.int32)
+    f_star = jnp.argmax(best_per_f).astype(jnp.int32)
+    k_star = best_k[f_star]
+    j_star = all_thr[f_star, k_star]
+    jidx = jnp.arange(B, dtype=jnp.int32)
+    left_mask = (jidx <= j_star) & (jidx < num_bins[f_star])
+    return {
+        "gain": best_per_f[f_star],
+        "feature": f_star,
+        "threshold": j_star,
+        "default_left": jnp.asarray(False),
+        "is_cat": jnp.asarray(False),
+        "left_mask": left_mask,
+        "left_stats": all_L[f_star, k_star],
+        "per_feature_gain": best_per_f,
+    }
+
+
 def eval_forced_split(hist: jax.Array, parent: jax.Array, feat, thr,
                       num_bins: jax.Array, missing_type: jax.Array,
                       params: SplitParams, monotone=None,

@@ -1,13 +1,14 @@
 /*
  * SWIG interface for the lightgbm_tpu C API — capability parity with
  * the reference's swig/lightgbmlib.i (re-exports the whole C API to
- * Java plus pointer/array helpers).
+ * Java plus the pointer/array helper surface, lightgbmlib.i:17-107).
  *
  * Generate (Java):
  *   swig -java -package io.ltpu -outdir java_out swig/ltpu.i
  * then compile the generated wrapper against libltpu_capi.so.
  */
 %module ltpulib
+%ignore LGBM_BoosterSaveModelToString;
 
 %{
 #include "../cpp/ltpu_c_api.h"
@@ -17,7 +18,30 @@
 %include "carrays.i"
 %include "cpointer.i"
 
-/* array/pointer helpers mirroring lightgbmlib.i:17-30 */
+/* JNI-friendly model serialization: returns the buffer instead of
+ * filling a caller-owned char*, which plain SWIG cannot marshal.
+ * %newobject makes the wrapper free the buffer after copying it into
+ * the jstring — without it every call leaks buffer_len bytes */
+%newobject LGBM_BoosterSaveModelToStringSWIG;
+%typemap(newfree) char * "delete[] $1;";
+%inline %{
+  char * LGBM_BoosterSaveModelToStringSWIG(BoosterHandle handle,
+                                           int start_iteration,
+                                           int num_iteration,
+                                           int64_t buffer_len,
+                                           int64_t* out_len) {
+    char* buf = new char[buffer_len];
+    if (LGBM_BoosterSaveModelToString(handle, start_iteration,
+                                      num_iteration, buffer_len,
+                                      out_len, buf) != 0) {
+      delete[] buf;
+      return nullptr;
+    }
+    return buf;
+  }
+%}
+
+/* array/pointer helpers */
 %array_functions(double, doubleArray)
 %array_functions(float, floatArray)
 %array_functions(int, intArray)
@@ -27,6 +51,50 @@
 %pointer_functions(double, doublep)
 %pointer_functions(float, floatp)
 %pointer_functions(int64_t, int64_tp)
-%pointer_functions(void*, voidpp)
+%pointer_functions(int32_t, int32_tp)
+
+/* pointer casts between the JNI-visible and C-API integer/real types */
+%pointer_cast(int64_t *, long *, int64_t_to_long_ptr)
+%pointer_cast(int64_t *, double *, int64_t_to_double_ptr)
+%pointer_cast(int32_t *, int *, int32_t_to_int_ptr)
+%pointer_cast(long *, int64_t *, long_to_int64_t_ptr)
+%pointer_cast(double *, int64_t *, double_to_int64_t_ptr)
+%pointer_cast(double *, void *, double_to_voidp_ptr)
+%pointer_cast(int *, int32_t *, int_to_int32_t_ptr)
+%pointer_cast(float *, void *, float_to_voidp_ptr)
+
+/* opaque-handle (void**) allocation, dereference and handle-slot
+ * creation — the Java side needs these to receive Dataset/Booster
+ * handles from the out-parameter C API */
+%define %handle_alloc(TYPE, NAME)
+%{
+  static TYPE *new_##NAME() { TYPE *p = new TYPE; return p; }
+  static void delete_##NAME(TYPE *p) { if (p) delete p; }
+%}
+TYPE *new_##NAME();
+void delete_##NAME(TYPE *p);
+%enddef
+
+%define %handle_deref(TYPE, NAME)
+%{
+  static TYPE NAME##_value(TYPE *p) { return *p; }
+%}
+TYPE NAME##_value(TYPE *p);
+%enddef
+
+%define %handle_slot(TYPE, NAME)
+%{
+  static TYPE *NAME##_handle() {
+    TYPE *p = new TYPE;
+    *p = (TYPE)operator new(sizeof(int*));
+    return p;
+  }
+%}
+TYPE *NAME##_handle();
+%enddef
+
+%handle_alloc(void*, voidpp)
+%handle_deref(void*, voidpp)
+%handle_slot(void*, voidpp)
 
 %include "../cpp/ltpu_c_api.h"

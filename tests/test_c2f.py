@@ -1,0 +1,207 @@
+"""Coarse-to-fine histogram refinement (hist_refinement).
+
+The c2f wave replaces each full-resolution histogram pass with a
+coarse pass + a narrow windowed refine pass (ops/histogram.py), and the
+split search scans coarse boundaries + in-window fine thresholds
+(ops/split.py:find_best_split_c2f).  Tests pin:
+
+- the windowed segsum oracle against a brute-force histogram,
+- the c2f search against the full-resolution search (never better,
+  exact whenever the best threshold falls in the window, and always at
+  least the best coarse boundary),
+- end-to-end tree self-consistency and quality vs the full-resolution
+  wave.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.ops.grow import GrowParams, build_tree
+from lightgbm_tpu.ops.histogram import (histogram_segsum_multi,
+                                        histogram_segsum_multi_win)
+from lightgbm_tpu.ops.split import (choose_window, find_best_split,
+                                    find_best_split_c2f, SplitParams)
+
+
+def test_windowed_segsum_oracle():
+    rng = np.random.RandomState(0)
+    F, N, W, R = 4, 512, 3, 8
+    bins = rng.randint(0, 29, size=(F, N)).astype(np.int32)
+    vals = rng.randn(N, 3).astype(np.float32)
+    sel = rng.randint(-1, W, size=N).astype(np.int32)
+    lo = rng.randint(0, 22, size=(W, F)).astype(np.int32)
+    out = np.asarray(histogram_segsum_multi_win(
+        jnp.asarray(bins), jnp.asarray(vals), jnp.asarray(sel),
+        jnp.asarray(lo), R, W))
+    ref = np.zeros((W, F, R, 3), np.float32)
+    for n in range(N):
+        if sel[n] < 0:
+            continue
+        for f in range(F):
+            r = bins[f, n] - lo[sel[n], f]
+            if 0 <= r < R:
+                ref[sel[n], f, r] += vals[n]
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)
+
+
+def test_coarse_shift_segsum():
+    rng = np.random.RandomState(1)
+    F, N, W = 3, 256, 2
+    bins = rng.randint(0, 63, size=(F, N)).astype(np.int32)
+    vals = rng.randn(N, 3).astype(np.float32)
+    sel = rng.randint(-1, W, size=N).astype(np.int32)
+    out = np.asarray(histogram_segsum_multi(
+        jnp.asarray(bins), jnp.asarray(vals), jnp.asarray(sel), 8, W,
+        shift=3))
+    ref = np.asarray(histogram_segsum_multi(
+        jnp.asarray(bins >> 3), jnp.asarray(vals), jnp.asarray(sel),
+        8, W))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)
+
+
+def _leaf_case(seed, B=63, F=6, N=4096):
+    rng = np.random.RandomState(seed)
+    bins = rng.randint(0, B, size=(F, N)).astype(np.int32)
+    # a planted signal so gains aren't pure noise
+    y = (bins[0] > rng.randint(10, 50)).astype(np.float32) + \
+        0.2 * rng.randn(N).astype(np.float32)
+    grad = (0.5 - y).astype(np.float32)
+    hess = np.ones(N, np.float32)
+    vals = np.stack([grad, hess, np.ones(N, np.float32)], -1)
+    return bins, vals
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_c2f_vs_full_single_leaf(seed):
+    B, F, shift = 63, 6, 3
+    R = 2 << shift
+    bins, vals = _leaf_case(seed, B=B, F=F)
+    sp = SplitParams(max_bin=B, min_data_in_leaf=5, any_cat=False,
+                     any_missing=False)
+    nb = jnp.full(F, B, jnp.int32)
+    fm = jnp.ones(F, bool)
+    hist = histogram_segsum_multi(jnp.asarray(bins), jnp.asarray(vals),
+                                  jnp.zeros(bins.shape[1], jnp.int32),
+                                  B, 1)[0]
+    parent = jnp.sum(hist[0], axis=0)
+    full = find_best_split(hist, parent, nb,
+                           jnp.zeros(F, jnp.int32), jnp.zeros(F, bool),
+                           fm, sp)
+    coarse = histogram_segsum_multi(
+        jnp.asarray(bins), jnp.asarray(vals),
+        jnp.zeros(bins.shape[1], jnp.int32), ((B - 1) >> shift) + 1, 1,
+        shift=shift)[0]
+    lo = choose_window(coarse, parent, nb, sp, shift)
+    win = histogram_segsum_multi_win(
+        jnp.asarray(bins), jnp.asarray(vals),
+        jnp.zeros(bins.shape[1], jnp.int32), lo[None, :], R, 1)[0]
+    c2f = find_best_split_c2f(coarse, win, lo, parent, nb, fm, sp, shift)
+    g_full, g_c2f = float(full["gain"]), float(c2f["gain"])
+    # c2f scans a subset of candidates: never better than full
+    assert g_c2f <= g_full + 1e-3 * abs(g_full) + 1e-4
+    thr_full = int(full["threshold"])
+    f_full = int(full["feature"])
+    in_win = int(lo[f_full]) <= thr_full < int(lo[f_full]) + R
+    on_boundary = (thr_full + 1) % (1 << shift) == 0
+    if in_win or on_boundary:
+        # the best fine threshold was scanned -> exact agreement
+        assert g_c2f >= g_full - 1e-3 * abs(g_full) - 1e-4
+        assert int(c2f["threshold"]) == thr_full
+        assert int(c2f["feature"]) == f_full
+        np.testing.assert_allclose(np.asarray(c2f["left_stats"]),
+                                   np.asarray(full["left_stats"]),
+                                   rtol=1e-4, atol=1e-3)
+
+
+def _tree_data(seed=3, N=8192, F=6, B=63):
+    rng = np.random.RandomState(seed)
+    bins = rng.randint(0, B, size=(F, N)).astype(np.int32)
+    logit = (bins[0] / B - 0.5) + 0.7 * (bins[1] > 40) - \
+        0.4 * (bins[2] < 9)
+    y = (rng.random_sample(N) < 1 / (1 + np.exp(-3 * logit))
+         ).astype(np.float32)
+    p0 = y.mean()
+    grad = (p0 - y).astype(np.float32)
+    hess = np.full(N, p0 * (1 - p0), np.float32)
+    return bins, grad, hess
+
+
+@pytest.mark.parametrize("L,W", [(16, 8), (31, 20)])
+def test_c2f_tree_self_consistent(L, W):
+    bins, grad, hess = _tree_data()
+    F, N = bins.shape
+    B = 63
+    p = GrowParams(split=SplitParams(max_bin=B, min_data_in_leaf=5,
+                                     any_cat=False, any_missing=False),
+                   num_leaves=L, hist_impl="segsum", wave=True,
+                   speculate=W, refine_shift=3)
+    rec = build_tree(jnp.asarray(bins), jnp.asarray(grad),
+                     jnp.asarray(hess), jnp.ones(N, jnp.float32),
+                     jnp.ones(F, bool), jnp.full(F, B, jnp.int32),
+                     jnp.zeros(F, jnp.int32), jnp.zeros(F, bool), p)
+    li = np.asarray(rec["leaf_idx"])
+    ls = np.asarray(rec["leaf_stats"])
+    nl = int(rec["n_leaves"])
+    assert nl > L // 2
+    for leaf in range(nl):
+        rows = li == leaf
+        assert abs(rows.sum() - ls[leaf, 2]) < 0.5, leaf
+        assert abs(grad[rows].sum() - ls[leaf, 0]) < 1e-2, leaf
+    valid = np.asarray(rec["valid"])
+    k = valid.sum()
+    assert valid[:k].all() and not valid[k:].any()
+
+
+def test_c2f_tree_quality_close_to_full_wave():
+    bins, grad, hess = _tree_data(seed=7, N=16384)
+    F, N = bins.shape
+    B = 63
+    out = {}
+    for name, shift in (("full", 0), ("c2f", 3)):
+        p = GrowParams(split=SplitParams(max_bin=B, min_data_in_leaf=5,
+                                         any_cat=False,
+                                         any_missing=False),
+                       num_leaves=31, hist_impl="segsum", wave=True,
+                       speculate=16, refine_shift=shift)
+        rec = build_tree(jnp.asarray(bins), jnp.asarray(grad),
+                         jnp.asarray(hess), jnp.ones(N, jnp.float32),
+                         jnp.ones(F, bool), jnp.full(F, B, jnp.int32),
+                         jnp.zeros(F, jnp.int32), jnp.zeros(F, bool), p)
+        li = np.asarray(rec["leaf_idx"])
+        lv = np.asarray(rec["leaf_values"])
+        # squared-error reduction of the fitted tree on grad
+        pred = lv[li]
+        out[name] = float(np.sum(grad * pred))
+    # c2f must realize most of the full-resolution wave's gradient fit
+    assert out["c2f"] <= 0
+    assert out["full"] <= 0
+    assert out["c2f"] <= 0.97 * out["full"], out
+
+
+def test_c2f_engine_auc():
+    """End-to-end through the public API with hist_refinement on/off."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(11)
+    N, F = 20000, 8
+    X = rng.randn(N, F)
+    logit = X[:, 0] + 0.6 * X[:, 1] * X[:, 1] - 0.8 * (X[:, 2] > 0.3)
+    y = (rng.random_sample(N) < 1 / (1 + np.exp(-logit))).astype(int)
+    Xtr, ytr, Xva, yva = X[:16000], y[:16000], X[16000:], y[16000:]
+    aucs = {}
+    for ref in (True, False):
+        # max_bin=255: the driver only enables refinement at >=128 bins
+        params = {"objective": "binary", "metric": "auc",
+                  "num_leaves": 31, "learning_rate": 0.1,
+                  "max_bin": 255, "wave_splits": True,
+                  "use_quantized_grad": True, "min_data_in_leaf": 1,
+                  "hist_refinement": ref, "verbose": -1}
+        ds = lgb.Dataset(Xtr, label=ytr)
+        vs = ds.create_valid(Xva, label=yva)
+        res = {}
+        bst = lgb.train(params, ds, num_boost_round=20,
+                        valid_sets=[vs], valid_names=["va"],
+                        callbacks=[lgb.record_evaluation(res)],
+                        verbose_eval=False)
+        aucs[ref] = res["va"]["auc"][-1]
+    assert aucs[True] > 0.5
+    assert abs(aucs[True] - aucs[False]) < 0.01, aucs
