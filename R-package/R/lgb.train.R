@@ -33,14 +33,21 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
   for (name in names(valids)) {
     booster$add_valid(valids[[name]], name)
   }
-  if (verbose > 0L && length(valids) > 0L) {
+  has_cb <- function(name) {
+    any(vapply(callbacks, function(cb) {
+      identical(attr(cb, "name"), name)
+    }, logical(1L)))
+  }
+  if (verbose > 0L && length(valids) > 0L &&
+      !has_cb("cb.print.evaluation")) {
     callbacks <- c(callbacks, list(cb.print.evaluation(eval_freq)))
   }
-  if (record && length(valids) > 0L) {
+  if (record && length(valids) > 0L &&
+      !has_cb("cb.record.evaluation")) {
     callbacks <- c(callbacks, list(cb.record.evaluation()))
   }
   if (!is.null(early_stopping_rounds) && early_stopping_rounds > 0L &&
-      length(valids) > 0L) {
+      length(valids) > 0L && !has_cb("cb.early.stop")) {
     callbacks <- c(callbacks,
                    list(cb.early.stop(early_stopping_rounds,
                                       verbose = verbose > 0L)))

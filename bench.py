@@ -64,7 +64,8 @@ def make_higgs_shaped(n_rows, n_features, seed=0):
     return X, y
 
 
-def run_variant(lgb, params, train, n_meas, auc_fn, profiling=None):
+def run_variant(lgb, params, train, n_meas, auc_fn, profiling=None,
+                diagnose_fetch=False):
     """Train WARMUP + n_meas iterations; return timing + AUC stats."""
     booster = lgb.Booster(params=params, train_set=train)
     t0 = time.time()
@@ -113,6 +114,25 @@ def run_variant(lgb, params, train, n_meas, auc_fn, profiling=None):
                 phases[name.split("/")[-1]] = round(t / c * 1e3, 1)
         if phases:
             out["phase_ms_per_iter"] = phases
+    if diagnose_fetch and profiling is not None:
+        # the "fetch" phase at steady state is the WAIT for the
+        # in-flight device build (the transfer overlaps the next
+        # build); split it with a 1-element sync to show the truly
+        # exposed transfer residue.  Extra-RTT diagnosis — run after
+        # the main timing so it cannot pollute it.
+        os.environ["LTPU_SPLIT_FETCH_TIMER"] = "1"
+        try:
+            profiling.reset()
+            for _ in range(6):
+                booster.update()
+            fet, fc = profiling.get("tree/fetch")
+            dw, dc = profiling.get("tree/device_wait")
+            if fc and dc:
+                out["fetch_device_wait_ms"] = round(dw / dc * 1e3, 1)
+                out["fetch_exposed_ms"] = round(
+                    max(fet / fc - dw / dc, 0.0) * 1e3, 1)
+        finally:
+            os.environ.pop("LTPU_SPLIT_FETCH_TIMER", None)
     return out
 
 
@@ -183,7 +203,8 @@ def main():
     train255 = train_for(255)
     out["binning_s"] = round(trains[255][1], 2)
     res = run_variant(lgb, dict(base_params, **fast), train255, n_meas,
-                      auc_fn, profiling)
+                      auc_fn, profiling,
+                      diagnose_fetch=backend != "cpu")
     out.update({f"wave255_{k}": v for k, v in res.items()
                 if k not in ("phase_ms_per_iter",)})
     out["phase_ms_per_iter"] = res.get("phase_ms_per_iter", {})
@@ -293,6 +314,165 @@ def main():
                 out["epsilon_shape_iters_per_s"] = round(1.0 / perw, 4)
         except Exception as exc:
             out["epsilon_shape_error"] = str(exc)[:200]
+        print(json.dumps(out), flush=True)
+
+    # ---- reference-DEFAULT learning-control config ------------------
+    # the headline rides min_data_in_leaf=0 (two_col W=64 tier); a user
+    # keeping the reference default (min_data_in_leaf=20, config.h) gets
+    # the W=42 quantized tier — report it so the headline is
+    # reproducible by a default user (docs/Design.md fast-path tiering)
+    if backend != "cpu" and os.environ.get("BENCH_DEFAULTCFG", "1") != "0" \
+            and time.time() - t_start < 6 * budget:
+        try:
+            res = run_variant(
+                lgb, dict(base_params, min_data_in_leaf=20, **fast),
+                train255, max(n_meas // 2, 8), auc_fn)
+            out.update({f"default255_{k}": v for k, v in res.items()})
+        except Exception as exc:
+            out["default255_error"] = str(exc)[:200]
+        print(json.dumps(out), flush=True)
+
+    # ---- ranking: MS-LTR-shaped lambdarank --------------------------
+    # reference speed table row: MS-LTR 2.27M x 136, 10K queries,
+    # 215.3 s / 500 iters (Experiments.rst:104-143)
+    if backend != "cpu" and os.environ.get("BENCH_RANK", "1") != "0" \
+            and time.time() - t_start < 7 * budget:
+        try:
+            from lightgbm_tpu.metrics import NDCGMetric
+            rng = np.random.RandomState(11)
+            n_r, f_r, docs_per_q = 2_270_000, 136, 227
+            n_r = (n_r // docs_per_q) * docs_per_q
+            Xr = rng.randn(n_r, f_r).astype(np.float32)
+            rel = Xr[:, 0] + 0.5 * Xr[:, 1] + 0.8 * rng.randn(n_r)
+            yr = np.clip(np.digitize(
+                rel, np.percentile(rel, [60, 80, 92, 98])), 0, 4
+            ).astype(np.float32)
+            groups = np.full(n_r // docs_per_q, docs_per_q, np.int64)
+            pr = dict(base_params, objective="lambdarank",
+                      metric="ndcg", eval_at=[1, 3, 5, 10],
+                      num_leaves=255, **fast)
+            dr = lgb.Dataset(Xr, label=yr, group=groups, params=pr)
+            dr.construct()
+            br = lgb.Booster(params=pr, train_set=dr)
+            br.update(); br.update()
+            times_r = []
+            t0 = time.time()
+            while len(times_r) < 12 and time.time() - t0 < 90:
+                t1 = time.time(); br.update()
+                times_r.append(time.time() - t1)
+            perr = sorted(times_r)[len(times_r) // 2]
+            out["msltr_shape_iters_per_s"] = round(1.0 / perr, 4)
+            out["msltr_shape_projected_500iter_s"] = round(500 * perr, 1)
+            out["msltr_shape_rows"] = n_r
+            # NDCG@{1,3,5,10} sanity on a 200-query train subset (the
+            # synthetic relevances make absolute values incomparable to
+            # MS-LTR; this pins that ranking learning happened at all)
+            n_sub = 200 * docs_per_q
+            cfg_r = Config()
+            cfg_r.eval_at = [1, 3, 5, 10]
+            nd = NDCGMetric(cfg_r)
+            qb = np.arange(0, n_sub + 1, docs_per_q)
+            pred_sub = br.predict(Xr[:n_sub], raw_score=True)
+            for (name, val) in nd.eval_all(
+                    yr[:n_sub].astype(np.float64), pred_sub,
+                    query_boundaries=qb):
+                out[f"msltr_shape_{name.replace('@', '_at_')}"] = \
+                    round(float(val), 4)
+        except Exception as exc:
+            out["msltr_shape_error"] = str(exc)[:200]
+        print(json.dumps(out), flush=True)
+
+    # ---- sparse one-hot + EFB (Allstate/Expo-like) ------------------
+    # reference rows: Allstate 13M x 4228 one-hot, Expo 11M x 700
+    # (Experiments.rst:42-61); scaled shape, EFB actually engaged
+    if backend != "cpu" and os.environ.get("BENCH_EFB", "1") != "0" \
+            and time.time() - t_start < 8 * budget:
+        try:
+            import scipy.sparse as sp_mod
+            rng = np.random.RandomState(13)
+            n_e, n_cats = 1_000_000, 40
+            # 40 categorical columns one-hot encoded at ~16 levels each
+            # -> 640 mutually-exclusive-in-blocks indicator columns
+            levels = rng.randint(8, 25, size=n_cats)
+            cols, rows_idx = [], []
+            col0 = 0
+            data_cols = []
+            for c, L in enumerate(levels):
+                v = rng.randint(0, L, size=n_e)
+                rows_idx.append(np.arange(n_e))
+                cols.append(col0 + v)
+                col0 += L
+            f_e = int(col0)
+            ridx = np.concatenate(rows_idx)
+            cidx = np.concatenate(cols)
+            Xe = sp_mod.csr_matrix(
+                (np.ones(ridx.size, np.float32), (ridx, cidx)),
+                shape=(n_e, f_e))
+            ye = (rng.random_sample(n_e) <
+                  1 / (1 + np.exp(-(Xe[:, :40].toarray().sum(1).ravel()
+                                    - 1)))).astype(np.float32)
+            pe = dict(base_params, max_bin=63, enable_bundle=True)
+            de = lgb.Dataset(Xe, label=ye, params=pe)
+            t0 = time.time(); de.construct()
+            out["allstate_shape_binning_s"] = round(time.time() - t0, 2)
+            be = lgb.Booster(params=pe, train_set=de)
+            be.update(); be.update()
+            times_e = []
+            t0 = time.time()
+            while len(times_e) < 12 and time.time() - t0 < 90:
+                t1 = time.time(); be.update()
+                times_e.append(time.time() - t1)
+            pere = sorted(times_e)[len(times_e) // 2]
+            out["allstate_shape_iters_per_s"] = round(1.0 / pere, 4)
+            out["allstate_shape_cols"] = f_e
+            bun = be._gbdt._bundles
+            out["allstate_shape_efb_groups"] = (
+                int(bun.num_groups) if bun is not None else f_e)
+        except Exception as exc:
+            out["allstate_shape_error"] = str(exc)[:200]
+        print(json.dumps(out), flush=True)
+
+    # ---- multiclass ------------------------------------------------
+    if backend != "cpu" and os.environ.get("BENCH_MULTI", "1") != "0" \
+            and time.time() - t_start < 9 * budget:
+        try:
+            rng = np.random.RandomState(17)
+            n_m, f_m, k_m = 1_000_000, 28, 5
+            Xm = rng.randn(n_m, f_m).astype(np.float32)
+            logits = Xm[:, :k_m] + 0.5 * rng.randn(n_m, k_m)
+            ym = logits.argmax(axis=1).astype(np.float32)
+            pm = dict(base_params, objective="multiclass",
+                      num_class=k_m, num_leaves=63, **fast)
+            dm = lgb.Dataset(Xm, label=ym, params=pm)
+            dm.construct()
+            bm = lgb.Booster(params=pm, train_set=dm)
+            bm.update(); bm.update()
+            times_m = []
+            t0 = time.time()
+            while len(times_m) < 10 and time.time() - t0 < 90:
+                t1 = time.time(); bm.update()
+                times_m.append(time.time() - t1)
+            perm = sorted(times_m)[len(times_m) // 2]
+            out["multiclass_shape_iters_per_s"] = round(1.0 / perm, 4)
+        except Exception as exc:
+            out["multiclass_shape_error"] = str(exc)[:200]
+
+    # ---- peak device memory ----------------------------------------
+    # reference GPU row: <= ~1 GB device memory for its largest run
+    # (GPU-Performance.rst:186-189)
+    try:
+        import jax as _jax
+        stats = _jax.local_devices()[0].memory_stats()
+        if stats:
+            for k_src, k_dst in (("peak_bytes_in_use", "peak"),
+                                 ("bytes_in_use", "in_use"),
+                                 ("bytes_limit", "limit")):
+                if k_src in stats:
+                    out[f"device_memory_{k_dst}_gb"] = round(
+                        stats[k_src] / 1e9, 3)
+    except Exception:
+        pass
+
     print(json.dumps(out))
 
 

@@ -14,6 +14,7 @@ per tree to materialize a :class:`Tree`.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -210,7 +211,13 @@ class GBDT:
         any_cat = bool(any(m.bin_type == BIN_CATEGORICAL
                            for m in mappers))
         any_missing = bool(any(m.missing_type != 0 for m in mappers))
-        wave_on = bool(config.wave_splits and not dist_active and
+        # wave growth composes with the data-parallel learner (psum-ed
+        # whole-wave histograms; grow.py wave_dist) the way the
+        # reference's GPU learner composes by template parameter
+        # (data_parallel_tree_learner.cpp:258-259); feature/voting
+        # learners still take the exact per-leaf path
+        wave_dist_ok = not dist_active or learner == "data"
+        wave_on = bool(config.wave_splits and wave_dist_ok and
                        use_pool and not forced)
         # two-column quantized passes (W=64): legal only when the count
         # channel is provably redundant (GrowParams.two_col contract).
@@ -266,10 +273,13 @@ class GBDT:
             forced=forced,
             bundled=self._bundles is not None,
             use_hist_pool=use_pool,
-            # quantized-gradient histograms (serial device learner):
-            # small ints are exact in bf16, halving the value columns
+            # quantized-gradient histograms: small ints are exact in
+            # bf16, halving the value columns; serial learner, or
+            # data-parallel under wave growth (shard-consistent scale)
             quantize=(config.num_grad_quant_bins
-                      if (config.use_quantized_grad and not dist_active)
+                      if (config.use_quantized_grad and
+                          (not dist_active or
+                           (learner == "data" and wave_on)))
                       else 0),
             spec_tolerance=float(config.speculative_tolerance),
             # wave growth (wave_splits): top-W splits applied per loop
@@ -284,7 +294,8 @@ class GBDT:
             speculate=(min(multi_width(config.use_quantized_grad,
                                        two_col), config.num_leaves)
                        if ((use_pallas or config.wave_splits) and
-                           not dist_active and use_pool and not forced)
+                           (not dist_active or wave_on) and
+                           use_pool and not forced)
                        else 0))
 
         # parallel tree learner over the device mesh
@@ -549,9 +560,25 @@ class GBDT:
 
     def _materialize_pending(self) -> bool:
         """Fetch + host-materialize the in-flight tree; returns True
-        when it could not split (the stop signal)."""
+        when it could not split (the stop signal).
+
+        The caller times this as ``tree/fetch`` — at steady state that
+        time is overwhelmingly the WAIT for the in-flight build to
+        finish on device, not transfer: the host dispatched tree t's
+        build before fetching t-1's records, so the fetch blocks on
+        t-1's remaining device compute while the ~one-RTT transfer and
+        t's build overlap it.  Set LTPU_SPLIT_FETCH_TIMER=1 to split
+        the phase into ``tree/device_wait`` (a 1-element sync) and the
+        residual transfer (costs one extra tunnel round-trip per tree,
+        so it is diagnosis-only)."""
         pending, self._pending = self._pending, None
         rec = pending["rec"]
+        if os.environ.get("LTPU_SPLIT_FETCH_TIMER"):
+            from ..utils.profiling import timed
+            with timed("tree/device_wait"):
+                # 1-element fetch: blocks until the build completed
+                # (block_until_ready is unreliable on the axon tunnel)
+                np.asarray(rec["n_leaves"])
         recs = self._fetch_records(rec)
         if "n_arm_passes" in recs:
             self.last_arm_passes = int(recs["n_arm_passes"])

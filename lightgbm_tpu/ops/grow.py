@@ -238,11 +238,19 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
     ax = dist.axis
     D = dist.num_shards
 
-    assert p.quantize == 0 or kind == "serial", \
-        "quantized histograms are supported by the serial learner only"
+    assert p.quantize == 0 or kind in ("serial", "data"), \
+        "quantized histograms: serial or data-parallel learners only"
     assert not p.two_col or (p.quantize > 0 and p.wave and
                              not p.bundled and p.split.counts_proxy), \
         "two_col requires quantized wave growth with counts_proxy"
+    # wave growth composes with the data-parallel learner the way the
+    # reference composes its accelerated learner with every parallel
+    # learner by template (DataParallelTreeLearner<GPUTreeLearner>,
+    # data_parallel_tree_learner.cpp:258-259, tree_learner.cpp:9-33):
+    # the batched multi-leaf pass runs per shard and is psum-ed whole,
+    # so every shard scans identical histograms and takes identical
+    # split decisions — no best-split merge needed.
+    wave_dist = p.wave and kind == "data"
     hist_scale = None
     if p.quantize:
         # stochastic rounding to ±quantize integer levels; sample_mask
@@ -253,10 +261,37 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         kg, kh = jax.random.split(key)
         g_w = grad * sample_mask
         h_w = hess * sample_mask
-        sg = jnp.maximum(jnp.max(jnp.abs(g_w)), jnp.float32(1e-30)) / q
-        sh = jnp.maximum(jnp.max(jnp.abs(h_w)), jnp.float32(1e-30)) / q
-        grad = jnp.floor(g_w / sg + jax.random.uniform(kg, grad.shape))
-        hess = jnp.floor(h_w / sh + jax.random.uniform(kh, hess.shape))
+        sg = jnp.maximum(jnp.max(jnp.abs(g_w)), jnp.float32(1e-30))
+        sh = jnp.maximum(jnp.max(jnp.abs(h_w)), jnp.float32(1e-30))
+        if kind in ("data", "voting"):
+            # shard-consistent scale: quantization must agree across
+            # shards or the psum-ed integer histograms mix units
+            sg = jax.lax.pmax(sg, ax)
+            sh = jax.lax.pmax(sh, ax)
+        sg, sh = sg / q, sh / q
+        # rounding noise is a hash of the GLOBAL row index (not
+        # jax.random.uniform, whose stream depends on the local shape):
+        # the same row gets the same noise under any row sharding, so
+        # an 8-shard data-parallel tree is bit-identical to the serial
+        # one (integer sums are exact in f32 up to 2^24)
+        if kind in ("data", "voting"):
+            idx0 = jax.lax.axis_index(ax).astype(jnp.uint32) * \
+                jnp.uint32(N)
+        else:
+            idx0 = jnp.uint32(0)
+        ridx = idx0 + jnp.arange(N, dtype=jnp.uint32)
+
+        def _row_uniform(k):
+            # Wang-style integer mix of (row index, key word)
+            kw = jnp.asarray(k, jnp.uint32).ravel()
+            h = ridx ^ (kw[0] ^ kw[-1])
+            h = (h ^ (h >> 16)) * jnp.uint32(0x7feb352d)
+            h = (h ^ (h >> 15)) * jnp.uint32(0x846ca68b)
+            h = h ^ (h >> 16)
+            return h.astype(jnp.float32) * jnp.float32(2.0 ** -32)
+
+        grad = jnp.floor(g_w / sg + _row_uniform(kg))
+        hess = jnp.floor(h_w / sh + _row_uniform(kh))
         # two_col: the count channel is a hess copy and must dequantize
         # with the hess scale to stay in one unit system
         hist_scale = jnp.stack([sg, sh,
@@ -270,7 +305,7 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
     pen_g = jnp.asarray(sp.penalty, jnp.float32) if has_pen else None
     BIG = jnp.float32(jnp.inf)
 
-    if kind == "data":
+    if kind == "data" and not wave_dist:
         # each shard owns histograms for one contiguous feature block
         # after the reduce-scatter (data_parallel_tree_learner.cpp:147)
         assert F % D == 0, (F, D)
@@ -322,25 +357,36 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         m = sample_mask * (leaf_idx == leaf_id)
         vals = jnp.stack([grad * m, hess * m, m], axis=-1)
         h = _hist(xt, vals, p)
+        # collectives run BEFORE dequantization: quantized histograms
+        # are integers, summed exactly in f32 in any order — reducing
+        # after the scale multiply would drift by reduction order and
+        # break serial<->sharded bit-equality
+        if kind == "data":
+            if wave_dist:
+                # wave path: full psum — every shard scans identical
+                # histograms and takes identical decisions
+                h = jax.lax.psum(h, ax)
+            else:
+                # HistogramBinEntry::SumReducer over the wire becomes
+                # one XLA reduce-scatter over the feature dimension
+                h = jax.lax.psum_scatter(h, ax, scatter_dimension=0,
+                                         tiled=True)
         if hist_scale is not None:
             h = h * hist_scale  # dequantize: ints -> gradient units
         if p.two_col:
             # hess-as-count everywhere, so pool subtraction stays in
             # one unit system (see GrowParams.two_col)
             h = jnp.concatenate([h[..., :2], h[..., 1:2]], axis=-1)
-        if kind == "data":
-            # HistogramBinEntry::SumReducer over the wire becomes one
-            # XLA reduce-scatter over the feature dimension
-            h = jax.lax.psum_scatter(h, ax, scatter_dimension=0, tiled=True)
         return h  # (F_hist, B, 3); local (not yet summed) for voting
 
     # speculative child arming (serial only): one batched pass fills
     # the MXU lanes with up to `speculate` smaller-child histograms
-    W_spec = min(p.speculate, L) if (kind == "serial" and p.use_hist_pool
-                                     and not p.forced and p.speculate > 1
-                                     ) else 0
+    W_spec = min(p.speculate, L) if (
+        (kind == "serial" or wave_dist) and p.use_hist_pool
+        and not p.forced and p.speculate > 1) else 0
     do_spec = W_spec > 1
-    use_wave = p.wave and do_spec and kind == "serial" and not p.forced
+    use_wave = p.wave and do_spec and (kind == "serial" or wave_dist) \
+        and not p.forced
     use_c2f = use_wave and p.refine_shift > 0
     if use_c2f:
         assert not sp.any_cat and not sp.any_missing and not p.bundled, \
@@ -359,6 +405,8 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
             else:
                 h = histogram_segsum_multi(xt, base_vals, sel, B, W_spec,
                                            two_col=p.two_col)
+            if wave_dist:
+                h = jax.lax.psum(h, ax)
             return h if hist_scale is None else h * hist_scale
     if use_c2f:
         c2f_shift = p.refine_shift
@@ -376,6 +424,8 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
                 h = histogram_segsum_multi(xt, base_vals, sel, Bc_c2f,
                                            W_spec, two_col=p.two_col,
                                            shift=c2f_shift)
+            if wave_dist:
+                h = jax.lax.psum(h, ax)
             return h if hist_scale is None else h * hist_scale
 
         def multi_hist_win(sel, lo_all):
@@ -389,6 +439,8 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
                 h = histogram_segsum_multi_win(xt, base_vals, sel, lo_all,
                                                R_c2f, W_spec,
                                                two_col=p.two_col)
+            if wave_dist:
+                h = jax.lax.psum(h, ax)
             return h if hist_scale is None else h * hist_scale
 
         def c2f_window(c, s, mn, mx):
@@ -418,7 +470,9 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
                                 penalty=pen_l, min_output=mn,
                                 max_output=mx)
             b["feature"] = b["feature"] + f_offset
-            if kind in ("data", "feature"):
+            if kind in ("data", "feature") and not wave_dist:
+                # wave_dist scans replicated histograms — every shard
+                # already holds the identical global winner
                 b = _merge_best(b, ax)
         allowed = (p.max_depth <= 0) | (depth < p.max_depth)
         b["gain"] = jnp.where(allowed, b["gain"], NEG_INF)
@@ -789,6 +843,62 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
     def wave_cond(st):
         return (st["n_leaves"] < L) & (jnp.max(st["best_gain"]) > 0)
 
+    def route_wave(li, ids_leaf, col_of_lane, thr_w, lane_mask,
+                   extras=()):
+        """Gather-free row routing shared by the wave bodies.
+
+        XLA's (N,)-element gather runs at well under 1 GB/s on TPU
+        (measured: a single table[leaf_idx] take costs ~60-90 ms at
+        bench shape), so every per-row lookup is an unrolled
+        select-chain against scalars — XLA fuses the whole block into
+        one streaming pass over leaf_idx and the xt rows.
+
+        Returns (w_row, in_wave, goes_left, extras_rows) where each
+        (W,) table in ``extras`` is broadcast to its per-row value.
+        """
+        W = ids_leaf.shape[0]
+        w_row = jnp.full(N, -1, jnp.int32)
+        for w in range(W):                          # leaf -> lane
+            w_row = jnp.where(li == ids_leaf[w], jnp.int32(w), w_row)
+        in_wave = w_row >= 0
+        csel = jnp.zeros(N, jnp.int32)              # lane -> column id
+        for w in range(W):
+            csel = jnp.where(w_row == w, col_of_lane[w], csel)
+        col = jnp.zeros(N, jnp.int32)               # per-row split bin
+        for g in range(G_cols):
+            col = jnp.where(csel == g, xt[g].astype(jnp.int32), col)
+        if not sp.any_cat and not sp.any_missing and not p.bundled:
+            # numerical splits with no missing bin: goes-left is a
+            # plain threshold compare — W scalar selects instead of
+            # the W x B/32 mask-word chain
+            thr_row = jnp.zeros(N, jnp.int32)
+            for w in range(W):
+                thr_row = jnp.where(w_row == w, thr_w[w], thr_row)
+            goes_left = in_wave & (col <= thr_row)
+        else:
+            nw = (B + 31) // 32
+            bits = jnp.pad(lane_mask.astype(jnp.uint32),
+                           ((0, 0), (0, nw * 32 - B)))
+            words = jnp.sum(
+                bits.reshape(W, nw, 32) <<
+                jnp.arange(32, dtype=jnp.uint32)[None, None, :],
+                axis=2)                             # (W, nw)
+            hi = col >> 5
+            wd = jnp.zeros(N, jnp.uint32)           # per-row mask word
+            for w in range(W):
+                for h in range(nw):
+                    wd = jnp.where((w_row == w) & (hi == h),
+                                   words[w, h], wd)
+            goes_left = in_wave & \
+                (((wd >> (col & 31).astype(jnp.uint32)) & 1) > 0)
+        ex_rows = []
+        for tbl in extras:
+            r = jnp.zeros(N, tbl.dtype)
+            for w in range(W):
+                r = jnp.where(w_row == w, tbl[w], r)
+            ex_rows.append(r)
+        return w_row, in_wave, goes_left, ex_rows
+
     def commit_wave(st, ids_leaf, new_leaf, ids_rec, bests, ch_stats,
                     ch_depth, recs, valid_w, mono_vals=None):
         """Shared state-commit tail of the wave bodies: scatter the
@@ -859,18 +969,6 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         rstat_w = pstat_w - lstat_w
         small_left_w = lstat_w[:, 2] <= rstat_w[:, 2]
 
-        # ---- gather-free row routing --------------------------------
-        # XLA's (N,)-element gather runs at well under 1 GB/s on TPU
-        # (measured: a single table[leaf_idx] take costs ~60-90 ms at
-        # bench shape), so every per-row lookup below is an unrolled
-        # select-chain against scalars — XLA fuses the whole block into
-        # one streaming pass over leaf_idx and the xt rows.
-        li = st["leaf_idx"]
-        w_row = jnp.full(N, -1, jnp.int32)
-        for w in range(W):                          # leaf -> lane
-            w_row = jnp.where(li == ids_leaf[w], jnp.int32(w), w_row)
-        in_wave = w_row >= 0
-
         # route every in-wave row through ITS leaf's split
         if p.bundled:
             col_of_lane = bm_group[feat_w]
@@ -879,45 +977,10 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         else:
             col_of_lane = feat_w
             lane_mask = mask_w
-        csel = jnp.zeros(N, jnp.int32)              # lane -> column id
-        for w in range(W):
-            csel = jnp.where(w_row == w, col_of_lane[w], csel)
-        col = jnp.zeros(N, jnp.int32)               # per-row split bin
-        for g in range(G_cols):
-            col = jnp.where(csel == g, xt[g].astype(jnp.int32), col)
-        if not sp.any_cat and not sp.any_missing and not p.bundled:
-            # numerical splits with no missing bin: goes-left is a
-            # plain threshold compare — W scalar selects instead of
-            # the W x B/32 mask-word chain (512 fused N-ops at W=64,
-            # 256 bins)
-            thr_row = jnp.zeros(N, jnp.int32)
-            for w in range(W):
-                thr_row = jnp.where(w_row == w, thr_w[w], thr_row)
-            goes_left = in_wave & (col <= thr_row)
-        else:
-            nw = (B + 31) // 32
-            bits = jnp.pad(lane_mask.astype(jnp.uint32),
-                           ((0, 0), (0, nw * 32 - B)))
-            words = jnp.sum(
-                bits.reshape(W, nw, 32) <<
-                jnp.arange(32, dtype=jnp.uint32)[None, None, :],
-                axis=2)                             # (W, nw)
-            hi = col >> 5
-            wd = jnp.zeros(N, jnp.uint32)           # per-row mask word
-            for w in range(W):
-                for h in range(nw):
-                    wd = jnp.where((w_row == w) & (hi == h),
-                                   words[w, h], wd)
-            goes_left = in_wave & \
-                (((wd >> (col & 31).astype(jnp.uint32)) & 1) > 0)
-
-        small_left_row = jnp.zeros(N, bool)
-        new_id_row = jnp.zeros(N, jnp.int32)
-        for w in range(W):
-            lane = w_row == w
-            small_left_row = jnp.where(lane, small_left_w[w],
-                                       small_left_row)
-            new_id_row = jnp.where(lane, new_ids[w], new_id_row)
+        li = st["leaf_idx"]
+        w_row, in_wave, goes_left, (small_left_row, new_id_row) = \
+            route_wave(li, ids_leaf, col_of_lane, thr_w, lane_mask,
+                       extras=(small_left_w, new_ids))
 
         to_small = goes_left == small_left_row
         sel = jnp.where(in_wave & to_small, w_row, jnp.int32(-1))
@@ -1012,25 +1075,12 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         pstat_w = st["leaf_stats"][ids]
         rstat_w = pstat_w - lstat_w
 
-        # gather-free routing (see wave_body); the c2f gate guarantees
+        # gather-free routing (route_wave); the c2f gate guarantees
         # numerical-only splits, so goes-left is a threshold compare
         li = st["leaf_idx"]
-        w_row = jnp.full(N, -1, jnp.int32)
-        for w in range(W):
-            w_row = jnp.where(li == ids_leaf[w], jnp.int32(w), w_row)
-        in_wave = w_row >= 0
-        csel = jnp.zeros(N, jnp.int32)
-        thr_row = jnp.zeros(N, jnp.int32)
-        new_id_row = jnp.zeros(N, jnp.int32)
-        for w in range(W):
-            lane = w_row == w
-            csel = jnp.where(lane, feat_w[w], csel)
-            thr_row = jnp.where(lane, thr_w[w], thr_row)
-            new_id_row = jnp.where(lane, new_ids[w], new_id_row)
-        col = jnp.zeros(N, jnp.int32)
-        for g in range(G_cols):
-            col = jnp.where(csel == g, xt[g].astype(jnp.int32), col)
-        goes_left = in_wave & (col <= thr_row)
+        w_row, in_wave, goes_left, (new_id_row,) = \
+            route_wave(li, ids_leaf, feat_w, thr_w, mask_w,
+                       extras=(new_ids,))
 
         # child subsets: left child of lane w -> slot w, right -> W + w
         sel = jnp.where(in_wave,
@@ -1146,6 +1196,8 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
                        jnp.stack([g_w, h_w, sample_mask], axis=-1),
                        max_bin=L, impl=p.hist_impl,
                        rows_per_block=p.rows_per_block)
+        if kind in ("data", "voting"):
+            ex = jax.lax.psum(ex, ax)
         extra["leaf_stats_exact"] = ex[0, :L]
         leaf_values_final = jnp.where(
             ex[0, :L, 2] > 0,

@@ -120,13 +120,25 @@ class DistributedBuilder:
             for k in ("rec_left_min", "rec_left_max",
                       "rec_right_min", "rec_right_max"):
                 out_specs[k] = R
+        # mirror build_tree's do_spec predicate exactly: a spec for an
+        # absent output is a pytree-structure error at call time
+        do_spec = (self.params.speculate > 1 and
+                   self.params.use_hist_pool and
+                   not self.params.forced and
+                   kind == "data" and self.params.wave)
+        if do_spec:
+            out_specs["n_arm_passes"] = R
+        if self.params.quantize:
+            out_specs["leaf_stats_exact"] = R
         out_specs["leaf_idx"] = leaf_idx_spec
 
-        fn = functools.partial(build_tree, params=self.params)
+        def fn(xt, grad, hess, mask, fmask, nb, mt, cat, qk):
+            return build_tree(xt, grad, hess, mask, fmask, nb, mt, cat,
+                              self.params, quant_key=qk)
         sharded = jax.shard_map(
             fn, mesh=self.mesh,
             in_specs=(xt_spec, row_spec, row_spec, row_spec, feat_spec,
-                      feat_spec, feat_spec, feat_spec),
+                      feat_spec, feat_spec, feat_spec, R),
             out_specs=out_specs, check_vma=False)
         self._call = jax.jit(sharded)
 
@@ -138,7 +150,9 @@ class DistributedBuilder:
         return pad_features_for(self.kind, self.num_shards, f)
 
     def __call__(self, xt, grad, hess, sample_mask, feature_mask,
-                 num_bins, missing_type, is_cat, params=None):
+                 num_bins, missing_type, is_cat, params=None,
+                 quant_key=None):
+        import jax
         # params is baked in at construction (signature-compatible with
         # the jitted serial build_tree); reject a drifting override
         # instead of silently training with stale parameters
@@ -148,5 +162,7 @@ class DistributedBuilder:
             raise ValueError(
                 "DistributedBuilder was constructed with different "
                 "GrowParams; rebuild the builder to change them")
+        if quant_key is None:
+            quant_key = jax.random.PRNGKey(0)
         return self._call(xt, grad, hess, sample_mask, feature_mask,
-                          num_bins, missing_type, is_cat)
+                          num_bins, missing_type, is_cat, quant_key)
