@@ -92,20 +92,3 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
   }
   booster
 }
-
-#' Simple training entry point (label + matrix in one call)
-#' @param data matrix / dgCMatrix / lgb.Dataset
-#' @param label labels when data is raw
-#' @param params named parameter list
-#' @param nrounds boosting iterations
-#' @param ... forwarded to lgb.train
-#' @export
-lightgbm <- function(data, label = NULL, params = list(),
-                     nrounds = 100L, ...) {
-  if (!inherits(data, "lgb.Dataset")) {
-    data <- lgb.Dataset(data, label = label, params = params)
-  } else if (!is.null(label)) {
-    setinfo(data, "label", label)
-  }
-  lgb.train(params = params, data = data, nrounds = nrounds, ...)
-}

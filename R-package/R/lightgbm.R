@@ -1,0 +1,22 @@
+# One-call training entry point (reference: R-package/R/lightgbm.R).
+
+#' Simple training entry point (label + matrix in one call)
+#'
+#' Wraps \code{lgb.Dataset} + \code{lgb.train} the way the upstream
+#' \code{lightgbm()} convenience function does.
+#'
+#' @param data matrix / dgCMatrix / lgb.Dataset
+#' @param label labels when data is raw
+#' @param params named parameter list
+#' @param nrounds boosting iterations
+#' @param ... forwarded to lgb.train
+#' @export
+lightgbm <- function(data, label = NULL, params = list(),
+                     nrounds = 100L, ...) {
+  if (!inherits(data, "lgb.Dataset")) {
+    data <- lgb.Dataset(data, label = label, params = params)
+  } else if (!is.null(label)) {
+    setinfo(data, "label", label)
+  }
+  lgb.train(params = params, data = data, nrounds = nrounds, ...)
+}

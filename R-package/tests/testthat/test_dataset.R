@@ -1,0 +1,67 @@
+# Dataset surface tests
+# (reference: R-package/tests/testthat/test_dataset.R): construction
+# from matrix and dgCMatrix, info get/set, dim/dimnames, subsetting
+# via valid-set alignment, and binary save/load.
+
+test_that("dataset from matrix: dims, infos", {
+  skip_if_no_backend()
+  toy <- make_toy(300L)
+  w <- runif(300L)
+  d <- lgb.Dataset(toy$x, label = toy$y,
+                   params = list(verbose = -1L))
+  setinfo(d, "weight", w)
+  lgb.Dataset.construct(d)
+  expect_equal(dim(d), c(300L, 4L))
+  expect_equal(getinfo(d, "label"), toy$y, tolerance = 1e-6)
+  expect_equal(getinfo(d, "weight"), w, tolerance = 1e-6)
+})
+
+test_that("dataset from dgCMatrix", {
+  skip_if_no_backend()
+  skip_if_not_installed("Matrix")
+  toy <- make_toy(200L)
+  xs <- toy$x
+  xs[abs(xs) < 0.5] <- 0
+  sm <- Matrix::Matrix(xs, sparse = TRUE)
+  expect_s4_class(sm, "dgCMatrix")
+  d <- lgb.Dataset(sm, label = toy$y, params = list(verbose = -1L))
+  lgb.Dataset.construct(d)
+  expect_equal(dim(d), c(200L, 4L))
+})
+
+test_that("valid set aligns to train reference", {
+  skip_if_no_backend()
+  toy <- make_toy(400L)
+  dtrain <- lgb.Dataset(toy$x[1:300, ], label = toy$y[1:300],
+                        params = list(verbose = -1L))
+  dvalid <- lgb.Dataset.create.valid(dtrain, toy$x[301:400, ],
+                                     label = toy$y[301:400])
+  bst <- lgb.train(params = list(objective = "binary", metric = "auc",
+                                 num_leaves = 7L, verbose = -1L),
+                   data = dtrain, nrounds = 5L,
+                   valids = list(v = dvalid), verbose = 0L)
+  expect_length(lgb.get.eval.result(bst, "v", "auc"), 5L)
+})
+
+test_that("binary save / reload", {
+  skip_if_no_backend()
+  toy <- make_toy(200L)
+  d <- lgb.Dataset(toy$x, label = toy$y, params = list(verbose = -1L))
+  lgb.Dataset.construct(d)
+  f <- tempfile(fileext = ".bin")
+  on.exit(unlink(f))
+  lgb.Dataset.save(d, f)
+  expect_true(file.exists(f))
+  expect_gt(file.info(f)$size, 0L)
+})
+
+test_that("dimnames set and read back", {
+  skip_if_no_backend()
+  toy <- make_toy(100L)
+  x <- toy$x
+  colnames(x) <- paste0("f", 1:4)
+  d <- lgb.Dataset(x, label = toy$y, params = list(verbose = -1L))
+  lgb.Dataset.construct(d)
+  dn <- dimnames(d)
+  expect_equal(dn[[2L]], paste0("f", 1:4))
+})

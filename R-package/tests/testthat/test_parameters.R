@@ -1,0 +1,63 @@
+# Parameter-handling tests
+# (reference: R-package/tests/testthat/test_parameters.R): alias
+# resolution, parameter-string rendering, learning-rate resets via
+# cb.reset.parameter, and constraint parameters reaching training.
+
+test_that("params render to the C API string form", {
+  expect_equal(lgb.params.str(list(num_leaves = 31L, lr = 0.1)),
+               "num_leaves=31 lr=0.1")
+  expect_equal(lgb.params.str(list(eval_at = c(1L, 3L, 5L))),
+               "eval_at=1,3,5")
+  expect_equal(lgb.params.str(list(is_unbalance = TRUE)),
+               "is_unbalance=true")
+  expect_error(lgb.params.str(list(1, 2)), "named")
+})
+
+test_that("aliases resolve (num_leaf == num_leaves)", {
+  skip_if_no_backend()
+  toy <- make_toy(300L)
+  out <- lapply(list(list(num_leaves = 4L), list(num_leaf = 4L)),
+                function(extra) {
+    d <- lgb.Dataset(toy$x, label = toy$y,
+                     params = list(verbose = -1L))
+    bst <- lgb.train(params = c(list(objective = "binary",
+                                     verbose = -1L), extra),
+                     data = d, nrounds = 3L, verbose = 0L)
+    predict(bst, toy$x[1:10, ])
+  })
+  expect_equal(out[[1L]], out[[2L]], tolerance = 1e-9)
+})
+
+test_that("cb.reset.parameter schedules the learning rate", {
+  skip_if_no_backend()
+  toy <- make_toy(300L)
+  d <- lgb.Dataset(toy$x, label = toy$y, params = list(verbose = -1L))
+  dv <- lgb.Dataset.create.valid(d, toy$x, label = toy$y)
+  sched <- function(iter, n) 0.1 * 0.5^(iter - 1L)
+  bst <- lgb.train(params = list(objective = "binary",
+                                 metric = "binary_logloss",
+                                 num_leaves = 7L, verbose = -1L),
+                   data = d, nrounds = 4L, valids = list(v = dv),
+                   verbose = 0L,
+                   callbacks = list(cb.reset.parameter(
+                     list(learning_rate = sched))))
+  ll <- lgb.get.eval.result(bst, "v", "binary_logloss")
+  expect_length(ll, 4L)
+  # decaying lr: loss must be non-increasing
+  expect_true(all(diff(ll) <= 1e-6))
+})
+
+test_that("lambda_l2 regularization shrinks leaf values", {
+  skip_if_no_backend()
+  toy <- make_toy(300L)
+  leaf_mag <- vapply(c(0, 100), function(l2) {
+    d <- lgb.Dataset(toy$x, label = toy$y,
+                     params = list(verbose = -1L))
+    bst <- lgb.train(params = list(objective = "binary",
+                                   num_leaves = 7L, lambda_l2 = l2,
+                                   verbose = -1L),
+                     data = d, nrounds = 2L, verbose = 0L)
+    mean(abs(predict(bst, toy$x, rawscore = TRUE)))
+  }, numeric(1L))
+  expect_lt(leaf_mag[2L], leaf_mag[1L])
+})

@@ -457,9 +457,12 @@ def main():
         except Exception as exc:
             out["multiclass_shape_error"] = str(exc)[:200]
 
-    # ---- peak device memory ----------------------------------------
+    # ---- device memory ---------------------------------------------
     # reference GPU row: <= ~1 GB device memory for its largest run
-    # (GPU-Performance.rst:186-189)
+    # (GPU-Performance.rst:186-189).  memory_stats() is not implemented
+    # by the tunneled backend (returns None); report it when available
+    # and otherwise the COMPUTED residency of the persistent training
+    # arrays (binned matrix + scores + masks) for the primary shape.
     try:
         import jax as _jax
         stats = _jax.local_devices()[0].memory_stats()
@@ -472,6 +475,22 @@ def main():
                         stats[k_src] / 1e9, 3)
     except Exception:
         pass
+    if "device_memory_peak_gb" not in out and trains:
+        try:
+            mb0 = sorted(trains)[0]
+            ds0 = trains[mb0][0]._constructed
+            n_pad = (ds0.num_data + 16383) // 16384 * 16384
+            fcols = ds0.binned.shape[1]
+            resident = (fcols * n_pad                 # uint8 bins
+                        + 2 * 4 * n_pad               # score + mask f32
+                        + 3 * 4 * n_pad)              # grad/hess/sel
+            out["device_resident_computed_gb"] = round(resident / 1e9, 3)
+            out["device_memory_note"] = (
+                "memory_stats unavailable through the tunnel; computed "
+                "residency of persistent training arrays at the "
+                "primary shape")
+        except Exception:
+            pass
 
     print(json.dumps(out))
 

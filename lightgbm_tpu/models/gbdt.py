@@ -170,14 +170,22 @@ class GBDT:
                 bin_budget=min(config.max_bin, 255),
                 seed=config.data_random_seed)
             # cost model for the one-hot-matmul histogram: work is
-            # columns x padded-bin-width, so bundling only pays when
-            # G x pow2(bundle bins) beats F x pow2(feature bins)
+            # columns x KERNEL-padded bin width (the kernel pads bins
+            # to a multiple of 8, so 2-bin one-hot indicator columns
+            # still stream 8 one-hot rows each — comparing unpadded
+            # widths wrongly rejected bundling exactly on the one-hot
+            # datasets EFB exists for)
+            from ..ops.histogram import _pad_bins
             pow2 = lambda v: int(2 ** np.ceil(np.log2(max(int(v), 2))))
-            B_bun = pow2(bundles.group_num_bins.max())
-            cost_bundled = bundles.num_groups * B_bun
-            cost_plain = F * self.max_bin
+            B_bun = int(bundles.group_num_bins.max())
+            cost_bundled = bundles.num_groups * _pad_bins(B_bun)
+            cost_plain = F * _pad_bins(self.max_bin)
             if bundles.num_groups < F and cost_bundled < 0.95 * cost_plain:
                 self._bundles = bundles
+                # commit the width that was costed: the kernel pads to
+                # a multiple of 8 itself, so rounding max_bin up to a
+                # power of two here would stream more one-hot rows than
+                # the acceptance decision accounted for
                 self.max_bin = max(self.max_bin, B_bun)
                 B = self.max_bin
                 fix = np.zeros((F, B), np.float32)

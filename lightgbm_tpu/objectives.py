@@ -588,10 +588,14 @@ class LambdaRank(Objective):
             dcg = np.sum(g / np.log2(np.arange(len(g)) + 2.0))
             inv_max[q] = 1.0 / dcg if dcg > 0 else 0.0
         self._inv_max_dcg = jnp.asarray(inv_max, jnp.float32)
-        self._gains_pad = jnp.asarray(
-            np.concatenate([gains_per_row, [0.0]]), jnp.float32)
-        self._label_pad = jnp.asarray(
-            np.concatenate([lab, [-1]]), jnp.int32)
+        # label/gain in PADDED (nq, mq) layout, precomputed once:
+        # gathering them per iteration costs two (nq*mq,)-element
+        # gathers of constants (XLA gathers are the slowest op on this
+        # target — see docs/Design.md)
+        lab_pad = np.concatenate([lab, [-1]])
+        gains_pad = np.concatenate([gains_per_row, [0.0]])
+        self._lbl_mat = jnp.asarray(lab_pad[idx], jnp.int32)
+        self._gain_mat = jnp.asarray(gains_pad[idx], jnp.float32)
 
     def get_gradients(self, score):
         score = score.reshape(-1)
@@ -600,10 +604,8 @@ class LambdaRank(Objective):
                                                    score.dtype)])
 
         def query_chunk(args):
-            doc_idx, valid, inv_max = args
+            doc_idx, valid, inv_max, lbl, gain = args
             s = sc_pad[doc_idx]                      # (cq, mq)
-            lbl = self._label_pad[doc_idx]
-            gain = self._gains_pad[doc_idx]
             order = jnp.argsort(-jnp.where(valid, s, -jnp.inf), axis=1,
                                 stable=True)
             rank = jnp.argsort(order, axis=1)        # row -> position
@@ -640,12 +642,18 @@ class LambdaRank(Objective):
                               jnp.zeros((pad_q, mq), bool)])
         im = jnp.concatenate([self._inv_max_dcg, jnp.zeros(pad_q,
                                                            jnp.float32)])
+        lm = jnp.concatenate([self._lbl_mat,
+                              jnp.full((pad_q, mq), -1, jnp.int32)])
+        gm = jnp.concatenate([self._gain_mat,
+                              jnp.zeros((pad_q, mq), jnp.float32)])
         grad = jnp.zeros(n + 1, jnp.float32)
         hess = jnp.zeros(n + 1, jnp.float32)
         idxs, gs, hs = jax.lax.map(
             query_chunk, (di.reshape(nchunks, cq, mq),
                           dv.reshape(nchunks, cq, mq),
-                          im.reshape(nchunks, cq)))
+                          im.reshape(nchunks, cq),
+                          lm.reshape(nchunks, cq, mq),
+                          gm.reshape(nchunks, cq, mq)))
         grad = grad.at[idxs.reshape(-1)].add(gs.reshape(-1))
         hess = hess.at[idxs.reshape(-1)].add(hs.reshape(-1))
         grad, hess = grad[:n], hess[:n]

@@ -43,8 +43,10 @@ import jax
 import jax.numpy as jnp
 
 from .histogram import (histogram_pallas, histogram_pallas_multi,
+                        histogram_pallas_multi_routed,
                         histogram_pallas_multi_win, histogram_segsum,
-                        histogram_segsum_multi, histogram_segsum_multi_win)
+                        histogram_segsum_multi,
+                        histogram_segsum_multi_win, routed_chunk_ok)
 from .split import (NEG_INF, SplitParams, choose_window,
                     eval_forced_split, find_best_split,
                     find_best_split_c2f, leaf_output)
@@ -395,10 +397,14 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
     if do_spec:
         base_vals = jnp.stack([grad * sample_mask, hess * sample_mask,
                                sample_mask], axis=-1)
+        # (a pre-transposed (2, N) bf16 value operand was measured
+        # SLOWER than this (N, 3) f32 layout — 0.61 vs 0.55 s/iter at
+        # 63 bins interleaved; sub-8-sublane bf16 blocks don't pay)
+        kvals = base_vals
 
         def multi_hist(sel):
             if p.hist_impl == "pallas":
-                h = histogram_pallas_multi(xt, base_vals, sel, B, W_spec,
+                h = histogram_pallas_multi(xt, kvals, sel, B, W_spec,
                                            p.rows_per_block,
                                            exact=p.quantize > 0,
                                            two_col=p.two_col)
@@ -408,14 +414,38 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
             if wave_dist:
                 h = jax.lax.psum(h, ax)
             return h if hist_scale is None else h * hist_scale
+    # in-kernel routing (ops/histogram.py routed kernels): the wave's
+    # row-routing select chain re-reads leaf_idx + every xt row from
+    # HBM (~13 ms/wave at bench shape); when every feature fits one
+    # kernel chunk and splits are plain threshold compares, the pass
+    # itself resolves lanes/goes-left and emits the new leaf vector
+    routed_ok = (do_spec and p.hist_impl == "pallas" and
+                 not p.bundled and not sp.any_cat and
+                 not sp.any_missing)
+    routed_full_ok = routed_ok and routed_chunk_ok(
+        B, G_cols, 128, p.rows_per_block)
+
+    def routed_call(li, tbl, max_bin_r, shift_r, mode):
+        hist, li_new, sel = histogram_pallas_multi_routed(
+            xt, kvals, li, tbl, max_bin_r, W_spec,
+            p.rows_per_block, exact=p.quantize > 0, two_col=p.two_col,
+            shift=shift_r, mode=mode)
+        if wave_dist:
+            hist = jax.lax.psum(hist, ax)
+        if hist_scale is not None:
+            hist = hist * hist_scale
+        return hist, li_new, sel
+
     if use_c2f:
         c2f_shift = p.refine_shift
         Bc_c2f = ((B - 1) >> c2f_shift) + 1
         R_c2f = 2 << c2f_shift       # 2 coarse bins at fine resolution
+        routed_coarse_ok = routed_ok and routed_chunk_ok(
+            Bc_c2f, G_cols, 128, p.rows_per_block)
 
         def multi_hist_coarse(sel):
             if p.hist_impl == "pallas":
-                h = histogram_pallas_multi(xt, base_vals, sel, Bc_c2f,
+                h = histogram_pallas_multi(xt, kvals, sel, Bc_c2f,
                                            W_spec, p.rows_per_block,
                                            exact=p.quantize > 0,
                                            two_col=p.two_col,
@@ -430,7 +460,7 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
 
         def multi_hist_win(sel, lo_all):
             if p.hist_impl == "pallas":
-                h = histogram_pallas_multi_win(xt, base_vals, sel, lo_all,
+                h = histogram_pallas_multi_win(xt, kvals, sel, lo_all,
                                                R_c2f, W_spec,
                                                p.rows_per_block,
                                                exact=p.quantize > 0,
@@ -969,24 +999,30 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         rstat_w = pstat_w - lstat_w
         small_left_w = lstat_w[:, 2] <= rstat_w[:, 2]
 
-        # route every in-wave row through ITS leaf's split
-        if p.bundled:
-            col_of_lane = bm_group[feat_w]
-            fb_w = bm_from[feat_w]                  # (W, B)
-            lane_mask = jnp.take_along_axis(mask_w, fb_w, axis=1)
-        else:
-            col_of_lane = feat_w
-            lane_mask = mask_w
         li = st["leaf_idx"]
-        w_row, in_wave, goes_left, (small_left_row, new_id_row) = \
-            route_wave(li, ids_leaf, col_of_lane, thr_w, lane_mask,
-                       extras=(small_left_w, new_ids))
-
-        to_small = goes_left == small_left_row
-        sel = jnp.where(in_wave & to_small, w_row, jnp.int32(-1))
-        hist_small = multi_hist(sel)                # (W, F_hist, B, 3)
-
-        leaf_idx = jnp.where(in_wave & ~goes_left, new_id_row, li)
+        if routed_full_ok:
+            # routing resolved inside the pass itself; the kernel
+            # also emits the updated leaf vector
+            tbl = jnp.stack([ids_leaf, feat_w, thr_w, new_ids,
+                             small_left_w.astype(jnp.int32)])
+            hist_small, leaf_idx, _ = routed_call(li, tbl, B, 0,
+                                                  "small")
+        else:
+            # route every in-wave row through ITS leaf's split
+            if p.bundled:
+                col_of_lane = bm_group[feat_w]
+                fb_w = bm_from[feat_w]              # (W, B)
+                lane_mask = jnp.take_along_axis(mask_w, fb_w, axis=1)
+            else:
+                col_of_lane = feat_w
+                lane_mask = mask_w
+            w_row, in_wave, goes_left, (small_left_row, new_id_row) = \
+                route_wave(li, ids_leaf, col_of_lane, thr_w, lane_mask,
+                           extras=(small_left_w, new_ids))
+            to_small = goes_left == small_left_row
+            sel = jnp.where(in_wave & to_small, w_row, jnp.int32(-1))
+            hist_small = multi_hist(sel)            # (W, F_hist, B, 3)
+            leaf_idx = jnp.where(in_wave & ~goes_left, new_id_row, li)
 
         hist_parent = st["hist"][ids]
         hist_large = hist_parent - hist_small
@@ -1075,20 +1111,27 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         pstat_w = st["leaf_stats"][ids]
         rstat_w = pstat_w - lstat_w
 
-        # gather-free routing (route_wave); the c2f gate guarantees
-        # numerical-only splits, so goes-left is a threshold compare
         li = st["leaf_idx"]
-        w_row, in_wave, goes_left, (new_id_row,) = \
-            route_wave(li, ids_leaf, feat_w, thr_w, mask_w,
-                       extras=(new_ids,))
-
-        # child subsets: left child of lane w -> slot w, right -> W + w
-        sel = jnp.where(in_wave,
-                        w_row + W * (~goes_left).astype(jnp.int32),
-                        jnp.int32(-1))
-        coarse = multi_hist_coarse(sel)[:W2]     # (2W, F, Bc, 3)
-
-        leaf_idx = jnp.where(in_wave & ~goes_left, new_id_row, li)
+        if routed_coarse_ok:
+            # routing + coarse histograms in ONE pass; the emitted sel
+            # (child slots) feeds the windowed refine pass directly
+            tbl = jnp.stack([ids_leaf, feat_w, thr_w, new_ids,
+                             jnp.zeros(W, jnp.int32)])
+            coarse, leaf_idx, sel = routed_call(li, tbl, Bc_c2f,
+                                                c2f_shift, "children")
+            coarse = coarse[:W2]
+        else:
+            # gather-free routing (route_wave); the c2f gate guarantees
+            # numerical-only splits, so goes-left is a threshold compare
+            w_row, in_wave, goes_left, (new_id_row,) = \
+                route_wave(li, ids_leaf, feat_w, thr_w, mask_w,
+                           extras=(new_ids,))
+            # child subsets: left child of lane w -> slot w, right W+w
+            sel = jnp.where(in_wave,
+                            w_row + W * (~goes_left).astype(jnp.int32),
+                            jnp.int32(-1))
+            coarse = multi_hist_coarse(sel)[:W2]     # (2W, F, Bc, 3)
+            leaf_idx = jnp.where(in_wave & ~goes_left, new_id_row, li)
 
         ch_stats = jnp.concatenate([lstat_w, rstat_w], axis=0)  # (2W, 3)
         depth_w = st["leaf_depth"][ids] + 1

@@ -140,6 +140,23 @@ def _split_hi_lo(v: jax.Array) -> jax.Array:
     return jnp.concatenate([v_hi, v - v_hi], axis=0)
 
 
+def _rhs_from(sel_oh: jax.Array, valsc: jax.Array) -> jax.Array:
+    """(W, T) subset selector x (C, T) values -> (128, T) bf16 rhs.
+
+    Built IN bf16, halving the stage's register traffic vs an f32
+    multiply followed by a cast.  Numerically identical to the old
+    f32-multiply-then-cast: 0/1 selectors and quantized ints are
+    bf16-exact, and for the float path the hi part is bf16-exact by
+    construction while the lo residual was ALREADY rounded to bf16 by
+    the final cast (the hi/lo split reaches ~2^-16 RELATIVE accuracy,
+    not exactness — see the module header)."""
+    W, T = sel_oh.shape
+    C = valsc.shape[0]
+    rhs = (sel_oh.astype(jnp.bfloat16)[:, None, :] *
+           valsc.astype(jnp.bfloat16)[None, :, :]).reshape(W * C, T)
+    return jnp.pad(rhs, ((0, 128 - W * C), (0, 0)))
+
+
 def _hist_kernel(x_ref, v_ref, out_ref, *, b_pad: int, cols: int,
                  exact: bool):
     """One grid step: accumulate one (feature-chunk × row-tile) into the
@@ -276,10 +293,8 @@ def _hist_kernel_multi(x_ref, v_ref, s_ref, out_ref, *, b_pad: int,
         cols = 3 if exact else 6
         valsc = v if exact else _split_hi_lo(v)        # (cols, T) f32
     sel_oh = (sel == jax.lax.broadcasted_iota(
-        jnp.int32, (width, T), 0)).astype(jnp.float32)  # (W, T)
-    rhs = (sel_oh[:, None, :] * valsc[None, :, :]).reshape(
-        width * cols, T).astype(jnp.bfloat16)          # (cols*W, T)
-    rhs = jnp.pad(rhs, ((0, 128 - width * cols), (0, 0)))
+        jnp.int32, (width, T), 0)).astype(jnp.bfloat16)  # (W, T)
+    rhs = _rhs_from(sel_oh, valsc)                     # (128, T) bf16
     onehot = (x[:, None, :] ==
               jax.lax.broadcasted_iota(jnp.int32, (FC, b_pad, T), 1)
               ).astype(jnp.bfloat16)
@@ -413,9 +428,7 @@ def _hist_kernel_multi_win(x_ref, v_ref, s_ref, lo_ref, out_ref, *,
         lo.T, sel_oh, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)             # (FC, T)
     rbin = x - lo_pr.astype(jnp.int32)
-    rhs = (sel_oh[:, None, :] * valsc[None, :, :]).reshape(
-        width * cols, T).astype(jnp.bfloat16)
-    rhs = jnp.pad(rhs, ((0, 128 - width * cols), (0, 0)))
+    rhs = _rhs_from(sel_oh, valsc)
     # out-of-window rows (rbin outside [0, r_pad)) match no iota column
     onehot = (rbin[:, None, :] ==
               jax.lax.broadcasted_iota(jnp.int32, (FC, r_pad, T), 1)
@@ -477,6 +490,221 @@ def histogram_pallas_multi_win(bins_t: jax.Array, vals: jax.Array,
     elif not exact:
         out = out[..., :3] + out[..., 3:]
     return jnp.moveaxis(out[:f, :r_bins], 2, 0)    # (W, F, R, 3)
+
+
+# ---- routed multi-leaf pass ----------------------------------------
+#
+# The wave bodies used to route rows in XLA-land: an unrolled
+# select-chain reading leaf_idx plus EVERY xt row (~340 MB per wave at
+# bench shape — ~13 ms of pure HBM re-read on a ~26 GB/s chip).  The
+# histogram pass already streams the bins matrix, so this variant does
+# the routing IN the kernel: per row it resolves its wave lane (a
+# table compare against the lane leaf-ids), its split column value (a
+# feature-one-hot contraction over the resident x tile), the
+# goes-left compare, and the subset selector — and writes the NEW leaf
+# assignment and selector as side outputs.  Requires the whole feature
+# dimension in one chunk (fc == f_pad, i.e. F <= ~32 at 8 bins) —
+# callers fall back to the XLA routing otherwise.
+#
+# Lane tables ride in a (5, W) int32 operand:
+#   row 0: lane leaf ids   row 1: lane split column
+#   row 2: lane threshold  row 3: lane new (right-child) leaf id
+#   row 4: smaller-child-is-left flag (mode="small" only)
+
+
+def _routed_parts(x, li, tbl, width: int, mode: str):
+    """Shared routing math: returns (sel_oh, li_new, sel_out).
+    x (FC, T) int32; li (1, T) int32; tbl (5, W) int32."""
+    FC, T = x.shape
+    W = width if mode == "small" else width // 2
+    ids = tbl[0:1, :W]                              # (1, W)
+    lane_oh = (li == ids.T).astype(jnp.float32)     # (W, T)
+    in_wave = jnp.sum(lane_oh, axis=0, keepdims=True) > 0.5
+    # per-row split-column value: feature-one-hot contraction against
+    # the resident x tile (an (N,) gather is poison; this is 2 tiny
+    # MXU dots + an FC*T multiply-reduce)
+    featoh = (tbl[1:2, :W].T ==
+              jax.lax.broadcasted_iota(jnp.int32, (W, FC), 1)
+              ).astype(jnp.float32)                 # (W, FC)
+    fsel = jax.lax.dot_general(
+        featoh.T, lane_oh, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)         # (FC, T)
+    col = jnp.sum(x.astype(jnp.float32) * fsel, axis=0,
+                  keepdims=True)                    # (1, T)
+    thr_pr = jax.lax.dot_general(
+        tbl[2:3, :W].astype(jnp.float32), lane_oh,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)         # (1, T)
+    gl = in_wave & (col <= thr_pr)                  # (1, T)
+    glf = gl.astype(jnp.float32)
+    new_pr = jax.lax.dot_general(
+        tbl[3:4, :W].astype(jnp.float32), lane_oh,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    li_new = jnp.where(in_wave & ~gl, new_pr.astype(jnp.int32), li)
+    if mode == "small":
+        sl_pr = jax.lax.dot_general(
+            tbl[4:5, :W].astype(jnp.float32), lane_oh,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        to_small = (glf == sl_pr)                   # (1, T)
+        sel_oh = lane_oh * to_small                 # (W, T)
+    else:
+        # children mode: left child of lane w -> slot w, right -> W+w
+        sel_oh = jnp.concatenate(
+            [lane_oh * glf, lane_oh * (1.0 - glf)], axis=0) * \
+            in_wave.astype(jnp.float32)             # (2W, T)
+    lane_idx = jax.lax.dot_general(
+        jnp.arange(sel_oh.shape[0], dtype=jnp.int32)[None, :].astype(
+            jnp.float32), sel_oh,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)         # (1, T)
+    any_sel = jnp.sum(sel_oh, axis=0, keepdims=True) > 0.5
+    sel_out = jnp.where(any_sel, lane_idx.astype(jnp.int32),
+                        jnp.int32(-1))
+    return sel_oh, li_new, sel_out
+
+
+def _hist_kernel_multi_routed(x_ref, v_ref, li_ref, tbl_ref, out_ref,
+                              li_out_ref, sel_out_ref, *, b_pad: int,
+                              width: int, exact: bool, two_col: bool,
+                              shift: int, mode: str):
+    import jax.experimental.pallas as pl
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    FC, T = x_ref.shape
+    x = x_ref[...].astype(jnp.int32)
+    v = v_ref[...]
+    li = li_ref[...].astype(jnp.int32)
+    tbl = tbl_ref[...]
+    sel_oh, li_new, sel_out = _routed_parts(x, li, tbl, width, mode)
+    li_out_ref[...] = li_new
+    sel_out_ref[...] = sel_out
+    if two_col:
+        cols = 2
+        valsc = v[:2]
+    else:
+        cols = 3 if exact else 6
+        valsc = v if exact else _split_hi_lo(v)
+    rhs = _rhs_from(sel_oh, valsc)
+    xb = (x >> shift) if shift else x
+    onehot = (xb[:, None, :] ==
+              jax.lax.broadcasted_iota(jnp.int32, (FC, b_pad, T), 1)
+              ).astype(jnp.bfloat16)
+    acc = jax.lax.dot_general(
+        onehot.reshape(FC * b_pad, T), rhs.T, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    out_ref[...] += acc
+
+
+def routed_chunk_ok(max_bin: int, f: int, cols: int = 128,
+                    rows_per_block: int = 1024) -> bool:
+    """True when the tiler keeps the whole feature dimension in one
+    chunk — the routed kernel's requirement."""
+    b_pad = _pad_bins(max_bin)
+    f_pad, fc, _ = _tile(b_pad, f, cols, rows_per_block)
+    return fc == f_pad
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "max_bin", "width", "rows_per_block", "exact", "two_col", "shift",
+    "mode"))
+def histogram_pallas_multi_routed(bins_t: jax.Array, vals: jax.Array,
+                                  leaf_idx: jax.Array,
+                                  tables: jax.Array, max_bin: int,
+                                  width: int,
+                                  rows_per_block: int = 1024,
+                                  exact: bool = False,
+                                  two_col: bool = False,
+                                  shift: int = 0,
+                                  mode: str = "small"):
+    """Multi-subset histogram with IN-KERNEL row routing.
+
+    bins_t (F, N); vals (N, 3) f32; leaf_idx (N,) int32; tables (5, W)
+    int32 (see module comment).  ``mode="small"``: subsets are the
+    smaller children (width W lanes); ``mode="children"``: both
+    children (lanes 2W, width counts the OUTPUT lanes = 2W).
+    Returns (hist (width, F, B, 3), new_leaf_idx (N,), sel (N,)).
+    """
+    import jax.experimental.pallas as pl
+
+    f, n = bins_t.shape
+    b_pad = _pad_bins(max_bin)
+    cols = 2 if two_col else (3 if exact else 6)
+    Wl = width
+    assert Wl * cols <= 128, (Wl, cols)
+    f_pad, fc, t = _tile(b_pad, f, 128, rows_per_block)
+    assert fc == f_pad, "routed kernel needs a single feature chunk"
+    assert n % t == 0, (n, t)
+    xt = bins_t
+    if f_pad != f:
+        xt = jnp.pad(xt, ((0, f_pad - f), (0, 0)))
+    vt = vals.astype(jnp.float32).T
+    lt = leaf_idx.astype(jnp.int32)[None, :]
+    W_tbl = tables.shape[1]
+
+    out, li_new, sel = pl.pallas_call(
+        functools.partial(_hist_kernel_multi_routed, b_pad=b_pad,
+                          width=Wl, exact=exact, two_col=two_col,
+                          shift=shift, mode=mode),
+        grid=(n // t,),
+        in_specs=[
+            pl.BlockSpec((fc, t), lambda i: (0, i)),
+            pl.BlockSpec((3, t), lambda i: (0, i)),
+            pl.BlockSpec((1, t), lambda i: (0, i)),
+            pl.BlockSpec((5, W_tbl), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((fc * b_pad, 128), lambda i: (0, 0)),
+            pl.BlockSpec((1, t), lambda i: (0, i)),
+            pl.BlockSpec((1, t), lambda i: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((f_pad * b_pad, 128), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.int32),
+            jax.ShapeDtypeStruct((1, n), jnp.int32),
+        ],
+        compiler_params=_compiler_params(),
+    )(xt, vt, lt, tables)
+    out = out[:, :cols * Wl].reshape(f_pad, b_pad, Wl, cols)
+    if two_col:
+        out = jnp.concatenate([out, out[..., 1:2]], axis=-1)
+    elif not exact:
+        out = out[..., :3] + out[..., 3:]
+    hist = jnp.moveaxis(out[:f, :max_bin], 2, 0)
+    return hist, li_new[0], sel[0]
+
+
+def histogram_segsum_multi_routed(bins_t, vals, leaf_idx, tables,
+                                  max_bin: int, width: int,
+                                  two_col: bool = False, shift: int = 0,
+                                  mode: str = "small"):
+    """jnp reference for :func:`histogram_pallas_multi_routed`."""
+    W = width if mode == "small" else width // 2
+    ids, colw, thrw, neww, slw = (tables[k, :W] for k in range(5))
+    li = leaf_idx.astype(jnp.int32)
+    lane = jnp.full(li.shape, -1, jnp.int32)
+    for w in range(W):
+        lane = jnp.where(li == ids[w], w, lane)
+    in_wave = lane >= 0
+    safe = jnp.clip(lane, 0, W - 1)
+    col_id = colw[safe]
+    col = jnp.take_along_axis(bins_t.astype(jnp.int32),
+                              col_id[None, :], axis=0)[0]
+    gl = in_wave & (col <= thrw[safe])
+    li_new = jnp.where(in_wave & ~gl, neww[safe], li)
+    if mode == "small":
+        to_small = gl == (slw[safe] > 0)
+        sel = jnp.where(in_wave & to_small, lane, -1)
+    else:
+        sel = jnp.where(in_wave, lane + W * (~gl).astype(jnp.int32), -1)
+        sel = jnp.where(in_wave, sel, -1)
+    hist = histogram_segsum_multi(bins_t, vals, sel, max_bin, width,
+                                  two_col=two_col, shift=shift)
+    return hist, li_new, sel
 
 
 def histogram_segsum_multi_win(bins_t: jax.Array, vals: jax.Array,

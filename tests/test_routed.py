@@ -1,0 +1,82 @@
+"""Routed histogram kernels: oracle pinning.
+
+The in-kernel-routing pass (``histogram_pallas_multi_routed``) is the
+default fast path for serial numeric Pallas runs; its CPU oracle
+(``histogram_segsum_multi_routed``) is pinned here against a
+brute-force reimplementation so a regression in the routing contract
+(lane resolution, goes-left compare, small/children subset selection,
+new-leaf emission) fails loudly on CPU.  The kernel half is validated
+against the same oracle on real hardware by
+``tools/check_routed_kernels.py`` (Pallas does not execute on the CPU
+backend these tests force).
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.ops.histogram import histogram_segsum_multi_routed
+
+
+def _brute(bins, vals, li, tbl, max_bin, width, mode, shift=0,
+           two_col=False):
+    F, N = bins.shape
+    W = width if mode == "small" else width // 2
+    ids, colw, thrw, neww, slw = tbl
+    lanes = width
+    hist = np.zeros((lanes, F, max_bin, 3), np.float64)
+    li_new = li.copy()
+    sel = np.full(N, -1, np.int64)
+    for n in range(N):
+        lane = -1
+        for w in range(W):
+            if li[n] == ids[w]:
+                lane = w
+                break
+        if lane < 0:
+            continue
+        gl = bins[colw[lane], n] <= thrw[lane]
+        if not gl:
+            li_new[n] = neww[lane]
+        if mode == "small":
+            if gl == bool(slw[lane]):
+                sel[n] = lane
+        else:
+            sel[n] = lane + (0 if gl else W)
+        if sel[n] >= 0:
+            for f in range(F):
+                b = bins[f, n] >> shift
+                hist[sel[n], f, b] += vals[n]
+    if two_col:
+        hist[..., 2] = hist[..., 1]
+    return hist, li_new, sel
+
+
+@pytest.mark.parametrize("mode", ["small", "children"])
+@pytest.mark.parametrize("shift", [0, 2])
+def test_routed_oracle_vs_brute_force(mode, shift):
+    rng = np.random.RandomState(3)
+    F, N, W_lane = 5, 2048, 8
+    nb_fine = 16
+    Bc = ((nb_fine - 1) >> shift) + 1
+    L = 40
+    bins = rng.randint(0, nb_fine, size=(F, N)).astype(np.int32)
+    vals = rng.randn(N, 3).astype(np.float32)
+    vals[:, 2] = 1.0
+    li = rng.randint(0, 30, size=N).astype(np.int32)
+    Wt = W_lane if mode == "small" else W_lane // 2
+    ids = rng.choice(30, size=Wt, replace=False).astype(np.int32)
+    ids[-1] = L  # one invalid (dummy) lane
+    tbl = np.stack([ids,
+                    rng.randint(0, F, size=Wt).astype(np.int32),
+                    rng.randint(0, nb_fine - 1, size=Wt).astype(np.int32),
+                    rng.randint(30, 40, size=Wt).astype(np.int32),
+                    rng.randint(0, 2, size=Wt).astype(np.int32)])
+    h, ln, s = histogram_segsum_multi_routed(
+        jnp.asarray(bins), jnp.asarray(vals), jnp.asarray(li),
+        jnp.asarray(tbl), Bc, W_lane, two_col=True, shift=shift,
+        mode=mode)
+    hb, lnb, sb = _brute(bins, vals, li, tbl, Bc, W_lane, mode,
+                         shift=shift, two_col=True)
+    np.testing.assert_allclose(np.asarray(h), hb, rtol=1e-5, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(ln), lnb)
+    np.testing.assert_array_equal(np.asarray(s), sb)

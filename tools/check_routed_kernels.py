@@ -1,0 +1,59 @@
+"""TPU-side oracle validation of the routed histogram kernels.
+
+Run on a machine with the accelerator tunnel up:
+    python tools/check_routed_kernels.py
+Compares histogram_pallas_multi_routed against the independent segsum
+oracle in all three modes (small / children / children+shift); every
+diff must print 0.  CI cannot run this (tests force the CPU backend,
+where Pallas does not execute) — the oracle itself is pinned on CPU by
+tests/test_routed.py and this script closes the kernel half.
+"""
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np, jax, jax.numpy as jnp
+from lightgbm_tpu.ops.histogram import (histogram_pallas_multi_routed,
+    histogram_segsum_multi_routed)
+print("backend:", jax.default_backend(), flush=True)
+rng = np.random.RandomState(0)
+F, N = 28, 262144
+bins = rng.randint(0, 63, size=(F, N)).astype(np.uint8)
+g = rng.randint(-120, 121, size=N).astype(np.float32)
+h = rng.randint(0, 121, size=N).astype(np.float32)
+vals = np.stack([g, h, np.ones(N, np.float32)], -1)
+L = 255
+li = rng.randint(0, 200, size=N).astype(np.int32)
+xb, vb, lb = jnp.asarray(bins), jnp.asarray(vals), jnp.asarray(li)
+
+for mode, W_lane in (("small", 64), ("children", 64)):
+    Wt = W_lane if mode == "small" else W_lane // 2
+    ids = rng.choice(200, size=Wt, replace=False).astype(np.int32)
+    ids[Wt-2:] = L  # two invalid lanes
+    tbl = np.stack([ids,
+                    rng.randint(0, F, size=Wt).astype(np.int32),
+                    rng.randint(0, 62, size=Wt).astype(np.int32),
+                    rng.randint(200, 255, size=Wt).astype(np.int32),
+                    rng.randint(0, 2, size=Wt).astype(np.int32)])
+    tb = jnp.asarray(tbl)
+    hp, lp, sp_ = histogram_pallas_multi_routed(
+        xb, vb, lb, tb, 63, W_lane, 16384, exact=True, two_col=True,
+        mode=mode)
+    hs, ls, ss = histogram_segsum_multi_routed(
+        xb, vb, lb, tb, 63, W_lane, two_col=True, mode=mode)
+    print(mode, "hist:", np.abs(np.asarray(hp)-np.asarray(hs)).max(),
+          "li:", np.abs(np.asarray(lp)-np.asarray(ls)).max(),
+          "sel:", np.abs(np.asarray(sp_)-np.asarray(ss)).max(),
+          flush=True)
+    # coarse/shift children variant
+    if mode == "children":
+        hp, lp, sp_ = histogram_pallas_multi_routed(
+            xb, vb, lb, tb, 8, W_lane, 16384, exact=True,
+            two_col=True, shift=3, mode=mode)
+        hs, ls, ss = histogram_segsum_multi_routed(
+            xb, vb, lb, tb, 8, W_lane, two_col=True, shift=3,
+            mode=mode)
+        print("children+shift hist:",
+              np.abs(np.asarray(hp)-np.asarray(hs)).max(),
+              "li:", np.abs(np.asarray(lp)-np.asarray(ls)).max(),
+              "sel:", np.abs(np.asarray(sp_)-np.asarray(ss)).max(),
+              flush=True)
+print("OK")
