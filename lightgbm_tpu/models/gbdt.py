@@ -176,9 +176,11 @@ class GBDT:
             # widths wrongly rejected bundling exactly on the one-hot
             # datasets EFB exists for)
             from ..ops.histogram import _pad_bins
-            pow2 = lambda v: int(2 ** np.ceil(np.log2(max(int(v), 2))))
             B_bun = int(bundles.group_num_bins.max())
-            cost_bundled = bundles.num_groups * _pad_bins(B_bun)
+            # the committed device width is max(max_bin, B_bun): cost
+            # the bundled pass at exactly that width
+            cost_bundled = bundles.num_groups * _pad_bins(
+                max(self.max_bin, B_bun))
             cost_plain = F * _pad_bins(self.max_bin)
             if bundles.num_groups < F and cost_bundled < 0.95 * cost_plain:
                 self._bundles = bundles

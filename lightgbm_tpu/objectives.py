@@ -598,8 +598,32 @@ class LambdaRank(Objective):
         self._gain_mat = jnp.asarray(gains_pad[idx], jnp.float32)
 
     def get_gradients(self, score):
+        # the whole pairwise computation runs as ONE jitted program:
+        # eagerly, every (cq, mq, mq) intermediate of the lambda chain
+        # materializes to HBM (tens of GB per iteration at this chip's
+        # ~26 GB/s) — fused under jit it stays in registers/VMEM
+        if getattr(self, "_grad_fn", None) is None:
+            self._grad_fn = jax.jit(
+                self._grads_impl,
+                static_argnames=("n", "nchunks", "cq", "norm",
+                                 "sigmoid", "weighted"))
+        nq, mq = self._doc_idx.shape
+        cq = max(1, min(nq, int(2e7 // max(mq * mq, 1))))
+        nchunks = (nq + cq - 1) // cq
+        n = int(score.reshape(-1).shape[0])
+        w = self.weight if self.weight is not None else \
+            jnp.zeros((0,), jnp.float32)
+        return self._grad_fn(
+            score, self._doc_idx, self._doc_valid, self._inv_max_dcg,
+            self._lbl_mat, self._gain_mat, w, n=n, nchunks=nchunks,
+            cq=cq, norm=self.norm, sigmoid=self.sigmoid,
+            weighted=self.weight is not None)
+
+    @staticmethod
+    def _grads_impl(score, doc_idx_all, valid_all, inv_max_all,
+                    lbl_all, gain_all, weight, *, n, nchunks, cq, norm,
+                    sigmoid, weighted):
         score = score.reshape(-1)
-        n = score.shape[0]
         sc_pad = jnp.concatenate([score, jnp.array([-jnp.inf],
                                                    score.dtype)])
 
@@ -618,33 +642,31 @@ class LambdaRank(Objective):
             dg = gain[:, :, None] - gain[:, None, :]
             dd = jnp.abs(disc[:, :, None] - disc[:, None, :])
             delta = dg * dd * inv_max[:, None, None]
-            if self.norm:
+            if norm:
                 smax = jnp.max(jnp.where(valid, s, -jnp.inf), axis=1)
                 smin = jnp.min(jnp.where(valid, s, jnp.inf), axis=1)
                 nz = (smax != smin)[:, None, None]
-                delta = jnp.where(nz, delta / (0.01 + jnp.abs(ds)), delta)
+                delta = jnp.where(nz, delta / (0.01 + jnp.abs(ds)),
+                                  delta)
             p = 2.0 / (1.0 + jnp.exp(jnp.clip(
-                2.0 * self.sigmoid * ds, -60.0, 60.0)))
+                2.0 * sigmoid * ds, -60.0, 60.0)))
             lam = jnp.where(pair_ok, -delta * p, 0.0)
             hes = jnp.where(pair_ok, 2.0 * delta * p * (2.0 - p), 0.0)
             g_doc = jnp.sum(lam, axis=2) - jnp.sum(lam, axis=1)
             h_doc = jnp.sum(hes, axis=2) + jnp.sum(hes, axis=1)
             return doc_idx, g_doc, h_doc
 
-        nq, mq = self._doc_idx.shape
-        # chunk queries so the (cq, mq, mq) tensors stay bounded
-        cq = max(1, min(nq, int(2e7 // max(mq * mq, 1))))
-        nchunks = (nq + cq - 1) // cq
+        nq, mq = doc_idx_all.shape
         pad_q = nchunks * cq - nq
-        di = jnp.concatenate([self._doc_idx,
+        di = jnp.concatenate([doc_idx_all,
                               jnp.full((pad_q, mq), n, jnp.int32)])
-        dv = jnp.concatenate([self._doc_valid,
+        dv = jnp.concatenate([valid_all,
                               jnp.zeros((pad_q, mq), bool)])
-        im = jnp.concatenate([self._inv_max_dcg, jnp.zeros(pad_q,
-                                                           jnp.float32)])
-        lm = jnp.concatenate([self._lbl_mat,
+        im = jnp.concatenate([inv_max_all, jnp.zeros(pad_q,
+                                                     jnp.float32)])
+        lm = jnp.concatenate([lbl_all,
                               jnp.full((pad_q, mq), -1, jnp.int32)])
-        gm = jnp.concatenate([self._gain_mat,
+        gm = jnp.concatenate([gain_all,
                               jnp.zeros((pad_q, mq), jnp.float32)])
         grad = jnp.zeros(n + 1, jnp.float32)
         hess = jnp.zeros(n + 1, jnp.float32)
@@ -657,7 +679,7 @@ class LambdaRank(Objective):
         grad = grad.at[idxs.reshape(-1)].add(gs.reshape(-1))
         hess = hess.at[idxs.reshape(-1)].add(hs.reshape(-1))
         grad, hess = grad[:n], hess[:n]
-        if self.weight is not None:
-            grad = grad * self.weight
-            hess = hess * self.weight
+        if weighted:
+            grad = grad * weight
+            hess = hess * weight
         return grad, hess

@@ -424,6 +424,10 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
                  not sp.any_missing)
     routed_full_ok = routed_ok and routed_chunk_ok(
         B, G_cols, 128, p.rows_per_block)
+    # leaf vector in uint8 when every pass goes through the routed
+    # kernel and ids fit (dummy id L included): it is re-read per pass
+    # and per score-update, 4x less HBM than int32
+    li_narrow = L <= 255
 
     def routed_call(li, tbl, max_bin_r, shift_r, mode):
         hist, li_new, sel = histogram_pallas_multi_routed(
@@ -578,7 +582,10 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         return mask_lookup(left_mask_row, col)
 
     # ---- init: root ------------------------------------------------
-    leaf_idx = jnp.zeros(N, dtype=jnp.int32)
+    li_dtype = jnp.uint8 if (
+        li_narrow and use_wave and
+        (routed_coarse_ok if use_c2f else routed_full_ok)) else jnp.int32
+    leaf_idx = jnp.zeros(N, dtype=li_dtype)
     root_count = jnp.sum(hess * sample_mask) if p.two_col \
         else jnp.sum(sample_mask)
     root_stats = global_stats(jnp.stack([jnp.sum(grad * sample_mask),

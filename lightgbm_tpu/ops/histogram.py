@@ -537,10 +537,14 @@ def _routed_parts(x, li, tbl, width: int, mode: str):
         preferred_element_type=jnp.float32)         # (1, T)
     gl = in_wave & (col <= thr_pr)                  # (1, T)
     glf = gl.astype(jnp.float32)
+    # leaf ids can exceed 256 (num_leaves>257), which is NOT bf16-exact
+    # — TPU f32 dots execute as bf16 passes at default precision, so
+    # this one contraction must run at HIGHEST (exact for ints < 2^24)
     new_pr = jax.lax.dot_general(
         tbl[3:4, :W].astype(jnp.float32), lane_oh,
         (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST)
     li_new = jnp.where(in_wave & ~gl, new_pr.astype(jnp.int32), li)
     if mode == "small":
         sl_pr = jax.lax.dot_general(
@@ -581,7 +585,7 @@ def _hist_kernel_multi_routed(x_ref, v_ref, li_ref, tbl_ref, out_ref,
     li = li_ref[...].astype(jnp.int32)
     tbl = tbl_ref[...]
     sel_oh, li_new, sel_out = _routed_parts(x, li, tbl, width, mode)
-    li_out_ref[...] = li_new
+    li_out_ref[...] = li_new.astype(li_out_ref.dtype)
     sel_out_ref[...] = sel_out
     if two_col:
         cols = 2
@@ -643,7 +647,9 @@ def histogram_pallas_multi_routed(bins_t: jax.Array, vals: jax.Array,
     if f_pad != f:
         xt = jnp.pad(xt, ((0, f_pad - f), (0, 0)))
     vt = vals.astype(jnp.float32).T
-    lt = leaf_idx.astype(jnp.int32)[None, :]
+    # keep the leaf vector in its NARROW storage dtype (uint8 at
+    # num_leaves<=255): it is re-read every pass
+    lt = leaf_idx[None, :]
     W_tbl = tables.shape[1]
 
     out, li_new, sel = pl.pallas_call(
@@ -664,7 +670,7 @@ def histogram_pallas_multi_routed(bins_t: jax.Array, vals: jax.Array,
         ],
         out_shape=[
             jax.ShapeDtypeStruct((f_pad * b_pad, 128), jnp.float32),
-            jax.ShapeDtypeStruct((1, n), jnp.int32),
+            jax.ShapeDtypeStruct((1, n), leaf_idx.dtype),
             jax.ShapeDtypeStruct((1, n), jnp.int32),
         ],
         compiler_params=_compiler_params(),
@@ -701,7 +707,6 @@ def histogram_segsum_multi_routed(bins_t, vals, leaf_idx, tables,
         sel = jnp.where(in_wave & to_small, lane, -1)
     else:
         sel = jnp.where(in_wave, lane + W * (~gl).astype(jnp.int32), -1)
-        sel = jnp.where(in_wave, sel, -1)
     hist = histogram_segsum_multi(bins_t, vals, sel, max_bin, width,
                                   two_col=two_col, shift=shift)
     return hist, li_new, sel

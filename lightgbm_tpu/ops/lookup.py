@@ -24,7 +24,7 @@ MAX_LOOKUP_TABLE = 512
 
 
 def _lookup_kernel(idx_ref, vals_ref, out_ref, *, table: int):
-    idx = idx_ref[...]                       # (1, T) int32
+    idx = idx_ref[...].astype(jnp.int32)     # narrow storage widened
     acc = jnp.zeros_like(out_ref)            # (1, T) f32
     for l in range(table):
         acc = jnp.where(idx == l, vals_ref[0, l], acc)
@@ -39,7 +39,10 @@ def _take_small_pallas(vals: jax.Array, idx: jax.Array,
     (L,) = vals.shape
     n = idx.shape[0]
     n_pad = (n + block - 1) // block * block
-    ix = idx.astype(jnp.int32)
+    # keep a narrow (uint8) index vector narrow — it is the kernel's
+    # dominant read; the kernel widens per tile
+    ix = idx if jnp.issubdtype(idx.dtype, jnp.integer) \
+        else idx.astype(jnp.int32)
     if n_pad != n:
         ix = jnp.pad(ix, (0, n_pad - n))
     Lp = (L + 127) // 128 * 128

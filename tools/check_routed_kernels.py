@@ -56,4 +56,21 @@ for mode, W_lane in (("small", 64), ("children", 64)):
               "li:", np.abs(np.asarray(lp)-np.asarray(ls)).max(),
               "sel:", np.abs(np.asarray(sp_)-np.asarray(ss)).max(),
               flush=True)
+# ids above 256 are not bf16-exact: pins the HIGHEST-precision
+# new-leaf contraction (silent corruption at num_leaves>257 otherwise)
+li2 = rng.randint(0, 500, size=N).astype(np.int32)
+ids2 = rng.choice(500, size=64, replace=False).astype(np.int32)
+tbl2 = np.stack([ids2,
+                 rng.randint(0, F, size=64).astype(np.int32),
+                 rng.randint(0, 62, size=64).astype(np.int32),
+                 rng.randint(257, 511, size=64).astype(np.int32),
+                 rng.randint(0, 2, size=64).astype(np.int32)])
+hp, lp, sp_ = histogram_pallas_multi_routed(
+    xb, vb, jnp.asarray(li2), jnp.asarray(tbl2), 63, 64, 16384,
+    exact=True, two_col=True, mode="small")
+hs, ls, ss = histogram_segsum_multi_routed(
+    xb, vb, jnp.asarray(li2), jnp.asarray(tbl2), 63, 64,
+    two_col=True, mode="small")
+print("L>256 ids li:", np.abs(np.asarray(lp)-np.asarray(ls)).max(),
+      "sel:", np.abs(np.asarray(sp_)-np.asarray(ss)).max(), flush=True)
 print("OK")
