@@ -328,8 +328,8 @@ PARAMS: List[Param] = [
        "choice is exact whenever the best fine threshold lies in the "
        "refine window (2 coarse bins around the best coarse boundary). "
        "Auto-disabled for categorical features, missing values, EFB "
-       "bundles, or max_bin<128 (below that the per-pass fixed cost "
-       "outweighs the stream saving)",
+       "bundles, max_bin<48, and shapes where the per-pass fixed cost "
+       "outweighs the stream saving (features x padded bins < ~7000)",
        group="device"),
 ]
 

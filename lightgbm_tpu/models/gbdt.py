@@ -81,7 +81,7 @@ class GBDT:
         import jax
         import jax.numpy as jnp
         from ..ops.grow import DistConfig, GrowParams, build_tree
-        from ..ops.histogram import multi_width
+        from ..ops.histogram import _pad_bins, multi_width
         from ..ops.split import SplitParams
 
         self.config = config
@@ -175,7 +175,6 @@ class GBDT:
             # still stream 8 one-hot rows each — comparing unpadded
             # widths wrongly rejected bundling exactly on the one-hot
             # datasets EFB exists for)
-            from ..ops.histogram import _pad_bins
             B_bun = int(bundles.group_num_bins.max())
             # the committed device width is max(max_bin, B_bun): cost
             # the bundled pass at exactly that width
@@ -245,14 +244,19 @@ class GBDT:
         # count; exactness caveat documented at GrowParams.refine_shift.
         # Measured on v5e: every pass carries ~25 ms of fixed cost
         # (~11 ms bins-matrix HBM read + kernel fixed work), so paying
-        # it twice per wave only wins where the stream term dominates —
-        # 255 bins: 60 ms/wave vs 122 ms full; 63 bins: 52 vs 45
-        # (c2f loses) — hence the max_bin >= 128 gate.
+        # it twice per wave only wins where the STREAM term dominates
+        # the floor.  Stream ∝ F x padded(B): at 28 x 256 (7168 units,
+        # the 255-bin bench) c2f measured 2x faster; at 28 x 64 it
+        # measured slower (52 vs 45 ms/wave); wide-and-shallow shapes
+        # (e.g. 2000 features x 63 bins = 128k units) are stream-bound
+        # again — hence the stream-size gate rather than a pure
+        # bin-count one.
         refine_shift = 0
         if (config.hist_refinement and wave_on and
                 self._bundles is None and not any_cat and
-                not any_missing and self.max_bin >= 128):
-            refine_shift = 4
+                not any_missing and self.max_bin >= 48 and
+                F * _pad_bins(self.max_bin) >= 7000):
+            refine_shift = 4 if self.max_bin > 64 else 3
         self.grow_params = GrowParams(
             split=SplitParams(
                 max_bin=self.max_bin,

@@ -422,10 +422,12 @@ def _hist_kernel_multi_win(x_ref, v_ref, s_ref, lo_ref, out_ref, *,
         valsc = v if exact else _split_hi_lo(v)
     sel_oh = (sel == jax.lax.broadcasted_iota(
         jnp.int32, (width, T), 0)).astype(jnp.float32)  # (W, T)
-    # per-row window start: lo[sel[t], f] via MXU instead of a gather
-    lo = lo_ref[...].astype(jnp.float32)                # (W, FC)
+    # per-row window start: lo[sel[t], f] via MXU instead of a gather.
+    # lo arrives (FC, W): a (W, FC) block would put FC on the 128-lane
+    # axis, which Mosaic rejects whenever features chunk (FC < F)
+    lo = lo_ref[...].astype(jnp.float32)                # (FC, W)
     lo_pr = jax.lax.dot_general(
-        lo.T, sel_oh, (((1,), (0,)), ((), ())),
+        lo, sel_oh, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)             # (FC, T)
     rbin = x - lo_pr.astype(jnp.int32)
     rhs = _rhs_from(sel_oh, valsc)
@@ -465,9 +467,9 @@ def histogram_pallas_multi_win(bins_t: jax.Array, vals: jax.Array,
         xt = jnp.pad(xt, ((0, f_pad - f), (0, 0)))
     vt = vals.astype(jnp.float32).T          # (3, N)
     st = sel.astype(jnp.int32)[None, :]      # (1, N)
-    lo = win_lo.astype(jnp.int32)
-    if f_pad != f:
-        lo = jnp.pad(lo, ((0, 0), (0, f_pad - f)))
+    lo = win_lo.astype(jnp.int32).T          # (F, W): W on the lane
+    if f_pad != f:                           # axis is always full
+        lo = jnp.pad(lo, ((0, f_pad - f), (0, 0)))
 
     out = pl.pallas_call(
         functools.partial(_hist_kernel_multi_win, r_pad=r_pad, width=W,
@@ -477,7 +479,7 @@ def histogram_pallas_multi_win(bins_t: jax.Array, vals: jax.Array,
             pl.BlockSpec((fc, t), lambda j, i: (j, i)),
             pl.BlockSpec((3, t), lambda j, i: (0, i)),
             pl.BlockSpec((1, t), lambda j, i: (0, i)),
-            pl.BlockSpec((W, fc), lambda j, i: (0, j)),
+            pl.BlockSpec((fc, W), lambda j, i: (j, 0)),
         ],
         out_specs=pl.BlockSpec((fc * r_pad, 128), lambda j, i: (j, 0)),
         out_shape=jax.ShapeDtypeStruct((f_pad * r_pad, 128),

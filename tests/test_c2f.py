@@ -182,14 +182,15 @@ def test_c2f_engine_auc():
     """End-to-end through the public API with hist_refinement on/off."""
     import lightgbm_tpu as lgb
     rng = np.random.RandomState(11)
-    N, F = 20000, 8
+    # F=28: the stream-size gate needs F * padded(max_bin) >= 7000
+    N, F = 20000, 28
     X = rng.randn(N, F)
     logit = X[:, 0] + 0.6 * X[:, 1] * X[:, 1] - 0.8 * (X[:, 2] > 0.3)
     y = (rng.random_sample(N) < 1 / (1 + np.exp(-logit))).astype(int)
     Xtr, ytr, Xva, yva = X[:16000], y[:16000], X[16000:], y[16000:]
     aucs = {}
     for ref in (True, False):
-        # max_bin=255: the driver only enables refinement at >=128 bins
+        # the stream-size gate needs F * padded(max_bin) >= 7000
         params = {"objective": "binary", "metric": "auc",
                   "num_leaves": 31, "learning_rate": 0.1,
                   "max_bin": 255, "wave_splits": True,
