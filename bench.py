@@ -114,25 +114,36 @@ def run_variant(lgb, params, train, n_meas, auc_fn, profiling=None,
                 phases[name.split("/")[-1]] = round(t / c * 1e3, 1)
         if phases:
             out["phase_ms_per_iter"] = phases
-    if diagnose_fetch and profiling is not None:
+    if diagnose_fetch:
         # the "fetch" phase at steady state is the WAIT for the
-        # in-flight device build (the transfer overlaps the next
-        # build); split it with a 1-element sync to show the truly
-        # exposed transfer residue.  Extra-RTT diagnosis — run after
-        # the main timing so it cannot pollute it.
-        os.environ["LTPU_SPLIT_FETCH_TIMER"] = "1"
+        # in-flight device build, not transfer.  The honest probe is a
+        # pipeline on/off A/B on the SAME booster (contiguous blocks;
+        # a 1-element-sync split timer mis-attributes, because the
+        # pack fetch queues behind the next build by construction).
+        g = booster._gbdt
+        prev_pipe = g._pipeline_enabled
         try:
-            profiling.reset()
+            g._pipeline_enabled = False
+            booster.update()              # flush transition
+            ts_off = []
             for _ in range(6):
+                t1 = time.time()
                 booster.update()
-            fet, fc = profiling.get("tree/fetch")
-            dw, dc = profiling.get("tree/device_wait")
-            if fc and dc:
-                out["fetch_device_wait_ms"] = round(dw / dc * 1e3, 1)
-                out["fetch_exposed_ms"] = round(
-                    max(fet / fc - dw / dc, 0.0) * 1e3, 1)
+                ts_off.append(time.time() - t1)
+            g._pipeline_enabled = prev_pipe
+            booster.update()
+            ts_on = []
+            for _ in range(6):
+                t1 = time.time()
+                booster.update()
+                ts_on.append(time.time() - t1)
+            med = lambda ts: sorted(ts)[len(ts) // 2]
+            out["pipeline_gain_ms_per_iter"] = round(
+                (med(ts_off) - med(ts_on)) * 1e3, 1)
+        except Exception as exc:
+            out["pipeline_probe_error"] = str(exc)[:200]
         finally:
-            os.environ.pop("LTPU_SPLIT_FETCH_TIMER", None)
+            g._pipeline_enabled = prev_pipe
     return out
 
 

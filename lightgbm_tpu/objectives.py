@@ -379,13 +379,31 @@ class Binary(Objective):
             jnp.float32)
 
     def get_gradients(self, score):
+        # jitted: the eager chain dispatches ~6 unfused (N,) kernels
+        # per iteration (each a full HBM round-trip on a ~26 GB/s chip)
+        if getattr(self, "_grad_fn", None) is None:
+            self._grad_fn = jax.jit(self._grads_impl,
+                                    static_argnames=("sigmoid",
+                                                     "weighted"))
+        w = self.weight if self.weight is not None else \
+            jnp.zeros((0,), jnp.float32)
+        return self._grad_fn(score, self.sign_label, self.cls_weight,
+                             w, sigmoid=self.sigmoid,
+                             weighted=self.weight is not None)
+
+    @staticmethod
+    def _grads_impl(score, sign_label, cls_weight, weight, *, sigmoid,
+                    weighted):
         # response = -yl*sigma / (1 + exp(yl*sigma*score))
-        t = self.sign_label * self.sigmoid
+        t = sign_label * sigmoid
         response = -t / (1.0 + jnp.exp(t * score))
         absr = jnp.abs(response)
-        grad = response * self.cls_weight
-        hess = absr * (self.sigmoid - absr) * self.cls_weight
-        return self._w(grad, hess)
+        grad = response * cls_weight
+        hess = absr * (sigmoid - absr) * cls_weight
+        if weighted:
+            grad = grad * weight
+            hess = hess * weight
+        return grad, hess
 
     def boost_from_score(self, class_id=0):
         p = min(max(self._p_mean, 1e-12), 1 - 1e-12)
