@@ -120,7 +120,6 @@ def run_variant(lgb, params, train, n_meas, auc_fn, profiling=None,
         # pipeline on/off A/B on the SAME booster (contiguous blocks;
         # a 1-element-sync split timer mis-attributes, because the
         # pack fetch queues behind the next build by construction).
-        g = booster._gbdt
         prev_pipe = g._pipeline_enabled
         try:
             g._pipeline_enabled = False
@@ -316,13 +315,18 @@ def main():
             bw.update()
             t0 = time.time()
             times_w = []
-            while len(times_w) < 20 and time.time() - t0 < 60:
+            # at least 5 samples even past the time cap: a single
+            # outlier iteration (one recompile / device hiccup) must
+            # not become "the median of one"
+            while len(times_w) < 20 and (time.time() - t0 < 60 or
+                                         len(times_w) < 5):
                 t1 = time.time()
                 bw.update()
                 times_w.append(time.time() - t1)
             if times_w:
                 perw = sorted(times_w)[len(times_w) // 2]
                 out["epsilon_shape_iters_per_s"] = round(1.0 / perw, 4)
+                out["epsilon_shape_samples"] = len(times_w)
         except Exception as exc:
             out["epsilon_shape_error"] = str(exc)[:200]
         print(json.dumps(out), flush=True)
@@ -368,7 +372,8 @@ def main():
             br.update(); br.update()
             times_r = []
             t0 = time.time()
-            while len(times_r) < 12 and time.time() - t0 < 90:
+            while len(times_r) < 12 and (time.time() - t0 < 90 or
+                                         len(times_r) < 4):
                 t1 = time.time(); br.update()
                 times_r.append(time.time() - t1)
             perr = sorted(times_r)[len(times_r) // 2]
@@ -430,7 +435,8 @@ def main():
             be.update(); be.update()
             times_e = []
             t0 = time.time()
-            while len(times_e) < 12 and time.time() - t0 < 90:
+            while len(times_e) < 12 and (time.time() - t0 < 90 or
+                                         len(times_e) < 4):
                 t1 = time.time(); be.update()
                 times_e.append(time.time() - t1)
             pere = sorted(times_e)[len(times_e) // 2]
@@ -460,7 +466,8 @@ def main():
             bm.update(); bm.update()
             times_m = []
             t0 = time.time()
-            while len(times_m) < 10 and time.time() - t0 < 90:
+            while len(times_m) < 10 and (time.time() - t0 < 90 or
+                                         len(times_m) < 4):
                 t1 = time.time(); bm.update()
                 times_m.append(time.time() - t1)
             perm = sorted(times_m)[len(times_m) // 2]

@@ -42,6 +42,18 @@ def create_objective(name: str, config) -> "Objective":
     return _REGISTRY[name](config)
 
 
+_EMPTY_F32 = None
+
+
+def _empty_f32():
+    """Cached 0-length weight sentinel (a fresh jnp.zeros per call is
+    an extra eager dispatch on the hot path)."""
+    global _EMPTY_F32
+    if _EMPTY_F32 is None:
+        _EMPTY_F32 = jnp.zeros((0,), jnp.float32)
+    return _EMPTY_F32
+
+
 class Objective:
     name = "base"
     is_constant_hessian = False
@@ -62,6 +74,21 @@ class Objective:
         if self.weight is not None:
             return grad * self.weight, hess * self.weight
         return grad, hess
+
+    def _jitted_gradients(self, impl, args, **statics):
+        """Dispatch ``impl(*args, weight, *, weighted=..., **statics)``
+        as ONE jitted program.  Eagerly, a gradient chain dispatches
+        each (N,)-scale op as its own HBM round-trip; fused it runs as
+        one pass.  ``weight`` rides as an argument (a closure over a
+        big device array would embed it in the remote-compile payload);
+        unweighted calls share a cached 0-length sentinel."""
+        if getattr(self, "_grad_fn", None) is None:
+            self._grad_fn = jax.jit(
+                impl,
+                static_argnames=tuple(statics) + ("weighted",))
+        w = self.weight if self.weight is not None else _empty_f32()
+        return self._grad_fn(*args, w, weighted=self.weight is not None,
+                             **statics)
 
     def get_gradients(self, score: jax.Array) -> Tuple[jax.Array, jax.Array]:
         raise NotImplementedError
@@ -379,17 +406,9 @@ class Binary(Objective):
             jnp.float32)
 
     def get_gradients(self, score):
-        # jitted: the eager chain dispatches ~6 unfused (N,) kernels
-        # per iteration (each a full HBM round-trip on a ~26 GB/s chip)
-        if getattr(self, "_grad_fn", None) is None:
-            self._grad_fn = jax.jit(self._grads_impl,
-                                    static_argnames=("sigmoid",
-                                                     "weighted"))
-        w = self.weight if self.weight is not None else \
-            jnp.zeros((0,), jnp.float32)
-        return self._grad_fn(score, self.sign_label, self.cls_weight,
-                             w, sigmoid=self.sigmoid,
-                             weighted=self.weight is not None)
+        return self._jitted_gradients(
+            self._grads_impl, (score, self.sign_label, self.cls_weight),
+            sigmoid=self.sigmoid)
 
     @staticmethod
     def _grads_impl(score, sign_label, cls_weight, weight, *, sigmoid,
@@ -438,13 +457,18 @@ class MulticlassSoftmax(Objective):
         self._class_init = np.log(np.maximum(counts / counts.sum(), 1e-10))
 
     def get_gradients(self, score):
+        return self._jitted_gradients(self._grads_impl,
+                                      (score, self._onehot))
+
+    @staticmethod
+    def _grads_impl(score, onehot, weight, *, weighted):
         # score (K, N)
         p = jax.nn.softmax(score, axis=0)
-        grad = p - self._onehot
+        grad = p - onehot
         hess = 2.0 * p * (1.0 - p)
-        if self.weight is not None:
-            grad = grad * self.weight[None, :]
-            hess = hess * self.weight[None, :]
+        if weighted:
+            grad = grad * weight[None, :]
+            hess = hess * weight[None, :]
         return grad, hess
 
     def boost_from_score(self, class_id=0):
@@ -620,22 +644,16 @@ class LambdaRank(Objective):
         # eagerly, every (cq, mq, mq) intermediate of the lambda chain
         # materializes to HBM (tens of GB per iteration at this chip's
         # ~26 GB/s) — fused under jit it stays in registers/VMEM
-        if getattr(self, "_grad_fn", None) is None:
-            self._grad_fn = jax.jit(
-                self._grads_impl,
-                static_argnames=("n", "nchunks", "cq", "norm",
-                                 "sigmoid", "weighted"))
         nq, mq = self._doc_idx.shape
         cq = max(1, min(nq, int(2e7 // max(mq * mq, 1))))
         nchunks = (nq + cq - 1) // cq
         n = int(score.reshape(-1).shape[0])
-        w = self.weight if self.weight is not None else \
-            jnp.zeros((0,), jnp.float32)
-        return self._grad_fn(
-            score, self._doc_idx, self._doc_valid, self._inv_max_dcg,
-            self._lbl_mat, self._gain_mat, w, n=n, nchunks=nchunks,
-            cq=cq, norm=self.norm, sigmoid=self.sigmoid,
-            weighted=self.weight is not None)
+        return self._jitted_gradients(
+            self._grads_impl,
+            (score, self._doc_idx, self._doc_valid, self._inv_max_dcg,
+             self._lbl_mat, self._gain_mat),
+            n=n, nchunks=nchunks, cq=cq, norm=self.norm,
+            sigmoid=self.sigmoid)
 
     @staticmethod
     def _grads_impl(score, doc_idx_all, valid_all, inv_max_all,
