@@ -2,25 +2,7 @@
 # stack is unavailable (this framework's dev image has no R toolchain;
 # see README.md for the build recipe).
 
-skip_if_no_backend <- function() {
-  ok <- tryCatch({
-    d <- lgb.Dataset(matrix(rnorm(40), ncol = 2L),
-                     label = rep(c(0, 1), 10L),
-                     params = list(min_data_in_bin = 1L, verbose = -1L))
-    lgb.Dataset.construct(d)
-    TRUE
-  }, error = function(e) FALSE)
-  if (!ok) {
-    skip("libltpu_capi.so backend unavailable")
-  }
-}
-
-make_toy <- function(n = 500L) {
-  set.seed(1L)
-  x <- matrix(rnorm(n * 4L), ncol = 4L)
-  y <- as.numeric(x[, 1L] + 0.5 * x[, 2L] + rnorm(n, sd = 0.1) > 0)
-  list(x = x, y = y)
-}
+# skip_if_no_backend / make_toy live in helper.R
 
 test_that("dataset roundtrip", {
   skip_if_no_backend()

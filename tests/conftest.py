@@ -29,7 +29,7 @@ REFERENCE_EXAMPLES = "/root/reference/examples"
 _SLOW_MODULES = {
     "test_consistency", "test_cli", "test_engine", "test_sklearn",
     "test_parallel", "test_quantized", "test_speculate",
-    "test_boosting_modes", "test_weak_scaling",
+    "test_boosting_modes", "test_weak_scaling", "test_bench_smoke",
 }
 
 

@@ -1,0 +1,34 @@
+"""bench.py CPU smoke: the driver runs the bench at every round end —
+a bench that crashes (bad section code, API drift) silently costs the
+round its artifact.  This pins that `python bench.py` completes on the
+CPU backend and emits a parsable JSON line with the contract fields.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_cpu_smoke():
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+                "BENCH_ROWS": "60000", "BENCH_MEAS_ITERS": "3"})
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.strip().splitlines()
+             if l.startswith("{")]
+    assert lines, out.stdout[-2000:]
+    d = json.loads(lines[-1])
+    assert d["metric"] == "higgs_shape_train_time_500iter"
+    assert d["unit"] == "s"
+    assert d["value"] > 0
+    assert "vs_baseline" in d
+    assert d["backend"] == "cpu"
+    assert d.get("auc_holdout") is None or d["auc_holdout"] > 0.5
