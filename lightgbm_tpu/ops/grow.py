@@ -1239,13 +1239,24 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         # leaf-output renewal from FULL-PRECISION gradient sums — the
         # quantized-training leaf refit (RenewIntGradTreeOutput,
         # src/treelearner/gradient_discretizer.cpp): leaf sums of the
-        # pre-quantization grad/hess via one single-"feature" histogram
-        # pass keyed by the final leaf assignment
+        # pre-quantization grad/hess keyed by the final leaf assignment
         from .histogram import histogram
-        ex = histogram(state["leaf_idx"][None, :],
-                       jnp.stack([g_w, h_w, sample_mask], axis=-1),
-                       max_bin=L, impl=p.hist_impl,
-                       rows_per_block=p.rows_per_block)
+        ex_vals = jnp.stack([g_w, h_w, sample_mask], axis=-1)
+        if p.hist_impl == "pallas" and L <= 256:
+            # leaf id split into (hi, lo) nibbles turns the 256-bin
+            # single-column pass into a 16-subset x 16-bin multi pass
+            # — ~4x less one-hot stream for the same exact sums (the
+            # tiler pads the 1-feature pass to fc=8, so 8x16=128 rows
+            # stream instead of ~2x256)
+            li_full = state["leaf_idx"].astype(jnp.int32)
+            ex16 = histogram_pallas_multi(
+                (li_full & 15)[None, :].astype(jnp.uint8), ex_vals,
+                li_full >> 4, 16, 16, p.rows_per_block)
+            ex = ex16.reshape(1, 16 * 16, 3)[:, :L]
+        else:
+            ex = histogram(state["leaf_idx"][None, :], ex_vals,
+                           max_bin=L, impl=p.hist_impl,
+                           rows_per_block=p.rows_per_block)
         if kind in ("data", "voting"):
             ex = jax.lax.psum(ex, ax)
         extra["leaf_stats_exact"] = ex[0, :L]
