@@ -38,14 +38,15 @@ def _oracle(exdir, *args):
     assert proc.returncode == 0, proc.stderr[-2000:]
 
 
-def _oracle_train_predict(tmp_path, exdir, test_file, rounds):
+def _oracle_train_predict(tmp_path, exdir, test_file, rounds,
+                          *overrides):
     model = os.path.join(str(tmp_path), "oracle.model")
     pred = os.path.join(str(tmp_path), "oracle.pred")
     # early_stopping_round=0 keeps the oracle at exactly ``rounds``
     # even for confs that enable early stopping (multiclass)
     _oracle(exdir, "config=train.conf", f"num_trees={rounds}",
             "early_stopping_round=0", f"output_model={model}",
-            "verbose=-1")
+            "verbose=-1", *overrides)
     _oracle(exdir, "task=predict", f"data={test_file}",
             f"input_model={model}", f"output_result={pred}",
             "verbose=-1")
@@ -175,3 +176,42 @@ def test_lambdarank_matches_oracle(tmp_path):
 
     n_o, n_m = ndcg5(o_pred), ndcg5(m_pred)
     assert n_m >= n_o - 0.03, (n_m, n_o)
+
+
+def test_binary_fast_path_matches_oracle(tmp_path):
+    """The BENCH fast path (wave growth + quantized histograms +
+    coarse-to-fine refinement) against the oracle on real data: the
+    headline perf claims (docs/Benchmarks.md) rest on this path
+    delivering reference-class quality, so the parity pin must cover
+    it, not only the exact serial learner."""
+    exdir = os.path.join(EXAMPLES, "binary_classification")
+    rounds = 30
+    # a CONTROLLED comparison: the oracle gets the same learning-
+    # control overrides the fast path needs (min_data_in_leaf=1 is
+    # the two_col tier gate), so any quality delta is the fast path's
+    o_pred = _oracle_train_predict(tmp_path, exdir, "binary.test",
+                                   rounds, "min_data_in_leaf=1",
+                                   "max_bin=255")
+
+    conf = Config.str2dict(open(os.path.join(exdir, "train.conf")).read())
+    for k in ("task", "data", "valid_data", "output_model",
+              "is_training_metric", "num_trees", "num_iterations"):
+        conf.pop(k, None)
+    conf.update(num_iterations=rounds, verbose=-1,
+                wave_splits=True, use_quantized_grad=True,
+                min_data_in_leaf=1, max_bin=255, hist_refinement=True)
+    train = lgb.Dataset(os.path.join(exdir, "binary.train"), params=conf)
+    bst = lgb.train(conf, train, num_boost_round=rounds,
+                    verbose_eval=False)
+    gp = bst._gbdt.grow_params
+    assert gp.wave and gp.quantize > 0 and gp.refine_shift > 0 and \
+        gp.two_col, \
+        "fast path not engaged; the parity pin would be vacuous"
+    Xt, yt, _ = parse_file(os.path.join(exdir, "binary.test"))
+    m_pred = bst.predict(Xt)
+
+    auc = AUCMetric(Config())
+    a_o = auc.eval(np.asarray(yt, float), o_pred)
+    a_m = auc.eval(np.asarray(yt, float), m_pred)
+    assert a_m >= a_o - 0.01, (a_m, a_o)
+    assert abs(a_m - a_o) < 0.02, (a_m, a_o)
