@@ -3,13 +3,17 @@
 
 #' Feature importance table
 #'
-#' Gain, split-count and cover-free frequency per feature, sorted by
-#' gain, mirroring the upstream \code{lgb.importance} columns
-#' (Feature, Gain, Frequency — Cover is undefined for this framework's
-#' device trees and is reported as the split share).
+#' Gain, cover and split-count frequency per feature, sorted by gain,
+#' mirroring the upstream \code{lgb.importance} columns (Feature, Gain,
+#' Cover, Frequency).  Cover is the number of observations covered by
+#' the feature's splitting nodes, aggregated from
+#' \code{internal_count} in the model dump; when the dump cannot be
+#' parsed (jsonlite unavailable) it is reported as \code{NA_real_}
+#' rather than a lookalike value.
 #'
 #' @param model lgb.Booster
-#' @param percentage rescale Gain/Frequency to fractions of their sums
+#' @param percentage rescale Gain/Cover/Frequency to fractions of
+#'   their sums
 #' @export
 lgb.importance <- function(model, percentage = TRUE) {
   lgb.check.handle(model, "lgb.Booster")
@@ -18,13 +22,27 @@ lgb.importance <- function(model, percentage = TRUE) {
   nm <- names(gain)
   freq <- as.numeric(split)
   gain <- as.numeric(gain)
+  if (is.null(nm)) nm <- paste0("Column_", seq_along(gain) - 1L)
+  cover <- rep(NA_real_, length(gain))
+  cover_ok <- FALSE
+  if (requireNamespace("jsonlite", quietly = TRUE)) {
+    nodes <- tryCatch(lgb.model.dt.tree(model), error = function(e) NULL)
+    if (!is.null(nodes)) {
+      splits <- nodes[!is.na(nodes$split_index), , drop = FALSE]
+      agg <- tapply(as.numeric(splits$internal_count),
+                    splits$split_feature, sum)
+      cover <- as.numeric(agg[nm])
+      cover[is.na(cover)] <- 0
+      cover_ok <- TRUE
+    }
+  }
   if (percentage) {
     if (sum(gain) > 0) gain <- gain / sum(gain)
     if (sum(freq) > 0) freq <- freq / sum(freq)
+    if (cover_ok && sum(cover) > 0) cover <- cover / sum(cover)
   }
-  if (is.null(nm)) nm <- paste0("Column_", seq_along(gain) - 1L)
   df <- data.frame(Feature = nm, Gain = gain,
-                   Cover = freq, Frequency = freq,
+                   Cover = cover, Frequency = freq,
                    Split = as.numeric(split),
                    stringsAsFactors = FALSE)
   df <- df[order(-df$Gain), , drop = FALSE]

@@ -1,0 +1,100 @@
+/* Minimal JNI declaration header for COMPILE/LINK validation of the
+ * SWIG-generated wrapper in an image without a JDK.  Written from the
+ * public JNI specification (Java Native Interface Specification,
+ * "JNI Functions" chapter); primitive type sizes and the function-
+ * table slot positions of the entries the wrapper uses match the
+ * spec, with reserved padding for the unused slots.
+ *
+ * This is NOT a JNI implementation: there is no JVM here.  It exists
+ * so `tests/test_swig.py` can compile `ltpu_wrap.cxx` and link it
+ * against `libltpu_capi.so`, proving the generated code is well-formed
+ * and every LGBM_* symbol it references resolves.  See
+ * swig/RUNTIME_VALIDATION.md. */
+#ifndef LTPU_MINIMAL_JNI_H
+#define LTPU_MINIMAL_JNI_H
+
+#include <stdarg.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* -- primitive types (JNI spec, "Primitive Types") ------------------ */
+typedef uint8_t  jboolean;
+typedef int8_t   jbyte;
+typedef uint16_t jchar;
+typedef int16_t  jshort;
+typedef int32_t  jint;
+typedef int64_t  jlong;
+typedef float    jfloat;
+typedef double   jdouble;
+typedef jint     jsize;
+
+/* -- reference types (opaque) --------------------------------------- */
+typedef void *jobject;
+typedef jobject jclass;
+typedef jobject jstring;
+typedef jobject jarray;
+typedef jarray jbooleanArray;
+typedef jarray jbyteArray;
+typedef jarray jcharArray;
+typedef jarray jshortArray;
+typedef jarray jintArray;
+typedef jarray jlongArray;
+typedef jarray jfloatArray;
+typedef jarray jdoubleArray;
+typedef jarray jobjectArray;
+typedef jobject jthrowable;
+typedef jobject jweak;
+
+typedef union jvalue {
+  jboolean z; jbyte b; jchar c; jshort s; jint i; jlong j;
+  jfloat f; jdouble d; jobject l;
+} jvalue;
+
+typedef void *jfieldID;
+typedef void *jmethodID;
+
+#define JNI_FALSE 0
+#define JNI_TRUE 1
+#define JNI_OK 0
+#define JNI_ERR (-1)
+#define JNI_VERSION_1_8 0x00010008
+
+#define JNIEXPORT __attribute__((visibility("default")))
+#define JNIIMPORT
+#define JNICALL
+
+struct JNINativeInterface_;
+typedef const struct JNINativeInterface_ *JNIEnv;
+
+/* JNI function table.  Slot positions follow the spec's fixed layout:
+ * 0-3 reserved, 4 GetVersion, 5 DefineClass, 6 FindClass, ...,
+ * 14 ThrowNew, 17 ExceptionClear, 167 NewStringUTF,
+ * 169 GetStringUTFChars, 170 ReleaseStringUTFChars.  Unused slots are
+ * reserved void* padding so the used entries sit at their true
+ * offsets. */
+struct JNINativeInterface_ {
+  void *reserved0_3[4];                            /* slots 0-3   */
+  void *pad4_5[2];                                 /* 4-5         */
+  jclass (JNICALL *FindClass)(JNIEnv *, const char *);      /* 6 */
+  void *pad7_13[7];                                /* 7-13        */
+  jint (JNICALL *ThrowNew)(JNIEnv *, jclass, const char *); /* 14 */
+  void *pad15_16[2];                               /* 15-16       */
+  void (JNICALL *ExceptionClear)(JNIEnv *);        /* 17          */
+  void *pad18_166[149];                            /* 18-166      */
+  jstring (JNICALL *NewStringUTF)(JNIEnv *, const char *);  /* 167 */
+  void *pad168[1];                                 /* 168         */
+  const char *(JNICALL *GetStringUTFChars)(JNIEnv *, jstring,
+                                           jboolean *);     /* 169 */
+  void (JNICALL *ReleaseStringUTFChars)(JNIEnv *, jstring,
+                                        const char *);      /* 170 */
+  void *pad171_232[62];                            /* 171-232     */
+};
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* LTPU_MINIMAL_JNI_H */

@@ -3,8 +3,12 @@
 Validates that the interface file generates cleanly with ``swig -java``
 and that the helper surface (array/pointer functions, pointer casts,
 void** handle helpers, the SaveModelToString wrapper) is present in the
-generated wrapper.  The JNI compile itself needs a JDK, which this
-image does not ship — generation is the testable boundary.
+generated wrapper.  A JVM smoke call needs a JDK, which this image
+does not ship (swig/RUNTIME_VALIDATION.md); the testable boundary is
+generation PLUS a compile/link of the generated wrapper against a
+minimal spec-derived JNI header (``swig/jni_minimal/jni.h``) with
+``-Wl,--no-undefined`` — proving the generated C++ is well-formed and
+every C-API symbol it references resolves in ``libltpu_capi.so``.
 """
 import os
 import shutil
@@ -41,3 +45,34 @@ def test_swig_java_generation():
                     "LGBM_BoosterPredictForMat", "LGBM_NetworkInit"):
             assert sym in src, sym
         assert os.listdir(java_out)
+
+
+@pytest.mark.skipif(shutil.which("swig") is None or
+                    shutil.which("g++") is None, reason="no swig/g++")
+@pytest.mark.skipif(
+    not os.path.exists(os.path.join(REPO, "cpp", "libltpu_capi.so")),
+    reason="libltpu_capi.so not built")
+def test_swig_wrapper_compiles_and_links():
+    """Compile the generated JNI wrapper against the minimal spec
+    header and link it against libltpu_capi.so with --no-undefined:
+    every LGBM_* symbol the wrapper references must resolve.  (A JVM
+    smoke call is impossible without a JDK — see
+    swig/RUNTIME_VALIDATION.md.)"""
+    with tempfile.TemporaryDirectory() as td:
+        java_out = os.path.join(td, "java")
+        os.makedirs(java_out)
+        wrap = os.path.join(td, "ltpu_wrap.cxx")
+        subprocess.run(
+            ["swig", "-java", "-package", "io.ltpu", "-outdir", java_out,
+             "-o", wrap, os.path.join(REPO, "swig", "ltpu.i")],
+            check=True, capture_output=True)
+        so = os.path.join(td, "libltpu_java.so")
+        res = subprocess.run(
+            ["g++", "-shared", "-fPIC", wrap,
+             "-I" + os.path.join(REPO, "swig", "jni_minimal"),
+             "-I" + os.path.join(REPO, "swig"),
+             "-L" + os.path.join(REPO, "cpp"), "-lltpu_capi",
+             "-Wl,--no-undefined", "-o", so],
+            capture_output=True, text=True)
+        assert res.returncode == 0, res.stderr[-2000:]
+        assert os.path.exists(so)
