@@ -318,18 +318,27 @@ PARAMS: List[Param] = [
        "histogram pass (K = the speculative pass width) instead of one "
        "leaf at a time: same greedy gain criterion, bulk-synchronous "
        "order — cuts the sequential growth loop from num_leaves-1 steps "
-       "to ~log2(K)+num_leaves/K (device serial learner only)",
+       "to ~log2(K)+num_leaves/K.  Composes with every tree_learner: "
+       "serial, data (whole-wave histogram psum), feature (batched "
+       "best merge + owner-bit routing psum), voting (batched "
+       "elected-only psum)",
        group="device"),
     _p("hist_refinement", True, bool, ("coarse_to_fine",),
        "coarse-to-fine histograms on the wave path: a cheap coarse pass "
        "(bins collapsed 16-to-1) locates the best split region per "
        "(leaf, feature) and one narrow windowed pass resolves it at "
-       "fine resolution — ~2x faster histograms at 255 bins.  Split "
-       "choice is exact whenever the best fine threshold lies in the "
-       "refine window (2 coarse bins around the best coarse boundary). "
-       "Auto-disabled for categorical features, missing values, EFB "
-       "bundles, max_bin<48, and shapes where the per-pass fixed cost "
-       "outweighs the stream saving (features x padded bins < ~7000)",
+       "fine resolution — ~2x faster histograms at 255 bins.  NOTE: "
+       "defaults ON, which makes split SELECTION approximate on "
+       "eligible shapes — the chosen split can differ from an "
+       "exhaustive scan when the best fine threshold falls outside "
+       "the refine window (2 coarse bins around the best coarse "
+       "boundary); set false for reference-exact selection.  Quality "
+       "is pinned by iteration-matched AUC tests, not split parity. "
+       "Missing values are supported (reserved coarse slot + default-"
+       "direction scans).  Auto-disabled for categorical features, EFB "
+       "bundles, max_bin<48, feature/voting parallel learners, and "
+       "shapes where the per-pass fixed cost outweighs the stream "
+       "saving (features x padded bins < ~7000)",
        group="device"),
 ]
 

@@ -220,22 +220,25 @@ class GBDT:
         any_cat = bool(any(m.bin_type == BIN_CATEGORICAL
                            for m in mappers))
         any_missing = bool(any(m.missing_type != 0 for m in mappers))
-        # wave growth composes with the data-parallel learner (psum-ed
-        # whole-wave histograms; grow.py wave_dist) the way the
+        # wave growth composes with ALL parallel learners the way the
         # reference's GPU learner composes by template parameter
-        # (data_parallel_tree_learner.cpp:258-259); feature/voting
-        # learners still take the exact per-leaf path
-        wave_dist_ok = not dist_active or learner == "data"
-        wave_on = bool(config.wave_splits and wave_dist_ok and
-                       use_pool and not forced)
+        # (data_parallel_tree_learner.cpp:258-259, tree_learner.cpp:
+        # 9-33): data psums whole-wave histograms, feature merges
+        # children bests by a batched all-gather arg-max, voting
+        # psums only the elected features' histograms (grow.py)
+        wave_on = bool(config.wave_splits and use_pool and not forced)
         # two-column quantized passes (W=64): legal only when the count
         # channel is provably redundant (GrowParams.two_col contract).
-        # Missing values also gate it off: the default-direction test
-        # reads the missing bin's count, and a hess copy can quantize
-        # to zero there even when missing rows exist.
+        # With missing values the default-direction "any missing data
+        # here?" test reads the hess-copy channel instead of a count —
+        # a row whose quantized hess rounds to 0 is then treated as
+        # absent for direction choice only (both directions tie in
+        # gain in that case; quality is pinned by the NaN-injection
+        # oracle test).  Categorical features still gate it off: their
+        # scans read REAL counts (cnt_ok, min_data_per_group).
         two_col = bool(
             config.use_quantized_grad and wave_on and
-            self._bundles is None and not any_cat and not any_missing and
+            self._bundles is None and not any_cat and
             config.min_data_in_leaf <= 1 and
             config.min_sum_hessian_in_leaf > 0)
         self._counts_proxy = two_col
@@ -253,9 +256,12 @@ class GBDT:
         # bin-count one.
         refine_shift = 0
         if (config.hist_refinement and wave_on and
+                (not dist_active or learner == "data") and
                 self._bundles is None and not any_cat and
-                not any_missing and self.max_bin >= 48 and
+                self.max_bin >= 48 and
                 F * _pad_bins(self.max_bin) >= 7000):
+            # missing values ride a RESERVED last coarse slot (grow.py
+            # Bc_c2f) and a default-left row in the routed lane tables
             refine_shift = 4 if self.max_bin > 64 else 3
         self.grow_params = GrowParams(
             split=SplitParams(
@@ -288,12 +294,12 @@ class GBDT:
             bundled=self._bundles is not None,
             use_hist_pool=use_pool,
             # quantized-gradient histograms: small ints are exact in
-            # bf16, halving the value columns; serial learner, or
-            # data-parallel under wave growth (shard-consistent scale)
+            # bf16, halving the value columns; serial learner, or any
+            # parallel learner under wave growth (shard-consistent
+            # scale via pmax; noise hashed from global row index)
             quantize=(config.num_grad_quant_bins
                       if (config.use_quantized_grad and
-                          (not dist_active or
-                           (learner == "data" and wave_on)))
+                          (not dist_active or wave_on))
                       else 0),
             spec_tolerance=float(config.speculative_tolerance),
             # wave growth (wave_splits): top-W splits applied per loop

@@ -257,15 +257,18 @@ def histogram(bins_t: jax.Array, vals: jax.Array, max_bin: int,
                             exact=exact)
 
 
-def _hist_kernel_multi(x_ref, v_ref, s_ref, out_ref, *, b_pad: int,
+def _hist_kernel_multi(x_ref, v_ref, s_ref, *rest, b_pad: int,
                        width: int, exact: bool, two_col: bool = False,
-                       shift: int = 0):
+                       shift: int = 0, miss_idx: int = -1):
     """Multi-leaf variant: one pass accumulates histograms for up to
     ``width`` row-disjoint subsets (the speculative child-arming pass).
 
     x_ref: (FC, T) int32 bins; v_ref: (3, T) f32; s_ref: (1, T) int32
     subset selector in [-1, width); out_ref: (FC*B, 128) f32, columns
-    beyond cols*width are zero padding.
+    beyond cols*width are zero padding.  With ``miss_idx >= 0`` an
+    extra (FC, 1) per-feature missing-bin ref precedes out_ref and
+    rows at their feature's missing bin map to the RESERVED coarse
+    slot ``miss_idx`` instead of ``bin >> shift``.
 
     The rhs grows from cols to cols*width columns, filling the MXU lane
     dimension (126/128 at width 21×6 or 42×3, 128/128 at 64×2) that the
@@ -273,6 +276,11 @@ def _hist_kernel_multi(x_ref, v_ref, s_ref, out_ref, *, b_pad: int,
     more than a single-leaf one.
     """
     import jax.experimental.pallas as pl
+
+    if miss_idx >= 0:
+        mb_ref, out_ref = rest
+    else:
+        (out_ref,) = rest
 
     @pl.when(pl.program_id(1) == 0)
     def _init():
@@ -283,7 +291,11 @@ def _hist_kernel_multi(x_ref, v_ref, s_ref, out_ref, *, b_pad: int,
     if shift:
         # coarse pass: bins collapsed 2^shift-to-1 on the fly — the
         # coarse-to-fine first stage streams b_pad/2^shift one-hot rows
-        x = x >> shift
+        if miss_idx >= 0:
+            mb = mb_ref[...].astype(jnp.int32)      # (FC, 1)
+            x = jnp.where(x == mb, miss_idx, x >> shift)
+        else:
+            x = x >> shift
     v = v_ref[...]                      # (3, T)
     sel = s_ref[...]                    # (1, T)
     if two_col:
@@ -312,7 +324,7 @@ def histogram_pallas_multi(bins_t: jax.Array, vals: jax.Array,
                            rows_per_block: int = 1024,
                            exact: bool = False,
                            two_col: bool = False,
-                           shift: int = 0) -> jax.Array:
+                           shift: int = 0, miss_bin=None) -> jax.Array:
     """Batched histogram over ``width`` disjoint row subsets.
 
     bins_t (F, N) ints; vals (N, 3) f32; sel (N,) int32 subset id per
@@ -323,7 +335,9 @@ def histogram_pallas_multi(bins_t: jax.Array, vals: jax.Array,
 
     With ``shift`` > 0 the stored fine bins are collapsed ``2^shift``-
     to-1 in the kernel (coarse-to-fine first stage); ``max_bin`` is
-    then the COARSE bin count.
+    then the COARSE bin count.  ``miss_bin`` (F,) int32 (with shift):
+    rows at their feature's missing bin map to the reserved last
+    coarse slot instead (see the segsum reference).
     """
     import jax.experimental.pallas as pl
 
@@ -337,23 +351,42 @@ def histogram_pallas_multi(bins_t: jax.Array, vals: jax.Array,
     xt = bins_t                              # narrow storage dtype
     if f_pad != f:
         xt = jnp.pad(xt, ((0, f_pad - f), (0, 0)))
-    vt = vals.astype(jnp.float32).T          # (3, N)
+    # narrow value operand: quantized gradients are small ints, exact
+    # in int8/bf16 — keep the (3, N) operand at 1 byte/entry (it is
+    # re-read from HBM EVERY pass; f32 costs ~4.8 ms/pass at bench
+    # shape on a ~26 GB/s chip, int8 ~1.2 ms).  Only the exact/two_col
+    # kernels may take it (the hi/lo float split needs f32).
+    if vals.dtype == jnp.int8:
+        assert exact or two_col, "int8 values need exact/two_col"
+        vt = vals.T                          # (3, N) int8
+    else:
+        vt = vals.astype(jnp.float32).T      # (3, N)
     st = sel.astype(jnp.int32)[None, :]      # (1, N)
 
+    in_specs = [
+        pl.BlockSpec((fc, t), lambda j, i: (j, i)),
+        pl.BlockSpec((3, t), lambda j, i: (0, i)),
+        pl.BlockSpec((1, t), lambda j, i: (0, i)),
+    ]
+    operands = [xt, vt, st]
+    miss_idx = -1
+    if miss_bin is not None and shift:
+        miss_idx = max_bin - 1
+        mb = jnp.pad(miss_bin.astype(jnp.int32), (0, f_pad - f),
+                     constant_values=-1)[:, None]       # (f_pad, 1)
+        in_specs.append(pl.BlockSpec((fc, 1), lambda j, i: (j, 0)))
+        operands.append(mb)
     out = pl.pallas_call(
         functools.partial(_hist_kernel_multi, b_pad=b_pad, width=W,
-                          exact=exact, two_col=two_col, shift=shift),
+                          exact=exact, two_col=two_col, shift=shift,
+                          miss_idx=miss_idx),
         grid=(f_pad // fc, n // t),
-        in_specs=[
-            pl.BlockSpec((fc, t), lambda j, i: (j, i)),
-            pl.BlockSpec((3, t), lambda j, i: (0, i)),
-            pl.BlockSpec((1, t), lambda j, i: (0, i)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((fc * b_pad, 128), lambda j, i: (j, 0)),
         out_shape=jax.ShapeDtypeStruct((f_pad * b_pad, 128),
                                        jnp.float32),
         compiler_params=_compiler_params(),
-    )(xt, vt, st)
+    )(*operands)
     out = out[:, :cols * W].reshape(f_pad, b_pad, W, cols)
     if two_col:
         # count := hess copy keeps every downstream shape at (..., 3);
@@ -367,11 +400,20 @@ def histogram_pallas_multi(bins_t: jax.Array, vals: jax.Array,
 def histogram_segsum_multi(bins_t: jax.Array, vals: jax.Array,
                            sel: jax.Array, max_bin: int, width: int,
                            two_col: bool = False,
-                           shift: int = 0) -> jax.Array:
-    """jnp reference for :func:`histogram_pallas_multi` (CPU/tests)."""
+                           shift: int = 0, miss_bin=None) -> jax.Array:
+    """jnp reference for :func:`histogram_pallas_multi` (CPU/tests).
+
+    ``miss_bin`` (F,) int32 (or None): with ``shift``, rows whose fine
+    bin equals the feature's missing bin map to the RESERVED last
+    coarse slot ``max_bin - 1`` instead of ``bin >> shift`` (-1 =
+    feature has no missing bin)."""
     f, n = bins_t.shape
     if shift:
-        bins_t = bins_t.astype(jnp.int32) >> shift
+        x = bins_t.astype(jnp.int32)
+        cb = x >> shift
+        if miss_bin is not None:
+            cb = jnp.where(x == miss_bin[:, None], max_bin - 1, cb)
+        bins_t = cb
     outs = []
     for w in range(width):
         m = (sel == w).astype(vals.dtype)[:, None]
@@ -397,14 +439,22 @@ def histogram_segsum_multi(bins_t: jax.Array, vals: jax.Array,
 # one-hot — ~3% of the pass FLOPs, on the MXU.
 
 
-def _hist_kernel_multi_win(x_ref, v_ref, s_ref, lo_ref, out_ref, *,
+def _hist_kernel_multi_win(x_ref, v_ref, s_ref, lo_ref, *rest,
                            r_pad: int, width: int, exact: bool,
-                           two_col: bool):
+                           two_col: bool, with_miss: bool = False):
     """Windowed refine step: accumulate (leaf, feature)-windowed fine
     histograms.  x_ref (FC, T) bins; v_ref (3, T); s_ref (1, T) subset
     selector in [-1, width); lo_ref (width, FC) per-(subset, feature)
-    fine-bin window starts; out_ref (FC*R, 128)."""
+    fine-bin window starts; out_ref (FC*R, 128).  With ``with_miss``
+    an extra (FC, 1) missing-bin ref precedes out_ref and rows at
+    their feature's missing bin are excluded (windowed stats cover
+    VALUE bins only)."""
     import jax.experimental.pallas as pl
+
+    if with_miss:
+        mb_ref, out_ref = rest
+    else:
+        (out_ref,) = rest
 
     @pl.when(pl.program_id(1) == 0)
     def _init():
@@ -412,6 +462,9 @@ def _hist_kernel_multi_win(x_ref, v_ref, s_ref, lo_ref, out_ref, *,
 
     FC, T = x_ref.shape
     x = x_ref[...].astype(jnp.int32)
+    if with_miss:
+        mb = mb_ref[...].astype(jnp.int32)  # (FC, 1)
+        x = jnp.where(x == mb, -1, x)       # miss rows match no window
     v = v_ref[...]                      # (3, T)
     sel = s_ref[...]                    # (1, T)
     if two_col:
@@ -449,10 +502,12 @@ def histogram_pallas_multi_win(bins_t: jax.Array, vals: jax.Array,
                                r_bins: int, width: int,
                                rows_per_block: int = 1024,
                                exact: bool = False,
-                               two_col: bool = False) -> jax.Array:
+                               two_col: bool = False,
+                               miss_bin=None) -> jax.Array:
     """Windowed multi-subset histogram: per (subset, feature) only the
     fine bins in [win_lo, win_lo + r_bins) are accumulated, at relative
-    positions.  win_lo (width, F) int32.  Returns (width, F, R, 3)."""
+    positions.  win_lo (width, F) int32.  Returns (width, F, R, 3).
+    ``miss_bin`` (F,) int32 or None: missing-bin rows are excluded."""
     import jax.experimental.pallas as pl
 
     f, n = bins_t.shape
@@ -465,27 +520,39 @@ def histogram_pallas_multi_win(bins_t: jax.Array, vals: jax.Array,
     xt = bins_t
     if f_pad != f:
         xt = jnp.pad(xt, ((0, f_pad - f), (0, 0)))
-    vt = vals.astype(jnp.float32).T          # (3, N)
+    if vals.dtype == jnp.int8:               # see histogram_pallas_multi
+        assert exact or two_col, "int8 values need exact/two_col"
+        vt = vals.T                          # (3, N) int8
+    else:
+        vt = vals.astype(jnp.float32).T      # (3, N)
     st = sel.astype(jnp.int32)[None, :]      # (1, N)
     lo = win_lo.astype(jnp.int32).T          # (F, W): W on the lane
     if f_pad != f:                           # axis is always full
         lo = jnp.pad(lo, ((0, f_pad - f), (0, 0)))
 
+    in_specs = [
+        pl.BlockSpec((fc, t), lambda j, i: (j, i)),
+        pl.BlockSpec((3, t), lambda j, i: (0, i)),
+        pl.BlockSpec((1, t), lambda j, i: (0, i)),
+        pl.BlockSpec((fc, W), lambda j, i: (j, 0)),
+    ]
+    operands = [xt, vt, st, lo]
+    if miss_bin is not None:
+        mb = jnp.pad(miss_bin.astype(jnp.int32), (0, f_pad - f),
+                     constant_values=-1)[:, None]
+        in_specs.append(pl.BlockSpec((fc, 1), lambda j, i: (j, 0)))
+        operands.append(mb)
     out = pl.pallas_call(
         functools.partial(_hist_kernel_multi_win, r_pad=r_pad, width=W,
-                          exact=exact, two_col=two_col),
+                          exact=exact, two_col=two_col,
+                          with_miss=miss_bin is not None),
         grid=(f_pad // fc, n // t),
-        in_specs=[
-            pl.BlockSpec((fc, t), lambda j, i: (j, i)),
-            pl.BlockSpec((3, t), lambda j, i: (0, i)),
-            pl.BlockSpec((1, t), lambda j, i: (0, i)),
-            pl.BlockSpec((fc, W), lambda j, i: (j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((fc * r_pad, 128), lambda j, i: (j, 0)),
         out_shape=jax.ShapeDtypeStruct((f_pad * r_pad, 128),
                                        jnp.float32),
         compiler_params=_compiler_params(),
-    )(xt, vt, st, lo)
+    )(*operands)
     out = out[:, :cols * W].reshape(f_pad, r_pad, W, cols)
     if two_col:
         out = jnp.concatenate([out, out[..., 1:2]], axis=-1)
@@ -514,9 +581,12 @@ def histogram_pallas_multi_win(bins_t: jax.Array, vals: jax.Array,
 #   row 4: smaller-child-is-left flag (mode="small" only)
 
 
-def _routed_parts(x, li, tbl, width: int, mode: str):
+def _routed_parts(x, li, tbl, width: int, mode: str, mb=None):
     """Shared routing math: returns (sel_oh, li_new, sel_out).
-    x (FC, T) int32; li (1, T) int32; tbl (5, W) int32."""
+    x (FC, T) int32; li (1, T) int32; tbl (5-6, W) int32 (row 5 = the
+    per-lane default-left flag, used with ``mb`` (FC, 1) per-feature
+    missing bins: a row AT its lane feature's missing bin routes by
+    the default direction instead of the threshold compare)."""
     FC, T = x.shape
     W = width if mode == "small" else width // 2
     ids = tbl[0:1, :W]                              # (1, W)
@@ -538,6 +608,16 @@ def _routed_parts(x, li, tbl, width: int, mode: str):
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)         # (1, T)
     gl = in_wave & (col <= thr_pr)                  # (1, T)
+    if mb is not None and tbl.shape[0] >= 6:
+        # per-row missing bin of the lane's feature + default-left
+        mb_pr = jnp.sum(mb.astype(jnp.float32) * fsel, axis=0,
+                        keepdims=True)              # (1, T)
+        dl_pr = jax.lax.dot_general(
+            tbl[5:6, :W].astype(jnp.float32), lane_oh,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        is_miss = (col == mb_pr) & (mb_pr >= 0)
+        gl = gl | (in_wave & (dl_pr > 0.5) & is_miss)
     glf = gl.astype(jnp.float32)
     # leaf ids can exceed 256 (num_leaves>257), which is NOT bf16-exact
     # — TPU f32 dots execute as bf16 passes at default precision, so
@@ -571,11 +651,17 @@ def _routed_parts(x, li, tbl, width: int, mode: str):
     return sel_oh, li_new, sel_out
 
 
-def _hist_kernel_multi_routed(x_ref, v_ref, li_ref, tbl_ref, out_ref,
-                              li_out_ref, sel_out_ref, *, b_pad: int,
-                              width: int, exact: bool, two_col: bool,
-                              shift: int, mode: str):
+def _hist_kernel_multi_routed(x_ref, v_ref, li_ref, tbl_ref, *rest,
+                              b_pad: int, width: int, exact: bool,
+                              two_col: bool, shift: int, mode: str,
+                              miss_idx: int = -1,
+                              with_miss: bool = False):
     import jax.experimental.pallas as pl
+
+    if with_miss:
+        mb_ref, out_ref, li_out_ref, sel_out_ref = rest
+    else:
+        out_ref, li_out_ref, sel_out_ref = rest
 
     @pl.when(pl.program_id(0) == 0)
     def _init():
@@ -586,7 +672,9 @@ def _hist_kernel_multi_routed(x_ref, v_ref, li_ref, tbl_ref, out_ref,
     v = v_ref[...]
     li = li_ref[...].astype(jnp.int32)
     tbl = tbl_ref[...]
-    sel_oh, li_new, sel_out = _routed_parts(x, li, tbl, width, mode)
+    mb = mb_ref[...].astype(jnp.int32) if with_miss else None  # (FC, 1)
+    sel_oh, li_new, sel_out = _routed_parts(x, li, tbl, width, mode,
+                                            mb=mb)
     li_out_ref[...] = li_new.astype(li_out_ref.dtype)
     sel_out_ref[...] = sel_out
     if two_col:
@@ -596,7 +684,14 @@ def _hist_kernel_multi_routed(x_ref, v_ref, li_ref, tbl_ref, out_ref,
         cols = 3 if exact else 6
         valsc = v if exact else _split_hi_lo(v)
     rhs = _rhs_from(sel_oh, valsc)
-    xb = (x >> shift) if shift else x
+    if shift:
+        xb = x >> shift
+        if with_miss and miss_idx >= 0:
+            # rows at their feature's missing bin land in the RESERVED
+            # last coarse slot (see histogram_segsum_multi)
+            xb = jnp.where(x == mb, miss_idx, xb)
+    else:
+        xb = x
     onehot = (xb[:, None, :] ==
               jax.lax.broadcasted_iota(jnp.int32, (FC, b_pad, T), 1)
               ).astype(jnp.bfloat16)
@@ -626,13 +721,18 @@ def histogram_pallas_multi_routed(bins_t: jax.Array, vals: jax.Array,
                                   exact: bool = False,
                                   two_col: bool = False,
                                   shift: int = 0,
-                                  mode: str = "small"):
+                                  mode: str = "small",
+                                  miss_bin=None):
     """Multi-subset histogram with IN-KERNEL row routing.
 
-    bins_t (F, N); vals (N, 3) f32; leaf_idx (N,) int32; tables (5, W)
-    int32 (see module comment).  ``mode="small"``: subsets are the
+    bins_t (F, N); vals (N, 3) f32; leaf_idx (N,) int32; tables
+    (5-6, W) int32 (see module comment; row 5 = per-lane default-left,
+    required with ``miss_bin``).  ``mode="small"``: subsets are the
     smaller children (width W lanes); ``mode="children"``: both
     children (lanes 2W, width counts the OUTPUT lanes = 2W).
+    ``miss_bin`` (F,) int32 or None: rows at their lane feature's
+    missing bin route by the default direction, and with ``shift``
+    they land in the reserved last coarse slot.
     Returns (hist (width, F, B, 3), new_leaf_idx (N,), sel (N,)).
     """
     import jax.experimental.pallas as pl
@@ -648,23 +748,40 @@ def histogram_pallas_multi_routed(bins_t: jax.Array, vals: jax.Array,
     xt = bins_t
     if f_pad != f:
         xt = jnp.pad(xt, ((0, f_pad - f), (0, 0)))
-    vt = vals.astype(jnp.float32).T
+    if vals.dtype == jnp.int8:               # see histogram_pallas_multi
+        assert exact or two_col, "int8 values need exact/two_col"
+        vt = vals.T
+    else:
+        vt = vals.astype(jnp.float32).T
     # keep the leaf vector in its NARROW storage dtype (uint8 at
     # num_leaves<=255): it is re-read every pass
     lt = leaf_idx[None, :]
     W_tbl = tables.shape[1]
+    R_tbl = tables.shape[0]
 
+    in_specs = [
+        pl.BlockSpec((fc, t), lambda i: (0, i)),
+        pl.BlockSpec((3, t), lambda i: (0, i)),
+        pl.BlockSpec((1, t), lambda i: (0, i)),
+        pl.BlockSpec((R_tbl, W_tbl), lambda i: (0, 0)),
+    ]
+    operands = [xt, vt, lt, tables]
+    miss_idx = -1
+    if miss_bin is not None:
+        assert R_tbl >= 6, "missing routing needs the default-left row"
+        if shift:
+            miss_idx = max_bin - 1
+        mb = jnp.pad(miss_bin.astype(jnp.int32), (0, f_pad - f),
+                     constant_values=-1)[:, None]
+        in_specs.append(pl.BlockSpec((fc, 1), lambda i: (0, 0)))
+        operands.append(mb)
     out, li_new, sel = pl.pallas_call(
         functools.partial(_hist_kernel_multi_routed, b_pad=b_pad,
                           width=Wl, exact=exact, two_col=two_col,
-                          shift=shift, mode=mode),
+                          shift=shift, mode=mode, miss_idx=miss_idx,
+                          with_miss=miss_bin is not None),
         grid=(n // t,),
-        in_specs=[
-            pl.BlockSpec((fc, t), lambda i: (0, i)),
-            pl.BlockSpec((3, t), lambda i: (0, i)),
-            pl.BlockSpec((1, t), lambda i: (0, i)),
-            pl.BlockSpec((5, W_tbl), lambda i: (0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((fc * b_pad, 128), lambda i: (0, 0)),
             pl.BlockSpec((1, t), lambda i: (0, i)),
@@ -676,7 +793,7 @@ def histogram_pallas_multi_routed(bins_t: jax.Array, vals: jax.Array,
             jax.ShapeDtypeStruct((1, n), jnp.int32),
         ],
         compiler_params=_compiler_params(),
-    )(xt, vt, lt, tables)
+    )(*operands)
     out = out[:, :cols * Wl].reshape(f_pad, b_pad, Wl, cols)
     if two_col:
         out = jnp.concatenate([out, out[..., 1:2]], axis=-1)
@@ -689,8 +806,13 @@ def histogram_pallas_multi_routed(bins_t: jax.Array, vals: jax.Array,
 def histogram_segsum_multi_routed(bins_t, vals, leaf_idx, tables,
                                   max_bin: int, width: int,
                                   two_col: bool = False, shift: int = 0,
-                                  mode: str = "small"):
-    """jnp reference for :func:`histogram_pallas_multi_routed`."""
+                                  mode: str = "small", miss_bin=None):
+    """jnp reference for :func:`histogram_pallas_multi_routed`.
+
+    With missing support, ``tables`` carries a 6th row: the per-lane
+    default-left flag; ``miss_bin`` (F,) gives each feature's missing
+    bin (-1 = none).  A row at its lane feature's missing bin routes
+    by the default direction instead of the threshold compare."""
     W = width if mode == "small" else width // 2
     ids, colw, thrw, neww, slw = (tables[k, :W] for k in range(5))
     li = leaf_idx.astype(jnp.int32)
@@ -702,7 +824,14 @@ def histogram_segsum_multi_routed(bins_t, vals, leaf_idx, tables,
     col_id = colw[safe]
     col = jnp.take_along_axis(bins_t.astype(jnp.int32),
                               col_id[None, :], axis=0)[0]
-    gl = in_wave & (col <= thrw[safe])
+    gl_thr = col <= thrw[safe]
+    if tables.shape[0] >= 6 and miss_bin is not None:
+        dlw = tables[5, :W]
+        mb_row = miss_bin[col_id]
+        is_miss = (col == mb_row) & (mb_row >= 0)
+        gl = in_wave & (gl_thr | ((dlw[safe] > 0) & is_miss))
+    else:
+        gl = in_wave & gl_thr
     li_new = jnp.where(in_wave & ~gl, neww[safe], li)
     if mode == "small":
         to_small = gl == (slw[safe] > 0)
@@ -710,21 +839,241 @@ def histogram_segsum_multi_routed(bins_t, vals, leaf_idx, tables,
     else:
         sel = jnp.where(in_wave, lane + W * (~gl).astype(jnp.int32), -1)
     hist = histogram_segsum_multi(bins_t, vals, sel, max_bin, width,
-                                  two_col=two_col, shift=shift)
+                                  two_col=two_col, shift=shift,
+                                  miss_bin=miss_bin)
     return hist, li_new, sel
+
+
+# ---- lane-routed windowed pass -------------------------------------
+#
+# The c2f wave's refine stage used an (N,) int32 subset selector
+# written by the coarse pass (42 MB written + re-read per wave).  The
+# leaf vector ALREADY encodes the routing after the coarse pass
+# updated it: each row's leaf id IS its child leaf id.  This variant
+# takes the (uint8/int32) leaf vector plus a per-lane child-leaf-id
+# table and resolves the lane one-hot in-kernel — reading ~10 MB
+# instead of 42, and writing nothing.
+
+
+def _hist_kernel_multi_win_lanes(x_ref, v_ref, li_ref, ids_ref, lo_ref,
+                                 *rest, r_pad: int, width: int,
+                                 exact: bool, two_col: bool,
+                                 with_miss: bool = False):
+    import jax.experimental.pallas as pl
+
+    if with_miss:
+        mb_ref, out_ref = rest
+    else:
+        (out_ref,) = rest
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    FC, T = x_ref.shape
+    x = x_ref[...].astype(jnp.int32)
+    if with_miss:
+        mb = mb_ref[...].astype(jnp.int32)              # (FC, 1)
+        x = jnp.where(x == mb, -1, x)   # miss rows match no window
+    v = v_ref[...]
+    li = li_ref[...].astype(jnp.int32)                  # (1, T)
+    ids = ids_ref[...]                                  # (1, W)
+    if two_col:
+        valsc = v[:2]
+    else:
+        valsc = v if exact else _split_hi_lo(v)
+    sel_oh_f = (li == ids.T).astype(jnp.float32)        # (W, T)
+    lo = lo_ref[...].astype(jnp.float32)                # (FC, W)
+    lo_pr = jax.lax.dot_general(
+        lo, sel_oh_f, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)             # (FC, T)
+    rbin = x - lo_pr.astype(jnp.int32)
+    in_lane = jnp.sum(sel_oh_f, axis=0, keepdims=True) > 0.5
+    rbin = jnp.where(in_lane, rbin, -1)
+    rhs = _rhs_from(sel_oh_f.astype(jnp.bfloat16), valsc)
+    onehot = (rbin[:, None, :] ==
+              jax.lax.broadcasted_iota(jnp.int32, (FC, r_pad, T), 1)
+              ).astype(jnp.bfloat16)
+    acc = jax.lax.dot_general(
+        onehot.reshape(FC * r_pad, T), rhs.T, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    out_ref[...] += acc
+
+
+@functools.partial(jax.jit, static_argnames=("r_bins", "width",
+                                             "rows_per_block", "exact",
+                                             "two_col"))
+def histogram_pallas_multi_win_lanes(bins_t: jax.Array, vals: jax.Array,
+                                     leaf_idx: jax.Array,
+                                     lane_ids: jax.Array,
+                                     win_lo: jax.Array,
+                                     r_bins: int, width: int,
+                                     rows_per_block: int = 1024,
+                                     exact: bool = False,
+                                     two_col: bool = False,
+                                     miss_bin=None) -> jax.Array:
+    """Windowed multi-subset histogram routed by the LEAF VECTOR.
+
+    Like :func:`histogram_pallas_multi_win`, but subset membership is
+    ``leaf_idx[n] == lane_ids[w]`` instead of an explicit (N,)
+    selector.  lane_ids (width,) int32 child leaf ids (use an
+    out-of-range id for dead lanes); win_lo (width, F) int32.
+    Returns (width, F, R, 3).
+    """
+    import jax.experimental.pallas as pl
+
+    f, n = bins_t.shape
+    r_pad = _pad_bins(r_bins)
+    cols = 2 if two_col else (3 if exact else 6)
+    W = width
+    assert W * cols <= 128, (W, cols)
+    f_pad, fc, t = _tile(r_pad, f, 128, rows_per_block)
+    assert n % t == 0, (n, t)
+    xt = bins_t
+    if f_pad != f:
+        xt = jnp.pad(xt, ((0, f_pad - f), (0, 0)))
+    if vals.dtype == jnp.int8:
+        assert exact or two_col, "int8 values need exact/two_col"
+        vt = vals.T
+    else:
+        vt = vals.astype(jnp.float32).T
+    lt = leaf_idx[None, :]                   # narrow storage dtype
+    it = lane_ids.astype(jnp.int32)[None, :]  # (1, W)
+    lo = win_lo.astype(jnp.int32).T          # (F, W): W on the lanes
+    if f_pad != f:
+        lo = jnp.pad(lo, ((0, f_pad - f), (0, 0)))
+
+    in_specs = [
+        pl.BlockSpec((fc, t), lambda j, i: (j, i)),
+        pl.BlockSpec((3, t), lambda j, i: (0, i)),
+        pl.BlockSpec((1, t), lambda j, i: (0, i)),
+        pl.BlockSpec((1, W), lambda j, i: (0, 0)),
+        pl.BlockSpec((fc, W), lambda j, i: (j, 0)),
+    ]
+    operands = [xt, vt, lt, it, lo]
+    if miss_bin is not None:
+        mb = jnp.pad(miss_bin.astype(jnp.int32), (0, f_pad - f),
+                     constant_values=-1)[:, None]
+        in_specs.append(pl.BlockSpec((fc, 1), lambda j, i: (j, 0)))
+        operands.append(mb)
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel_multi_win_lanes, r_pad=r_pad,
+                          width=W, exact=exact, two_col=two_col,
+                          with_miss=miss_bin is not None),
+        grid=(f_pad // fc, n // t),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((fc * r_pad, 128), lambda j, i: (j, 0)),
+        out_shape=jax.ShapeDtypeStruct((f_pad * r_pad, 128),
+                                       jnp.float32),
+        compiler_params=_compiler_params(),
+    )(*operands)
+    out = out[:, :cols * W].reshape(f_pad, r_pad, W, cols)
+    if two_col:
+        out = jnp.concatenate([out, out[..., 1:2]], axis=-1)
+    elif not exact:
+        out = out[..., :3] + out[..., 3:]
+    return jnp.moveaxis(out[:f, :r_bins], 2, 0)    # (W, F, R, 3)
+
+
+def histogram_segsum_multi_win_lanes(bins_t, vals, leaf_idx, lane_ids,
+                                     win_lo, r_bins: int, width: int,
+                                     two_col: bool = False,
+                                     miss_bin=None) -> jax.Array:
+    """jnp reference for :func:`histogram_pallas_multi_win_lanes`."""
+    li = leaf_idx.astype(jnp.int32)
+    sel = jnp.full(li.shape, -1, jnp.int32)
+    for w in range(width):
+        sel = jnp.where(li == lane_ids[w], w, sel)
+    return histogram_segsum_multi_win(bins_t, vals, sel, win_lo,
+                                      r_bins, width, two_col=two_col,
+                                      miss_bin=miss_bin)
+
+
+# ---- leaf-stats (renewal) kernel -----------------------------------
+#
+# Quantized training renews leaf outputs from FULL-PRECISION per-leaf
+# gradient sums (RenewIntGradTreeOutput).  A generic 256-bin histogram
+# pass costs ~25 ms at bench shape, mostly intermediates: the (N, 3)
+# f32 value stack (126 MB written + re-read), the nibble-split bins
+# and an int32 selector.  This kernel reads ONLY the already-resident
+# arrays — leaf vector (uint8/int32) + grad + hess + mask — and
+# resolves the (hi, lo) leaf-nibble factorization internally: lo-
+# nibble one-hot rows (16, T) against an rhs of hi-nibble selectors x
+# hi/lo-split values (16 x 6 = 96 lanes).  acc[lo, hi*6+c] is then the
+# exact sum for leaf hi*16+lo.
+
+
+def _leaf_stats_kernel(li_ref, g_ref, h_ref, m_ref, out_ref):
+    import jax.experimental.pallas as pl
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    li = li_ref[...].astype(jnp.int32)          # (1, T)
+    m = m_ref[...]
+    g = g_ref[...] * m
+    h = h_ref[...] * m
+    T = li.shape[1]
+    v = jnp.concatenate([g, h, m], axis=0)      # (3, T) f32
+    valsc = _split_hi_lo(v)                     # (6, T)
+    sel_oh = ((li >> 4) == jax.lax.broadcasted_iota(
+        jnp.int32, (16, T), 0)).astype(jnp.bfloat16)     # (16, T)
+    rhs = _rhs_from(sel_oh, valsc)              # (128, T) bf16
+    onehot = ((li & 15) == jax.lax.broadcasted_iota(
+        jnp.int32, (16, T), 0)).astype(jnp.bfloat16)     # (16, T)
+    out_ref[...] += jax.lax.dot_general(
+        onehot, rhs.T, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)     # (16, 128)
+
+
+@functools.partial(jax.jit, static_argnames=("rows_per_block",))
+def leaf_stats_pallas(leaf_idx: jax.Array, grad: jax.Array,
+                      hess: jax.Array, mask: jax.Array,
+                      rows_per_block: int = 1024) -> jax.Array:
+    """Exact per-leaf [sum_grad, sum_hess, count] for up to 256 leaves.
+
+    leaf_idx (N,) uint8/int32 in [0, 256); grad/hess/mask (N,) f32
+    (mask applied in-kernel).  Returns (256, 3) f32 at hi/lo-split
+    (~2^-16 relative) accuracy — the same accuracy class as the
+    default histogram path.
+    """
+    import jax.experimental.pallas as pl
+
+    n = leaf_idx.shape[0]
+    t = min(16384, rows_per_block)
+    while n % t:
+        t //= 2
+    out = pl.pallas_call(
+        _leaf_stats_kernel,
+        grid=(n // t,),
+        in_specs=[pl.BlockSpec((1, t), lambda i: (0, i))] * 4,
+        out_specs=pl.BlockSpec((16, 128), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((16, 128), jnp.float32),
+        compiler_params=_compiler_params(),
+    )(leaf_idx[None, :], grad[None, :], hess[None, :], mask[None, :])
+    acc = out[:, :96].reshape(16, 16, 6)        # (lo, hi, cols)
+    acc = jnp.transpose(acc, (1, 0, 2)).reshape(256, 6)
+    return acc[:, :3] + acc[:, 3:]              # hi + lo parts
 
 
 def histogram_segsum_multi_win(bins_t: jax.Array, vals: jax.Array,
                                sel: jax.Array, win_lo: jax.Array,
                                r_bins: int, width: int,
-                               two_col: bool = False) -> jax.Array:
-    """jnp reference for :func:`histogram_pallas_multi_win`."""
+                               two_col: bool = False,
+                               miss_bin=None) -> jax.Array:
+    """jnp reference for :func:`histogram_pallas_multi_win`.
+    ``miss_bin`` (F,) int32 or None: rows at the feature's missing bin
+    are excluded from the window (windowed stats are VALUE bins only;
+    missing stats live in the reserved coarse slot)."""
     f, n = bins_t.shape
     x = bins_t.astype(jnp.int32)
     outs = []
     for w in range(width):
         rbin = x - win_lo[w][:, None]                  # (F, N)
         in_win = (rbin >= 0) & (rbin < r_bins)
+        if miss_bin is not None:
+            in_win = in_win & (x != miss_bin[:, None])
         m = (sel == w)[None, :] & in_win
         ids = jnp.where(m, rbin, r_bins) + \
             jnp.arange(f, dtype=jnp.int32)[:, None] * (r_bins + 1)

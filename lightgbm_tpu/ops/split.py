@@ -368,41 +368,80 @@ def find_best_split(hist: jax.Array, parent: jax.Array,
 # gates it (models/gbdt.py).
 
 
+def _c2f_miss(coarse: jax.Array, num_bins: jax.Array,
+              missing_type: jax.Array, params: SplitParams):
+    """Missing-bin stats on the c2f path.  With ``params.any_missing``
+    the LAST coarse slot is RESERVED for the per-feature missing bin
+    (the histogram kernels map ``x == num_bins-1`` there when the
+    feature has one); value bins occupy slots [0, Bc-1).  Returns
+    (value_slots (F, Bcv, 3), miss (F, 3), no_miss (F,))."""
+    if not params.any_missing:
+        F = coarse.shape[0]
+        return coarse, jnp.zeros((F, 3), coarse.dtype), None
+    has = (missing_type != 0)
+    miss = coarse[:, -1, :] * has[:, None]
+    # "no missing data in this leaf": with counts_proxy the count
+    # channel is a hess copy — the same proxy the constraint checks use
+    no_miss = miss[:, 2] <= 0
+    return coarse[:, :-1, :], miss, no_miss
+
+
 def _c2f_coarse_scan(coarse: jax.Array, parent: jax.Array,
                      num_bins: jax.Array, params: SplitParams,
                      shift: int, monotone=None, min_output=None,
-                     max_output=None):
-    """Gains at the coarse boundaries.  coarse (F, Bc, 3) dequantized;
-    returns (gains (F, Bc), L (F, Bc, 3), thr_fine (Bc,))."""
+                     max_output=None, missing_type=None):
+    """Gains at the coarse boundaries.  coarse (F, Bc, 3) dequantized
+    (last slot = reserved missing bin when ``params.any_missing``);
+    returns (gains (F, Bcv), L (F, Bcv, 3), thr_fine (Bcv,),
+    dir_left (F, Bcv))."""
     p = params
-    F, Bc, _ = coarse.shape
     l1, l2, mds = p.lambda_l1, p.lambda_l2, p.max_delta_step
     parent_gain = leaf_gain(parent[0], parent[1], l1, l2, mds)
     gain_shift = parent_gain + p.min_gain_to_split
-    cum = jnp.cumsum(coarse, axis=1)                  # (F, Bc, 3)
-    thr_fine = ((jnp.arange(Bc, dtype=jnp.int32) + 1) << shift) - 1
-    ok = thr_fine[None, :] <= num_bins[:, None] - 2
-    L = cum
-    R = parent[None, None, :] - L
+    vals, miss, no_miss = _c2f_miss(coarse, num_bins, missing_type, p)
+    F, Bcv, _ = vals.shape
+    cum = jnp.cumsum(vals, axis=1)                    # (F, Bcv, 3)
+    thr_fine = ((jnp.arange(Bcv, dtype=jnp.int32) + 1) << shift) - 1
+    if p.any_missing:
+        nv = num_bins - (missing_type != 0).astype(jnp.int32)
+    else:
+        nv = num_bins
+    ok = thr_fine[None, :] <= nv[:, None] - 2
     mono_col = None if monotone is None else monotone[:, None]
-    g = (_split_gain(L[..., 0], L[..., 1] + EPS,
-                     R[..., 0], R[..., 1] + EPS, l1, l2, mds,
-                     min_output, max_output, mono_col) - gain_shift)
-    ok = ok & _constraints(L, R, p)
-    return jnp.where(ok, g, NEG_INF), L, thr_fine
+
+    def scan_dir(default_left: bool):
+        L = cum + (miss[:, None, :] if default_left else 0.0)
+        R = parent[None, None, :] - L
+        g = (_split_gain(L[..., 0], L[..., 1] + EPS,
+                         R[..., 0], R[..., 1] + EPS, l1, l2, mds,
+                         min_output, max_output, mono_col) - gain_shift)
+        return jnp.where(ok & _constraints(L, R, p), g, NEG_INF), L
+
+    g_r, L_r = scan_dir(False)
+    if p.any_missing:
+        g_l, L_l = scan_dir(True)
+        g_l = jnp.where(no_miss[:, None], NEG_INF, g_l)
+        g = jnp.maximum(g_r, g_l)
+        dir_left = g_l > g_r
+        L = jnp.where(dir_left[..., None], L_l, L_r)
+    else:
+        g, L = g_r, L_r
+        dir_left = jnp.zeros_like(g, dtype=bool)
+    return g, L, thr_fine, dir_left
 
 
 def choose_window(coarse: jax.Array, parent: jax.Array,
                   num_bins: jax.Array, params: SplitParams, shift: int,
-                  monotone=None, min_output=None, max_output=None
-                  ) -> jax.Array:
+                  monotone=None, min_output=None, max_output=None,
+                  missing_type=None) -> jax.Array:
     """Pick the per-feature refine window start (fine-bin id, coarse-
     aligned): the 2 coarse bins straddling the best coarse boundary."""
-    g, _, _ = _c2f_coarse_scan(coarse, parent, num_bins, params, shift,
-                               monotone, min_output, max_output)
-    Bc = coarse.shape[1]
+    g, _, _, _ = _c2f_coarse_scan(coarse, parent, num_bins, params,
+                                  shift, monotone, min_output,
+                                  max_output, missing_type)
+    Bcv = g.shape[1]
     c_star = jnp.argmax(g, axis=1).astype(jnp.int32)        # (F,)
-    win_c = jnp.clip(c_star, 0, max(Bc - 2, 0))
+    win_c = jnp.clip(c_star, 0, max(Bcv - 2, 0))
     return win_c << shift
 
 
@@ -411,47 +450,75 @@ def find_best_split_c2f(coarse: jax.Array, win: jax.Array,
                         win_lo: jax.Array, parent: jax.Array,
                         num_bins: jax.Array, feature_mask: jax.Array,
                         params: SplitParams, shift: int, monotone=None,
-                        penalty=None, min_output=None, max_output=None):
+                        penalty=None, min_output=None, max_output=None,
+                        missing_type=None):
     """Best split from a coarse histogram + fine refine window.
 
     coarse (F, Bc, 3); win (F, R, 3) fine bins at positions
     [win_lo, win_lo + R); win_lo (F,) int32 coarse-aligned; parent (3,).
-    Same record contract as :func:`find_best_split`, numerical splits
-    without missing values only (default_left always False).
+    Same record contract as :func:`find_best_split`; numerical splits
+    only.  With ``params.any_missing`` the last coarse slot is the
+    reserved missing bin (see :func:`_c2f_miss`), the windowed stats
+    exclude missing rows, and both default directions are scanned.
     """
     p = params
-    F, Bc, _ = coarse.shape
+    F = coarse.shape[0]
     R_w = win.shape[1]
     B = p.max_bin
     l1, l2, mds = p.lambda_l1, p.lambda_l2, p.max_delta_step
     mn, mx = min_output, max_output
-    g_c, L_c, thr_c = _c2f_coarse_scan(coarse, parent, num_bins, p,
-                                       shift, monotone, mn, mx)
+    g_c, L_c, thr_c, dirl_c = _c2f_coarse_scan(
+        coarse, parent, num_bins, p, shift, monotone, mn, mx,
+        missing_type)
+    Bcv = g_c.shape[1]
     parent_gain = leaf_gain(parent[0], parent[1], l1, l2, mds)
     gain_shift = parent_gain + p.min_gain_to_split
+    vals_c, miss, no_miss = _c2f_miss(coarse, num_bins, missing_type, p)
+    if p.any_missing:
+        has_missing = missing_type != 0
+        nv = num_bins - has_missing.astype(jnp.int32)
+    else:
+        has_missing = jnp.zeros((F,), bool)
+        nv = num_bins
 
     # fine candidates: exact prefix = coarse prefix before the window
     # (win_lo is coarse-aligned) + fine prefix within the window
-    cum_c = jnp.cumsum(coarse, axis=1)
+    cum_c = jnp.cumsum(vals_c, axis=1)
     cpad = jnp.concatenate([jnp.zeros((F, 1, 3), coarse.dtype), cum_c],
                            axis=1)
     win_c0 = (win_lo >> shift).astype(jnp.int32)
     base = jnp.take_along_axis(cpad, win_c0[:, None, None],
                                axis=1)                   # (F, 1, 3)
-    L_f = base + jnp.cumsum(win, axis=1)                 # (F, R, 3)
+    Lf_base = base + jnp.cumsum(win, axis=1)             # (F, R, 3)
     thr_f = win_lo[:, None] + jnp.arange(R_w, dtype=jnp.int32)[None, :]
-    ok_f = thr_f <= num_bins[:, None] - 2
-    R_side = parent[None, None, :] - L_f
+    ok_f = thr_f <= nv[:, None] - 2
     mono_col = None if monotone is None else monotone[:, None]
-    g_f = (_split_gain(L_f[..., 0], L_f[..., 1] + EPS,
-                       R_side[..., 0], R_side[..., 1] + EPS, l1, l2, mds,
-                       mn, mx, mono_col) - gain_shift)
-    g_f = jnp.where(ok_f & _constraints(L_f, R_side, p), g_f, NEG_INF)
 
-    all_gain = jnp.concatenate([g_c, g_f], axis=1)       # (F, Bc+R)
+    def fine_dir(default_left: bool):
+        L_f = Lf_base + (miss[:, None, :] if default_left else 0.0)
+        R_side = parent[None, None, :] - L_f
+        g = (_split_gain(L_f[..., 0], L_f[..., 1] + EPS,
+                         R_side[..., 0], R_side[..., 1] + EPS, l1, l2,
+                         mds, mn, mx, mono_col) - gain_shift)
+        return jnp.where(ok_f & _constraints(L_f, R_side, p), g,
+                         NEG_INF), L_f
+
+    gf_r, Lf_r = fine_dir(False)
+    if p.any_missing:
+        gf_l, Lf_l = fine_dir(True)
+        gf_l = jnp.where(no_miss[:, None], NEG_INF, gf_l)
+        g_f = jnp.maximum(gf_r, gf_l)
+        dirl_f = gf_l > gf_r
+        L_f = jnp.where(dirl_f[..., None], Lf_l, Lf_r)
+    else:
+        g_f, L_f = gf_r, Lf_r
+        dirl_f = jnp.zeros_like(g_f, dtype=bool)
+
+    all_gain = jnp.concatenate([g_c, g_f], axis=1)       # (F, Bcv+R)
     all_thr = jnp.concatenate(
-        [jnp.broadcast_to(thr_c[None, :], (F, Bc)), thr_f], axis=1)
+        [jnp.broadcast_to(thr_c[None, :], (F, Bcv)), thr_f], axis=1)
     all_L = jnp.concatenate([L_c, L_f], axis=1)
+    all_dirl = jnp.concatenate([dirl_c, dirl_f], axis=1)
     if penalty is not None:
         all_gain = jnp.where(all_gain > 0.5 * NEG_INF,
                              all_gain * penalty[:, None], all_gain)
@@ -461,13 +528,19 @@ def find_best_split_c2f(coarse: jax.Array, win: jax.Array,
     f_star = jnp.argmax(best_per_f).astype(jnp.int32)
     k_star = best_k[f_star]
     j_star = all_thr[f_star, k_star]
+    dir_left = all_dirl[f_star, k_star]
     jidx = jnp.arange(B, dtype=jnp.int32)
-    left_mask = (jidx <= j_star) & (jidx < num_bins[f_star])
+    nv_f = nv[f_star]
+    left_mask = (jidx <= j_star) & (jidx < nv_f)
+    if p.any_missing:
+        left_mask = left_mask | \
+            (dir_left & has_missing[f_star] &
+             (jidx == num_bins[f_star] - 1))
     return {
         "gain": best_per_f[f_star],
         "feature": f_star,
         "threshold": j_star,
-        "default_left": jnp.asarray(False),
+        "default_left": dir_left,
         "is_cat": jnp.asarray(False),
         "left_mask": left_mask,
         "left_stats": all_L[f_star, k_star],

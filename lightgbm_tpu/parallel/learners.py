@@ -125,7 +125,8 @@ class DistributedBuilder:
         do_spec = (self.params.speculate > 1 and
                    self.params.use_hist_pool and
                    not self.params.forced and
-                   kind == "data" and self.params.wave)
+                   kind in ("data", "feature", "voting") and
+                   self.params.wave)
         if do_spec:
             out_specs["n_arm_passes"] = R
         if self.params.quantize:

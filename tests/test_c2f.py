@@ -206,3 +206,110 @@ def test_c2f_engine_auc():
         aucs[ref] = res["va"]["auc"][-1]
     assert aucs[True] > 0.5
     assert abs(aucs[True] - aucs[False]) < 0.01, aucs
+
+
+# ---- missing-value c2f -------------------------------------------------
+
+def _missing_leaf_case(seed, B=64, F=6, N=4096, miss_frac=0.15):
+    """Binned data where each feature's LAST bin is the missing bin."""
+    rng = np.random.RandomState(seed)
+    nv = B - 1                      # value bins 0..B-2, missing = B-1
+    bins = rng.randint(0, nv, size=(F, N)).astype(np.int32)
+    miss = rng.random_sample((F, N)) < miss_frac
+    bins[miss] = B - 1
+    y = (bins[0] > rng.randint(10, 50)).astype(np.float32) + \
+        0.3 * miss[0] + 0.2 * rng.randn(N).astype(np.float32)
+    grad = (0.5 - y).astype(np.float32)
+    hess = np.ones(N, np.float32)
+    vals = np.stack([grad, hess, np.ones(N, np.float32)], -1)
+    return bins, vals
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_c2f_missing_vs_full_single_leaf(seed):
+    """c2f with the reserved missing coarse slot must agree with the
+    full-resolution scan (threshold, direction, stats) whenever the
+    best fine threshold lands in the window or on a boundary."""
+    B, F, shift = 64, 6, 3
+    R = 2 << shift
+    bins, vals = _missing_leaf_case(seed, B=B, F=F)
+    sp = SplitParams(max_bin=B, min_data_in_leaf=5, any_cat=False,
+                     any_missing=True)
+    nb = jnp.full(F, B, jnp.int32)
+    mt = jnp.full(F, 1, jnp.int32)          # MissingType NaN
+    mb = nb - 1
+    fm = jnp.ones(F, bool)
+    zsel = jnp.zeros(bins.shape[1], jnp.int32)
+    hist = histogram_segsum_multi(jnp.asarray(bins), jnp.asarray(vals),
+                                  zsel, B, 1)[0]
+    parent = jnp.sum(hist[0], axis=0)
+    full = find_best_split(hist, parent, nb, mt,
+                           jnp.zeros(F, bool), fm, sp)
+    Bc = ((B - 1) >> shift) + 2             # +1 reserved missing slot
+    coarse = histogram_segsum_multi(
+        jnp.asarray(bins), jnp.asarray(vals), zsel, Bc, 1,
+        shift=shift, miss_bin=mb)[0]
+    # reserved slot must hold exactly the missing-bin stats
+    np.testing.assert_allclose(np.asarray(coarse[:, -1]),
+                               np.asarray(hist[:, B - 1]),
+                               rtol=1e-5, atol=1e-4)
+    lo = choose_window(coarse, parent, nb, sp, shift, missing_type=mt)
+    win = histogram_segsum_multi_win(
+        jnp.asarray(bins), jnp.asarray(vals), zsel, lo[None, :], R, 1,
+        miss_bin=mb)[0]
+    c2f = find_best_split_c2f(coarse, win, lo, parent, nb, fm, sp,
+                              shift, missing_type=mt)
+    g_full, g_c2f = float(full["gain"]), float(c2f["gain"])
+    assert g_c2f <= g_full + 1e-3 * abs(g_full) + 1e-4
+    thr_full = int(full["threshold"])
+    f_full = int(full["feature"])
+    in_win = int(lo[f_full]) <= thr_full < int(lo[f_full]) + R
+    on_boundary = (thr_full + 1) % (1 << shift) == 0
+    if in_win or on_boundary:
+        assert g_c2f >= g_full - 1e-3 * abs(g_full) - 1e-4
+        assert int(c2f["threshold"]) == thr_full
+        assert int(c2f["feature"]) == f_full
+        assert bool(c2f["default_left"]) == bool(full["default_left"])
+        np.testing.assert_allclose(np.asarray(c2f["left_stats"]),
+                                   np.asarray(full["left_stats"]),
+                                   rtol=1e-4, atol=1e-3)
+        np.testing.assert_array_equal(np.asarray(c2f["left_mask"]),
+                                      np.asarray(full["left_mask"]))
+
+
+def test_c2f_engine_auc_with_missing():
+    """End-to-end: NaN-laden data runs the wave + quantized + c2f fast
+    tiers (no exact-tier fallback) at quality parity with the
+    full-resolution exact scan."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(23)
+    N, F = 20000, 28
+    X = rng.randn(N, F)
+    logit = X[:, 0] + 0.6 * X[:, 1] * X[:, 1] - 0.8 * (X[:, 2] > 0.3)
+    y = (rng.random_sample(N) < 1 / (1 + np.exp(-logit))).astype(int)
+    X[rng.random_sample((N, F)) < 0.1] = np.nan     # 10% missing
+    Xtr, ytr, Xva, yva = X[:16000], y[:16000], X[16000:], y[16000:]
+    aucs = {}
+    for fast in (True, False):
+        params = {"objective": "binary", "metric": "auc",
+                  "num_leaves": 31, "learning_rate": 0.1,
+                  "max_bin": 255, "wave_splits": fast,
+                  "use_quantized_grad": fast, "min_data_in_leaf": 1,
+                  "hist_refinement": fast, "verbose": -1}
+        ds = lgb.Dataset(Xtr, label=ytr)
+        vs = ds.create_valid(Xva, label=yva)
+        res = {}
+        bst = lgb.train(params, ds, num_boost_round=20,
+                        valid_sets=[vs], valid_names=["va"],
+                        callbacks=[lgb.record_evaluation(res)],
+                        verbose_eval=False)
+        aucs[fast] = res["va"]["auc"][-1]
+        if fast:
+            gp = bst._gbdt.grow_params
+            assert gp.wave and gp.quantize > 0
+            assert gp.refine_shift > 0, \
+                "c2f must stay ON with missing values"
+            assert gp.two_col, "two_col must stay ON with missing"
+            assert gp.split.any_missing
+    assert aucs[True] > 0.5
+    assert abs(aucs[True] - aucs[False]) < 0.015, aucs

@@ -205,3 +205,51 @@ def test_voting_parallel_distribution_pin(binary_example):
         voting_feats.update(np.asarray(t.split_feature[:n]))
     overlap = len(serial_feats & voting_feats) / max(len(serial_feats), 1)
     assert overlap >= 0.9, (sorted(serial_feats), sorted(voting_feats))
+
+
+def test_wave_quantized_feature_parallel_equals_serial(binary_example):
+    """VERDICT r4 #3: wave growth + quantized histograms compose with
+    the FEATURE-parallel learner (the reference composes by template,
+    tree_learner.cpp:9-33).  Feature-parallel reduces no float
+    histograms (local feature blocks + arg-max merge + one owner-bit
+    routing psum), and the quantization noise hashes the global row
+    index with replicated rows — so the 8-device wave model must be
+    structurally identical to the serial wave model."""
+    X, y, Xt, _ = binary_example
+    fast = {"wave_splits": True, "use_quantized_grad": True,
+            "min_data_in_leaf": 1, "max_bin": 63}
+    serial = _train(X, y, "serial", rounds=5, **fast)
+    feat = _train(X, y, "feature", rounds=5, **fast)
+    assert feat._gbdt.grow_params.wave
+    assert feat._gbdt.grow_params.quantize > 0
+    assert feat._gbdt._dist is not None
+    _assert_same_structure(serial, feat)
+    np.testing.assert_allclose(feat.predict(Xt), serial.predict(Xt),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_wave_quantized_voting_parallel(binary_example):
+    """VERDICT r4 #3: wave growth + quantized histograms compose with
+    the VOTING-parallel learner.  With top_k >= num_features every
+    feature is elected, the elected-only psum runs on raw integer
+    histograms (exact in f32 in any order), and the wave tree must be
+    structurally identical to the serial wave tree; with the default
+    top_k the election is approximate and quality is pinned."""
+    from lightgbm_tpu.metrics import AUCMetric
+    from lightgbm_tpu.config import Config
+    X, y, Xt, yt = binary_example
+    fast = {"wave_splits": True, "use_quantized_grad": True,
+            "min_data_in_leaf": 1, "max_bin": 63}
+    serial = _train(X, y, "serial", rounds=5, **fast)
+    # full electorate: must match serial exactly in structure
+    vote_full = _train(X, y, "voting", rounds=5, top_k=X.shape[1],
+                       **fast)
+    assert vote_full._gbdt.grow_params.wave
+    assert vote_full._gbdt.grow_params.quantize > 0
+    _assert_same_structure(serial, vote_full)
+    # default electorate: approximate, but quality holds
+    vote = _train(X, y, "voting", rounds=5, top_k=3, **fast)
+    auc = AUCMetric(Config())
+    auc_s = auc.eval(np.asarray(yt, np.float64), serial.predict(Xt))
+    auc_v = auc.eval(np.asarray(yt, np.float64), vote.predict(Xt))
+    assert abs(auc_s - auc_v) < 0.01, (auc_s, auc_v)
