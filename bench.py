@@ -331,6 +331,71 @@ def main():
             out["epsilon_shape_error"] = str(exc)[:200]
         print(json.dumps(out), flush=True)
 
+    # ---- missing + categorical Higgs-shape --------------------------
+    # real-world data shapes carry NaNs and categorical columns; the
+    # fast tiers must stay engaged there (VERDICT r4 #2).  10% NaN
+    # over the same Higgs-shaped numericals; the categorical variant
+    # additionally remaps 4 columns to 12-level categories (wave +
+    # quantized, W=42 tier — categorical scans need real counts)
+    if backend != "cpu" and os.environ.get("BENCH_MISSING", "1") != "0" \
+            and time.time() - t_start < 5.5 * budget:
+        try:
+            rngm = np.random.RandomState(29)
+            Xm_ = X.copy()
+            # chunked NaN injection bounds the transient mask memory
+            for lo_ in range(0, Xm_.shape[0], 1_000_000):
+                hi_ = min(lo_ + 1_000_000, Xm_.shape[0])
+                blk_ = rngm.random_sample((hi_ - lo_, Xm_.shape[1]))
+                Xm_[lo_:hi_][blk_ < 0.10] = np.nan
+            pm_ = dict(base_params, **fast)
+            dm_ = lgb.Dataset(Xm_, label=y, params=pm_)
+            dm_.construct()
+            bm_ = lgb.Booster(params=pm_, train_set=dm_)
+            bm_.update(); bm_.update()
+            gpm = bm_._gbdt.grow_params
+            out["missing_shape_tiers"] = {
+                "wave": bool(gpm.wave), "quantize": int(gpm.quantize),
+                "two_col": bool(gpm.two_col),
+                "refine_shift": int(gpm.refine_shift)}
+            times_n = []
+            t0 = time.time()
+            while len(times_n) < 15 and (time.time() - t0 < 60 or
+                                         len(times_n) < 5):
+                t1 = time.time(); bm_.update()
+                times_n.append(time.time() - t1)
+            pern = sorted(times_n)[len(times_n) // 2]
+            out["missing_shape_iters_per_s"] = round(1.0 / pern, 4)
+            if out.get("iters_per_s"):
+                out["missing_vs_headline_ratio"] = round(
+                    out["iters_per_s"] / (1.0 / pern), 3)
+            del bm_, dm_
+            # categorical variant: 4 columns -> 12-level categories
+            Xc_ = Xm_
+            for c in range(4):
+                Xc_[:, c] = np.floor(
+                    np.abs(np.nan_to_num(Xc_[:, c])) * 4) % 12
+            pc_ = dict(base_params, **fast,
+                       categorical_feature="0,1,2,3")
+            dc_ = lgb.Dataset(Xc_, label=y, params=pc_,
+                              categorical_feature=[0, 1, 2, 3])
+            dc_.construct()
+            bc_ = lgb.Booster(params=pc_, train_set=dc_)
+            bc_.update(); bc_.update()
+            gpc = bc_._gbdt.grow_params
+            assert gpc.split.any_cat and gpc.wave and gpc.quantize > 0
+            times_c = []
+            t0 = time.time()
+            while len(times_c) < 12 and (time.time() - t0 < 60 or
+                                         len(times_c) < 4):
+                t1 = time.time(); bc_.update()
+                times_c.append(time.time() - t1)
+            perc = sorted(times_c)[len(times_c) // 2]
+            out["missing_cat_shape_iters_per_s"] = round(1.0 / perc, 4)
+            del bc_, dc_, Xm_, Xc_
+        except Exception as exc:
+            out["missing_shape_error"] = str(exc)[:200]
+        print(json.dumps(out), flush=True)
+
     # ---- reference-DEFAULT learning-control config ------------------
     # the headline rides min_data_in_leaf=0 (two_col W=64 tier); a user
     # keeping the reference default (min_data_in_leaf=20, config.h) gets

@@ -33,6 +33,20 @@ from .utils.log import Log
 __all__ = ["Dataset", "Booster"]
 
 
+def _resolve_cat_indices(spec, names):
+    """Name-or-index categorical spec -> column indices (shared by the
+    file / sparse / matrix construction branches)."""
+    cat_idx = []
+    for c in spec:
+        if isinstance(c, str):
+            if not names or c not in names:
+                Log.fatal("categorical feature name %s not found", c)
+            cat_idx.append(names.index(c))
+        else:
+            cat_idx.append(int(c))
+    return cat_idx
+
+
 def _to_matrix(data, feature_name="auto", categorical_feature="auto"):
     """Normalize input data to (matrix, feature_names, categorical_idx)."""
     cat_idx: List[int] = []
@@ -69,14 +83,7 @@ def _to_matrix(data, feature_name="auto", categorical_feature="auto"):
     if feature_name != "auto" and feature_name is not None:
         names = list(feature_name)
     if categorical_feature != "auto" and categorical_feature is not None:
-        cat_idx = []
-        for c in categorical_feature:
-            if isinstance(c, str):
-                if names is None or c not in names:
-                    Log.fatal("categorical feature name %s not found", c)
-                cat_idx.append(names.index(c))
-            else:
-                cat_idx.append(int(c))
+        cat_idx = _resolve_cat_indices(categorical_feature, names)
     return mat, names, cat_idx
 
 
@@ -116,6 +123,19 @@ class Dataset:
             return self
         cfg = Config(self.params)
         label, weight, group = self.label, self.weight, self.group
+        if self.categorical_feature in ("auto", None) and \
+                getattr(cfg, "categorical_feature", ""):
+            # params/conf-file spec (``categorical_feature=6,7,8`` or
+            # ``name:c1,c2`` — io/config.h categorical_feature): the
+            # reference honors it for FILE data too, so resolve it
+            # before the data-source branches
+            spec = cfg.categorical_feature
+            if isinstance(spec, str):
+                spec = spec[5:] if spec.startswith("name:") else spec
+                spec = [s.strip() for s in spec.split(",") if s.strip()]
+                spec = [int(s) if s.lstrip("+-").isdigit() else s
+                        for s in spec]
+            self.categorical_feature = list(spec)
 
         if isinstance(self.data, (str, os.PathLike)):
             from .utils.file_io import is_remote, localize
@@ -155,6 +175,9 @@ class Dataset:
             if si is not None and self.init_score is None:
                 self.init_score = si
             cat_idx = []
+            if self.categorical_feature not in ("auto", None):
+                cat_idx = _resolve_cat_indices(self.categorical_feature,
+                                               names)
             if self.feature_name == "auto":
                 self.feature_name = names
         elif hasattr(self.data, "tocsc") and self.used_indices is None:
@@ -164,15 +187,8 @@ class Dataset:
                 if self.feature_name not in ("auto", None) else None
             cat_idx = []
             if self.categorical_feature not in ("auto", None):
-                for c in self.categorical_feature:
-                    if isinstance(c, str):
-                        # name resolution mirrors the dense _to_matrix
-                        if names is None or c not in names:
-                            Log.fatal("categorical feature name %s not "
-                                      "found", c)
-                        cat_idx.append(names.index(c))
-                    else:
-                        cat_idx.append(int(c))
+                cat_idx = _resolve_cat_indices(self.categorical_feature,
+                                               names)
             mappers = None
             if self.reference is not None:
                 self.reference.construct()

@@ -138,8 +138,10 @@ class GrowParams:
     # per-pass fixed cost — see models/gbdt.py).  The fine-resolution
     # pool is dropped.  Split choice is exact whenever the best fine
     # threshold lies in the chosen window (see ops/split.py).
-    # Requires the wave path, numerical features only, no missing
-    # values, no bundling.
+    # Missing values ARE supported: the per-feature missing bin maps
+    # to a RESERVED last coarse slot and both default directions are
+    # scanned.  Requires the wave path, numerical (non-categorical)
+    # features, no bundling.
     refine_shift: int = 0
     # store the batched-pass value operand as int8 — quantized
     # gradients are small ints (|v| <= quantize <= 127), exact in
@@ -789,21 +791,40 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         topg, ids = jax.lax.top_k(gains, W_spec)
         valid_w = topg > 0.5 * NEG_INF
         ids_safe = jnp.where(valid_w, ids, L)
-        sel = jnp.full(N, -1, jnp.int32)
+        if routed_full_ok:
+            # resolve lanes/goes-left INSIDE the pass (the exact-tier
+            # analog of the wave's routed kernel): the XLA select
+            # chain below re-reads leaf_idx + every xt column per
+            # armed lane, ~10x this pass's HBM floor at bench shape.
+            # The kernel's leaf-vector output is discarded — arming
+            # must not move rows (the split is not applied yet), so
+            # the new-id table row is the dummy L.
+            ls_w = st["best_left_stats"][ids]
+            ps_w = st["leaf_stats"][ids]
+            small_left_w = ls_w[:, 2] <= ps_w[:, 2] - ls_w[:, 2]
+            tbl = lane_tables(ids_safe, st["best_feature"][ids],
+                              st["best_threshold"][ids],
+                              jnp.full((W_spec,), L, jnp.int32),
+                              small_left_w,
+                              st["best_default_left"][ids])
+            hists, _, _ = routed_call(st["leaf_idx"], tbl, B, 0,
+                                      "small")
+        else:
+            sel = jnp.full(N, -1, jnp.int32)
 
-        def per_w(w, sel):
-            l = ids[w]
-            feat = st["best_feature"][l]
-            goes_left = goes_left_of(feat, st["best_left_mask"][l])
-            ls = st["best_left_stats"][l]
-            ps = st["leaf_stats"][l]
-            small_is_left = ls[2] <= ps[2] - ls[2]
-            pick = (st["leaf_idx"] == l) & (goes_left == small_is_left) & \
-                valid_w[w]
-            return jnp.where(pick, jnp.int32(w), sel)
+            def per_w(w, sel):
+                l = ids[w]
+                feat = st["best_feature"][l]
+                goes_left = goes_left_of(feat, st["best_left_mask"][l])
+                ls = st["best_left_stats"][l]
+                ps = st["leaf_stats"][l]
+                small_is_left = ls[2] <= ps[2] - ls[2]
+                pick = (st["leaf_idx"] == l) & \
+                    (goes_left == small_is_left) & valid_w[w]
+                return jnp.where(pick, jnp.int32(w), sel)
 
-        sel = jax.lax.fori_loop(0, W_spec, per_w, sel)
-        hists = multi_hist(sel)  # (W, F_hist, B, 3)
+            sel = jax.lax.fori_loop(0, W_spec, per_w, sel)
+            hists = multi_hist(sel)  # (W, F_hist, B, 3)
         st = dict(st)
         st["armed_hist"] = st["armed_hist"].at[ids_safe].set(hists)
         st["armed"] = st["armed"].at[ids_safe].set(valid_w) \

@@ -364,12 +364,14 @@ def find_best_split(hist: jax.Array, parent: jax.Array,
 # the best fine threshold falls inside the chosen window; the window
 # heuristic (2 coarse bins straddling the best coarse boundary) is
 # validated empirically in tests/test_c2f.py and by the bench AUC
-# anchor.  Numerical features without missing values only — the driver
-# gates it (models/gbdt.py).
+# anchor.  Numerical (non-categorical) features only — the driver
+# gates it (models/gbdt.py).  Missing values are supported: the
+# per-feature missing bin rides a RESERVED last coarse slot
+# (:func:`_c2f_miss`) and both default directions are scanned.
 
 
-def _c2f_miss(coarse: jax.Array, num_bins: jax.Array,
-              missing_type: jax.Array, params: SplitParams):
+def _c2f_miss(coarse: jax.Array, missing_type: jax.Array,
+              params: SplitParams):
     """Missing-bin stats on the c2f path.  With ``params.any_missing``
     the LAST coarse slot is RESERVED for the per-feature missing bin
     (the histogram kernels map ``x == num_bins-1`` there when the
@@ -398,7 +400,7 @@ def _c2f_coarse_scan(coarse: jax.Array, parent: jax.Array,
     l1, l2, mds = p.lambda_l1, p.lambda_l2, p.max_delta_step
     parent_gain = leaf_gain(parent[0], parent[1], l1, l2, mds)
     gain_shift = parent_gain + p.min_gain_to_split
-    vals, miss, no_miss = _c2f_miss(coarse, num_bins, missing_type, p)
+    vals, miss, no_miss = _c2f_miss(coarse, missing_type, p)
     F, Bcv, _ = vals.shape
     cum = jnp.cumsum(vals, axis=1)                    # (F, Bcv, 3)
     thr_fine = ((jnp.arange(Bcv, dtype=jnp.int32) + 1) << shift) - 1
@@ -473,7 +475,7 @@ def find_best_split_c2f(coarse: jax.Array, win: jax.Array,
     Bcv = g_c.shape[1]
     parent_gain = leaf_gain(parent[0], parent[1], l1, l2, mds)
     gain_shift = parent_gain + p.min_gain_to_split
-    vals_c, miss, no_miss = _c2f_miss(coarse, num_bins, missing_type, p)
+    vals_c, miss, no_miss = _c2f_miss(coarse, missing_type, p)
     if p.any_missing:
         has_missing = missing_type != 0
         nv = num_bins - has_missing.astype(jnp.int32)

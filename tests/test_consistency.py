@@ -215,3 +215,160 @@ def test_binary_fast_path_matches_oracle(tmp_path):
     a_m = auc.eval(np.asarray(yt, float), m_pred)
     assert a_m >= a_o - 0.01, (a_m, a_o)
     assert abs(a_m - a_o) < 0.02, (a_m, a_o)
+
+
+def test_binary_fast_path_missing_matches_oracle(tmp_path):
+    """VERDICT r4 #2: the fast tiers (wave + quantized + two_col +
+    coarse-to-fine) must stay engaged on MISSING-VALUE data and match
+    the oracle trained on the identical NaN-injected files — real
+    datasets have NaNs, and falling to the exact tier (or losing
+    quality) on them would void the headline claims."""
+    exdir = os.path.join(EXAMPLES, "binary_classification")
+    rounds = 30
+    Xtr, ytr, _ = parse_file(os.path.join(exdir, "binary.train"))
+    Xte, yte, _ = parse_file(os.path.join(exdir, "binary.test"))
+    rng = np.random.RandomState(7)
+    Xtr = np.array(Xtr, float)
+    Xte = np.array(Xte, float)
+    Xtr[rng.random_sample(Xtr.shape) < 0.1] = np.nan
+    Xte[rng.random_sample(Xte.shape) < 0.1] = np.nan
+    trf = os.path.join(str(tmp_path), "nan.train")
+    tef = os.path.join(str(tmp_path), "nan.test")
+    for path, X_, y_ in ((trf, Xtr, ytr), (tef, Xte, yte)):
+        arr = np.column_stack([np.asarray(y_, float), X_])
+        np.savetxt(path, arr, delimiter="\t", fmt="%.6g")
+
+    # the conf enables bagging + feature_fraction, whose seed draws
+    # swing single-model AUC by ~±0.02 on this 7k-row set and the two
+    # implementations' RNG streams are incomparable — neutralize the
+    # SAMPLING randomness so the pin isolates MISSING-VALUE handling
+    # (sampling parity is covered by the clean fast-path row and the
+    # dart/goss/mvs rows)
+    det = ("bagging_freq=0", "bagging_fraction=1.0",
+           "feature_fraction=1.0")
+    o_pred = _oracle_train_predict(
+        tmp_path, exdir, tef, rounds, f"data={trf}",
+        "min_data_in_leaf=1", "max_bin=255", *det)
+
+    conf = Config.str2dict(open(os.path.join(exdir, "train.conf")).read())
+    for k in ("task", "data", "valid_data", "output_model",
+              "is_training_metric", "num_trees", "num_iterations"):
+        conf.pop(k, None)
+    conf.update(num_iterations=rounds, verbose=-1,
+                wave_splits=True, use_quantized_grad=True,
+                min_data_in_leaf=1, max_bin=255, hist_refinement=True)
+    auc = AUCMetric(Config())
+    a_o = auc.eval(np.asarray(yte, float), o_pred)
+    c = dict(conf, bagging_freq=0, bagging_fraction=1.0,
+             feature_fraction=1.0)
+    train = lgb.Dataset(trf, params=c)
+    bst = lgb.train(c, train, num_boost_round=rounds,
+                    verbose_eval=False)
+    gp = bst._gbdt.grow_params
+    assert gp.split.any_missing, "NaN injection did not register"
+    assert gp.wave and gp.quantize > 0 and gp.refine_shift > 0 \
+        and gp.two_col, \
+        "fast tiers must stay engaged on missing-value data"
+    a_m = auc.eval(np.asarray(yte, float), bst.predict(Xte))
+    assert a_m >= a_o - 0.01, (a_m, a_o)
+    assert abs(a_m - a_o) < 0.02, (a_m, a_o)
+
+
+@pytest.mark.parametrize("mode,overrides", [
+    ("dart", ("drop_rate=0.1", "max_drop=50")),
+    # the conf enables bagging, which GOSS rejects — neutralize it
+    ("goss", ("top_rate=0.2", "other_rate=0.1", "bagging_freq=0",
+              "bagging_fraction=1.0")),
+    ("mvs", ("bagging_fraction=0.5",)),
+])
+def test_sampling_boosting_modes_match_oracle(tmp_path, mode, overrides):
+    """VERDICT r4 #4: oracle-parity pins for the SAMPLING boosting
+    modes (DART's drop/renormalize cycle, GOSS's gradient-based
+    one-sided sampling, the fork's MVS adaptive-threshold sampling —
+    src/boosting/{dart,goss,mvs}.hpp).  Same conf, same data, same
+    mode: held-out AUC must agree with the oracle like the gbdt rows."""
+    exdir = os.path.join(EXAMPLES, "binary_classification")
+    rounds = 40
+    o_pred = _oracle_train_predict(
+        tmp_path, exdir, "binary.test", rounds, f"boosting={mode}",
+        *overrides)
+
+    conf = Config.str2dict(open(os.path.join(exdir, "train.conf")).read())
+    for k in ("task", "data", "valid_data", "output_model",
+              "is_training_metric", "num_trees", "num_iterations",
+              "boosting_type", "boosting"):
+        conf.pop(k, None)
+    conf.update(num_iterations=rounds, verbose=-1, boosting=mode)
+    for ov in overrides:
+        k, v = ov.split("=")
+        conf[k] = float(v) if "." in v else int(v)
+    train = lgb.Dataset(os.path.join(exdir, "binary.train"), params=conf)
+    bst = lgb.train(conf, train, num_boost_round=rounds,
+                    verbose_eval=False)
+    Xt, yt, _ = parse_file(os.path.join(exdir, "binary.test"))
+    m_pred = bst.predict(Xt)
+
+    auc = AUCMetric(Config())
+    a_o = auc.eval(np.asarray(yt, float), o_pred)
+    a_m = auc.eval(np.asarray(yt, float), m_pred)
+    # sampling modes carry RNG-stream differences by construction;
+    # the pin is quality-level agreement, not bit equality
+    assert a_m >= a_o - 0.01, (mode, a_m, a_o)
+    assert abs(a_m - a_o) < 0.025, (mode, a_m, a_o)
+
+
+def test_categorical_fast_path_matches_oracle(tmp_path):
+    """VERDICT r4 #2 (categorical half): wave + quantized growth must
+    stay engaged on datasets WITH categorical features (mask-chain
+    routing; W=42 tier keeps real counts for the categorical scans)
+    and match the oracle trained on identical data with the same
+    categorical_feature spec."""
+    rng = np.random.RandomState(11)
+    N, Fn, Fc = 8000, 6, 4
+    Xn = rng.randn(N, Fn)
+    Xc = rng.randint(0, 12, size=(N, Fc)).astype(float)
+    X = np.column_stack([Xn, Xc])
+    logit = Xn[:, 0] + 0.9 * np.isin(Xc[:, 0], [2, 5, 7]) - \
+        0.6 * (Xc[:, 1] > 8) + 0.3 * Xn[:, 1]
+    y = (rng.random_sample(N) < 1 / (1 + np.exp(-logit))).astype(float)
+    ntr = 6000
+    trf = os.path.join(str(tmp_path), "cat.train")
+    tef = os.path.join(str(tmp_path), "cat.test")
+    np.savetxt(trf, np.column_stack([y[:ntr], X[:ntr]]),
+               delimiter="\t", fmt="%.6g")
+    np.savetxt(tef, np.column_stack([y[ntr:], X[ntr:]]),
+               delimiter="\t", fmt="%.6g")
+    cats = ",".join(str(Fn + i) for i in range(Fc))
+    rounds = 40
+
+    model = os.path.join(str(tmp_path), "oracle.model")
+    pred = os.path.join(str(tmp_path), "oracle.pred")
+    _oracle(str(tmp_path), f"data={trf}", "task=train",
+            "objective=binary", f"num_trees={rounds}", "num_leaves=31",
+            "learning_rate=0.1", "max_bin=63", "min_data_in_leaf=1",
+            f"categorical_feature={cats}", "verbose=-1",
+            f"output_model={model}")
+    _oracle(str(tmp_path), "task=predict", f"data={tef}",
+            f"input_model={model}", f"output_result={pred}",
+            "verbose=-1")
+    o_pred = np.loadtxt(pred)
+
+    conf = {"objective": "binary", "num_leaves": 31,
+            "learning_rate": 0.1, "max_bin": 63, "min_data_in_leaf": 1,
+            "categorical_feature": cats, "verbose": -1,
+            "wave_splits": True, "use_quantized_grad": True}
+    train = lgb.Dataset(trf, params=conf)
+    bst = lgb.train(conf, train, num_boost_round=rounds,
+                    verbose_eval=False)
+    gp = bst._gbdt.grow_params
+    assert gp.split.any_cat, "categorical spec did not register"
+    assert gp.wave and gp.quantize > 0, \
+        "wave+quantized must stay engaged on categorical data"
+    Xt, yt, _ = parse_file(tef)
+    m_pred = bst.predict(Xt)
+
+    auc = AUCMetric(Config())
+    a_o = auc.eval(np.asarray(yt, float), o_pred)
+    a_m = auc.eval(np.asarray(yt, float), m_pred)
+    assert a_m >= a_o - 0.01, (a_m, a_o)
+    assert abs(a_m - a_o) < 0.025, (a_m, a_o)
