@@ -74,3 +74,83 @@ hs, ls, ss = histogram_segsum_multi_routed(
 print("L>256 ids li:", np.abs(np.asarray(lp)-np.asarray(ls)).max(),
       "sel:", np.abs(np.asarray(sp_)-np.asarray(ss)).max(), flush=True)
 print("OK")
+
+# ---- round-5 kernel variants ---------------------------------------
+from lightgbm_tpu.ops.histogram import (
+    histogram_pallas_multi, histogram_segsum_multi,
+    histogram_pallas_multi_win, histogram_segsum_multi_win,
+    histogram_pallas_multi_win_lanes, histogram_segsum_multi_win_lanes,
+    leaf_stats_pallas)
+
+# int8 value operand (quantized ints exact in int8/bf16)
+v8 = jnp.asarray(vals.astype(np.int8))
+hp = histogram_pallas_multi(xb, v8, jnp.asarray(li % 64), 63, 64,
+                            16384, exact=True, two_col=True)
+hs = histogram_segsum_multi(xb, vb, jnp.asarray(li % 64), 63, 64,
+                            two_col=True)
+print("int8 multi:", np.abs(np.asarray(hp)-np.asarray(hs)).max(),
+      flush=True)
+
+# lane-routed windowed pass (li + child-id tables, no (N,) selector)
+ids_w = rng.choice(200, size=64, replace=False).astype(np.int32)
+lo_w = rng.randint(0, 32, size=(64, F)).astype(np.int32)
+hp = histogram_pallas_multi_win_lanes(
+    xb, v8, lb, jnp.asarray(ids_w), jnp.asarray(lo_w), 16, 64, 16384,
+    exact=True, two_col=True)
+hs = histogram_segsum_multi_win_lanes(
+    xb, vb, lb, jnp.asarray(ids_w), jnp.asarray(lo_w), 16, 64,
+    two_col=True)
+print("win_lanes:", np.abs(np.asarray(hp)-np.asarray(hs)).max(),
+      flush=True)
+
+# missing-value variants: 6-row tables + per-feature miss bins
+mb = np.full(F, 62, np.int32); mb[::3] = -1      # some without missing
+mbj = jnp.asarray(mb)
+tbl6 = np.stack([rng.choice(200, size=64, replace=False).astype(np.int32),
+                 rng.randint(0, F, size=64).astype(np.int32),
+                 rng.randint(0, 60, size=64).astype(np.int32),
+                 rng.randint(200, 255, size=64).astype(np.int32),
+                 rng.randint(0, 2, size=64).astype(np.int32),
+                 rng.randint(0, 2, size=64).astype(np.int32)])
+tb6 = jnp.asarray(tbl6)
+# routed full-res with default-direction routing
+hp, lp, sp_ = histogram_pallas_multi_routed(
+    xb, v8, lb, tb6, 63, 64, 16384, exact=True, two_col=True,
+    mode="small", miss_bin=mbj)
+hs, ls, ss = histogram_segsum_multi_routed(
+    xb, vb, lb, tb6, 63, 64, two_col=True, mode="small", miss_bin=mbj)
+print("routed+miss:", np.abs(np.asarray(hp)-np.asarray(hs)).max(),
+      "li:", np.abs(np.asarray(lp)-np.asarray(ls)).max(),
+      "sel:", np.abs(np.asarray(sp_)-np.asarray(ss)).max(), flush=True)
+# routed coarse with the reserved missing slot (Bc = 8 value + 1)
+hp, lp, sp_ = histogram_pallas_multi_routed(
+    xb, v8, lb, tb6, 9, 64, 16384, exact=True, two_col=True,
+    shift=3, mode="small", miss_bin=mbj)
+hs, ls, ss = histogram_segsum_multi_routed(
+    xb, vb, lb, tb6, 9, 64, two_col=True, shift=3, mode="small",
+    miss_bin=mbj)
+print("routed+miss+shift:",
+      np.abs(np.asarray(hp)-np.asarray(hs)).max(),
+      "li:", np.abs(np.asarray(lp)-np.asarray(ls)).max(), flush=True)
+# windowed with missing exclusion
+hp = histogram_pallas_multi_win(
+    xb, v8, jnp.asarray(li % 64), jnp.asarray(lo_w), 16, 64, 16384,
+    exact=True, two_col=True, miss_bin=mbj)
+hs = histogram_segsum_multi_win(
+    xb, vb, jnp.asarray(li % 64), jnp.asarray(lo_w), 16, 64,
+    two_col=True, miss_bin=mbj)
+print("win+miss:", np.abs(np.asarray(hp)-np.asarray(hs)).max(),
+      flush=True)
+
+# leaf-stats (renewal) kernel vs numpy
+gf = rng.randn(N).astype(np.float32)
+hf = np.abs(rng.randn(N)).astype(np.float32)
+mf = (rng.random_sample(N) < 0.9).astype(np.float32)
+lsp = np.asarray(leaf_stats_pallas(lb, jnp.asarray(gf),
+                                   jnp.asarray(hf), jnp.asarray(mf),
+                                   16384))
+ref = np.zeros((256, 3), np.float64)
+np.add.at(ref, li, np.stack([gf*mf, hf*mf, mf], -1).astype(np.float64))
+rel = np.abs(lsp[:200] - ref[:200]) / (np.abs(ref[:200]) + 1e-3)
+print("leaf_stats rel err:", rel.max(), flush=True)
+print("ALL R5 CHECKS DONE")

@@ -1,0 +1,73 @@
+"""num_leaves sweep at bench shape: fixed-block + per-pass decomposition.
+
+One process, one dataset; boosters for each num_leaves are trained
+round-robin (interleaved medians — the only honest timing on the
+shared chip).  iter(L) ≈ fixed + waves(L) * wave_cost decomposes the
+headline iteration into the fixed block (gradients + quantize chain +
+renewal + score update + dispatch) vs per-wave pass cost.
+
+Env: PS_ROWS (default 10_500_000), PS_BINS (255), PS_LEAVES
+(comma list, default "2,4,16,64,255"), PS_ITERS (8 per leaf count).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    rows = int(os.environ.get("PS_ROWS", "10500000"))
+    bins = int(os.environ.get("PS_BINS", "255"))
+    leaves = [int(x) for x in os.environ.get(
+        "PS_LEAVES", "2,4,16,64,255").split(",")]
+    iters = int(os.environ.get("PS_ITERS", "8"))
+
+    import lightgbm_tpu as lgb
+    from bench import make_higgs_shaped
+
+    X, y = make_higgs_shaped(rows, 28)
+    base = {"objective": "binary", "max_bin": bins,
+            "learning_rate": 0.1, "min_sum_hessian_in_leaf": 100.0,
+            "min_data_in_leaf": 0, "verbose": -1, "metric": "None",
+            "wave_splits": True, "use_quantized_grad": True}
+    d = lgb.Dataset(X, label=y, params=dict(base, num_leaves=255))
+    d.construct()
+
+    boosters = {}
+    for L in leaves:
+        b = lgb.Booster(params=dict(base, num_leaves=L), train_set=d)
+        t0 = time.time()
+        b.update(); b.update()
+        print(f"L={L}: warmup {time.time()-t0:.1f}s", flush=True)
+        boosters[L] = b
+
+    times = {L: [] for L in leaves}
+    passes = {L: [] for L in leaves}
+    for it in range(iters):
+        for L in leaves:
+            b = boosters[L]
+            t0 = time.time()
+            b.update()
+            times[L].append(time.time() - t0)
+            g = b._gbdt
+            if hasattr(g, "last_arm_passes"):
+                passes[L].append(g.last_arm_passes)
+        print(f"round {it}: " + " ".join(
+            f"L{L}={times[L][-1]:.3f}" for L in leaves), flush=True)
+
+    out = {}
+    for L in leaves:
+        ts = sorted(times[L])
+        out[f"L{L}_median_s"] = round(ts[len(ts) // 2], 4)
+        out[f"L{L}_min_s"] = round(ts[0], 4)
+        if passes[L]:
+            out[f"L{L}_passes"] = int(sorted(passes[L])[len(passes[L]) // 2])
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

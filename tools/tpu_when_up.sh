@@ -1,0 +1,22 @@
+#!/bin/bash
+# Poll the axon tunnel; when it answers, run the round-5 TPU sequence:
+#   1. kernel oracle validation (all new kernel variants)
+#   2. interleaved int8 A/B at bench shape
+# Logs under /tmp/tpu_r5_*.log.  One TPU process at a time, always.
+set -u
+cd /root/repo
+for i in $(seq 1 200); do
+    if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+        echo "tunnel up at $(date)" | tee /tmp/tpu_r5_status.log
+        break
+    fi
+    echo "poll $i: tunnel down $(date)" >> /tmp/tpu_r5_status.log
+    sleep 240
+done
+timeout 900 python tools/check_routed_kernels.py > /tmp/tpu_r5_kernels.log 2>&1
+echo "kernels rc=$?" >> /tmp/tpu_r5_status.log
+timeout 2400 python tools/check_tpu_integration.py > /tmp/tpu_r5_integ.log 2>&1
+echo "integ rc=$?" >> /tmp/tpu_r5_status.log
+AB_ITERS=12 timeout 2400 python tools/ab_vals_i8.py > /tmp/tpu_r5_ab.log 2>&1
+echo "ab rc=$?" >> /tmp/tpu_r5_status.log
+echo "SEQUENCE DONE $(date)" >> /tmp/tpu_r5_status.log
