@@ -140,8 +140,19 @@ def _split_hi_lo(v: jax.Array) -> jax.Array:
     return jnp.concatenate([v_hi, v - v_hi], axis=0)
 
 
+def _rhs_cols(width: int, cols: int) -> int:
+    """rhs lane count for a pass: one 128-lane MXU tile when the
+    subsets fit, two tiles (256) for the WIDE passes (e.g. all 2W
+    children of a wave in ONE windowed pass — same total MXU work as
+    two 128-lane passes, but one bins-matrix read and one launch)."""
+    need = width * cols
+    assert need <= 256, (width, cols)
+    return 128 if need <= 128 else 256
+
+
 def _rhs_from(sel_oh: jax.Array, valsc: jax.Array) -> jax.Array:
-    """(W, T) subset selector x (C, T) values -> (128, T) bf16 rhs.
+    """(W, T) subset selector x (C, T) values -> (128 or 256, T) bf16
+    rhs.
 
     Built IN bf16, halving the stage's register traffic vs an f32
     multiply followed by a cast.  Numerically identical to the old
@@ -154,7 +165,7 @@ def _rhs_from(sel_oh: jax.Array, valsc: jax.Array) -> jax.Array:
     C = valsc.shape[0]
     rhs = (sel_oh.astype(jnp.bfloat16)[:, None, :] *
            valsc.astype(jnp.bfloat16)[None, :, :]).reshape(W * C, T)
-    return jnp.pad(rhs, ((0, 128 - W * C), (0, 0)))
+    return jnp.pad(rhs, ((0, _rhs_cols(W, C) - W * C), (0, 0)))
 
 
 def _hist_kernel(x_ref, v_ref, out_ref, *, b_pad: int, cols: int,
