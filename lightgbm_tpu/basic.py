@@ -590,8 +590,13 @@ class Booster:
             ni = self.best_iteration if self.best_iteration > 0 else -1
         else:
             ni = num_iteration
+        # per-call inference-engine overrides (no shared-config
+        # mutation: concurrent predicts on one booster stay safe)
+        eng = {k: kwargs[k] for k in ("predict_engine",
+                                      "predict_chunk_rows")
+               if kwargs.get(k) is not None}
         if pred_leaf:
-            return self._gbdt.predict_leaf_index(mat, ni)
+            return self._gbdt.predict_leaf_index(mat, ni, **eng)
         if pred_contrib:
             from .ops.shap import predict_contrib
             return predict_contrib(self._gbdt.models, mat, ni,
@@ -604,12 +609,12 @@ class Booster:
                   "early_stop_margin": float(
                       kwargs.get("pred_early_stop_margin", 10.0))}
         if raw_score:
-            return self._gbdt.predict_raw(mat, ni, **es)
+            return self._gbdt.predict_raw(mat, ni, **es, **eng)
         if es:
-            raw = self._gbdt.predict_raw(mat, ni, **es)
+            raw = self._gbdt.predict_raw(mat, ni, **es, **eng)
             obj = self._gbdt.objective
             return obj.convert_output(raw) if obj is not None else raw
-        return self._gbdt.predict(mat, ni)
+        return self._gbdt.predict(mat, ni, **eng)
 
     # ------------------------------------------------------------------
     def _objective_string(self) -> str:

@@ -465,6 +465,8 @@ def booster_set_leaf_value(b: Booster, tree_idx: int, leaf_idx: int,
         raise IndexError(f"leaf {leaf_idx} out of range "
                          f"(tree has {tree.num_leaves})")
     tree.leaf_value[leaf_idx] = float(val)
+    # in-place leaf mutation: the flattened-predictor tables are stale
+    b._gbdt._invalidate_predictor()
 
 
 def booster_feature_importance(b: Booster, num_iteration: int,
@@ -572,10 +574,14 @@ def _predict(b: Booster, data, predict_type: int, num_iteration: int,
     way, only ``None`` falls back to best_iteration)."""
     kw = {}
     # str2dict values are raw strings; coerce through the registry so
-    # "pred_early_stop=false" disables rather than truthy-enables
+    # "pred_early_stop=false" disables rather than truthy-enables.
+    # predict_engine / predict_chunk_rows ride the same path: per-call
+    # kwargs, never written to the shared booster config (concurrent
+    # predicts on one booster stay safe)
     coerced = Config(_params(parameters)) if parameters else None
     for k in ("pred_early_stop", "pred_early_stop_freq",
-              "pred_early_stop_margin"):
+              "pred_early_stop_margin", "predict_engine",
+              "predict_chunk_rows"):
         if coerced is not None and k in coerced._user_set:
             kw[k] = getattr(coerced, k)
     out = b.predict(data, num_iteration=num_iteration,

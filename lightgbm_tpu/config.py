@@ -229,6 +229,14 @@ PARAMS: List[Param] = [
        group="io"),
     _p("pred_early_stop_margin", 10.0, float, (),
        "prediction early stop margin", group="io"),
+    _p("predict_engine", True, bool, ("use_predict_engine",),
+       "serve predict/predict_raw/predict_leaf_index from the "
+       "ensemble-flattened jitted batch engine (ops/predict.py); "
+       "false = per-tree host traversal", group="io"),
+    _p("predict_chunk_rows", 16384, int, (),
+       "row-chunk size of the batched inference engine; chunks are "
+       "padded to power-of-two buckets that key the compile cache",
+       group="io", check=">0"),
     _p("convert_model_language", "", str, (),
        "language of converted model (cpp)", group="io"),
     _p("convert_model", "gbdt_prediction.cpp", str,

@@ -248,6 +248,9 @@ class DART(GBDT):
             return
         pre_score, pre_valid, (tw, sw), dropped, scale = self._dart_undo
         K = self.num_tree_per_iteration
+        # pop-then-retrain aliases the count-keyed flattened-predictor
+        # cache (and non-empty drops additionally unscale in place)
+        self._invalidate_predictor()
         for i in dropped:
             for k in range(K):
                 self.models[i * K + k].apply_shrinkage(1.0 / scale)
@@ -269,6 +272,9 @@ class DART(GBDT):
         k = float(len(self._drop_index))
         if k == 0:
             return 1.0
+        # renormalization rescales EXISTING trees' leaf values in
+        # place — the flattened inference tables must be rebuilt
+        self._invalidate_predictor()
         cfg = self.config
         lr = cfg.learning_rate
         scale = k / (k + 1.0) if not cfg.xgboost_dart_mode else \

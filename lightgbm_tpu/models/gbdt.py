@@ -74,6 +74,15 @@ class GBDT:
         if getattr(self, "_pending", None) is not None:
             self._flush_pending()
         self._models = list(value)
+        self._invalidate_predictor()
+
+    def _invalidate_predictor(self) -> None:
+        """Drop the flattened-forest cache (ops/predict.py).  Appends
+        and pops are covered by the tree-count in the cache key; this
+        hook is for IN-PLACE mutations of existing trees — DART
+        renormalization, refit, merge splices, model-list swaps."""
+        self._model_version = getattr(self, "_model_version", 0) + 1
+        self._flat_cache = None
 
     def __init__(self, config: Config, train_set: TpuDataset,
                  objective: Optional[Objective],
@@ -89,6 +98,8 @@ class GBDT:
         self.objective = objective
         self.metrics = list(metrics)
         self._models: List[Tree] = []
+        self._model_version = 0
+        self._flat_cache = None     # (key, FlatForest) — ops/predict.py
         self._pending = None        # in-flight tree (pipelined boosting)
         self._stop_flag = False
         self._pipeline_enabled = True  # DART/RF opt out
@@ -987,10 +998,40 @@ class GBDT:
         return out
 
     # ------------------------------------------------------------------
+    def _use_predict_engine(self, override=None) -> bool:
+        from ..ops.predict import engine_enabled
+        if not engine_enabled():
+            return False
+        if override is not None:
+            return bool(override)
+        return bool(getattr(self.config, "predict_engine", True))
+
+    def _flat_forest(self):
+        """Flattened SoA forest tables (ops/predict.py), cached until
+        the model mutates — appends/pops change the tree count in the
+        key, in-place tree mutations bump ``_model_version`` via
+        :meth:`_invalidate_predictor`."""
+        from ..ops.predict import flatten_forest
+        models = self.models            # flushes any pending tree
+        key = (self._model_version, len(models))
+        if self._flat_cache is None or self._flat_cache[0] != key:
+            self._flat_cache = (key, flatten_forest(
+                models, self.num_tree_per_iteration))
+        return self._flat_cache[1]
+
     def predict_raw(self, X: np.ndarray, num_iteration: int = -1,
                     early_stop: bool = False, early_stop_freq: int = 10,
-                    early_stop_margin: float = 10.0) -> np.ndarray:
+                    early_stop_margin: float = 10.0,
+                    predict_engine=None,
+                    predict_chunk_rows=None) -> np.ndarray:
         """Raw scores (rows,) or (rows, num_class).
+
+        Served by the flattened jitted engine (``ops/predict.py``);
+        ``LTPU_PREDICT_ENGINE=0`` or ``predict_engine=false`` falls
+        back to the per-tree host loop (the oracle path).  The
+        ``predict_engine``/``predict_chunk_rows`` arguments are
+        per-call overrides of the config values (the C-API passes them
+        from the parameters string without mutating shared state).
 
         ``early_stop``: per-row prediction early stopping
         (``prediction_early_stop.cpp``): every ``early_stop_freq``
@@ -1002,9 +1043,30 @@ class GBDT:
         n_trees = len(self.models)
         if num_iteration is not None and num_iteration > 0:
             n_trees = min(n_trees, num_iteration * k)
+        use_es = early_stop and k >= 1 and not self.average_output
+        if n_trees > 0 and X.shape[0] > 0 and \
+                self._use_predict_engine(predict_engine):
+            from ..ops.predict import get_engine
+            out = get_engine().predict_raw(
+                self._flat_forest(), X, n_trees, early_stop=use_es,
+                early_stop_freq=early_stop_freq,
+                early_stop_margin=early_stop_margin,
+                chunk_rows=predict_chunk_rows or
+                getattr(self.config, "predict_chunk_rows", 0))
+        else:
+            out = self._predict_raw_loop(X, n_trees, k, use_es,
+                                         early_stop_freq,
+                                         early_stop_margin)
+        if self.average_output and n_trees:
+            out = out / max(n_trees // k, 1)
+        return out[0] if k == 1 else out.T
+
+    def _predict_raw_loop(self, X: np.ndarray, n_trees: int, k: int,
+                          use_es: bool, early_stop_freq: int,
+                          early_stop_margin: float) -> np.ndarray:
+        """Per-tree host traversal — the engine's bit-level oracle."""
         n = X.shape[0]
         out = np.zeros((k, n), dtype=np.float64)
-        use_es = early_stop and k >= 1 and not self.average_output
         active = np.ones(n, dtype=bool)
         for i in range(n_trees):
             if use_es and not np.all(active):
@@ -1022,22 +1084,29 @@ class GBDT:
                     top2 = np.partition(out, k - 2, axis=0)[-2:]
                     margin = top2[1] - top2[0]
                 active &= margin < early_stop_margin
-        if self.average_output and n_trees:
-            out /= max(n_trees // k, 1)
-        return out[0] if k == 1 else out.T
+        return out
 
-    def predict(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
-        raw = self.predict_raw(X, num_iteration)
+    def predict(self, X: np.ndarray, num_iteration: int = -1,
+                **engine_kw) -> np.ndarray:
+        raw = self.predict_raw(X, num_iteration, **engine_kw)
         if self.objective is not None:
             return self.objective.convert_output(raw)
         return raw
 
-    def predict_leaf_index(self, X: np.ndarray, num_iteration: int = -1
-                           ) -> np.ndarray:
+    def predict_leaf_index(self, X: np.ndarray, num_iteration: int = -1,
+                           predict_engine=None,
+                           predict_chunk_rows=None) -> np.ndarray:
         X = np.ascontiguousarray(np.asarray(X, np.float64))
         n_trees = len(self.models)
         if num_iteration is not None and num_iteration > 0:
             n_trees = min(n_trees, num_iteration * self.num_tree_per_iteration)
+        if n_trees > 0 and X.shape[0] > 0 and \
+                self._use_predict_engine(predict_engine):
+            from ..ops.predict import get_engine
+            return get_engine().predict_leaf_index(
+                self._flat_forest(), X, n_trees,
+                chunk_rows=predict_chunk_rows or
+                getattr(self.config, "predict_chunk_rows", 0))
         return np.stack([self.models[i].predict_leaf_index(X)
                          for i in range(n_trees)], axis=1)
 
@@ -1127,6 +1196,7 @@ class GBDT:
                     decay_rate: float) -> None:
         from ..ops.split import EPS
         import jax.numpy as jnp
+        self._invalidate_predictor()    # leaf values mutate in place
         k = max(self.num_tree_per_iteration, 1)
         score = jnp.zeros((k, n), jnp.float32)
         cfg = self.config
@@ -1195,6 +1265,9 @@ class GBDT:
         # before the rollback restores/clears them
         self._flush_pending()
         self._stop_flag = False  # the popped tree may have set it
+        # pop-then-retrain restores the tree COUNT, so the count-keyed
+        # flattened-predictor cache must be version-bumped explicitly
+        self._invalidate_predictor()
         self._score = self._prev_score
         for vs, snap in zip(self.valid_sets, self._prev_valid_scores):
             vs.score = snap

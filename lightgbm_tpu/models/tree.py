@@ -196,7 +196,13 @@ class Tree:
         return out
 
     def predict(self, X: np.ndarray) -> np.ndarray:
-        """Batch leaf-value prediction on raw features (rows, features)."""
+        """Batch leaf-value prediction on raw features (rows, features).
+
+        This single-tree numpy traversal is the ORACLE for the
+        ensemble-flattened jitted engine (``ops/predict.py``), which
+        serves the production ``GBDT.predict*`` paths; the node-table
+        round-trip ``flatten(tree) -> traverse == tree.predict`` is
+        pinned in ``tests/test_tree.py``."""
         return self.leaf_value[self.predict_leaf_index(X)]
 
     def predict_leaf_index(self, X: np.ndarray) -> np.ndarray:

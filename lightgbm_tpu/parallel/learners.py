@@ -136,11 +136,18 @@ class DistributedBuilder:
         def fn(xt, grad, hess, mask, fmask, nb, mt, cat, qk):
             return build_tree(xt, grad, hess, mask, fmask, nb, mt, cat,
                               self.params, quant_key=qk)
-        sharded = jax.shard_map(
-            fn, mesh=self.mesh,
+        specs = dict(
             in_specs=(xt_spec, row_spec, row_spec, row_spec, feat_spec,
                       feat_spec, feat_spec, feat_spec, R),
-            out_specs=out_specs, check_vma=False)
+            out_specs=out_specs)
+        if hasattr(jax, "shard_map"):
+            sharded = jax.shard_map(fn, mesh=self.mesh, check_vma=False,
+                                    **specs)
+        else:
+            # jax < 0.5: shard_map lives in jax.experimental and the
+            # replication-check kwarg is check_rep
+            from jax.experimental.shard_map import shard_map as _sm
+            sharded = _sm(fn, mesh=self.mesh, check_rep=False, **specs)
         self._call = jax.jit(sharded)
 
     # ------------------------------------------------------------------

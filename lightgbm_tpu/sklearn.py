@@ -283,9 +283,11 @@ class LGBMModel(_SKBase):
                 f"Number of features of the model must match the input. "
                 f"Model n_features_ is {self._n_features} and input "
                 f"n_features is {X.shape[1]}")
+        # forward prediction kwargs (pred_early_stop* ride through to
+        # the flattened inference engine's chunked margin checks)
         return self._Booster.predict(
             X, raw_score=raw_score, num_iteration=num_iteration,
-            pred_leaf=pred_leaf, pred_contrib=pred_contrib)
+            pred_leaf=pred_leaf, pred_contrib=pred_contrib, **kwargs)
 
     # -- fitted attributes -------------------------------------------------
     @property

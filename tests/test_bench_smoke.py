@@ -30,3 +30,7 @@ def test_bench_cpu_smoke():
     assert "vs_baseline" in d
     assert d["backend"] == "cpu"
     assert d.get("auc_holdout") is None or d["auc_holdout"] > 0.5
+    # batch-inference rows (flattened engine vs per-tree loop)
+    assert d.get("predict_engine_rows_per_s", 0) > 0, \
+        d.get("predict_bench_error")
+    assert d.get("predict_loop_rows_per_s", 0) > 0

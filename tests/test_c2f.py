@@ -178,6 +178,7 @@ def test_c2f_tree_quality_close_to_full_wave():
     assert out["c2f"] <= 0.97 * out["full"], out
 
 
+@pytest.mark.slow
 def test_c2f_engine_auc():
     """End-to-end through the public API with hist_refinement on/off."""
     import lightgbm_tpu as lgb
@@ -277,6 +278,7 @@ def test_c2f_missing_vs_full_single_leaf(seed):
                                       np.asarray(full["left_mask"]))
 
 
+@pytest.mark.slow
 def test_c2f_engine_auc_with_missing():
     """End-to-end: NaN-laden data runs the wave + quantized + c2f fast
     tiers (no exact-tier fallback) at quality parity with the

@@ -218,6 +218,8 @@ def test_cv(binary_example):
 
 
 def test_dataset_from_file_with_sidecars():
+    from conftest import _need_reference
+    _need_reference()
     base = "/root/reference/examples/binary_classification/"
     train = lgb.Dataset(base + "binary.train")
     train.construct()
@@ -303,6 +305,63 @@ def test_lambdarank(rank_example):
     assert n1 >= 0.617 - 0.02
     assert n5 >= 0.663 - 0.02
     assert n5 > er["valid_0"]["ndcg@5"][0]
+
+
+def test_predict_engine_matches_host_loop():
+    """The flattened jitted engine (ops/predict.py) must reproduce the
+    per-tree host loop bit-for-bit-ish (<=1e-12) on trained models:
+    probabilities, raw scores, leaf indices, num_iteration truncation,
+    and prediction early stopping on a case where rows deactivate.
+    Synthetic data (not the reference fixtures) so the parity pin runs
+    on images without /root/reference."""
+    import os
+
+    def loop(fn):
+        prev = os.environ.get("LTPU_PREDICT_ENGINE")
+        os.environ["LTPU_PREDICT_ENGINE"] = "0"
+        try:
+            return fn()
+        finally:
+            if prev is None:
+                del os.environ["LTPU_PREDICT_ENGINE"]
+            else:
+                os.environ["LTPU_PREDICT_ENGINE"] = prev
+
+    r = np.random.RandomState(0)
+    X = r.randn(3000, 12)
+    X[r.random_sample(X.shape) < 0.08] = np.nan
+    y = (np.nan_to_num(X[:, 0] + X[:, 1] * X[:, 2]) > 0).astype(float)
+    Xt = r.randn(900, 12)
+    Xt[r.random_sample(Xt.shape) < 0.08] = np.nan
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbose": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=25, verbose_eval=False)
+    for kw in ({}, {"raw_score": True}, {"num_iteration": 7},
+               {"pred_leaf": True}):
+        pe = bst.predict(Xt, **kw)
+        pl = loop(lambda: bst.predict(Xt, **kw))
+        if kw.get("pred_leaf"):
+            np.testing.assert_array_equal(pe, pl)
+        else:
+            np.testing.assert_allclose(pe, pl, rtol=1e-12, atol=1e-12)
+    # early stopping: tight margin so rows really deactivate
+    es = {"raw_score": True, "pred_early_stop": True,
+          "pred_early_stop_freq": 2, "pred_early_stop_margin": 0.5}
+    pe = bst.predict(Xt, **es)
+    pl = loop(lambda: bst.predict(Xt, **es))
+    np.testing.assert_allclose(pe, pl, rtol=1e-12, atol=1e-12)
+    assert np.max(np.abs(pe - bst.predict(Xt, raw_score=True))) > 1e-6
+
+    Xm = r.randn(2000, 8)
+    ym = np.argmax(Xm[:, :5] + 0.3 * r.randn(2000, 5), axis=1).astype(
+        float)
+    Xmt = r.randn(400, 8)
+    bm = lgb.train({"objective": "multiclass", "num_class": 5,
+                    "verbose": -1}, lgb.Dataset(Xm, label=ym),
+                   num_boost_round=8, verbose_eval=False)
+    np.testing.assert_allclose(
+        bm.predict(Xmt), loop(lambda: bm.predict(Xmt)),
+        rtol=1e-12, atol=1e-12)
 
 
 def test_early_stopping_first_metric_only_with_train_metric(binary_example):

@@ -39,6 +39,8 @@ def _python_parse(path, **kw):
     "lambdarank/rank.train",          # libsvm
 ])
 def test_native_matches_python(native_lib, rel):
+    from conftest import _need_reference
+    _need_reference()
     from lightgbm_tpu.io import parser
     path = os.path.join("/root/reference/examples", rel)
     Xn, yn, _, wn, gn = parser.parse_file_full(path)

@@ -1,0 +1,252 @@
+"""Fast unit tests for the ensemble-flattened inference engine
+(``ops/predict.py``): parity with the per-tree numpy oracle across
+split/missing semantics on synthetic forests, and the shape-bucketed
+compile-cache contract (same bucket => no recompile)."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.models.tree import (MISSING_NAN, MISSING_NONE,
+                                      MISSING_ZERO, Tree, cat_bitset)
+from lightgbm_tpu.ops import predict as pr
+
+import contextlib
+
+
+@contextlib.contextmanager
+def oracle_env():
+    """Force the per-tree host loop, restoring the prior env value."""
+    import os
+    prev = os.environ.get("LTPU_PREDICT_ENGINE")
+    os.environ["LTPU_PREDICT_ENGINE"] = "0"
+    try:
+        yield
+    finally:
+        if prev is None:
+            del os.environ["LTPU_PREDICT_ENGINE"]
+        else:
+            os.environ["LTPU_PREDICT_ENGINE"] = prev
+
+
+
+def random_tree(rng, n_leaves, n_feat, cat_feats=()):
+    """Random splits covering all missing types, default directions and
+    (optionally) categorical bitset splits."""
+    t = Tree(max_leaves=max(n_leaves, 2))
+    for _ in range(n_leaves - 1):
+        leaf = rng.randint(t.num_leaves)
+        f = rng.randint(n_feat)
+        if f in cat_feats:
+            cats = rng.choice(64, size=rng.randint(1, 12), replace=False)
+            t.split_categorical(leaf, f, cat_bitset(cats),
+                                rng.randn() * .1, rng.randn() * .1,
+                                1, 1, 1, 1, 1.0,
+                                rng.choice([MISSING_NONE, MISSING_NAN]))
+        else:
+            mt = rng.choice([MISSING_NONE, MISSING_ZERO, MISSING_NAN])
+            t.split(leaf, f, 0, rng.randn(), rng.randn() * .1,
+                    rng.randn() * .1, 1, 1, 1, 1, 1.0, mt,
+                    bool(rng.rand() < 0.5))
+    return t
+
+
+def messy_matrix(rng, n, n_feat, cat_feats=()):
+    X = rng.randn(n, n_feat)
+    X[rng.random_sample(X.shape) < 0.15] = np.nan
+    X[rng.random_sample(X.shape) < 0.15] = 0.0
+    for f in cat_feats:
+        X[:, f] = rng.randint(-3, 70, n)          # unseen/negative cats
+        X[rng.random_sample(n) < 0.1, f] = np.nan
+        X[rng.random_sample(n) < 0.05, f] = 2.5   # non-integer code
+    return X
+
+
+def oracle_raw(trees, X, k=1):
+    out = np.zeros((k, X.shape[0]))
+    for i, t in enumerate(trees):
+        out[i % k] += t.predict(X)
+    return out
+
+
+@pytest.mark.parametrize("n_leaves,n_trees", [(2, 1), (15, 7), (31, 40),
+                                              (80, 9)])
+def test_engine_matches_oracle(n_leaves, n_trees):
+    """All missing types, mixed depths, single/multi-word leaf masks."""
+    rng = np.random.RandomState(n_leaves * 100 + n_trees)
+    trees = [random_tree(rng, rng.randint(2, n_leaves + 1), 6)
+             for _ in range(n_trees)]
+    X = messy_matrix(rng, 700, 6)
+    flat = pr.flatten_forest(trees, 1)
+    got = pr.PredictEngine().predict_raw(flat, X)[0]
+    np.testing.assert_allclose(got, oracle_raw(trees, X)[0], rtol=1e-12,
+                               atol=1e-12)
+
+
+def test_engine_categorical_and_leaf_index():
+    rng = np.random.RandomState(7)
+    trees = [random_tree(rng, 12, 5, cat_feats=(1, 3))
+             for _ in range(9)]
+    X = messy_matrix(rng, 500, 5, cat_feats=(1, 3))
+    flat = pr.flatten_forest(trees, 1)
+    eng = pr.PredictEngine()
+    np.testing.assert_allclose(eng.predict_raw(flat, X)[0],
+                               oracle_raw(trees, X)[0], rtol=1e-12,
+                               atol=1e-12)
+    leaves = eng.predict_leaf_index(flat, X)
+    want = np.stack([t.predict_leaf_index(X) for t in trees], axis=1)
+    np.testing.assert_array_equal(leaves, want)
+
+
+def test_engine_multiclass_and_truncation():
+    rng = np.random.RandomState(11)
+    k = 3
+    trees = [random_tree(rng, 9, 4) for _ in range(k * 6)]
+    X = messy_matrix(rng, 300, 4)
+    flat = pr.flatten_forest(trees, k)
+    eng = pr.PredictEngine()
+    np.testing.assert_allclose(eng.predict_raw(flat, X),
+                               oracle_raw(trees, X, k), rtol=1e-12,
+                               atol=1e-12)
+    # num_iteration truncation = first n trees only
+    np.testing.assert_allclose(eng.predict_raw(flat, X, n_trees=2 * k),
+                               oracle_raw(trees[:2 * k], X, k),
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_compile_cache_same_bucket_no_recompile():
+    """Two batches landing in the same power-of-two bucket must reuse
+    the compiled predictor — no retrace, cache hit recorded."""
+    rng = np.random.RandomState(3)
+    trees = [random_tree(rng, 15, 6) for _ in range(5)]
+    flat = pr.flatten_forest(trees, 1)
+    eng = pr.PredictEngine()
+    X1 = messy_matrix(rng, 300, 6)
+    X2 = messy_matrix(rng, 500, 6)    # same 512 bucket as 300
+    r1 = eng.predict_raw(flat, X1)
+    traces_after_first = pr.TRACE_COUNT
+    misses_after_first = eng.misses
+    r2 = eng.predict_raw(flat, X2)
+    assert pr.TRACE_COUNT == traces_after_first, "same bucket retraced"
+    assert eng.misses == misses_after_first
+    assert eng.hits >= 1
+    np.testing.assert_allclose(r2[0], oracle_raw(trees, X2)[0],
+                               rtol=1e-12, atol=1e-12)
+    # a different bucket is a different compiled predictor
+    X3 = messy_matrix(rng, 1200, 6)   # 2048 bucket
+    eng.predict_raw(flat, X3)
+    assert eng.misses == misses_after_first + 1
+
+
+def test_engine_early_stop_parity_rows_deactivate():
+    """Early-stopped scores must equal the host loop on a case where
+    rows REALLY deactivate (and differ from the non-stopped scores)."""
+    rng = np.random.RandomState(5)
+    trees = []
+    for _ in range(12):
+        t = random_tree(rng, 8, 3)
+        t.leaf_value[:t.num_leaves] += rng.randn() * 0.5
+        trees.append(t)
+    X = messy_matrix(rng, 400, 3)
+    flat = pr.flatten_forest(trees, 1)
+    eng = pr.PredictEngine()
+    margin, freq = 0.8, 2
+    got = eng.predict_raw(flat, X, early_stop=True, early_stop_freq=freq,
+                          early_stop_margin=margin)[0]
+    # host-loop oracle with identical semantics
+    out = np.zeros(X.shape[0])
+    active = np.ones(X.shape[0], bool)
+    for i, t in enumerate(trees):
+        out[active] += t.predict(X[active])
+        if (i + 1) % freq == 0:
+            active &= 2.0 * np.abs(out) < margin
+    assert np.any(~active), "test case must actually deactivate rows"
+    np.testing.assert_allclose(got, out, rtol=1e-12, atol=1e-12)
+    assert np.max(np.abs(got - eng.predict_raw(flat, X)[0])) > 1e-6
+
+
+def test_rollback_then_retrain_invalidates_cache():
+    """pop-then-append restores the tree COUNT, so rollback must bump
+    the flatten version or stale tables serve the popped tree."""
+    import os
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(4)
+    X = rng.randn(600, 5)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbose": -1, "learning_rate": 0.1},
+                    lgb.Dataset(X, label=y), num_boost_round=5,
+                    verbose_eval=False)
+    g = bst._gbdt
+    bst.predict(X, raw_score=True)            # populate the cache
+    g.rollback_one_iter()
+    g.shrinkage_rate = 0.5                    # retrained tree differs
+    g.train_one_iter()
+    pe = bst.predict(X, raw_score=True)
+    with oracle_env():
+        pl = bst.predict(X, raw_score=True)
+    np.testing.assert_allclose(pe, pl, rtol=1e-12, atol=1e-12)
+
+
+def test_engine_rejects_narrow_input():
+    """Inputs narrower than the model's referenced features must raise
+    (the per-tree loop IndexErrors; silent zero-fill would be wrong)."""
+    rng = np.random.RandomState(6)
+    trees = [random_tree(rng, 8, 6) for _ in range(3)]
+    flat = pr.flatten_forest(trees, 1)
+    with pytest.raises(ValueError, match="features"):
+        pr.PredictEngine().predict_raw(flat, rng.randn(50, 2))
+    # constant forests reference no features: any width is fine
+    from lightgbm_tpu.models.tree import Tree
+    t = Tree(2)
+    t.leaf_value[0] = 1.5
+    out = pr.PredictEngine().predict_raw(
+        pr.flatten_forest([t], 1), np.zeros((4, 0)))
+    np.testing.assert_allclose(out[0], [1.5] * 4)
+
+
+def test_capi_set_leaf_value_invalidates_and_huge_es_freq():
+    import os
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import capi
+    rng = np.random.RandomState(8)
+    X = rng.randn(500, 4)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbose": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=3, verbose_eval=False)
+    bst.predict(X, raw_score=True)            # populate the cache
+    capi.booster_set_leaf_value(bst, 0, 1, 5.0)
+    pe = bst.predict(X, raw_score=True)
+    with oracle_env():
+        pl = bst.predict(X, raw_score=True)
+    np.testing.assert_allclose(pe, pl, rtol=1e-12, atol=1e-12)
+    # early-stop freq far beyond the forest: no dummy-padded blowup,
+    # identical scores to no-early-stop (no check ever fires)
+    g = bst._gbdt
+    pes = g.predict_raw(X, -1, early_stop=True, early_stop_freq=1000)
+    np.testing.assert_allclose(pes, pe, rtol=1e-12, atol=1e-12)
+    flat = g._flat_forest()
+    assert all(k[0] <= len(g.models) for k in flat._dev)
+
+
+def test_flatten_invalidation_key_changes_with_mutation():
+    """GBDT-level cache: in-place leaf mutation bumps the version."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(2)
+    X = rng.randn(400, 4)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbose": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=3, verbose_eval=False)
+    g = bst._gbdt
+    p0 = bst.predict(X, raw_score=True)
+    flat0 = g._flat_forest()
+    assert g._flat_forest() is flat0          # cached
+    g._invalidate_predictor()
+    assert g._flat_forest() is not flat0      # rebuilt
+    # refit mutates leaf values in place -> predictions move with it
+    bst.refit(X, y, decay_rate=0.5)
+    p1 = bst.predict(X, raw_score=True)
+    with oracle_env():
+        p1_oracle = bst.predict(X, raw_score=True)
+    np.testing.assert_allclose(p1, p1_oracle, rtol=1e-12, atol=1e-12)
+    assert np.max(np.abs(p1 - p0)) > 1e-9

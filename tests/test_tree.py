@@ -82,3 +82,46 @@ def test_json_dump():
     assert j["num_leaves"] == 3
     assert j["tree_structure"]["split_feature"] == 0
     assert j["tree_structure"]["left_child"]["leaf_value"] == 1.0
+
+
+def _flat_predict(trees, X):
+    """flatten(trees) -> jitted traversal, on raw features."""
+    from lightgbm_tpu.ops.predict import PredictEngine, flatten_forest
+    flat = flatten_forest(trees, 1)
+    return PredictEngine().predict_raw(flat, np.asarray(X, np.float64))[0]
+
+
+def test_flatten_roundtrip_simple():
+    """Node-table round-trip: flatten(tree) -> traverse == tree.predict
+    (the single-tree numpy path stays the oracle for ops/predict.py)."""
+    t = build_simple_tree()
+    X = np.random.RandomState(3).uniform(-1, 6, size=(200, 2))
+    np.testing.assert_array_equal(_flat_predict([t], X), t.predict(X))
+
+
+def test_flatten_roundtrip_missing_and_categorical():
+    tn = Tree(max_leaves=2)
+    tn.split(0, feature=0, threshold_bin=1, threshold_real=0.5,
+             left_value=-1.0, right_value=1.0, left_weight=1,
+             right_weight=1, left_count=1, right_count=1, gain=1.0,
+             missing_type=MISSING_NAN, default_left=True)
+    tc = Tree(max_leaves=2)
+    tc.split_categorical(0, feature=1, cat_bitset=cat_bitset([2, 5, 40]),
+                         left_value=1.0, right_value=-1.0,
+                         left_weight=1, right_weight=1, left_count=1,
+                         right_count=1, gain=1.0,
+                         missing_type=MISSING_NONE)
+    X = np.array([[np.nan, 2.0], [0.0, 5.0], [1.0, 40.0], [0.3, 3.0],
+                  [np.nan, np.nan], [-2.0, 2.5], [0.5, -1.0]])
+    np.testing.assert_array_equal(_flat_predict([tn], X), tn.predict(X))
+    np.testing.assert_array_equal(_flat_predict([tc], X), tc.predict(X))
+    # and as one forest (sum of both trees)
+    np.testing.assert_allclose(_flat_predict([tn, tc], X),
+                               tn.predict(X) + tc.predict(X), rtol=1e-15)
+
+
+def test_flatten_roundtrip_single_leaf():
+    t = Tree(max_leaves=31)
+    t.leaf_value[0] = 0.25
+    X = np.zeros((5, 2))
+    np.testing.assert_allclose(_flat_predict([t], X), [0.25] * 5)

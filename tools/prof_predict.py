@@ -1,0 +1,116 @@
+"""Profile the flattened inference engine vs the per-tree host loop.
+
+Sweeps batch size x n_trees over a deterministic synthetic forest
+(random splits through the real ``Tree`` API — covers every missing
+type and default direction without paying a training run) and prints
+old-vs-new throughput per cell plus the engine speedup.
+
+    JAX_PLATFORMS=cpu python tools/prof_predict.py
+    python tools/prof_predict.py --rows 100000 --trees 200 --reps 5
+
+The 100000x200 cell is the acceptance shape recorded in
+``docs/Benchmarks.md``.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def random_tree(rng, n_leaves, n_feat):
+    from lightgbm_tpu.models.tree import (MISSING_NAN, MISSING_NONE,
+                                          MISSING_ZERO, Tree)
+    t = Tree(max_leaves=max(n_leaves, 2))
+    for _ in range(n_leaves - 1):
+        mt = rng.choice([MISSING_NONE, MISSING_ZERO, MISSING_NAN])
+        t.split(rng.randint(t.num_leaves), rng.randint(n_feat), 0,
+                rng.randn(), rng.randn() * .1, rng.randn() * .1,
+                1, 1, 1, 1, 1.0, mt, bool(rng.rand() < 0.5))
+    return t
+
+
+def median_time(fn, reps):
+    ts = []
+    for _ in range(reps):
+        t0 = time.time()
+        fn()
+        ts.append(time.time() - t0)
+    return sorted(ts)[len(ts) // 2]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, nargs="+",
+                    default=[10_000, 100_000])
+    ap.add_argument("--trees", type=int, nargs="+",
+                    default=[50, 200, 500])
+    ap.add_argument("--leaves", type=int, default=31)
+    ap.add_argument("--features", type=int, default=28)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--nan-frac", type=float, default=0.05)
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON line per cell")
+    args = ap.parse_args()
+
+    from lightgbm_tpu.ops.predict import PredictEngine, flatten_forest
+
+    rng = np.random.RandomState(0)
+    max_rows = max(args.rows)
+    X = rng.randn(max_rows, args.features)
+    X[rng.random_sample(X.shape) < args.nan_frac] = np.nan
+    trees = [random_tree(rng, args.leaves, args.features)
+             for _ in range(max(args.trees))]
+
+    print(f"# forest: {max(args.trees)} trees x {args.leaves} leaves, "
+          f"{args.features} features, median of {args.reps}")
+    header = (f"{'rows':>9} {'trees':>6} {'loop_s':>9} {'engine_s':>9} "
+              f"{'loop_rows/s':>12} {'eng_rows/s':>12} {'speedup':>8}")
+    print(header)
+    results = []
+    for n_trees in args.trees:
+        flat = flatten_forest(trees[:n_trees], 1)
+        engine = PredictEngine()
+        for n in args.rows:
+            Xn = X[:n]
+
+            def run_loop():
+                out = np.zeros(n)
+                for t in trees[:n_trees]:
+                    out += t.predict(Xn)
+                return out
+
+            def run_engine():
+                return engine.predict_raw(flat, Xn)[0]
+
+            ref = run_loop()
+            got = run_engine()          # warm the compile cache
+            err = float(np.max(np.abs(ref - got)))
+            assert err < 1e-10, f"engine diverges from oracle: {err}"
+            t_loop = median_time(run_loop, args.reps)
+            t_eng = median_time(run_engine, args.reps)
+            row = {"rows": n, "trees": n_trees,
+                   "loop_s": round(t_loop, 4),
+                   "engine_s": round(t_eng, 4),
+                   "loop_rows_per_s": round(n / t_loop),
+                   "engine_rows_per_s": round(n / t_eng),
+                   "speedup": round(t_loop / t_eng, 2),
+                   "max_abs_err": err}
+            results.append(row)
+            if args.json:
+                print(json.dumps(row), flush=True)
+            else:
+                print(f"{n:>9} {n_trees:>6} {t_loop:>9.3f} "
+                      f"{t_eng:>9.3f} {n / t_loop:>12.0f} "
+                      f"{n / t_eng:>12.0f} {t_loop / t_eng:>7.1f}x",
+                      flush=True)
+    return results
+
+
+if __name__ == "__main__":
+    main()
