@@ -28,7 +28,7 @@ def __getattr__(name):
         from . import sklearn
         return getattr(sklearn, name)
     if name in ("early_stopping", "print_evaluation", "record_evaluation",
-                "reset_parameter"):
+                "record_telemetry", "reset_parameter"):
         from . import callback
         return getattr(callback, name)
     if name in ("plot_importance", "plot_metric", "plot_tree",

@@ -114,6 +114,7 @@ def _task_train(params: Dict[str, str], config: Config) -> None:
                     verbose_eval=max(config.metric_freq, 1))
     booster.save_model(config.output_model)
     Log.info("Finished training; model saved to %s", config.output_model)
+    _close_telemetry(booster)
 
 
 def _task_predict(params: Dict[str, str], config: Config) -> None:
@@ -126,6 +127,10 @@ def _task_predict(params: Dict[str, str], config: Config) -> None:
         Log.fatal("No data to predict: set data=<file>")
     from .io.parser import parse_file_full
     booster = Booster(model_file=config.input_model)
+    if config.telemetry_file:
+        # loaded boosters skip GBDT.__init__; the inference entry
+        # points still feed run records once a recorder is attached
+        booster._gbdt.attach_telemetry(config.telemetry_file)
     # drop the same non-feature columns training dropped, or feature
     # indices shift against the trained model
     X, _, _, _, _ = parse_file_full(
@@ -161,6 +166,16 @@ def _task_predict(params: Dict[str, str], config: Config) -> None:
                          for row in out)
     Log.info("Finished prediction; results saved to %s",
              config.output_result)
+    _close_telemetry(booster)
+
+
+def _close_telemetry(booster) -> None:
+    """Flush the run_end record + Log summary at task end (the atexit
+    hook would also fire, but an explicit close keeps the CLI's JSONL
+    complete even when the interpreter is torn down abruptly)."""
+    rec = getattr(booster._gbdt, "_telemetry", None)
+    if rec is not None:
+        rec.close()
 
 
 def _task_convert_model(params: Dict[str, str], config: Config) -> None:

@@ -92,6 +92,40 @@ def record_evaluation(eval_result: Dict) -> Callable:
     return _RecordEvaluation(eval_result)
 
 
+class _RecordTelemetry:
+    """Attach a run recorder (``utils/telemetry.py``) to the booster
+    before the first iteration — the callback form of the
+    ``telemetry_file`` config parameter, for callers who want to hand
+    in an existing :class:`RunRecorder` (the bench) or an in-memory
+    recorder (tests).  Iteration/predict records are emitted by the
+    booster itself once a recorder is attached; eval records by the
+    training loop."""
+    order = 5
+    before_iteration = True
+
+    def __init__(self, target):
+        self.target = target
+        self.recorder = None
+
+    def __call__(self, env: CallbackEnv) -> None:
+        if self.recorder is not None:
+            return
+        gbdt = getattr(env.model, "_gbdt", None)
+        if gbdt is None:   # cv hands a CVBooster; attach per fold
+            for bst in getattr(env.model, "boosters", []):
+                bst._gbdt.attach_telemetry(self.target)
+            self.recorder = True
+            return
+        self.recorder = gbdt.attach_telemetry(self.target)
+
+
+def record_telemetry(target) -> Callable:
+    """Feed structured run telemetry to ``target`` — a JSONL path or a
+    :class:`lightgbm_tpu.utils.telemetry.RunRecorder`.  Equivalent to
+    setting ``telemetry_file=<path>`` in the params."""
+    return _RecordTelemetry(target)
+
+
 class _ResetParameter:
     order = 10
     before_iteration = True

@@ -237,6 +237,14 @@ PARAMS: List[Param] = [
        "row-chunk size of the batched inference engine; chunks are "
        "padded to power-of-two buckets that key the compile cache",
        group="io", check=">0"),
+    _p("telemetry_file", "", str, ("telemetry", "telemetry_filename"),
+       "append schema-versioned JSONL run records to this path: "
+       "per-iteration phase timings, XLA compile/retrace counters, "
+       "predict-engine cache hits/misses/evictions, histogram tier/gate "
+       "decisions, collective payload bytes, backend identity; '' "
+       "disables.  Read with tools/triage_run.py (anomaly triage, "
+       "--check schema lint); a summary is logged at shutdown",
+       group="io"),
     _p("convert_model_language", "", str, (),
        "language of converted model (cpp)", group="io"),
     _p("convert_model", "gbdt_prediction.cpp", str,
@@ -310,7 +318,20 @@ PARAMS: List[Param] = [
     _p("use_quantized_grad", False, bool, ("quantized_grad",),
        "histogram gradients/hessians as stochastically-rounded small "
        "integers: exact in bf16, so the speculative histogram pass packs "
-       "42 leaves per MXU matmul instead of 21 (device learner only)",
+       "42 leaves per MXU matmul instead of 21 (device learner only).  "
+       "Under wave growth, eligible configs (min_data_in_leaf <= 1, "
+       "min_sum_hessian_in_leaf > 0, no categorical features, no EFB "
+       "bundles) drop further to two-column (grad, hess) passes fitting "
+       "64 leaves per pass: the histogram count channel becomes a "
+       "QUANTIZED HESS COPY.  Missing-value caveat of that proxy: the "
+       "default-direction \"any missing data here?\" test reads the "
+       "hess-copy channel instead of a real count, so a missing-bin row "
+       "whose quantized hessian rounds to 0 is treated as absent for "
+       "the direction choice ONLY (both directions tie in gain there; "
+       "split thresholds and leaf values are unaffected, and real leaf "
+       "counts are restored from the full-precision renewal sums — "
+       "quality is pinned by the NaN-injection oracle test).  Set "
+       "min_data_in_leaf >= 2 to force the counted W=42 tier instead",
        group="device"),
     _p("num_grad_quant_bins", 120, int, (),
        "quantization levels per side for use_quantized_grad",

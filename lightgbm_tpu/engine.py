@@ -122,6 +122,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
             evaluation_result_list.extend(
                 _run_feval(feval, booster, train_set, valid_sets,
                            valid_names))
+        _telemetry_rec = getattr(booster._gbdt, "_telemetry", None)
+        if _telemetry_rec is not None and evaluation_result_list:
+            # metric stream rides the run record (telemetry JSONL is
+            # the artifact docs/Benchmarks.md-class documents come from)
+            _telemetry_rec.emit("eval", iter=i, results=[
+                [d, m, float(v), bool(h)]
+                for d, m, v, h in evaluation_result_list])
         try:
             for cb in cbs_after:
                 cb(CallbackEnv(booster, params, i, 0, num_boost_round,

@@ -346,7 +346,9 @@ class RF(GBDT):
         self._rf_grad = jnp.atleast_2d(g)
         self._rf_hess = jnp.atleast_2d(h)
 
-    def train_one_iter(self, grad=None, hess=None) -> bool:
+    def _train_one_iter_impl(self, grad=None, hess=None) -> bool:
+        # overriding the IMPL keeps the base train_one_iter's telemetry
+        # wrapper (per-iteration run records) around RF iterations too
         import jax.numpy as jnp
         if grad is not None:
             Log.fatal("rf does not support a custom objective")

@@ -383,6 +383,29 @@ class GBDT:
         if objective is not None:
             objective.init(train_set.metadata, n)
 
+        # ---- observability -------------------------------------------
+        # tier/gate decision record: which fast tier every tree of this
+        # booster runs on, and the gate that rejected each higher tier
+        # (utils/telemetry.py; the round-4/5 regressions were all
+        # invisible because this was only derivable from profiler runs)
+        self.tier_decision = self._tier_gates(
+            config, use_pallas=use_pallas, dist_active=dist_active,
+            learner=learner, num_shards=num_shards, wave_on=wave_on,
+            two_col=two_col, refine_shift=refine_shift, any_cat=any_cat,
+            any_missing=any_missing, use_pool=use_pool,
+            forced=bool(forced), G_cols=G_cols)
+        self._collective_per_pass = 0
+        if dist_active and self._dist is not None:
+            from ..ops.grow import collective_bytes_per_pass
+            # the builder's params carry the real DistConfig (the
+            # booster-level grow_params keeps the serial default)
+            self._collective_per_pass = collective_bytes_per_pass(
+                self._dist.params, self._F_pad, self._n_pad)["total"]
+        self._telemetry = None
+        self._tele_counters_last: Dict[str, float] = {}
+        if getattr(config, "telemetry_file", ""):
+            self.attach_telemetry(config.telemetry_file)
+
     # ------------------------------------------------------------------
     def _constraint_tuples(self, config: Config, train_set: TpuDataset,
                            F: int):
@@ -446,6 +469,160 @@ class GBDT:
                 queue.append((node["right"], t + 1))
             t += 1
         return tuple(out)
+
+    # ------------------------------------------------------------------
+    def _tier_gates(self, config, use_pallas, dist_active, learner,
+                    num_shards, wave_on, two_col, refine_shift, any_cat,
+                    any_missing, use_pool, forced, G_cols):
+        """The histogram-tier decision for this booster, with the gate
+        that rejected each higher tier.  Mirrors the driver gates above
+        and the routed-kernel feasibility in ``ops/grow.py`` — the
+        telemetry contract is that a reader can tell WHY a run landed
+        on a slower tier without rerunning it under a profiler."""
+        from ..ops.histogram import routed_chunk_ok
+        gates = {}
+        quantize = int(self.grow_params.quantize)
+        speculate = int(self.grow_params.speculate)
+        if not two_col:
+            if not config.use_quantized_grad:
+                gates["two_col"] = "use_quantized_grad=false"
+            elif not wave_on:
+                gates["two_col"] = "wave growth off"
+            elif self._bundles is not None:
+                gates["two_col"] = ("EFB bundles active "
+                                    "(FixHistogram reads counts)")
+            elif any_cat:
+                gates["two_col"] = ("categorical scans read real counts "
+                                    "(cnt_ok, min_data_per_group)")
+            elif config.min_data_in_leaf > 1:
+                gates["two_col"] = "min_data_in_leaf > 1 needs counts"
+            else:
+                gates["two_col"] = "min_sum_hessian_in_leaf <= 0"
+        if not wave_on:
+            if not config.wave_splits:
+                gates["wave"] = "wave_splits=false"
+            elif not use_pool:
+                gates["wave"] = ("histogram pool over budget "
+                                 "(histogram_pool_size)")
+            else:
+                gates["wave"] = "forced splits"
+        if refine_shift == 0:
+            if not config.hist_refinement:
+                gates["c2f"] = "hist_refinement=false"
+            elif not wave_on:
+                gates["c2f"] = "wave growth off"
+            elif dist_active and learner != "data":
+                gates["c2f"] = f"tree_learner={learner}"
+            elif self._bundles is not None:
+                gates["c2f"] = "EFB bundles active"
+            elif any_cat:
+                gates["c2f"] = "categorical features"
+            elif self.max_bin < 48:
+                gates["c2f"] = f"max_bin={self.max_bin} < 48"
+            else:
+                gates["c2f"] = ("stream below the per-pass fixed-cost "
+                                "break-even (features x bins < ~7000)")
+        # routed-kernel feasibility (ops/grow.py routed_full_ok /
+        # routed_coarse_ok — the in-pass routing tier)
+        if not use_pallas:
+            gates["routed"] = "cpu backend (segsum histograms)"
+        elif self._bundles is not None:
+            gates["routed"] = "EFB bundles active"
+        elif any_cat:
+            gates["routed"] = "categorical splits need bin masks"
+        elif learner == "feature":
+            gates["routed"] = ("feature-parallel: split column lives "
+                               "on one shard")
+        routed = "routed" not in gates and routed_chunk_ok(
+            self.max_bin, G_cols, 128,
+            int(config.tpu_rows_per_block))
+        if "routed" not in gates and not routed:
+            gates["routed"] = "feature block exceeds one kernel chunk"
+        if two_col:
+            tier = "two_col"
+        elif wave_on:
+            tier = "wave_quant" if quantize else "wave"
+        elif speculate:
+            tier = "speculative"
+        else:
+            tier = "exact"
+        return {
+            "tier": tier,
+            "gates": gates,
+            "routed": bool(routed),
+            "c2f": bool(refine_shift),
+            "refine_shift": int(refine_shift),
+            "quantize": quantize,
+            "speculate": speculate,
+            "wave": bool(wave_on),
+            "hist_impl": self.grow_params.hist_impl,
+            "use_hist_pool": bool(use_pool),
+            "efb_groups": (int(self._bundles.num_groups)
+                           if self._bundles is not None else 0),
+            "learner": learner if dist_active else "serial",
+            "num_shards": int(num_shards) if dist_active else 1,
+        }
+
+    # ------------------------------------------------------------------
+    def attach_telemetry(self, target):
+        """Attach a run recorder (``utils/telemetry.py``): a JSONL path
+        or an existing :class:`RunRecorder`.  Idempotent — the first
+        attachment wins.  Works on loaded (predict-only) boosters too.
+        """
+        from ..utils import telemetry
+        if getattr(self, "_telemetry", None) is not None:
+            return self._telemetry
+        if isinstance(target, telemetry.RunRecorder):
+            rec = target
+            rec.emit("run_start", **self._run_info())
+        else:
+            rec = telemetry.RunRecorder(str(target),
+                                        run_info=self._run_info())
+        self._telemetry = rec
+        self._tele_counters_last = telemetry.counters_snapshot()
+        return rec
+
+    def telemetry_summary(self):
+        rec = getattr(self, "_telemetry", None)
+        return rec.summary() if rec is not None else None
+
+    def _run_info(self):
+        """Backend identity + config subset for the run_start record."""
+        cfg = self.config
+        info = {
+            "backend": "unknown",
+            "tier": getattr(self, "tier_decision", None),
+            "params": {
+                "objective": cfg.objective,
+                "num_leaves": cfg.num_leaves,
+                "max_bin": cfg.max_bin,
+                "num_class": cfg.num_class,
+                "tree_learner": cfg.tree_learner,
+                "use_quantized_grad": cfg.use_quantized_grad,
+                "wave_splits": cfg.wave_splits,
+                "hist_refinement": cfg.hist_refinement,
+                "min_data_in_leaf": cfg.min_data_in_leaf,
+            },
+        }
+        if self.train_set is not None:
+            info["rows"] = int(self.num_data)
+            info["features"] = int(self.num_features)
+        try:
+            import jax
+            info["backend"] = jax.default_backend()
+            dev = jax.local_devices()[0]
+            info["device_kind"] = str(getattr(dev, "device_kind", ""))
+            stats = dev.memory_stats()
+            if stats:
+                info["device_memory"] = {
+                    k: int(stats[k]) for k in
+                    ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+                    if k in stats}
+        except Exception:
+            # backend identity must never take the run down — degraded
+            # environments are exactly when telemetry matters most
+            info["backend_degraded"] = True
+        return info
 
     # ------------------------------------------------------------------
     def add_valid(self, name: str, raw: np.ndarray, metadata: Metadata,
@@ -691,7 +868,63 @@ class GBDT:
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration; returns True when training should stop
-        (no splittable leaf)."""
+        (no splittable leaf).  With a telemetry recorder attached, every
+        iteration emits a structured record (phase deltas, compile/
+        retrace counters, tier, histogram passes, collective bytes)."""
+        rec = getattr(self, "_telemetry", None)
+        if rec is None:
+            return self._train_one_iter_impl(grad, hess)
+        import time as _time
+        from ..utils import profiling
+        it = self.iter
+        ph0 = profiling.snapshot()
+        t0 = _time.perf_counter()
+        stop = self._train_one_iter_impl(grad, hess)
+        dur_ms = (_time.perf_counter() - t0) * 1e3
+        cdelta, self._tele_counters_last = rec.counters_delta(
+            self._tele_counters_last)
+        fields = {
+            "iter": it,
+            "duration_ms": round(dur_ms, 3),
+            "phases_ms": profiling.delta_ms(ph0),
+            "counters": cdelta,
+            "tier": self.tier_decision["tier"],
+            "trees_per_iter": self.num_tree_per_iteration,
+            # raw list length: the models property would flush the
+            # pipelined in-flight tree and kill the fetch overlap
+            "n_trees": len(self._models) +
+            (1 if self._pending is not None else 0),
+            "stopped": bool(stop),
+        }
+        passes = getattr(self, "last_arm_passes", None)
+        if passes is not None:
+            hp = (int(passes) + 1) * self.num_tree_per_iteration
+            fields["hist_passes"] = hp
+            # pool hit rate: fraction of the 2S child histograms a tree
+            # needed that came from the pool (subtraction trick / armed
+            # cache) instead of a fresh device pass.  Uses the last
+            # MATERIALIZED tree's split count (the pipelined path trails
+            # by one tree; the rate is a per-booster steady-state stat)
+            if self._models and self._models[-1].num_leaves > 1:
+                S = self._models[-1].num_leaves - 1
+                fields["pool_hit_rate"] = round(
+                    max(0.0, 1.0 - hp / float(2 * S)), 4)
+        if self._collective_per_pass:
+            # passes this iteration: measured for speculative/wave
+            # builds; otherwise ~one fresh smaller-child pass per
+            # split plus the root (subtraction covers the sibling)
+            hp = fields.get("hist_passes")
+            if hp is None:
+                n_leaves = (self._models[-1].num_leaves if self._models
+                            else self.config.num_leaves)
+                hp = max(n_leaves, 1) * self.num_tree_per_iteration
+            fields["collective_bytes"] = int(
+                self._collective_per_pass * hp)
+        rec.emit("iteration", **fields)
+        return stop
+
+    def _train_one_iter_impl(self, grad: Optional[np.ndarray] = None,
+                             hess: Optional[np.ndarray] = None) -> bool:
         import jax.numpy as jnp
 
         if grad is None and self._pipeline_ok():
@@ -1038,14 +1271,17 @@ class GBDT:
         iterations, rows whose margin (|score| for binary, top1-top2
         for multiclass) exceeds ``early_stop_margin`` stop accumulating
         further trees."""
+        import time as _time
+        t0 = _time.perf_counter()
         X = np.ascontiguousarray(np.asarray(X, np.float64))
         k = self.num_tree_per_iteration
         n_trees = len(self.models)
         if num_iteration is not None and num_iteration > 0:
             n_trees = min(n_trees, num_iteration * k)
         use_es = early_stop and k >= 1 and not self.average_output
-        if n_trees > 0 and X.shape[0] > 0 and \
-                self._use_predict_engine(predict_engine):
+        used_engine = n_trees > 0 and X.shape[0] > 0 and \
+            self._use_predict_engine(predict_engine)
+        if used_engine:
             from ..ops.predict import get_engine
             out = get_engine().predict_raw(
                 self._flat_forest(), X, n_trees, early_stop=use_es,
@@ -1059,6 +1295,7 @@ class GBDT:
                                          early_stop_margin)
         if self.average_output and n_trees:
             out = out / max(n_trees // k, 1)
+        self._record_predict("raw", X.shape[0], n_trees, used_engine, t0)
         return out[0] if k == 1 else out.T
 
     def _predict_raw_loop(self, X: np.ndarray, n_trees: int, k: int,
@@ -1096,19 +1333,46 @@ class GBDT:
     def predict_leaf_index(self, X: np.ndarray, num_iteration: int = -1,
                            predict_engine=None,
                            predict_chunk_rows=None) -> np.ndarray:
+        import time as _time
+        t0 = _time.perf_counter()
         X = np.ascontiguousarray(np.asarray(X, np.float64))
         n_trees = len(self.models)
         if num_iteration is not None and num_iteration > 0:
             n_trees = min(n_trees, num_iteration * self.num_tree_per_iteration)
-        if n_trees > 0 and X.shape[0] > 0 and \
-                self._use_predict_engine(predict_engine):
+        used_engine = n_trees > 0 and X.shape[0] > 0 and \
+            self._use_predict_engine(predict_engine)
+        if used_engine:
             from ..ops.predict import get_engine
-            return get_engine().predict_leaf_index(
+            out = get_engine().predict_leaf_index(
                 self._flat_forest(), X, n_trees,
                 chunk_rows=predict_chunk_rows or
                 getattr(self.config, "predict_chunk_rows", 0))
-        return np.stack([self.models[i].predict_leaf_index(X)
-                         for i in range(n_trees)], axis=1)
+        else:
+            out = np.stack([self.models[i].predict_leaf_index(X)
+                            for i in range(n_trees)], axis=1)
+        self._record_predict("leaf", X.shape[0], n_trees, used_engine, t0)
+        return out
+
+    def _record_predict(self, kind: str, rows: int, n_trees: int,
+                        used_engine: bool, t0: float) -> None:
+        """One ``predict`` telemetry record per call.  Cache counters
+        are reported CUMULATIVE from the process-wide engine — the
+        merge-safe form under concurrent predicts (utils/telemetry.py
+        aggregates by keeping the latest value)."""
+        rec = getattr(self, "_telemetry", None)
+        if rec is None:
+            return
+        import time as _time
+        fields = {"kind": kind, "rows": int(rows), "n_trees": int(n_trees),
+                  "engine": bool(used_engine),
+                  "duration_ms": round((_time.perf_counter() - t0) * 1e3,
+                                       3)}
+        try:
+            from ..ops.predict import get_engine
+            fields["cache"] = get_engine().cache_info()
+        except Exception:
+            pass
+        rec.emit("predict", **fields)
 
     def init_from_model(self, models: List[Tree],
                         raw: Optional[np.ndarray]) -> None:

@@ -54,7 +54,8 @@ from .split import (NEG_INF, SplitParams, choose_window,
                     eval_forced_split, find_best_split,
                     find_best_split_c2f, leaf_output)
 
-__all__ = ["DistConfig", "GrowParams", "build_tree"]
+__all__ = ["DistConfig", "GrowParams", "build_tree",
+           "collective_bytes_per_pass"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -158,6 +159,60 @@ class GrowParams:
     # deferred leaf stays in the queue and splits next).  0 = exact
     # best-first (default).
     spec_tolerance: float = 0.0
+
+
+def collective_bytes_per_pass(params: GrowParams, num_features: int,
+                              num_rows: int) -> dict:
+    """Static per-shard estimate of the collective payload ONE
+    histogram pass (plus its best-split merge and row-routing
+    collectives) moves under this strategy — the accounting GPU
+    boosting systems report to attribute time to comms (arXiv:
+    1806.11248 §reducing histograms; arXiv:2005.09148).
+
+    The estimate mirrors the collectives in :func:`build_tree`:
+
+    - ``data``  — wave: full ``psum`` of the (W, F, B, 3) f32 batched
+      pass; non-wave: ``psum_scatter`` of one (F, B, 3) leaf histogram
+      plus the all-gathered best-split merge.
+    - ``feature`` — no histogram traffic; per-child best merge
+      all-gather plus one (N,) f32 owner-bit routing psum per wave.
+    - ``voting`` — ballot all-gather plus the elected-only (2k, B, 3)
+      psum per scanned child.
+
+    Keys: hist / merge / route / total, all bytes.  Coarse-to-fine and
+    two-column passes stream fewer bins; this reports the full-
+    resolution upper bound (telemetry consumers care about order of
+    magnitude and trend, not exact wire bytes).
+    """
+    p = params
+    kind = p.dist.kind
+    D = max(p.dist.num_shards, 1)
+    F = max(num_features, 1)
+    B = p.split.max_bin
+    W = p.speculate if (p.wave and p.speculate > 1) else 1
+    out = {"hist": 0, "merge": 0, "route": 0, "total": 0}
+    if kind in ("serial", "") or D <= 1:
+        return out
+    # one _MERGE_KEYS record: gain f32 + feature/threshold i32 +
+    # default_left/is_cat bool + (B,) bool left_mask + (3,) f32 stats
+    rec_bytes = 4 + 4 + 4 + 1 + 1 + B + 12
+    n_children = 2 * W if p.wave else 1
+    if kind == "data":
+        if p.wave:
+            out["hist"] = W * F * B * 3 * 4
+        else:
+            out["hist"] = F * B * 3 * 4
+            out["merge"] = rec_bytes * D
+    elif kind == "feature":
+        out["merge"] = n_children * rec_bytes * D
+        out["route"] = num_rows * 4
+    elif kind == "voting":
+        n_vote = min(p.dist.top_k, F)
+        n_elect = min(2 * p.dist.top_k, F)
+        out["merge"] = n_children * n_vote * 4 * D
+        out["hist"] = n_children * n_elect * B * 3 * 4
+    out["total"] = out["hist"] + out["merge"] + out["route"]
+    return out
 
 
 def _hist(xt, vals, p: GrowParams):

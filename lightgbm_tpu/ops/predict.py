@@ -49,10 +49,13 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import threading
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+from ..utils.telemetry import counters as _tele_counters
 
 _KZERO = 1e-35
 
@@ -113,9 +116,13 @@ class FlatForest:
         is a small LRU — per-iteration staged predicts (num_iteration
         = 1..T) must not accumulate T full forest copies."""
         key = (n_trees, tree_chunk)
-        if key in self._dev:
-            self._dev.move_to_end(key)
-            return self._dev[key]
+        hit = self._dev.get(key)  # .get: concurrent predicts may evict
+        if hit is not None:
+            try:
+                self._dev.move_to_end(key)
+            except KeyError:
+                pass
+            return hit
         import jax.numpy as jnp
         Tc = tree_chunk
         C = max((n_trees + Tc - 1) // Tc, 1)
@@ -464,25 +471,37 @@ class PredictEngine:
         self.tree_chunk = int(tree_chunk)
         self.cache_size = int(cache_size)
         self._cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._cache_lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
 
     # -- cache -----------------------------------------------------------
     def _compiled(self, key):
-        hit = self._cache.get(key)
-        if hit is not None:
-            self._cache.move_to_end(key)
-            self.hits += 1
-            return hit
-        self.misses += 1
-        kernels = _make_kernels(key)
-        self._cache[key] = kernels
-        while len(self._cache) > self.cache_size:
-            self._cache.popitem(last=False)
-        return kernels
+        # concurrent predicts share the process-wide engine; the LRU
+        # reorder/evict must be atomic.  jax.jit is lazy, so holding
+        # the lock through _make_kernels wraps closures only — the
+        # actual XLA compile happens at call time, outside the lock.
+        with self._cache_lock:
+            hit = self._cache.get(key)
+            if hit is not None:
+                self._cache.move_to_end(key)
+                self.hits += 1
+                _tele_counters.incr("predict_cache_hits")
+                return hit
+            self.misses += 1
+            _tele_counters.incr("predict_cache_misses")
+            kernels = _make_kernels(key)
+            self._cache[key] = kernels
+            while len(self._cache) > self.cache_size:
+                self._cache.popitem(last=False)
+                self.evictions += 1
+                _tele_counters.incr("predict_cache_evictions")
+            return kernels
 
     def cache_info(self) -> Dict[str, int]:
         return {"hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions,
                 "entries": len(self._cache), "traces": TRACE_COUNT}
 
     # -- bucketing -------------------------------------------------------

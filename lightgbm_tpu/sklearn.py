@@ -233,6 +233,11 @@ class LGBMModel(_SKBase):
         self._evals_result = evals_result
         self._best_iteration = self._Booster.best_iteration
         self._best_score = getattr(self._Booster, "best_score", {})
+        # run-record aggregate for sklearn users (telemetry_file= / a
+        # record_telemetry callback): phase totals, compile counts,
+        # predict-cache traffic — None when no recorder was attached
+        self._telemetry_summary = self._Booster._gbdt.telemetry_summary() \
+            if hasattr(self._Booster._gbdt, "telemetry_summary") else None
         return self
 
     @staticmethod
@@ -321,6 +326,14 @@ class LGBMModel(_SKBase):
     @property
     def evals_result_(self):
         return self._evals_result
+
+    @property
+    def telemetry_summary_(self):
+        """Aggregate run-record summary of the last fit (phase totals,
+        XLA compile counts, predict-cache traffic); None unless a
+        telemetry recorder was attached (``telemetry_file=`` param or a
+        ``record_telemetry`` callback)."""
+        return getattr(self, "_telemetry_summary", None)
 
     @property
     def feature_importances_(self) -> np.ndarray:

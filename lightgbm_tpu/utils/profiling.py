@@ -23,7 +23,8 @@ import threading
 import time
 from typing import Dict, Iterator, Tuple
 
-__all__ = ["timed", "summary", "reset", "get", "jax_trace"]
+__all__ = ["timed", "summary", "reset", "get", "snapshot", "delta_ms",
+           "jax_trace"]
 
 _lock = threading.Lock()
 _acc: Dict[str, Tuple[float, int]] = {}
@@ -51,6 +52,24 @@ def get(name: str) -> Tuple[float, int]:
 def reset() -> None:
     with _lock:
         _acc.clear()
+
+
+def snapshot() -> Dict[str, Tuple[float, int]]:
+    """Copy of the accumulator — telemetry diffs two snapshots to
+    attribute time to phases per iteration."""
+    with _lock:
+        return dict(_acc)
+
+
+def delta_ms(before: Dict[str, Tuple[float, int]]) -> Dict[str, float]:
+    """Per-phase milliseconds accumulated since ``before`` (a
+    :func:`snapshot` result); phases with no new time are omitted."""
+    out = {}
+    for name, (total, _count) in snapshot().items():
+        d = total - before.get(name, (0.0, 0))[0]
+        if d > 0:
+            out[name] = round(d * 1e3, 3)
+    return out
 
 
 def summary() -> str:

@@ -1,0 +1,488 @@
+"""Structured run telemetry: schema-versioned JSONL run records.
+
+Round 5 lost its on-hardware perf evidence because one tunnel outage
+turned the bench artifact into a raw traceback, and ``docs/
+Benchmarks.md`` drifted because it was written from memory instead of
+from artifacts.  This module is the run-record discipline GPU boosting
+systems lean on to attribute time to kernels, transfers and comms
+(XGBoost: Scalable GPU Accelerated Learning, arXiv:1806.11248;
+Out-of-Core GPU Gradient Boosting, arXiv:2005.09148): every training
+and inference entry point feeds a :class:`RunRecorder`, which appends
+one JSON object per line to ``telemetry_file`` and logs an aggregate
+summary through :class:`~lightgbm_tpu.utils.log.Log` at shutdown.
+
+Record stream (all records carry ``schema``/``type``/``seq``/``wall_time``):
+
+- ``run_start``  — backend identity (platform, device kind, degraded
+  flags), the tier/gate decision for the booster (two_col vs wave vs
+  routed vs exact, with the gate that rejected each higher tier),
+  config subset, device memory stats when the backend exposes them.
+- ``iteration``  — per boosting iteration: phase-timer deltas from
+  ``profiling.py``, XLA compile/retrace counter deltas (hooked via
+  ``jax.monitoring``, so a silent retrace storm becomes a visible
+  number), histogram passes + pool hit rate, per-learner collective
+  payload bytes, trees added.
+- ``eval``       — metric results as the training loop computed them.
+- ``predict``    — one per predict call: rows, trees, engine on/off,
+  predict-engine compile-cache hit/miss/eviction deltas.
+- ``run_end``    — the aggregate summary (also Log.info'd).
+
+Consumers: ``tools/triage_run.py`` (anomaly triage + ``--check``
+schema lint) and ``tools/render_benchmarks.py`` (regenerates
+``docs/Benchmarks.md`` from artifacts).  The bench-artifact recovery
+parser lives here too so ``bench.py`` and the tools share one
+implementation — and it must stay importable WITHOUT jax (the bench's
+outage path runs when the backend cannot even initialize).
+"""
+from __future__ import annotations
+
+import atexit
+import glob
+import json
+import os
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .log import Log
+
+__all__ = [
+    "SCHEMA_VERSION", "RECORD_TYPES", "RunRecorder", "counters",
+    "counters_snapshot", "install_jax_hooks", "validate_record",
+    "lint_file", "read_records", "parse_bench_artifact",
+    "latest_good_bench", "get_recorder", "set_recorder",
+]
+
+SCHEMA_VERSION = 1
+
+RECORD_TYPES = ("run_start", "iteration", "eval", "predict", "run_end")
+
+# per-type required fields on top of the common envelope; values are
+# (field, type-or-types) pairs the lint enforces
+_COMMON_FIELDS = (("schema", int), ("type", str), ("seq", int),
+                  ("wall_time", float))
+_TYPE_FIELDS: Dict[str, Tuple[Tuple[str, Any], ...]] = {
+    "run_start": (("backend", str),),
+    "iteration": (("iter", int), ("duration_ms", (int, float))),
+    "eval": (("iter", int), ("results", list)),
+    "predict": (("rows", int), ("n_trees", int), ("engine", bool)),
+    "run_end": (("summary", dict),),
+}
+
+
+# ----------------------------------------------------------------------
+# process-wide counters (compile/retrace events, predict-cache traffic)
+# ----------------------------------------------------------------------
+class _Counters:
+    """Thread-safe monotonic counters; recorders snapshot-and-diff."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._c: Dict[str, float] = {}
+
+    def incr(self, name: str, by: float = 1.0) -> None:
+        with self._lock:
+            self._c[name] = self._c.get(name, 0.0) + by
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._c)
+
+
+counters = _Counters()
+
+
+def counters_snapshot() -> Dict[str, float]:
+    return counters.snapshot()
+
+
+_HOOKS_INSTALLED = False
+_HOOKS_LOCK = threading.Lock()
+
+
+def install_jax_hooks() -> bool:
+    """Register ``jax.monitoring`` listeners feeding the process-wide
+    compile/retrace counters.  Idempotent; returns False when the
+    monitoring API is unavailable.  Event mapping (measured on jax
+    0.4.x): ``.../backend_compile_duration`` fires once per REAL XLA
+    compile (silent on executable-cache hits), ``.../jaxpr_trace_
+    duration`` fires per abstract trace — a flat compile counter with a
+    climbing trace counter is the signature of a retrace storm served
+    from the compile cache, both climbing is new-shape compilation."""
+    global _HOOKS_INSTALLED
+    with _HOOKS_LOCK:
+        if _HOOKS_INSTALLED:
+            return True
+        try:
+            import jax.monitoring as monitoring
+        except Exception:  # pragma: no cover - ancient jax
+            return False
+
+        def _on_duration(name, secs, **kw):
+            if name.endswith("backend_compile_duration"):
+                counters.incr("xla_compiles")
+                counters.incr("xla_compile_secs", secs)
+            elif name.endswith("jaxpr_trace_duration"):
+                counters.incr("jax_traces")
+                counters.incr("jax_trace_secs", secs)
+
+        def _on_event(name, **kw):
+            if "cache_miss" in name:
+                counters.incr("jax_cache_misses")
+
+        # register each listener independently: the two APIs changed
+        # at different jax releases, and a partial success must still
+        # mark the hooks installed (re-registering the survivor on the
+        # next call would double-count every compile)
+        ok = False
+        try:
+            monitoring.register_event_duration_secs_listener(_on_duration)
+            ok = True
+        except Exception:  # pragma: no cover
+            pass
+        try:
+            monitoring.register_event_listener(_on_event)
+            ok = True
+        except Exception:  # pragma: no cover
+            pass
+        _HOOKS_INSTALLED = ok
+        return ok
+
+
+# ----------------------------------------------------------------------
+# recorder
+# ----------------------------------------------------------------------
+_OPEN_RECORDERS: List["RunRecorder"] = []
+_OPEN_LOCK = threading.Lock()
+_GLOBAL: Optional["RunRecorder"] = None
+
+
+def _atexit_close():  # pragma: no cover - exercised via CLI/bench runs
+    with _OPEN_LOCK:
+        recs = list(_OPEN_RECORDERS)
+    for r in recs:
+        try:
+            r.close()
+        except Exception:
+            pass
+
+
+atexit.register(_atexit_close)
+
+
+def get_recorder() -> Optional["RunRecorder"]:
+    """The process-default recorder (set by the CLI / bench), if any."""
+    return _GLOBAL
+
+
+def set_recorder(rec: Optional["RunRecorder"]) -> None:
+    global _GLOBAL
+    _GLOBAL = rec
+
+
+class RunRecorder:
+    """Collects run records and appends them as JSONL.
+
+    Thread-safe: ``emit`` may be called from concurrent predict
+    threads.  When ``path`` is falsy the records are kept in memory
+    only (``self.records``) — the test/tooling mode."""
+
+    def __init__(self, path: Optional[str] = None,
+                 run_info: Optional[Dict[str, Any]] = None,
+                 keep_records: Optional[bool] = None):
+        self._lock = threading.RLock()
+        self.path = path or None
+        self._fh = open(self.path, "a", buffering=1) if self.path else None
+        self.keep_records = (not self.path) if keep_records is None \
+            else bool(keep_records)
+        self.records: List[Dict[str, Any]] = []
+        self._seq = 0
+        self._closed = False
+        self._t0 = time.time()
+        # aggregates for the shutdown summary
+        self._agg: Dict[str, float] = {}
+        self._phase_totals: Dict[str, float] = {}
+        self._tier: Optional[str] = None
+        self._backend: Optional[str] = None
+        self._base = counters.snapshot()
+        install_jax_hooks()
+        with _OPEN_LOCK:
+            _OPEN_RECORDERS.append(self)
+        # the header record must satisfy its own schema even for a bare
+        # recorder (no run_info yet): attach_telemetry emits a second,
+        # fully-populated run_start once a booster adopts the recorder
+        info = dict(run_info or {})
+        info.setdefault("backend", "unknown")
+        self.emit("run_start", **info)
+
+    # ------------------------------------------------------------------
+    def counters_delta(self, last: Dict[str, float]
+                       ) -> Tuple[Dict[str, float], Dict[str, float]]:
+        """(delta since ``last``, fresh snapshot).  The caller owns the
+        snapshot so concurrent iteration/predict streams don't steal
+        each other's deltas."""
+        now = counters.snapshot()
+        delta = {k: round(v - last.get(k, 0.0), 6)
+                 for k, v in now.items() if v != last.get(k, 0.0)}
+        return delta, now
+
+    def emit(self, rtype: str, **fields) -> Dict[str, Any]:
+        rec = {"schema": SCHEMA_VERSION, "type": rtype,
+               "wall_time": round(time.time(), 3)}
+        rec.update(fields)
+        with self._lock:
+            if self._closed:
+                return rec
+            rec["seq"] = self._seq
+            self._seq += 1
+            self._aggregate(rec)
+            if self.keep_records:
+                self.records.append(rec)
+            if self._fh is not None:
+                # one atomic write per record: concurrent emitters must
+                # never interleave partial lines
+                self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
+        return rec
+
+    def _aggregate(self, rec: Dict[str, Any]) -> None:
+        t = rec.get("type")
+        if t == "run_start":
+            self._backend = rec.get("backend")
+            tier = rec.get("tier")
+            if isinstance(tier, dict):
+                self._tier = tier.get("tier")
+        elif t == "iteration":
+            self._agg["iterations"] = self._agg.get("iterations", 0) + 1
+            self._agg["train_ms"] = self._agg.get("train_ms", 0.0) + \
+                float(rec.get("duration_ms", 0.0))
+            for name, ms in (rec.get("phases_ms") or {}).items():
+                self._phase_totals[name] = \
+                    self._phase_totals.get(name, 0.0) + float(ms)
+            for key in ("xla_compiles", "xla_compile_secs", "jax_traces"):
+                v = (rec.get("counters") or {}).get(key)
+                if v:
+                    self._agg[key] = self._agg.get(key, 0.0) + float(v)
+            self._agg["hist_passes"] = self._agg.get("hist_passes", 0.0) \
+                + float(rec.get("hist_passes", 0.0))
+            self._agg["collective_bytes"] = \
+                self._agg.get("collective_bytes", 0.0) + \
+                float(rec.get("collective_bytes", 0.0))
+        elif t == "predict":
+            self._agg["predicts"] = self._agg.get("predicts", 0) + 1
+            self._agg["predict_rows"] = \
+                self._agg.get("predict_rows", 0) + int(rec.get("rows", 0))
+            # cache counters arrive CUMULATIVE (the engine is process-
+            # wide and predicts may run concurrently — per-call deltas
+            # would steal each other's events); keep the latest
+            cache = rec.get("cache") or {}
+            for key in ("hits", "misses", "evictions"):
+                if key in cache:
+                    self._agg[f"predict_cache_{key}"] = float(cache[key])
+
+    # ------------------------------------------------------------------
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = {
+                "backend": self._backend,
+                "tier": self._tier,
+                "duration_s": round(time.time() - self._t0, 3),
+            }
+            out.update({k: (round(v, 6) if isinstance(v, float) else v)
+                        for k, v in self._agg.items()})
+            if self._phase_totals:
+                out["phase_totals_ms"] = {
+                    k: round(v, 3) for k, v in sorted(
+                        self._phase_totals.items(),
+                        key=lambda kv: -kv[1])}
+            return out
+
+    def close(self, log: bool = True) -> None:
+        """Emit ``run_end`` with the aggregate summary, Log.info it, and
+        release the file handle.  Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            s = self.summary()
+            self.emit("run_end", summary=s)
+            self._closed = True
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                finally:
+                    self._fh = None
+        with _OPEN_LOCK:
+            if self in _OPEN_RECORDERS:
+                _OPEN_RECORDERS.remove(self)
+        if log:
+            parts = [f"telemetry: {s.get('iterations', 0):.0f} iterations"
+                     if s.get("iterations") else "telemetry:"]
+            if s.get("xla_compiles"):
+                parts.append(f"{s['xla_compiles']:.0f} XLA compiles "
+                             f"({s.get('xla_compile_secs', 0.0):.1f}s)")
+            if s.get("predicts"):
+                parts.append(
+                    f"{s['predicts']:.0f} predicts "
+                    f"({s.get('predict_cache_hits', 0):.0f} cache hits / "
+                    f"{s.get('predict_cache_misses', 0):.0f} misses)")
+            if self.path:
+                parts.append(f"records -> {self.path}")
+            Log.info("%s", ", ".join(parts))
+            for name, ms in list(
+                    (s.get("phase_totals_ms") or {}).items())[:6]:
+                Log.info("telemetry phase %-24s %10.1f ms", name, ms)
+
+
+# ----------------------------------------------------------------------
+# schema lint
+# ----------------------------------------------------------------------
+def validate_record(rec: Any) -> List[str]:
+    """Schema-lint one record; returns a list of problems (empty =
+    valid).  The contract ``tools/triage_run.py --check`` enforces."""
+    errs: List[str] = []
+    if not isinstance(rec, dict):
+        return ["record is not a JSON object"]
+    for field, ftype in _COMMON_FIELDS:
+        if field not in rec:
+            errs.append(f"missing field {field!r}")
+            continue
+        v = rec[field]
+        # bool is an int subclass; numeric fields must be real numbers
+        ok = isinstance(v, (int, float) if ftype is float else ftype) \
+            and not isinstance(v, bool)
+        if ftype is str:
+            ok = isinstance(v, str)
+        if not ok:
+            errs.append(f"field {field!r} has type {type(v).__name__}")
+    if errs:
+        return errs
+    if rec["schema"] != SCHEMA_VERSION:
+        errs.append(f"schema version {rec['schema']} != {SCHEMA_VERSION}")
+    rtype = rec["type"]
+    if rtype not in RECORD_TYPES:
+        errs.append(f"unknown record type {rtype!r}")
+        return errs
+    for field, ftype in _TYPE_FIELDS.get(rtype, ()):
+        if field not in rec:
+            errs.append(f"{rtype}: missing field {field!r}")
+        elif field != "engine" and isinstance(rec[field], bool):
+            errs.append(f"{rtype}: field {field!r} is bool")
+        elif not isinstance(rec[field], ftype):
+            errs.append(f"{rtype}: field {field!r} has type "
+                        f"{type(rec[field]).__name__}")
+    return errs
+
+
+def read_records(path: str) -> List[Dict[str, Any]]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def lint_file(path: str) -> Tuple[int, List[str]]:
+    """(record count, errors).  Errors carry 1-based line numbers."""
+    n = 0
+    errs: List[str] = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            n += 1
+            try:
+                rec = json.loads(line)
+            except ValueError as exc:
+                errs.append(f"line {lineno}: not JSON ({exc})")
+                continue
+            for e in validate_record(rec):
+                errs.append(f"line {lineno}: {e}")
+    if n == 0:
+        errs.append("no records")
+    return n, errs
+
+
+# ----------------------------------------------------------------------
+# bench-artifact recovery parser (shared by bench.py and the tools)
+# ----------------------------------------------------------------------
+_BENCH_GLOB = "BENCH_r[0-9][0-9].json"
+
+
+def _recover_json_line(text: str) -> Optional[Dict[str, Any]]:
+    """Last parseable JSON object in ``text``.  Driver wrappers keep
+    only the final bytes of stdout, so the last line's HEAD may be cut
+    mid-key — recover by dropping everything before the first complete
+    ``, "key":`` boundary and re-opening the object."""
+    lines = [ln.strip() for ln in text.strip().splitlines()
+             if ln.strip().endswith("}")]
+    for line in reversed(lines):
+        if not line.startswith("{"):
+            cut = line.find(', "')
+            if cut < 0:
+                continue
+            line = "{" + line[cut + 2:]
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict):
+            return obj
+    return None
+
+
+def parse_bench_artifact(path: str) -> Optional[Dict[str, Any]]:
+    """Parse one BENCH artifact into the bench's result dict.
+
+    Accepts the driver wrapper form ``{"n", "cmd", "rc", "tail",
+    "parsed"}`` (preferring ``parsed``, recovering from a truncated
+    ``tail`` otherwise; ``rc != 0`` yields None) and the raw
+    JSON-lines form ``bench.py`` itself prints.  A recovered dict must
+    look like a bench result (carry a known bench key) — driver noise
+    never becomes a benchmark row."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return None
+    obj = None
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        pass
+    if isinstance(obj, dict) and "tail" in obj and "rc" in obj:
+        if obj.get("rc") != 0:
+            return None
+        parsed = obj.get("parsed")
+        rec = parsed if isinstance(parsed, dict) \
+            else _recover_json_line(str(obj.get("tail", "")))
+    elif isinstance(obj, dict):
+        rec = obj
+    else:
+        rec = _recover_json_line(text)
+    if not isinstance(rec, dict):
+        return None
+    known = ("metric", "value", "vs_baseline", "iters_per_s",
+             "tpu_unavailable")
+    if not any(k in rec for k in known):
+        return None
+    return rec
+
+
+def latest_good_bench(root: str) -> Tuple[Optional[str], Optional[Dict]]:
+    """(artifact filename, parsed rows) of the NEWEST parseable bench
+    artifact under ``root`` — outage rounds (rc != 0, unparseable, or
+    ``tpu_unavailable`` re-emissions) are skipped."""
+    for path in sorted(glob.glob(os.path.join(root, _BENCH_GLOB)),
+                       reverse=True):
+        rec = parse_bench_artifact(path)
+        if rec is not None and not rec.get("tpu_unavailable"):
+            return os.path.basename(path), rec
+    return None, None
+
+
+def bench_round(name: str) -> Optional[int]:
+    m = re.match(r"BENCH_r(\d+)\.json$", os.path.basename(name))
+    return int(m.group(1)) if m else None

@@ -1,0 +1,201 @@
+"""Triage a telemetry run: schema lint, anomaly scan, run-vs-run diff.
+
+Reads the schema-versioned JSONL a training/inference run wrote
+(``telemetry_file=<path>``, ``utils/telemetry.py``) and prints the
+top phase / retrace / tier anomalies — the "is the chip down or is the
+code broken?" readout round 5 didn't have.
+
+    python tools/triage_run.py RUN.jsonl                 # triage
+    python tools/triage_run.py RUN.jsonl --baseline PRIOR.jsonl
+    python tools/triage_run.py RUN.jsonl --check         # schema lint
+    python tools/triage_run.py RUN.jsonl --check --quiet # CI gate
+
+``--check`` exits non-zero on any malformed record (CI's schema gate);
+``--baseline`` compares per-iteration phase medians against a prior
+run's JSONL and ranks the regressions.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from lightgbm_tpu.utils.telemetry import (  # noqa: E402
+    lint_file, read_records)
+
+# compiles after this many iterations are anomalous: steady-state
+# boosting re-runs the same jitted programs, so a climbing compile
+# counter past warmup is a retrace storm (shape drift, cache thrash)
+WARMUP_ITERS = 3
+
+
+def _median(vals):
+    vals = sorted(vals)
+    return vals[len(vals) // 2] if vals else 0.0
+
+
+def phase_medians(records):
+    """{phase: median ms/iter} over the run's iteration records."""
+    acc = {}
+    for r in records:
+        if r.get("type") != "iteration":
+            continue
+        for name, ms in (r.get("phases_ms") or {}).items():
+            acc.setdefault(name, []).append(float(ms))
+    return {name: _median(vals) for name, vals in acc.items()}
+
+
+def iter_durations(records):
+    return [float(r.get("duration_ms", 0.0)) for r in records
+            if r.get("type") == "iteration"]
+
+
+def scan_anomalies(records):
+    """Ordered (severity, message) anomaly list for one run."""
+    out = []
+    iters = [r for r in records if r.get("type") == "iteration"]
+    post_warmup = [r for r in iters if r.get("iter", 0) >= WARMUP_ITERS]
+    compiles_late = sum((r.get("counters") or {}).get("xla_compiles", 0)
+                       for r in post_warmup)
+    if compiles_late:
+        secs = sum((r.get("counters") or {}).get("xla_compile_secs", 0.0)
+                   for r in post_warmup)
+        out.append(("HIGH", f"retrace storm: {compiles_late:.0f} XLA "
+                            f"compiles ({secs:.1f}s) AFTER iteration "
+                            f"{WARMUP_ITERS} — steady state should "
+                            f"re-run cached programs"))
+    durs = iter_durations(records)
+    if len(durs) > 2 * WARMUP_ITERS:
+        steady = durs[WARMUP_ITERS:]
+        med = _median(steady)
+        worst = max(steady)
+        if med > 0 and worst > 3 * med:
+            out.append(("MED", f"iteration-time spike: worst steady "
+                               f"iteration {worst:.0f} ms vs median "
+                               f"{med:.0f} ms"))
+    preds = [r for r in records if r.get("type") == "predict"]
+    if preds:
+        cache = preds[-1].get("cache") or {}
+        if cache.get("evictions", 0) > 0:
+            out.append(("MED", f"predict compile-cache thrash: "
+                               f"{cache['evictions']} evictions "
+                               f"(cache_size too small for the serving "
+                               f"shape mix)"))
+    for r in records:
+        if r.get("type") == "run_start" and r.get("backend_degraded"):
+            out.append(("HIGH", "backend identity unavailable at "
+                                "run_start (degraded environment)"))
+    return out
+
+
+def triage(records, baseline=None):
+    lines = []
+    # a bare recorder emits a placeholder run_start ("backend":
+    # "unknown") before a booster adopts it and emits the real one —
+    # prefer the first header carrying a tier decision
+    starts = [r for r in records if r.get("type") == "run_start"]
+    start = next((r for r in starts if r.get("tier")),
+                 starts[0] if starts else {})
+    end = next((r for r in reversed(records)
+                if r.get("type") == "run_end"), None)
+    tier = start.get("tier") or {}
+    lines.append(f"backend     : {start.get('backend', '?')} "
+                 f"{start.get('device_kind', '')}".rstrip())
+    if tier:
+        lines.append(f"tier        : {tier.get('tier')} "
+                     f"(learner={tier.get('learner')}, "
+                     f"routed={tier.get('routed')}, "
+                     f"c2f={tier.get('c2f')}, "
+                     f"quantize={tier.get('quantize')})")
+        for name, why in sorted((tier.get("gates") or {}).items()):
+            lines.append(f"  gate      : {name:<12s} rejected: {why}")
+    durs = iter_durations(records)
+    if durs:
+        lines.append(f"iterations  : {len(durs)}  median "
+                     f"{_median(durs):.1f} ms/iter")
+    meds = phase_medians(records)
+    total = sum(meds.values()) or 1.0
+    for name, ms in sorted(meds.items(), key=lambda kv: -kv[1])[:8]:
+        lines.append(f"  phase     : {name:<24s} {ms:10.1f} ms/iter "
+                     f"({100 * ms / total:4.1f}%)")
+    if end is not None:
+        s = end.get("summary") or {}
+        lines.append(f"compiles    : "
+                     f"{s.get('xla_compiles', 0):.0f} "
+                     f"({s.get('xla_compile_secs', 0.0):.1f}s), "
+                     f"traces {s.get('jax_traces', 0):.0f}")
+        if s.get("predicts"):
+            lines.append(
+                f"predicts    : {s['predicts']:.0f} calls, "
+                f"{s.get('predict_rows', 0):.0f} rows, cache "
+                f"{s.get('predict_cache_hits', 0):.0f}h/"
+                f"{s.get('predict_cache_misses', 0):.0f}m/"
+                f"{s.get('predict_cache_evictions', 0):.0f}e")
+        if s.get("collective_bytes"):
+            lines.append(f"collectives : "
+                         f"{s['collective_bytes'] / 1e6:.1f} MB moved "
+                         f"(estimate)")
+    anomalies = scan_anomalies(records)
+    lines.append("anomalies   : " + ("none" if not anomalies else ""))
+    for sev, msg in anomalies:
+        lines.append(f"  [{sev}] {msg}")
+    if baseline is not None:
+        lines.append("")
+        lines.append("vs baseline:")
+        base_meds = phase_medians(baseline)
+        base_durs = iter_durations(baseline)
+        if durs and base_durs:
+            a, b = _median(durs), _median(base_durs)
+            lines.append(f"  iteration : {a:.1f} vs {b:.1f} ms/iter "
+                         f"({'+' if a >= b else ''}{100 * (a - b) / max(b, 1e-9):.1f}%)")
+        deltas = []
+        for name in set(meds) | set(base_meds):
+            a = meds.get(name, 0.0)
+            b = base_meds.get(name, 0.0)
+            deltas.append((abs(a - b), name, a, b))
+        for _, name, a, b in sorted(deltas, reverse=True)[:6]:
+            pct = 100 * (a - b) / max(b, 1e-9)
+            lines.append(f"  phase     : {name:<24s} {a:9.1f} vs "
+                         f"{b:9.1f} ms/iter ({'+' if pct >= 0 else ''}"
+                         f"{pct:.1f}%)")
+        base_tier = next((r.get("tier") for r in baseline
+                          if r.get("type") == "run_start"), None) or {}
+        if tier and base_tier and tier.get("tier") != base_tier.get("tier"):
+            lines.append(f"  [HIGH] TIER CHANGED: {base_tier.get('tier')} "
+                         f"-> {tier.get('tier')} (check the gates above)")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("run", help="telemetry JSONL to triage")
+    ap.add_argument("--baseline", help="prior run's JSONL to diff against")
+    ap.add_argument("--check", action="store_true",
+                    help="schema-lint only; exit 1 on malformed records")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress OK output (CI mode)")
+    args = ap.parse_args(argv)
+
+    if args.check:
+        n, errs = lint_file(args.run)
+        if errs:
+            print(f"{args.run}: {n} records, {len(errs)} schema "
+                  f"errors:")
+            for e in errs[:20]:
+                print(f"  {e}")
+            return 1
+        if not args.quiet:
+            print(f"{args.run}: {n} records, schema OK "
+                  f"(all records valid, version pinned)")
+        return 0
+
+    records = read_records(args.run)
+    baseline = read_records(args.baseline) if args.baseline else None
+    print(triage(records, baseline))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
