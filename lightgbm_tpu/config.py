@@ -369,6 +369,32 @@ PARAMS: List[Param] = [
        "shapes where the per-pass fixed cost outweighs the stream "
        "saving (features x padded bins < ~7000)",
        group="device"),
+    _p("fused_iters", 1, int, ("fused_iterations", "superstep_iters"),
+       "boosting iterations fused into ONE on-device super-step: a "
+       "single jitted lax.scan runs K iterations of gradients + "
+       "bagging/GOSS/MVS mask draw + tree build + score update with "
+       "the (score, bagging-mask) carry donated, and the K trees' "
+       "split records come back in one device->host transfer — "
+       "O(iterations/K) Python dispatches and tunnel round-trips "
+       "instead of O(iterations).  1 disables (the per-iteration "
+       "path).  Bit-exact with the sequential path; parity is pinned "
+       "by tests/test_superstep.py.  Automatically falls back to "
+       "per-iteration training for: custom objectives (fobj), "
+       "objectives with leaf-renewal hooks (l1/quantile/mape), "
+       "multi-model-per-iteration objectives (multiclass), DART/RF "
+       "boosting, distributed tree learners, attached validation "
+       "sets or training metrics (their eval cadence — including "
+       "early stopping — needs per-iteration scores), and the "
+       "boost_from_average iteration 0 (which then runs unfused "
+       "before fusion engages).  Super-steps are auto-sized down "
+       "near the num_iterations boundary (the tail block runs a "
+       "shorter scan; expect one extra XLA compile there).  A "
+       "learning_rates schedule (reset_parameter callback) changing "
+       "the shrinkage mid-block triggers an exact rewind + "
+       "redispatch — correct, but it rebuilds the block every "
+       "iteration and negates the fusion win; prefer a constant "
+       "learning_rate with fused_iters",
+       group="device", check=">=1"),
 ]
 
 _PARAM_BY_NAME: Dict[str, Param] = {p.name: p for p in PARAMS}

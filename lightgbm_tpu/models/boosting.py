@@ -43,15 +43,34 @@ class GOSS(GBDT):
         Log.info("Using GOSS")
 
     def _bagging_mask(self, grad=None, hess=None):
+        if grad is None:
+            return None
+        return self._goss_mask(self.iter, grad, hess)
+
+    def _fused_mask_fn(self):
+        """GOSS inside the fused super-step: the mask is a pure device
+        function of the iteration's gradients and the PRNG fold of the
+        GLOBAL iteration index — bit-identical to the sequential
+        draw."""
+        return lambda it, prev, grad, hess: self._goss_mask(it, grad,
+                                                            hess)
+
+    def _goss_mask(self, it, grad, hess):
         """Device GOSS mask: the top set is everything above the
         ``top_rate``-quantile of |g*h| (one device sort, no host
         round-trip), the rest is a Bernoulli sample at ``other_rate``'s
         expected size — same expected composition and upweighting as
         the reference's exact argsort + without-replacement choice, in
         O(sort) device work instead of a full-N host argsort per
-        iteration."""
-        if grad is None:
-            return None
+        iteration.  ``it`` may be a host int or a traced scalar; one
+        jitted program serves the sequential and scan-inlined call
+        sites (fused-path bit-parity)."""
+        import jax
+        if getattr(self, "_goss_mask_jit", None) is None:
+            self._goss_mask_jit = jax.jit(self._goss_mask_impl)
+        return self._goss_mask_jit(it, grad, hess)
+
+    def _goss_mask_impl(self, it, grad, hess):
         import jax
         import jax.numpy as jnp
         cfg = self.config
@@ -60,7 +79,7 @@ class GOSS(GBDT):
         top_k = max(int(n * cfg.top_rate), 1)
         other_k = int(n * cfg.other_rate)
         thr = -jnp.sort(-gh)[top_k - 1]
-        key = jax.random.fold_in(self._bag_key, self.iter)
+        key = jax.random.fold_in(self._bag_key, it)
         ku, kt = jax.random.split(key)
         # tie-safe top set: strictly-greater rows always kept, rows AT
         # the threshold admitted at the rate that fills top_k in
@@ -112,18 +131,35 @@ class MVS(GBDT):
         return jnp.where(jnp.any(over), mu_in, s_desc[-1])
 
     def _bagging_mask(self, grad=None, hess=None):
-        if grad is None:
+        if grad is None or self.config.bagging_fraction >= 1.0:
             return None
+        return self._mvs_mask(self.iter, grad, hess)
+
+    def _fused_mask_fn(self):
+        """MVS inside the fused super-step: pure function of the
+        iteration's gradients + the global-iteration PRNG fold."""
+        if self.config.bagging_fraction >= 1.0:
+            return None
+        return lambda it, prev, grad, hess: self._mvs_mask(it, grad,
+                                                           hess)
+
+    def _mvs_mask(self, it, grad, hess):
+        """One jitted program from both call sites — see
+        :meth:`GOSS._goss_mask`."""
+        import jax
+        if getattr(self, "_mvs_mask_jit", None) is None:
+            self._mvs_mask_jit = jax.jit(self._mvs_mask_impl)
+        return self._mvs_mask_jit(it, grad, hess)
+
+    def _mvs_mask_impl(self, it, grad, hess):
         import jax
         import jax.numpy as jnp
         cfg = self.config
-        if cfg.bagging_fraction >= 1.0:
-            return None
         n = self.num_data
         gh = jnp.sum(jnp.abs(grad * hess), axis=0)[:n]
         s = jnp.sqrt(gh * gh + jnp.float32(cfg.var_weight))
         mu = self._threshold_device(s, cfg.bagging_fraction * n)
-        key = jax.random.fold_in(self._bag_key, self.iter)
+        key = jax.random.fold_in(self._bag_key, it)
         prob = jnp.minimum(s / jnp.maximum(mu, 1e-35), 1.0)
         keep = jax.random.uniform(key, (n,)) < prob
         return jnp.where(keep, 1.0 / jnp.maximum(prob, 1e-35),
@@ -141,6 +177,7 @@ class DART(GBDT):
         super().__init__(*args, **kwargs)
         self._track_train_leaf = True
         self._pipeline_enabled = False  # drops need the host tree
+        self._superstep_enabled = False  # per-iter drops/renormalize
         self._rng_drop = np.random.RandomState(
             self.config.drop_seed & 0x7FFFFFFF)
         self.tree_weight: List[float] = []
@@ -324,6 +361,7 @@ class RF(GBDT):
                       "(bagging_freq > 0, 0 < bagging_fraction < 1)")
         self.average_output = True
         self._pipeline_enabled = False  # averaged-score updates
+        self._superstep_enabled = False  # averaged-score updates
         self.shrinkage_rate = 1.0
         if self.objective is None:
             Log.fatal("rf does not support a custom objective")

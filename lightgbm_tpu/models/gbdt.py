@@ -103,6 +103,13 @@ class GBDT:
         self._pending = None        # in-flight tree (pipelined boosting)
         self._stop_flag = False
         self._pipeline_enabled = True  # DART/RF opt out
+        # fused boosting super-steps (config.fused_iters > 1): one
+        # jitted lax.scan runs K iterations on device; the block state
+        # below serves its trees one per train_one_iter call
+        self._superstep_enabled = True  # DART/RF opt out
+        self._fused_block = None        # in-flight super-step block
+        self._superstep_jit = None      # lazily-built jitted scan
+        self._fused_has_bagging = False
         self._trees_dispatched = 0  # quantization PRNG stream position
         self.iter = 0
         self.num_class = max(config.num_class, 1)
@@ -674,6 +681,56 @@ class GBDT:
             mask[self._rng_feature.choice(F, size=k, replace=False)] = True
         return jnp.asarray(mask)
 
+    def _bagging_active(self) -> bool:
+        cfg = self.config
+        pos_neg = (cfg.pos_bagging_fraction < 1.0 or
+                   cfg.neg_bagging_fraction < 1.0)
+        return cfg.bagging_freq > 0 and (cfg.bagging_fraction < 1.0 or
+                                         pos_neg)
+
+    def _draw_bag_mask(self, it):
+        """Pure device draw of the bernoulli/stratified bagging mask
+        for (global) iteration ``it`` — ``it`` may be a host int or a
+        traced scalar (the fused super-step folds it inside the scan).
+        Keying the PRNG by the GLOBAL iteration — and running ONE
+        jitted program from both the sequential and the scan-inlined
+        call sites — makes the fused and sequential paths
+        bit-identical."""
+        import jax
+        if getattr(self, "_bag_draw_jit", None) is None:
+            self._ensure_label_pos()
+            self._bag_draw_jit = jax.jit(self._draw_bag_mask_impl)
+        return self._bag_draw_jit(it)
+
+    def _ensure_label_pos(self) -> None:
+        """Materialize the label-sign vector for stratified bagging
+        OUTSIDE any trace (a lazily-built device array created during
+        tracing would cache a tracer on self)."""
+        import jax.numpy as jnp
+        cfg = self.config
+        pos_neg = (cfg.pos_bagging_fraction < 1.0 or
+                   cfg.neg_bagging_fraction < 1.0)
+        if pos_neg and self._label_pos is None:
+            self._label_pos = jnp.asarray(np.asarray(
+                self.train_set.metadata.label)[:self.num_data] > 0)
+
+    def _draw_bag_mask_impl(self, it):
+        import jax
+        import jax.numpy as jnp
+        cfg = self.config
+        pos_neg = (cfg.pos_bagging_fraction < 1.0 or
+                   cfg.neg_bagging_fraction < 1.0)
+        key = jax.random.fold_in(self._bag_key, it)
+        u = jax.random.uniform(key, (self.num_data,))
+        if pos_neg:
+            # class-stratified bagging: positives/negatives sampled
+            # at their own fractions
+            return jnp.where(self._label_pos,
+                             u < cfg.pos_bagging_fraction,
+                             u < cfg.neg_bagging_fraction
+                             ).astype(jnp.float32)
+        return (u < cfg.bagging_fraction).astype(jnp.float32)
+
     def _bagging_mask(self, grad=None, hess=None):
         """Per-row sample weights for this iteration (0 = out of bag;
         non-0/1 weights rescale grad/hess, counts stay presence-based).
@@ -682,32 +739,32 @@ class GBDT:
         the gradient magnitudes.  Returns a DEVICE (N,) f32 vector —
         mask generation is jitted device work (a host mask means a 4N-
         byte upload per iteration through the tunnel)."""
-        import jax
-        import jax.numpy as jnp
         cfg = self.config
-        pos_neg = (cfg.pos_bagging_fraction < 1.0 or
-                   cfg.neg_bagging_fraction < 1.0)
-        if cfg.bagging_freq <= 0 or \
-                (cfg.bagging_fraction >= 1.0 and not pos_neg):
+        if not self._bagging_active():
             return None
         if self.iter % cfg.bagging_freq == 0:
-            key = jax.random.fold_in(self._bag_key, self.iter)
-            u = jax.random.uniform(key, (self.num_data,))
-            if pos_neg:
-                # class-stratified bagging: positives/negatives sampled
-                # at their own fractions
-                if self._label_pos is None:
-                    self._label_pos = jnp.asarray(np.asarray(
-                        self.train_set.metadata.label
-                    )[:self.num_data] > 0)
-                mask = jnp.where(self._label_pos,
-                                 u < cfg.pos_bagging_fraction,
-                                 u < cfg.neg_bagging_fraction
-                                 ).astype(jnp.float32)
-            else:
-                mask = (u < cfg.bagging_fraction).astype(jnp.float32)
-            self._cached_bag = mask
+            self._cached_bag = self._draw_bag_mask(self.iter)
         return getattr(self, "_cached_bag", None)
+
+    def _fused_mask_fn(self):
+        """The sampling mask as a scan-capturable pure function
+        ``(iter, prev_mask, grad, hess) -> mask`` for the fused
+        super-step, or None when no sampling applies.  Base class:
+        bernoulli/stratified bagging — redraw on ``bagging_freq``
+        boundaries, carry the previous mask otherwise (exactly
+        :meth:`_bagging_mask`'s cache semantics, with the cache as the
+        scan carry).  GOSS/MVS override (models/boosting.py): their
+        masks are pure functions of the iteration's gradients."""
+        import jax
+        if not self._bagging_active():
+            return None
+        self._ensure_label_pos()
+        freq = self.config.bagging_freq
+
+        def fn(it, prev, grad, hess):
+            return jax.lax.cond(it % freq == 0, self._draw_bag_mask,
+                                lambda _: prev, it)
+        return fn
 
     # ------------------------------------------------------------------
     def _pipeline_ok(self) -> bool:
@@ -722,6 +779,318 @@ class GBDT:
                 self.objective is not None and self.num_features > 0 and
                 type(self.objective).renew_tree_output
                 is Objective.renew_tree_output)
+
+    # ---- fused boosting super-steps ----------------------------------
+    # One jitted ``lax.scan`` runs K = config.fused_iters boosting
+    # iterations entirely on device — objective gradients, the
+    # bagging/GOSS/MVS mask draw (PRNG key folded by GLOBAL iteration
+    # inside the scan), ``build_tree`` and the score update — with the
+    # (score, bagging-mask) carry donated.  The stacked (K, ...) split
+    # records come back in ONE packed device->host transfer and are
+    # materialized into K Trees up front; train_one_iter then serves
+    # them one per call, so the external one-iteration-per-update
+    # contract (engine loop, callbacks, num_boost_round counting) is
+    # unchanged while Python dispatch and tunnel round-trips drop from
+    # O(iterations) to O(iterations / K).  Both GPU-GBDT systems we
+    # track keep the iteration resident on the accelerator the same
+    # way (arXiv:1806.11248; arXiv:1706.08359).  Bit-exact with the
+    # sequential (pipelined) path: same ops in the same order, the
+    # same PRNG folds, and the same host-RNG feature-fraction draws
+    # (pre-drawn per block in sequential order).
+
+    def _fused_ok(self) -> bool:
+        """Super-step eligibility.  Anything that needs the host tree,
+        per-iteration scores, or per-iteration host randomness beyond
+        the pre-drawn feature masks falls back to the per-iteration
+        path: custom objectives (grad is checked at the call site),
+        leaf-renewal objectives, multi-model-per-iteration objectives,
+        DART/RF (``_superstep_enabled``), distributed learners,
+        attached validation sets and training metrics (their eval
+        cadence — including early stopping — reads scores every
+        iteration)."""
+        cfg = self.config
+        return (self._superstep_enabled and cfg.fused_iters > 1 and
+                self.num_tree_per_iteration == 1 and
+                not self.valid_sets and not self._track_train_leaf and
+                self._dist is None and self.objective is not None and
+                self.num_features > 0 and
+                not cfg.is_provide_training_metric and
+                type(self.objective).renew_tree_output
+                is Objective.renew_tree_output and
+                self.objective.gradient_fn() is not None)
+
+    def _fused_bias_pending(self) -> bool:
+        """True when the NEXT iteration is the boost_from_average
+        iteration 0 — it mutates the score from host state and the
+        first tree absorbs the bias, so it runs unfused (the pipelined
+        path); fusion engages from iteration 1."""
+        return (self.iter == 0 and self.config.boost_from_average and
+                not self._models and self._pending is None and
+                self.train_set.metadata.init_score is None)
+
+    def _build_superstep_fn(self):
+        """Build the jitted K-iteration scan.  K is carried by the xs
+        shapes, so one jitted callable serves every block size (the
+        shorter tail block recompiles once).  Big device residents
+        (the binned matrix, masks, descriptors) ride as ARGUMENTS —
+        closure capture would embed them in the remote-compile
+        payload; the objective's label tensors stay closure-captured
+        because ``gradient_fn`` owns them."""
+        import jax
+        import jax.numpy as jnp
+        from ..ops.grow import build_tree_impl
+        from ..ops.lookup import take_small
+
+        p = self.grow_params
+        n, n_pad = self.num_data, self._n_pad
+        grad_fn = self.objective.gradient_fn()
+        mask_fn = self._fused_mask_fn()
+        self._fused_has_bagging = mask_fn is not None
+        bundle_maps = self._bundle_maps
+        quantize = bool(p.quantize)
+        li_dt = jnp.uint8 if self.config.num_leaves <= 255 else jnp.uint16
+        # keys the host never reads stay on device (leaf_idx is kept
+        # separately, narrow, for the exact rewind/rollback replay)
+        drop = ("leaf_idx", "leaf_values", "leaf_values_final",
+                "leaf_stats")
+
+        def superstep(score, bag0, lr, quant_key, xt, base_mask,
+                      num_bins, missing_type, is_cat, iters, fmasks,
+                      tree_ids):
+            def step(carry, xs):
+                sc, bag_prev = carry
+                it, fmask, tid = xs
+                grad, hess = grad_fn(sc)
+                grad = jnp.atleast_2d(grad)
+                hess = jnp.atleast_2d(hess)
+                bag = mask_fn(it, bag_prev, grad, hess) \
+                    if mask_fn is not None else None
+                gp = jnp.pad(grad[0].astype(jnp.float32), (0, n_pad - n))
+                hp = jnp.pad(hess[0].astype(jnp.float32), (0, n_pad - n))
+                mask = base_mask
+                if bag is not None:
+                    w = jnp.pad(jnp.asarray(bag, jnp.float32).reshape(-1),
+                                (0, n_pad - n))
+                    gp = gp * w
+                    hp = hp * w
+                    mask = mask * (w > 0)
+                kw = {}
+                if quantize:
+                    kw["quant_key"] = jax.random.fold_in(quant_key, tid)
+                if bundle_maps is not None:
+                    kw["bundle_maps"] = bundle_maps
+                rec = build_tree_impl(xt, gp, hp, mask, fmask, num_bins,
+                                      missing_type, is_cat, p, **kw)
+                vals = rec["leaf_values_final"] * lr
+                new_sc = sc.at[0].add(take_small(vals,
+                                                 rec["leaf_idx"][:n]))
+                host_rec = {k: v for k, v in rec.items()
+                            if k not in drop}
+                new_bag = bag if bag is not None else bag_prev
+                return (new_sc, new_bag), \
+                    (host_rec, rec["leaf_idx"][:n].astype(li_dt), vals)
+
+            (final_sc, final_bag), (recs, leaf_idx_k, vals_k) = \
+                jax.lax.scan(step, (score, bag0),
+                             (iters, fmasks, tree_ids))
+            # returning the donated input forces XLA to copy the
+            # block-start score out — the rewind/rollback anchor at no
+            # extra dispatch
+            return score, final_sc, final_bag, recs, leaf_idx_k, vals_k
+
+        # carry donation frees both N-sized buffers for in-place reuse
+        # on device; CPU XLA has no donation and would warn per call
+        donate = (0, 1) if jax.default_backend() not in ("cpu",) else ()
+        return jax.jit(superstep, donate_argnums=donate)
+
+    def _train_superstep(self) -> bool:
+        """Dispatch one fused super-step (the K' trees materialize
+        from a single stacked fetch) and serve its first tree."""
+        import jax
+        import jax.numpy as jnp
+        from ..utils import telemetry as _telemetry
+        from ..utils.profiling import timed
+
+        self._flush_pending()
+        if self._stop_flag:
+            return True
+        cfg = self.config
+        K = int(cfg.fused_iters)
+        remaining = cfg.num_iterations - self.iter
+        if 0 < remaining < K:
+            # auto-size the tail block down to the num_iterations
+            # boundary (shorter scan -> one extra XLA compile there,
+            # which triage_run treats as per-shape warmup)
+            K = remaining
+        i0 = self.iter
+        rng_state = self._rng_feature.get_state()
+        with timed("superstep/dispatch"):
+            # host feature-fraction draws consumed in sequential order
+            fmasks = jnp.stack([self._feature_fraction_mask()
+                                for _ in range(K)])
+            iters = jnp.arange(i0, i0 + K, dtype=jnp.int32)
+            tree_ids = jnp.arange(self._trees_dispatched,
+                                  self._trees_dispatched + K,
+                                  dtype=jnp.int32)
+            if self._superstep_jit is None:
+                self._superstep_jit = self._build_superstep_fn()
+            bag0 = getattr(self, "_cached_bag", None)
+            if bag0 is None:
+                # ALL-ONES sentinel: with no cached mask the sequential
+                # path trains UNBAGGED until the next bagging_freq
+                # boundary (continue-training starts mid-cycle), and a
+                # unit weight vector is bit-identical to "no mask"
+                # (x*1.0 == x); a zeros sentinel would silently zero
+                # every gradient until the first in-block draw
+                bag0 = jnp.ones(self.num_data, jnp.float32)
+            qk = self._quant_key if self._quant_key is not None \
+                else jax.random.PRNGKey(0)
+            _telemetry.counters.incr("superstep_dispatches")
+            (start_score, final_score, final_bag, recs, leaf_idx_k,
+             vals_k) = self._superstep_jit(
+                self._score, bag0, jnp.float32(self.shrinkage_rate), qk,
+                self._xt, self._base_mask, self._num_bins,
+                self._missing_type, self._is_cat, iters, fmasks,
+                tree_ids)
+        start_tid = self._trees_dispatched
+        self._trees_dispatched += K
+        with timed("superstep/fetch"):
+            # the block's ONE device->host transfer (packed f32)
+            _telemetry.counters.incr("superstep_fetches")
+            host = self._fetch_records(recs)
+        with timed("superstep/to_tree"):
+            n_leaves_k = host["n_leaves"]
+            trees, stop_idx = [], None
+            for t in range(K):
+                if int(n_leaves_k[t]) <= 1:
+                    # constant stop tree; its init bias is always 0
+                    # here (iteration 0 runs unfused) and its score
+                    # contribution inside the scan was gated to 0
+                    trees.append(Tree(2))
+                    stop_idx = t
+                    break
+                rec_t = {k: v[t] for k, v in host.items()}
+                tree = self._records_to_tree(rec_t)
+                tree.apply_shrinkage(self.shrinkage_rate)
+                trees.append(tree)
+        if "n_arm_passes" in host:
+            passes = host["n_arm_passes"][:len(trees)]
+            self.last_arm_passes = int(passes[-1])
+            hist_passes = int(np.sum(passes)) + len(trees)
+        else:
+            hist_passes = None
+        self._fused_block = {
+            "start_score": start_score, "start_iter": i0,
+            "start_tid": start_tid, "rng_state": rng_state,
+            "trees": trees, "stop_idx": stop_idx,
+            "leaf_idx": leaf_idx_k, "vals": vals_k, "served": 0,
+            # the shrinkage the block's trees were built with: a
+            # learning_rates schedule (reset_parameter callback)
+            # changing it mid-block invalidates the unserved trees
+            "lr": self.shrinkage_rate,
+        }
+        if stop_idx is None:
+            self._score = final_score
+            if self._fused_has_bagging:
+                self._cached_bag = final_bag
+        else:
+            # the scan has no early exit: iterations AFTER the stop
+            # tree still ran, and under bagging their fresh draws can
+            # even split — those phantom contributions (and the
+            # post-stop bagging mask) must not leak into the
+            # model-consistent state.  Replay the pre-stop prefix
+            # (the stop tree itself contributes 0).
+            self._score, _ = self._fused_replay_score(stop_idx)
+        # superstep telemetry marker (consumed by train_one_iter)
+        self._tele_superstep = {"k": K, "hist_passes": hist_passes}
+        return self._serve_fused()
+
+    def _serve_fused(self) -> bool:
+        """Append the next materialized tree of the in-flight block —
+        one boosting iteration from the caller's point of view."""
+        blk = self._fused_block
+        t = blk["served"]
+        blk["served"] = t + 1
+        self._models.append(blk["trees"][t])
+        self._tele_serving = True
+        if blk["stop_idx"] is not None and t == blk["stop_idx"]:
+            self._stop_flag = True
+            Log.warning("Stopped training because there are no more "
+                        "leaves that meet the split requirements")
+            return True
+        self.iter += 1
+        return False
+
+    def _fused_replay_score(self, pos: int):
+        """(score, prev_score) after replaying ``pos`` block
+        iterations from the stacked (leaf values, leaf assignment)
+        pairs the scan returned — the same take_small + f32 add the
+        scan performed, so the replayed score is bit-identical to the
+        in-scan partial state.  The ONE implementation behind the
+        stop path, the rewind/rollback restore and the mid-block
+        ``train_score`` reader (they must never drift apart)."""
+        import jax.numpy as jnp
+        from ..ops.lookup import take_small
+        blk = self._fused_block
+        score, prev = blk["start_score"], None
+        for t in range(pos):
+            prev = score
+            score = score.at[0].add(
+                take_small(blk["vals"][t],
+                           blk["leaf_idx"][t].astype(jnp.int32)))
+        return score, prev
+
+    def _fused_restore(self, pos: int) -> None:
+        """Restore the exact sequential state at block-start + ``pos``
+        iterations: partial score replay, host-RNG rewind with the
+        block's consumed draws re-drawn, and the bagging-mask cache
+        recomputed from its defining PRNG fold."""
+        blk = self._fused_block
+        self._score, self._prev_score = self._fused_replay_score(pos)
+        self.iter = blk["start_iter"] + pos
+        self._trees_dispatched = blk["start_tid"] + pos
+        self._rng_feature.set_state(blk["rng_state"])
+        for _ in range(pos):
+            self._feature_fraction_mask()
+        cfg = self.config
+        if self._fused_has_bagging and \
+                type(self)._bagging_mask is GBDT._bagging_mask:
+            it = self.iter
+            if it > 0:
+                last_draw = (it - 1) // cfg.bagging_freq * \
+                    cfg.bagging_freq
+                self._cached_bag = self._draw_bag_mask(last_draw)
+            else:
+                self.__dict__.pop("_cached_bag", None)
+
+    def _fused_rewind(self) -> None:
+        """Discard the block's unserved trees and land on the served
+        boundary — the escape hatch when eligibility drifts mid-block
+        (a validation set attached, a custom-gradient call)."""
+        blk = self._fused_block
+        if blk is None:
+            return
+        self._fused_restore(blk["served"])
+        self._fused_block = None
+
+    def _fused_rollback(self) -> None:
+        """Undo the last served iteration of the in-flight block."""
+        blk = self._fused_block
+        self._stop_flag = False
+        self._invalidate_predictor()
+        self._models.pop()
+        served = blk["served"]
+        stopped = blk["stop_idx"] is not None and \
+            served > blk["stop_idx"]
+        if stopped:
+            # the stop serve never advanced ``iter``: score rolls to
+            # after the last REAL iteration, the counter steps back
+            # (mirroring the sequential rollback-after-stop behavior)
+            self._fused_restore(served - 1)
+            self.iter -= 1
+        else:
+            self._fused_restore(served - 1)
+        self._fused_block = None
 
     def _dispatch_build(self, grad_k, hess_k, bag):
         """Pad + bag-weight one class's gradients, draw the feature
@@ -839,7 +1208,14 @@ class GBDT:
                 self._score = self._score.at[0].add(init)
                 Log.info("Start training from score %f", init)
         with timed("boosting/gradients"):
-            grad, hess = self.objective.get_gradients(self._score)
+            # the jitted wrapper, not the eager chain: one fused pass,
+            # and the same compiled math the fused super-step inlines
+            # (bit-parity between the two paths requires it).  An
+            # objective that opted out of the pure contract
+            # (gradient_fn -> None) keeps its eager get_gradients.
+            grad_fn = self.objective.gradient_fn() or \
+                self.objective.get_gradients
+            grad, hess = grad_fn(self._score)
         grad = jnp.atleast_2d(grad)
         hess = jnp.atleast_2d(hess)
         bag = self._bagging_mask(grad, hess)
@@ -873,7 +1249,12 @@ class GBDT:
         retrace counters, tier, histogram passes, collective bytes)."""
         rec = getattr(self, "_telemetry", None)
         if rec is None:
-            return self._train_one_iter_impl(grad, hess)
+            stop = self._train_one_iter_impl(grad, hess)
+            # clear the superstep markers: a recorder attached later
+            # must not mis-emit a stale block
+            self.__dict__.pop("_tele_superstep", None)
+            self.__dict__.pop("_tele_serving", None)
+            return stop
         import time as _time
         from ..utils import profiling
         it = self.iter
@@ -881,6 +1262,33 @@ class GBDT:
         t0 = _time.perf_counter()
         stop = self._train_one_iter_impl(grad, hess)
         dur_ms = (_time.perf_counter() - t0) * 1e3
+        ss = self.__dict__.pop("_tele_superstep", None)
+        if ss is not None:
+            # fused super-step: ONE record per K iterations carrying
+            # the block's amortized phase deltas and compile counters;
+            # the K-1 serve calls that follow emit nothing (their cost
+            # is microseconds of host list work)
+            self._tele_serving = False
+            cdelta, self._tele_counters_last = rec.counters_delta(
+                self._tele_counters_last)
+            fields = {
+                "iter": it,
+                "k": int(ss["k"]),
+                "duration_ms": round(dur_ms, 3),
+                "phases_ms": profiling.delta_ms(ph0),
+                "counters": cdelta,
+                "tier": self.tier_decision["tier"],
+                "trees_per_iter": self.num_tree_per_iteration,
+                "n_trees": len(self._models),
+                "stopped": bool(stop),
+            }
+            if ss.get("hist_passes") is not None:
+                fields["hist_passes"] = int(ss["hist_passes"])
+            rec.emit("superstep", **fields)
+            return stop
+        if self.__dict__.pop("_tele_serving", False):
+            # serving a tree from an already-recorded super-step block
+            return stop
         cdelta, self._tele_counters_last = rec.counters_delta(
             self._tele_counters_last)
         fields = {
@@ -927,13 +1335,36 @@ class GBDT:
                              hess: Optional[np.ndarray] = None) -> bool:
         import jax.numpy as jnp
 
+        fused = grad is None and self._fused_ok()
+        blk = self._fused_block
+        if blk is not None:
+            in_flight = blk["served"] < len(blk["trees"])
+            # a learning_rates schedule changed the shrinkage since
+            # dispatch: the unserved trees were built with the old
+            # rate — rewind and redispatch at the new one
+            lr_drift = blk.get("lr") != self.shrinkage_rate
+            if fused and in_flight and not lr_drift:
+                return self._serve_fused()
+            if in_flight:
+                # eligibility drifted mid-block (custom gradients, a
+                # freshly attached valid set, a shrinkage change):
+                # rewind to the served boundary, then fall through
+                self._fused_rewind()
+            elif not fused:
+                self._fused_block = None  # rollback window closed
+        if fused and not self._fused_bias_pending():
+            return self._train_superstep()
         if grad is None and self._pipeline_ok():
             return self._train_one_iter_pipelined()
         self._flush_pending()
         if self._stop_flag:
             return True
         self._prev_score = self._score  # snapshot for rollback (immutable)
-        self._prev_valid_scores = [vs.score.copy() for vs in self.valid_sets]
+        # valid scores are NOT snapshotted per iteration: rollback
+        # restores them by subtracting the popped trees' predictions
+        # (``GBDT::RollbackOneIter`` does the same via Shrinkage(-1) +
+        # AddScore) — a full f64 copy per valid set per iteration was
+        # dead weight on the hot loop whenever nobody rolls back
         init_scores = [0.0] * self.num_tree_per_iteration
         custom = grad is not None
         if not custom:
@@ -952,7 +1383,9 @@ class GBDT:
                         Log.info("Start training from score %f", init)
             from ..utils.profiling import timed
             with timed("boosting/gradients"):
-                grad, hess = self.objective.get_gradients(self._score)
+                grad_fn = self.objective.gradient_fn() or \
+                    self.objective.get_gradients
+                grad, hess = grad_fn(self._score)
             grad = jnp.atleast_2d(grad)
             hess = jnp.atleast_2d(hess)
         else:
@@ -1088,11 +1521,13 @@ class GBDT:
         import jax.numpy as jnp
 
         keys = [k for k in sorted(rec) if k != "leaf_idx"]
-        if self._rec_layout is None or \
-                [k for k, _, _ in self._rec_layout] != keys:
-            self._rec_layout = [
-                (k, tuple(rec[k].shape), np.dtype(rec[k].dtype))
-                for k in keys]
+        layout = [(k, tuple(rec[k].shape), np.dtype(rec[k].dtype))
+                  for k in keys]
+        if self._rec_layout != layout:
+            # keyed on SHAPES too: the fused super-step fetches stacked
+            # (K, ...) records through the same pack, and the tail
+            # block's K differs
+            self._rec_layout = layout
             self._rec_pack = jax.jit(lambda r: jnp.concatenate(
                 [r[k].astype(jnp.float32).reshape(-1) for k in keys]))
         flat = np.asarray(self._rec_pack({k: rec[k] for k in keys}))
@@ -1191,6 +1626,15 @@ class GBDT:
     # ------------------------------------------------------------------
     @property
     def train_score(self) -> np.ndarray:
+        blk = getattr(self, "_fused_block", None)
+        if blk is not None and blk["served"] < len(blk["trees"]):
+            # mid-block the device score is ahead of the model (it
+            # holds the end-of-block state); replay the served prefix
+            # non-destructively so readers see the model-consistent
+            # score — fusion eligibility already excludes every
+            # per-iteration reader (metrics, custom fobj)
+            score, _ = self._fused_replay_score(blk["served"])
+            return np.asarray(score)[:, :self.num_data]
         return np.asarray(self._score)[:, :self.num_data]
 
     def _eval_one_set(self, name: str, score_kn: np.ndarray,
@@ -1520,8 +1964,17 @@ class GBDT:
                        for j in range(k)]
 
     def rollback_one_iter(self) -> None:
-        """Undo the last iteration (``GBDT::RollbackOneIter``) using the
-        pre-iteration score snapshot taken in :meth:`train_one_iter`."""
+        """Undo the last iteration (``GBDT::RollbackOneIter``): train
+        score from the pre-iteration snapshot; valid scores by
+        SUBTRACTING the popped trees' predictions (the reference's
+        ``Shrinkage(-1)`` + ``AddScore``) — per-iteration valid-score
+        copies were dropped from the hot loop.  A subclass that still
+        snapshots (RF's multiplicative averaging) restores from
+        ``_prev_valid_scores`` instead."""
+        blk = getattr(self, "_fused_block", None)
+        if blk is not None and blk["served"] > 0:
+            self._fused_rollback()
+            return
         if self.iter <= 0 or self._prev_score is None:
             return
         # materialize any in-flight tree FIRST: its flush mutates score
@@ -1533,8 +1986,21 @@ class GBDT:
         # flattened-predictor cache must be version-bumped explicitly
         self._invalidate_predictor()
         self._score = self._prev_score
-        for vs, snap in zip(self.valid_sets, self._prev_valid_scores):
-            vs.score = snap
+        if self._prev_valid_scores:
+            for vs, snap in zip(self.valid_sets, self._prev_valid_scores):
+                vs.score = snap
+        elif self.valid_sets:
+            # subtract the iteration's trees: tree.predict includes any
+            # absorbed init bias, which the forward path added to the
+            # valid score separately (bias + raw contribution = the
+            # biased prediction), so one subtraction undoes both
+            k = max(self.num_tree_per_iteration, 1)
+            models = self.models  # flushed above; property is safe
+            for j in range(k):
+                tree = models[-1 - j]
+                tree_idx = (len(models) - 1 - j) % k
+                for vs in self.valid_sets:
+                    vs.score[tree_idx] -= tree.predict(vs.raw)
         self._prev_score = None
         for _ in range(self.num_tree_per_iteration):
             self.models.pop()

@@ -47,10 +47,14 @@ _EMPTY_F32 = None
 
 def _empty_f32():
     """Cached 0-length weight sentinel (a fresh jnp.zeros per call is
-    an extra eager dispatch on the hot path)."""
+    an extra eager dispatch on the hot path).  Created under
+    ``ensure_compile_time_eval``: the first call may now happen inside
+    a jit trace (``gradient_fn``), and caching a tracer in a global
+    would leak it into every later trace."""
     global _EMPTY_F32
     if _EMPTY_F32 is None:
-        _EMPTY_F32 = jnp.zeros((0,), jnp.float32)
+        with jax.ensure_compile_time_eval():
+            _EMPTY_F32 = jnp.zeros((0,), jnp.float32)
     return _EMPTY_F32
 
 
@@ -92,6 +96,34 @@ class Objective:
 
     def get_gradients(self, score: jax.Array) -> Tuple[jax.Array, jax.Array]:
         raise NotImplementedError
+
+    def gradient_fn(self):
+        """A pure JITTED ``score -> (grad, hess)`` device function,
+        capturable inside a larger jitted program (the fused training
+        super-step traces it inside a ``lax.scan`` body,
+        ``models/gbdt.py``).
+
+        The contract: the returned callable reads only ``score`` and
+        device arrays fixed at ``init`` time (labels, weights, query
+        layouts) — no host work, no Python state mutation beyond
+        first-call jit caching.  Every built-in objective's
+        ``get_gradients`` satisfies this (the label/weight tensors are
+        device residents and the math is jnp), so the base
+        implementation jits it; an objective whose gradients need
+        per-iteration host work must override this to return ``None``,
+        which excludes it from super-step fusion.
+
+        The jit wrapper is ALSO what the sequential training loop
+        calls: XLA's fused elementwise loops are not bit-identical to
+        the same chain dispatched eagerly (measured on the CPU
+        backend: a fused ``sqrt(x*x+c)`` differs in the last ulp), so
+        routing both paths through one compiled function is what makes
+        the fused super-step bit-exact against the per-iteration path
+        — and it is the faster form anyway (one pass over the score
+        array instead of one HBM round-trip per op)."""
+        if getattr(self, "_gradient_fn_jit", None) is None:
+            self._gradient_fn_jit = jax.jit(self.get_gradients)
+        return self._gradient_fn_jit
 
     def boost_from_score(self, class_id: int = 0) -> float:
         return 0.0

@@ -54,7 +54,7 @@ from .split import (NEG_INF, SplitParams, choose_window,
                     eval_forced_split, find_best_split,
                     find_best_split_c2f, leaf_output)
 
-__all__ = ["DistConfig", "GrowParams", "build_tree",
+__all__ = ["DistConfig", "GrowParams", "build_tree", "build_tree_impl",
            "collective_bytes_per_pass"]
 
 
@@ -259,12 +259,11 @@ def _merge_best(best, axis):
     return jax.tree.map(lambda a: a[i], stacked)
 
 
-@functools.partial(jax.jit, static_argnames=("params",))
-def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
-               sample_mask: jax.Array, feature_mask: jax.Array,
-               num_bins: jax.Array, missing_type: jax.Array,
-               is_cat: jax.Array, params: GrowParams, bundle_maps=None,
-               quant_key=None):
+def build_tree_impl(xt: jax.Array, grad: jax.Array, hess: jax.Array,
+                    sample_mask: jax.Array, feature_mask: jax.Array,
+                    num_bins: jax.Array, missing_type: jax.Array,
+                    is_cat: jax.Array, params: GrowParams,
+                    bundle_maps=None, quant_key=None):
     """Grow one tree.
 
     xt: (F, N) binned features (transposed layout — contiguous per-feature
@@ -1586,6 +1585,18 @@ def build_tree(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         "leaf_stats": state["leaf_stats"],
         "n_leaves": state["n_leaves"],
     }
+
+
+# The standalone jitted entry point.  ``build_tree_impl`` stays
+# exported UNJITTED so the fused training super-step
+# (models/gbdt.py:_train_superstep) can capture it inside a
+# ``lax.scan`` body — the whole K-iteration block then compiles as ONE
+# program instead of K dispatches of this one.  The implementation is
+# already scan-compatible by construction: static trip counts
+# (fori/while with traced state), no data-dependent Python, and a flat
+# record-of-splits output that lax.scan stacks into (K, ...) arrays.
+build_tree = functools.partial(jax.jit, static_argnames=("params",))(
+    build_tree_impl)
 
 
 @functools.partial(jax.jit, static_argnames=("num_leaves",))

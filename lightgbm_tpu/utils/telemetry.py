@@ -22,6 +22,12 @@ Record stream (all records carry ``schema``/``type``/``seq``/``wall_time``):
   ``jax.monitoring``, so a silent retrace storm becomes a visible
   number), histogram passes + pool hit rate, per-learner collective
   payload bytes, trees added.
+- ``superstep``  — one record per fused K-iteration block
+  (``fused_iters`` > 1, ``models/gbdt.py``): the block's first
+  iteration, K, and the AMORTIZED phase/counter deltas — per-iteration
+  wall time is ``duration_ms / k``, which is how ``triage_run.py``
+  normalizes it (a K-fold drop in per-iteration time is the fused
+  path working, not an anomaly).
 - ``eval``       — metric results as the training loop computed them.
 - ``predict``    — one per predict call: rows, trees, engine on/off,
   predict-engine compile-cache hit/miss/eviction deltas.
@@ -56,7 +62,8 @@ __all__ = [
 
 SCHEMA_VERSION = 1
 
-RECORD_TYPES = ("run_start", "iteration", "eval", "predict", "run_end")
+RECORD_TYPES = ("run_start", "iteration", "superstep", "eval", "predict",
+                "run_end")
 
 # per-type required fields on top of the common envelope; values are
 # (field, type-or-types) pairs the lint enforces
@@ -65,6 +72,12 @@ _COMMON_FIELDS = (("schema", int), ("type", str), ("seq", int),
 _TYPE_FIELDS: Dict[str, Tuple[Tuple[str, Any], ...]] = {
     "run_start": (("backend", str),),
     "iteration": (("iter", int), ("duration_ms", (int, float))),
+    # one record per fused K-iteration super-step (fused_iters > 1):
+    # ``iter`` is the block's first iteration, ``k`` the block size,
+    # ``duration_ms``/``phases_ms``/``counters`` cover the WHOLE block
+    # (per-iteration cost = value / k)
+    "superstep": (("iter", int), ("k", int),
+                  ("duration_ms", (int, float))),
     "eval": (("iter", int), ("results", list)),
     "predict": (("rows", int), ("n_trees", int), ("engine", bool)),
     "run_end": (("summary", dict),),
@@ -252,8 +265,10 @@ class RunRecorder:
             tier = rec.get("tier")
             if isinstance(tier, dict):
                 self._tier = tier.get("tier")
-        elif t == "iteration":
-            self._agg["iterations"] = self._agg.get("iterations", 0) + 1
+        elif t in ("iteration", "superstep"):
+            # a superstep record stands for k iterations
+            k = int(rec.get("k", 1)) if t == "superstep" else 1
+            self._agg["iterations"] = self._agg.get("iterations", 0) + k
             self._agg["train_ms"] = self._agg.get("train_ms", 0.0) + \
                 float(rec.get("duration_ms", 0.0))
             for name, ms in (rec.get("phases_ms") or {}).items():

@@ -19,7 +19,7 @@ def test_bench_cpu_smoke(tmp_path):
                 "BENCH_TELEMETRY": tele})
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
-        capture_output=True, text=True, timeout=600, env=env,
+        capture_output=True, text=True, timeout=900, env=env,
         cwd=REPO)
     assert out.returncode == 0, out.stderr[-2000:]
     lines = [l for l in out.stdout.strip().splitlines()
@@ -27,6 +27,11 @@ def test_bench_cpu_smoke(tmp_path):
     assert lines, out.stdout[-2000:]
     d = json.loads(lines[-1])
     assert d["metric"] == "higgs_shape_train_time_500iter"
+    # fused super-step contract row: present, with the compile pin
+    # (0 compiles in the measured window after the first block)
+    assert d.get("fused4_measured_xla_compiles") == 0, \
+        d.get("fused_error")
+    assert "fused4_mean_iter_s" in d
     assert d["unit"] == "s"
     assert d["value"] > 0
     assert "vs_baseline" in d
@@ -69,4 +74,28 @@ def test_bench_outage_emits_structured_artifact():
     assert d["metric"] == "higgs_shape_train_time_500iter"
     # the artifact carries the last good round's rows for the VERDICT
     assert d["last_good_source"] == "BENCH_r04.json"
+    assert d["last_good"]["value"] == 412.45
+
+
+def test_bench_inprocess_init_failure_emits_structured_artifact():
+    """The BENCH_r05 race: the subprocess probe succeeds but the
+    IN-PROCESS backend init still dies (the tunnel fell over between
+    the two) — that must yield the same rc-0 structured artifact with
+    the failure phase recorded, never a raw traceback."""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+                "BENCH_SIM_INPROC_FAIL": "1"})
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "Traceback" not in out.stdout
+    lines = [l for l in out.stdout.strip().splitlines()
+             if l.startswith("{")]
+    assert lines, out.stdout[-2000:]
+    d = json.loads(lines[-1])
+    assert d["tpu_unavailable"] is True
+    assert d["probe_phase"] == "in_process"
+    assert "in-process init failed" in d["probe_error"]
     assert d["last_good"]["value"] == 412.45

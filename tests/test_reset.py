@@ -85,3 +85,34 @@ def test_subset_shares_parent_bins(rng):
     sub.construct()
     # identical mappers: subset rows bin exactly as in the parent
     assert d._constructed.check_align(sub._constructed)
+
+
+def test_rollback_restores_valid_scores_by_subtraction(rng):
+    """Valid scores are no longer snapshotted per iteration (dead f64
+    copies on the hot loop); rollback subtracts the popped trees'
+    predictions instead — the reference's ``Shrinkage(-1)`` +
+    ``AddScore`` form.  The restore is float-accurate to the last-ulp
+    class (not bit-exact), and continued training must agree with a
+    run that never rolled back."""
+    X, y = _toy(rng)
+    p = {"objective": "binary", "metric": "auc", "num_leaves": 7,
+         "verbose": -1, "min_data_in_leaf": 5}
+    d = lgb.Dataset(X[:500], label=y[:500], params=p)
+    bst = lgb.Booster(params=p, train_set=d)
+    bst.add_valid(d.create_valid(X[500:], label=y[500:]), "v0")
+    for _ in range(4):
+        bst.update()
+    vs = bst._gbdt.valid_sets[0]
+    before = vs.score.copy()
+    bst.update()
+    bst.rollback_one_iter()
+    assert bst.num_trees() == 4
+    # residue class: the forward update added the f32 device leaf
+    # values, the rollback subtracts the f64 host leaf values — a
+    # ~1e-8 absolute residue per tree, same class as the reference's
+    # negate-and-re-add rollback (which is not bit-exact either)
+    np.testing.assert_allclose(vs.score, before, atol=1e-7)
+    # eval still works and training continues cleanly
+    bst.update()
+    assert bst.num_trees() == 5
+    assert bst.eval_valid()

@@ -1,0 +1,432 @@
+"""Fused boosting super-steps (``fused_iters``): parity, device-call
+budget, stop/rollback semantics, eligibility fallbacks.
+
+The contract under test: a booster trained with ``fused_iters=K``
+produces BIT-IDENTICAL trees and training scores (atol=0) to the
+per-iteration path for every built-in single-output objective and
+every sampling mode, while issuing 2 device dispatches (the jitted
+scan + the packed-record fetch) and 1 device->host transfer per K
+iterations instead of ~5 dispatches per iteration.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils import telemetry
+
+
+def _data(objective="binary", n=400, f=6, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    if objective in ("binary",):
+        y = (X[:, 0] + 0.5 * rng.randn(n) > 0).astype(np.float64)
+    elif objective == "poisson":
+        y = np.abs(X[:, 0] * 2 + 0.3 * rng.randn(n))
+    else:
+        y = X[:, 0] * 2 + 0.3 * rng.randn(n)
+    return X, y
+
+
+def _train(fused, objective="binary", extra=None, rounds=10, data=None):
+    X, y = data if data is not None else _data(objective)
+    p = {"objective": objective, "num_leaves": 7, "max_bin": 31,
+         "verbose": -1, "metric": "None", "num_iterations": rounds,
+         "fused_iters": fused}
+    if extra:
+        p.update(extra)
+    d = lgb.Dataset(X, label=y, params=p)
+    return lgb.train(p, d, num_boost_round=rounds, verbose_eval=False)
+
+
+def _assert_identical(a, b):
+    """Trees, training scores and predictions bit-identical."""
+    ga, gb = a._gbdt, b._gbdt
+    assert len(ga.models) == len(gb.models)
+    for ta, tb in zip(ga.models, gb.models):
+        assert ta.num_leaves == tb.num_leaves
+        np.testing.assert_array_equal(ta.leaf_value, tb.leaf_value)
+        np.testing.assert_array_equal(ta.split_feature, tb.split_feature)
+        np.testing.assert_array_equal(ta.threshold_bin, tb.threshold_bin)
+        np.testing.assert_array_equal(ta.decision_type, tb.decision_type)
+        np.testing.assert_array_equal(ta.leaf_count, tb.leaf_count)
+    np.testing.assert_array_equal(ga.train_score, gb.train_score)
+    X = _data()[0]
+    np.testing.assert_array_equal(a.predict(X), b.predict(X))
+
+
+# ---------------------------------------------------------------------
+# parity
+# ---------------------------------------------------------------------
+def test_parity_plain_binary():
+    a = _train(1)
+    b = _train(4)
+    _assert_identical(a, b)
+
+
+def test_parity_tail_autosize():
+    """10 iterations with K=7: one full block + an auto-sized 2-block
+    tail after the unfused bias iteration (1 + 7 + 2)."""
+    a = _train(1, "regression", rounds=10)
+    b = _train(7, "regression", rounds=10)
+    _assert_identical(a, b)
+    # the fused booster really fused (blocks were dispatched)
+    assert b._gbdt._fused_block is not None
+
+
+def test_parity_bagging_and_feature_fraction():
+    extra = {"bagging_fraction": 0.7, "bagging_freq": 2,
+             "feature_fraction": 0.6}
+    a = _train(1, "regression", extra)
+    b = _train(4, "regression", extra)
+    _assert_identical(a, b)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("objective", ["binary", "regression",
+                                       "poisson"])
+@pytest.mark.parametrize("extra", [
+    {},
+    {"bagging_fraction": 0.7, "bagging_freq": 2},
+    {"boosting": "goss"},
+    {"boosting": "mvs", "bagging_fraction": 0.6},
+], ids=["none", "bernoulli", "goss", "mvs"])
+@pytest.mark.parametrize("fused", [4, 7])
+def test_parity_matrix(objective, extra, fused):
+    """The acceptance matrix: objectives x sampling modes x
+    fused_iters in {4, 7} against a 10-iteration run (non-divisible:
+    both K values exercise the auto-sized tail block)."""
+    data = _data(objective)
+    a = _train(1, objective, extra, data=data)
+    b = _train(fused, objective, extra, data=data)
+    _assert_identical(a, b)
+
+
+def test_parity_efb_bundled():
+    """EFB bundles ride inside the scan (bundle_maps are static
+    closure state of the jitted super-step)."""
+    rng = np.random.RandomState(3)
+    n = 600
+    cats = [rng.randint(0, 12, n) for _ in range(4)]
+    X = np.zeros((n, 48), np.float32)
+    for c, v in enumerate(cats):
+        X[np.arange(n), c * 12 + v] = 1.0
+    y = (cats[0] + cats[1] % 3 > 6).astype(np.float64)
+
+    def train(fused):
+        p = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+             "verbose": -1, "metric": "None", "num_iterations": 9,
+             "enable_bundle": True, "fused_iters": fused}
+        d = lgb.Dataset(X, label=y, params=p)
+        return lgb.train(p, d, num_boost_round=9, verbose_eval=False)
+
+    a, b = train(1), train(4)
+    assert a._gbdt._bundles is not None     # EFB engaged
+    assert b._gbdt._fused_block is not None  # fusion engaged
+    np.testing.assert_array_equal(a._gbdt.train_score,
+                                  b._gbdt.train_score)
+    np.testing.assert_array_equal(a.predict(X), b.predict(X))
+    for ta, tb in zip(a._gbdt.models, b._gbdt.models):
+        np.testing.assert_array_equal(ta.leaf_value, tb.leaf_value)
+
+
+@pytest.mark.slow
+def test_parity_stratified_and_quantized():
+    for extra in ({"pos_bagging_fraction": 0.8,
+                   "neg_bagging_fraction": 0.5, "bagging_freq": 1},
+                  {"use_quantized_grad": True},
+                  {"boost_from_average": False}):
+        a = _train(1, "binary", extra)
+        b = _train(4, "binary", extra)
+        _assert_identical(a, b)
+
+
+# ---------------------------------------------------------------------
+# device-call budget + compile stability
+# ---------------------------------------------------------------------
+def test_dispatch_and_fetch_budget():
+    """fused_iters=8 issues <= 2 device dispatches (one jitted scan +
+    one packed-record pack) and exactly 1 device->host fetch per 8
+    iterations, and the scan compiles ONCE — the second same-K block
+    re-runs the cached program."""
+    X, y = _data("regression")
+    p = {"objective": "regression", "num_leaves": 7, "max_bin": 31,
+         "verbose": -1, "metric": "None", "num_iterations": 100,
+         "fused_iters": 8}
+    d = lgb.Dataset(X, label=y, params=p)
+    d.construct()
+    bst = lgb.Booster(params=p, train_set=d)
+    bst.update()                      # iteration 0: unfused (bias)
+    c0 = telemetry.counters_snapshot()
+    for _ in range(8):                # block 1: dispatch + 7 serves
+        bst.update()
+    c1 = telemetry.counters_snapshot()
+    for _ in range(8):                # block 2: same-K, cached scan
+        bst.update()
+    c2 = telemetry.counters_snapshot()
+
+    def delta(a, b, key):
+        return b.get(key, 0.0) - a.get(key, 0.0)
+
+    # block 1: one scan dispatch, one packed fetch
+    assert delta(c0, c1, "superstep_dispatches") == 1
+    assert delta(c0, c1, "superstep_fetches") == 1
+    # block 2: same budget, and ZERO fresh XLA compiles — the fused
+    # program is cached for repeated same-K blocks
+    assert delta(c1, c2, "superstep_dispatches") == 1
+    assert delta(c1, c2, "superstep_fetches") == 1
+    assert delta(c1, c2, "xla_compiles") == 0
+    assert len(bst._gbdt.models) == 17
+
+
+# ---------------------------------------------------------------------
+# stop / rollback / mid-block state
+# ---------------------------------------------------------------------
+def test_stop_parity():
+    """Unsplittable data stops both paths with identical scores and
+    predictions.  Tree counts may differ by the pipelined path's
+    documented stop-detection lag (it gains trailing constant trees);
+    the fused path stops exactly at the unsplittable iteration."""
+    X, _ = _data("regression")
+    y = np.ones(X.shape[0])
+    data = (X, y)
+    a = _train(1, "regression", rounds=8, data=data)
+    b = _train(4, "regression", rounds=8, data=data)
+    ga, gb = a._gbdt, b._gbdt
+    assert ga._stop_flag and gb._stop_flag
+    assert len(gb.models) <= len(ga.models)
+    np.testing.assert_array_equal(ga.train_score, gb.train_score)
+    np.testing.assert_array_equal(a.predict(X), b.predict(X))
+
+
+def _paired_boosters(rounds=20, fused=4):
+    X, y = _data("binary")
+    pa = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+          "verbose": -1, "metric": "None", "num_iterations": rounds}
+    da = lgb.Dataset(X, label=y, params=pa)
+    da.construct()
+    ba = lgb.Booster(params=pa, train_set=da)
+    pb = dict(pa, fused_iters=fused)
+    db = lgb.Dataset(X, label=y, params=pb)
+    db.construct()
+    bb = lgb.Booster(params=pb, train_set=db)
+    return ba, bb, X
+
+
+def test_rollback_mid_block():
+    """Rollback during a fused block restores the exact sequential
+    state (score replay from the block's stacked leaf tables + host
+    RNG rewind), and training continues bit-identically."""
+    ba, bb, X = _paired_boosters()
+    for _ in range(6):                 # fused: mid-block at serve 2/4
+        ba.update()
+        bb.update()
+    ba.rollback_one_iter()
+    bb.rollback_one_iter()
+    assert len(ba._gbdt.models) == len(bb._gbdt.models) == 5
+    assert ba._gbdt.iter == bb._gbdt.iter == 5
+    np.testing.assert_array_equal(ba._gbdt.train_score,
+                                  bb._gbdt.train_score)
+    for _ in range(4):
+        ba.update()
+        bb.update()
+    np.testing.assert_array_equal(ba._gbdt.train_score,
+                                  bb._gbdt.train_score)
+    np.testing.assert_array_equal(ba.predict(X), bb.predict(X))
+
+
+def test_train_score_mid_block_matches_model():
+    """Mid-block, ``train_score`` replays the served prefix — it must
+    agree with the sequential booster after the same number of
+    updates, not leak the end-of-block device state."""
+    ba, bb, _ = _paired_boosters()
+    for _ in range(3):                 # fused: 1 unfused + serve 2/4
+        ba.update()
+        bb.update()
+    blk = bb._gbdt._fused_block
+    assert blk is not None and blk["served"] < len(blk["trees"])
+    np.testing.assert_array_equal(ba._gbdt.train_score,
+                                  bb._gbdt.train_score)
+
+
+def test_valid_attach_mid_block_rewinds():
+    """Attaching a validation set mid-block drops fusion from the next
+    iteration on (eligibility drift) without corrupting state."""
+    ba, bb, X = _paired_boosters()
+    y = (X[:, 0] > 0).astype(np.float64)
+    for _ in range(3):
+        ba.update()
+        bb.update()
+    from lightgbm_tpu.io.dataset import Metadata
+    for g in (ba._gbdt, bb._gbdt):
+        meta = Metadata(X.shape[0])
+        meta.set_label(y)
+        g.add_valid("v0", X, meta)
+    for _ in range(4):
+        ba.update()
+        bb.update()
+    assert bb._gbdt._fused_block is None     # fusion disengaged
+    np.testing.assert_array_equal(ba._gbdt.train_score,
+                                  bb._gbdt.train_score)
+    for va, vb in zip(ba._gbdt.valid_sets, bb._gbdt.valid_sets):
+        np.testing.assert_array_equal(va.score, vb.score)
+
+
+def test_continue_training_mid_bagging_cycle():
+    """Continue-training starts with no cached bagging mask and the
+    global iteration off a bagging_freq boundary: the sequential path
+    trains UNBAGGED until the next boundary, and the fused block must
+    reproduce that (an all-zeros mask sentinel would silently zero
+    every gradient)."""
+    X, y = _data("binary")
+    base = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+            "verbose": -1, "metric": "None", "bagging_freq": 5,
+            "bagging_fraction": 0.6, "num_iterations": 7}
+    d0 = lgb.Dataset(X, label=y, params=base)
+    prev = lgb.train(base, d0, num_boost_round=7, verbose_eval=False)
+
+    def cont(fused):
+        p = dict(base, num_iterations=13, fused_iters=fused)
+        d = lgb.Dataset(X, label=y, params=p)
+        return lgb.train(p, d, verbose_eval=False, init_model=prev)
+
+    a, b = cont(1), cont(4)
+    assert len(a._gbdt.models) == len(b._gbdt.models) == 20
+    np.testing.assert_array_equal(a._gbdt.train_score,
+                                  b._gbdt.train_score)
+    np.testing.assert_array_equal(a.predict(X), b.predict(X))
+
+
+def test_learning_rates_schedule_rewinds_block():
+    """A per-iteration learning_rates schedule changes the shrinkage
+    between serves: the block's unserved trees (built at the old rate)
+    must be rewound and redispatched, not served stale."""
+    X, y = _data("binary")
+    lrs = [0.3 * 0.7 ** i for i in range(8)]
+
+    def sched(fused):
+        p = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+             "verbose": -1, "metric": "None", "num_iterations": 8,
+             "fused_iters": fused}
+        d = lgb.Dataset(X, label=y, params=p)
+        return lgb.train(p, d, verbose_eval=False, learning_rates=lrs)
+
+    a, b = sched(1), sched(4)
+    _assert_identical(a, b)
+
+
+def test_stop_with_bagging_keeps_score_model_consistent():
+    """The scan has no early exit: iterations after a mid-block stop
+    tree still run (and under bagging draw fresh masks); their phantom
+    contributions must not leak into the training score."""
+    X, _ = _data("regression")
+    y = np.ones(X.shape[0])
+    extra = {"bagging_freq": 1, "bagging_fraction": 0.5}
+    a = _train(1, "regression", extra, rounds=8, data=(X, y))
+    b = _train(4, "regression", extra, rounds=8, data=(X, y))
+    assert a._gbdt._stop_flag and b._gbdt._stop_flag
+    np.testing.assert_array_equal(a._gbdt.train_score,
+                                  b._gbdt.train_score)
+    np.testing.assert_array_equal(a.predict(X), b.predict(X))
+
+
+# ---------------------------------------------------------------------
+# eligibility fallbacks
+# ---------------------------------------------------------------------
+def test_fallback_modes_never_fuse():
+    """DART/RF, multiclass, valid sets and custom gradients all run
+    the per-iteration path untouched even with fused_iters set."""
+    X, y = _data("binary")
+    # DART
+    b = _train(4, "binary", {"boosting": "dart", "skip_drop": 0.0},
+               rounds=5)
+    assert b._gbdt._fused_block is None
+    # custom fobj: grad is passed in -> per-iteration path
+    p = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+         "metric": "None", "fused_iters": 4}
+    d = lgb.Dataset(X, label=y, params=p)
+
+    def fobj(score, ds):
+        lbl = ds.get_label()
+        prob = 1.0 / (1.0 + np.exp(-score))
+        return prob - lbl, prob * (1 - prob)
+
+    bst = lgb.train(dict(p, objective="none"), d, num_boost_round=5,
+                    fobj=fobj, verbose_eval=False)
+    assert bst._gbdt._fused_block is None
+    assert len(bst._gbdt.models) == 5
+
+
+def test_gradient_fn_opt_out_falls_back():
+    """An objective that opts out of the pure-gradient contract
+    (gradient_fn -> None) must both disable fusion AND keep the
+    sequential path training through its eager get_gradients."""
+    X, y = _data("regression")
+    p = {"objective": "regression", "num_leaves": 7, "max_bin": 31,
+         "verbose": -1, "metric": "None", "num_iterations": 20,
+         "fused_iters": 4}
+    d = lgb.Dataset(X, label=y, params=p)
+    d.construct()
+    bst = lgb.Booster(params=p, train_set=d)
+    bst._gbdt.objective.gradient_fn = lambda: None
+    for _ in range(4):
+        bst.update()
+    assert bst._gbdt._fused_block is None
+    assert len(bst._gbdt.models) == 4
+
+
+def test_l1_renewal_objective_falls_back():
+    """l1's per-leaf percentile renewal needs the host tree each
+    iteration — it must train per-iteration (and still be correct)."""
+    b = _train(4, "regression_l1", rounds=5)
+    a = _train(1, "regression_l1", rounds=5)
+    assert b._gbdt._fused_block is None
+    _assert_identical(a, b)
+
+
+# ---------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------
+def test_superstep_telemetry_records(tmp_path):
+    """One ``superstep`` record per K-iteration block (k-annotated,
+    schema-valid), zero per-iteration records inside fused blocks, a
+    flat compile counter across repeated same-K blocks, and
+    ``triage_run.py --check`` accepting the stream."""
+    import json
+    path = str(tmp_path / "fused.jsonl")
+    X, y = _data("binary")
+    p = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+         "verbose": -1, "metric": "None", "num_iterations": 13,
+         "fused_iters": 4, "telemetry_file": path}
+    d = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.train(p, d, num_boost_round=13, verbose_eval=False)
+    bst._gbdt._telemetry.close()
+    recs = [json.loads(l) for l in open(path) if l.strip()]
+    ss = [r for r in recs if r["type"] == "superstep"]
+    iters = [r for r in recs if r["type"] == "iteration"]
+    # 13 rounds = 1 unfused bias iteration + 4+4+4 fused
+    assert [r["k"] for r in ss] == [4, 4, 4]
+    assert [r["iter"] for r in ss] == [1, 5, 9]
+    assert len(iters) == 1 and iters[0]["iter"] == 0
+    # compile counter flat on the repeated same-K blocks
+    for r in ss[1:]:
+        assert not (r.get("counters") or {}).get("xla_compiles"), r
+    # the aggregate counts each superstep as k iterations
+    end = [r for r in recs if r["type"] == "run_end"][-1]
+    assert end["summary"]["iterations"] == 13
+    # schema lint + triage accept the stream (and its anomaly scan
+    # does NOT flag the K-fold per-iteration time drop)
+    n, errs = telemetry.lint_file(path)
+    assert errs == [] and n == len(recs)
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    triage = os.path.join(repo, "tools", "triage_run.py")
+    r = subprocess.run([sys.executable, triage, path, "--check",
+                        "--quiet"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run([sys.executable, triage, path],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "anomalies   : none" in r.stdout, r.stdout
+    assert "supersteps  : 3 fused blocks" in r.stdout, r.stdout

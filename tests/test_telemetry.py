@@ -292,6 +292,32 @@ def test_validate_record_rejects_malformed():
     assert validate_record(bad) != []
 
 
+def test_validate_superstep_record():
+    """The fused super-step record type: k is REQUIRED (a consumer
+    must be able to amortize duration_ms to per-iteration figures)."""
+    good = {"schema": SCHEMA_VERSION, "type": "superstep", "seq": 0,
+            "wall_time": 1.0, "iter": 1, "k": 8, "duration_ms": 80.0}
+    assert validate_record(good) == []
+    bad = dict(good)
+    del bad["k"]
+    assert validate_record(bad) != []
+    assert validate_record(dict(good, k=True)) != []
+
+
+def test_superstep_aggregates_as_k_iterations():
+    """A superstep record counts as k iterations in the run summary —
+    the aggregate the shutdown Log line and render tools read."""
+    from lightgbm_tpu.utils.telemetry import RunRecorder
+    rec = RunRecorder(None)
+    rec.emit("iteration", iter=0, duration_ms=10.0)
+    rec.emit("superstep", iter=1, k=8, duration_ms=80.0,
+             phases_ms={"superstep/dispatch": 75.0})
+    s = rec.summary()
+    assert s["iterations"] == 9
+    assert s["train_ms"] == 90.0
+    rec.close(log=False)
+
+
 def test_lint_file_flags_corruption(tmp_path):
     p = tmp_path / "corrupt.jsonl"
     p.write_text('{"schema": 1, "type": "run_start", "seq": 0, '

@@ -36,20 +36,38 @@ def _median(vals):
     return vals[len(vals) // 2] if vals else 0.0
 
 
+def _block_k(r):
+    """Iterations a record stands for: 1, or k for a fused superstep."""
+    return max(int(r.get("k", 1)), 1) if r.get("type") == "superstep" \
+        else 1
+
+
 def phase_medians(records):
-    """{phase: median ms/iter} over the run's iteration records."""
+    """{phase: median ms/iter} over the run's iteration records.
+    A fused ``superstep`` record carries a whole K-iteration block:
+    its phase deltas are normalized by k and weighted k-fold, so the
+    median stays a per-iteration figure."""
     acc = {}
     for r in records:
-        if r.get("type") != "iteration":
+        if r.get("type") not in ("iteration", "superstep"):
             continue
+        k = _block_k(r)
         for name, ms in (r.get("phases_ms") or {}).items():
-            acc.setdefault(name, []).append(float(ms))
+            acc.setdefault(name, []).extend([float(ms) / k] * k)
     return {name: _median(vals) for name, vals in acc.items()}
 
 
 def iter_durations(records):
-    return [float(r.get("duration_ms", 0.0)) for r in records
-            if r.get("type") == "iteration"]
+    """Per-iteration wall times; a superstep record expands to k
+    entries of duration/k — the K-fold drop in per-iteration time the
+    fused path delivers must read as throughput, not as an anomaly."""
+    out = []
+    for r in records:
+        if r.get("type") not in ("iteration", "superstep"):
+            continue
+        k = _block_k(r)
+        out.extend([float(r.get("duration_ms", 0.0)) / k] * k)
+    return out
 
 
 def scan_anomalies(records):
@@ -59,6 +77,29 @@ def scan_anomalies(records):
     post_warmup = [r for r in iters if r.get("iter", 0) >= WARMUP_ITERS]
     compiles_late = sum((r.get("counters") or {}).get("xla_compiles", 0)
                        for r in post_warmup)
+    # fused super-steps: the scan program compiles once per distinct
+    # block size k (the auto-sized tail block is a shorter scan), so
+    # compiles on the FIRST superstep of each k are per-shape warmup;
+    # compiles on a REPEATED k are a real retrace storm
+    seen_k = set()
+    ss_late, ss_secs = 0.0, 0.0
+    for r in records:
+        if r.get("type") != "superstep":
+            continue
+        k = int(r.get("k", 1))
+        first_of_k = k not in seen_k
+        seen_k.add(k)
+        c = (r.get("counters") or {}).get("xla_compiles", 0)
+        if c and not first_of_k:
+            ss_late += c
+            ss_secs += (r.get("counters") or {}).get(
+                "xla_compile_secs", 0.0)
+    if ss_late:
+        out.append(("HIGH", f"superstep retrace storm: {ss_late:.0f} "
+                            f"XLA compiles ({ss_secs:.1f}s) on "
+                            f"repeated same-k super-steps — the fused "
+                            f"scan should compile once per block "
+                            f"size"))
     if compiles_late:
         secs = sum((r.get("counters") or {}).get("xla_compile_secs", 0.0)
                    for r in post_warmup)
@@ -66,14 +107,35 @@ def scan_anomalies(records):
                             f"compiles ({secs:.1f}s) AFTER iteration "
                             f"{WARMUP_ITERS} — steady state should "
                             f"re-run cached programs"))
-    durs = iter_durations(records)
-    if len(durs) > 2 * WARMUP_ITERS:
-        steady = durs[WARMUP_ITERS:]
+    # steady-state per-iteration durations: unfused warmup iterations
+    # AND the first superstep of each block size are compile-bearing
+    # by design — only repeats count toward the spike check.  The two
+    # populations are judged SEPARATELY: a mixed run (fused blocks
+    # plus a few legitimate unfused iterations after an eligibility
+    # drift) would otherwise read the unfused iterations as spikes
+    # against the K-fold-lower fused median.
+    steady_k = set()
+    steady_unfused, steady_fused = [], []
+    for r in records:
+        t = r.get("type")
+        if t == "iteration":
+            if r.get("iter", 0) >= WARMUP_ITERS:
+                steady_unfused.append(float(r.get("duration_ms", 0.0)))
+        elif t == "superstep":
+            k = _block_k(r)
+            if k in steady_k:
+                steady_fused.extend(
+                    [float(r.get("duration_ms", 0.0)) / k] * k)
+            steady_k.add(k)
+    for label, steady in (("iteration", steady_unfused),
+                          ("fused per-iteration", steady_fused)):
+        if len(steady) <= WARMUP_ITERS:
+            continue
         med = _median(steady)
         worst = max(steady)
         if med > 0 and worst > 3 * med:
-            out.append(("MED", f"iteration-time spike: worst steady "
-                               f"iteration {worst:.0f} ms vs median "
+            out.append(("MED", f"{label} time spike: worst steady "
+                               f"{worst:.0f} ms vs median "
                                f"{med:.0f} ms"))
     preds = [r for r in records if r.get("type") == "predict"]
     if preds:
@@ -115,6 +177,13 @@ def triage(records, baseline=None):
     if durs:
         lines.append(f"iterations  : {len(durs)}  median "
                      f"{_median(durs):.1f} ms/iter")
+    supersteps = [r for r in records if r.get("type") == "superstep"]
+    if supersteps:
+        ks = sorted({int(r.get("k", 1)) for r in supersteps})
+        fused_iters = sum(_block_k(r) for r in supersteps)
+        lines.append(f"supersteps  : {len(supersteps)} fused blocks "
+                     f"(k={'/'.join(str(k) for k in ks)}), covering "
+                     f"{fused_iters} iterations")
     meds = phase_medians(records)
     total = sum(meds.values()) or 1.0
     for name, ms in sorted(meds.items(), key=lambda kv: -kv[1])[:8]:
