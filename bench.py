@@ -175,6 +175,97 @@ def bench_predict(booster, X, reps=3):
     return res
 
 
+def bench_serve(booster, n_features, swap_booster=None,
+                n_requests=400, threads=8, rows_max=900,
+                max_batch_rows=1024, batch_wait_ms=1.0, seed=0):
+    """Online-serving microbench: in-process Server, concurrent
+    clients issuing mixed row-count requests through the
+    micro-batching scheduler (one mid-run hot-swap when
+    ``swap_booster`` is given).  Reports latency percentiles,
+    throughput, batch occupancy and the steady-state compile count —
+    the serving analog of ``bench_predict``."""
+    import threading as _threading
+
+    import numpy as np
+    from lightgbm_tpu.serve import ServeConfig, Server
+    from lightgbm_tpu.utils.telemetry import counters_snapshot
+
+    cfg = ServeConfig(max_batch_rows=max_batch_rows,
+                      batch_wait_ms=batch_wait_ms, timeout_ms=60000,
+                      queue_rows=max(rows_max * threads * 4, 16384))
+    srv = Server(booster, config=cfg).start()
+    lat, lock = [], _threading.Lock()
+    errors, rows_done = [], [0]
+    issued = [0]
+    swap_at = n_requests // 2 if swap_booster is not None else -1
+
+    def client(tid):
+        r = np.random.RandomState(seed + tid)
+        while True:
+            with lock:
+                if issued[0] >= n_requests:
+                    return
+                issued[0] += 1
+                i = issued[0]
+            if i == swap_at:
+                srv.swap(booster=swap_booster)
+                continue
+            n = int(r.randint(1, rows_max + 1))
+            X = r.randn(n, n_features)
+            t0 = time.time()
+            try:
+                srv.predict(X)
+            except Exception as exc:   # noqa: BLE001 - recorded
+                errors.append(str(exc)[:120])
+                continue
+            with lock:
+                lat.append((time.time() - t0) * 1e3)
+                rows_done[0] += n
+
+    try:
+        srv.predict(np.zeros((1, n_features)))   # settle first touch
+        base = counters_snapshot()
+        t_start = time.time()
+        clients = [_threading.Thread(target=client, args=(i,))
+                   for i in range(threads)]
+        for t in clients:
+            t.start()
+        for t in clients:
+            t.join()
+        wall = time.time() - t_start
+        now = counters_snapshot()
+    finally:
+        srv.stop()
+    lat.sort()
+    from lightgbm_tpu.utils.telemetry import percentile
+
+    def pct(q):
+        return round(percentile(lat, q), 2) if lat else None
+
+    batches = now.get("serve_batches", 0) - base.get("serve_batches", 0)
+    breal = now.get("serve_batch_rows", 0) - \
+        base.get("serve_batch_rows", 0)
+    bpad = now.get("serve_padded_rows", 0) - \
+        base.get("serve_padded_rows", 0)
+    return {
+        "requests": len(lat),
+        "threads": threads,
+        "rows_total": rows_done[0],
+        "wall_s": round(wall, 3),
+        "rows_per_s": round(rows_done[0] / max(wall, 1e-9)),
+        "req_per_s": round(len(lat) / max(wall, 1e-9), 1),
+        "p50_ms": pct(0.50), "p95_ms": pct(0.95), "p99_ms": pct(0.99),
+        "batches": int(batches),
+        "mean_batch_rows": round(breal / max(batches, 1), 1),
+        "mean_occupancy": round(breal / max(bpad, 1), 4),
+        "hot_swaps": 1 if swap_booster is not None else 0,
+        "failed_requests": len(errors),
+        "steady_xla_compiles": int(now.get("xla_compiles", 0) -
+                                   base.get("xla_compiles", 0)),
+        "errors": errors[:5],
+    }
+
+
 def run_variant(lgb, params, train, n_meas, auc_fn, profiling=None,
                 diagnose_fetch=False, keep=None):
     """Train WARMUP + n_meas iterations; return timing + AUC stats.
@@ -278,6 +369,69 @@ def run_variant(lgb, params, train, n_meas, auc_fn, profiling=None,
         finally:
             g._pipeline_enabled = prev_pipe
     return out
+
+
+def serve_only():
+    """Fast path (``python bench.py --serve-only``): train a small
+    booster pair on the CPU backend and record the online-serving
+    latency/throughput matrix as BENCH_serve_cpu.json — the artifact
+    ``tools/render_benchmarks.py`` renders into docs/Benchmarks.md.
+    Runs anywhere (CI serve-bench smoke); the absolute numbers are
+    only meaningful per-backend, like the other *_cpu artifacts."""
+    import datetime
+
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils import telemetry as _telemetry
+    _telemetry.install_jax_hooks()
+
+    n_features = 28
+    rng = np.random.RandomState(0)
+    X = rng.randn(20000, n_features).astype(np.float32)
+    w = rng.randn(n_features).astype(np.float32)
+    y = (1.0 / (1.0 + np.exp(-(X @ w) * 0.5)) >
+         rng.random_sample(20000)).astype(np.float32)
+
+    def train(rounds, seed):
+        d = lgb.Dataset(X, label=y, params={"objective": "binary",
+                                            "verbose": -1})
+        return lgb.train({"objective": "binary", "num_leaves": 31,
+                          "verbose": -1, "metric": "None",
+                          "seed": seed}, d, num_boost_round=rounds)
+
+    b1, b2 = train(20, 1), train(20, 2)
+    forest = (f"{b1.num_trees()}-tree 31-leaf binary forest over "
+              f"{n_features} features, float64 engine scoring")
+    n_req = int(os.environ.get("BENCH_SERVE_REQUESTS", "400"))
+    cells = []
+    for label, threads, wait_ms, swap in (
+            ("sequential", 1, 0.0, None),
+            ("concurrent x8", 8, 1.0, None),
+            ("concurrent x8 + hot-swap", 8, 1.0, b2)):
+        res = bench_serve(b1, n_features, swap_booster=swap,
+                          n_requests=n_req, threads=threads,
+                          batch_wait_ms=wait_ms)
+        res["label"] = label
+        cells.append(res)
+        print(json.dumps({"serve_cell": label, **res}), flush=True)
+    out = {
+        "metric": "serve_latency_throughput_cpu",
+        "unit": "ms",
+        "backend": "cpu",
+        "date": datetime.date.today().isoformat(),
+        "source": "JAX_PLATFORMS=cpu python bench.py --serve-only",
+        "env": "2-core CPU container",
+        "forest": forest,
+        "config": {"max_batch_rows": 1024, "rows_max": 900,
+                   "requests": n_req, "timeout_ms": 60000},
+        "cells": cells,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_serve_cpu.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    print(json.dumps({"wrote": os.path.basename(path)}), flush=True)
+    return 0
 
 
 def main():
@@ -433,6 +587,25 @@ def main():
     except Exception as exc:      # the training result must survive
         out["predict_bench_error"] = str(exc)[:200]
     print(json.dumps(out), flush=True)
+
+    # ---- online serving: micro-batching scheduler over the engine ---
+    # (p50/p99 request latency, rows/s, batch occupancy, plus one
+    # mid-run hot-swap republishing the primary booster; the compile
+    # counter pins the zero-steady-state-compile serving contract.
+    # The standalone matrix is `bench.py --serve-only` ->
+    # BENCH_serve_cpu.json)
+    if os.environ.get("BENCH_SERVE", "1") != "0":
+        try:
+            res = bench_serve(
+                kept["booster"], N_FEATURES,
+                swap_booster=kept["booster"],
+                n_requests=100 if cpu_smoke else 400,
+                rows_max=300 if cpu_smoke else 900)
+            out.update({f"serve_{k}": v for k, v in res.items()
+                        if k != "errors"})
+        except Exception as exc:  # the training result must survive
+            out["serve_bench_error"] = str(exc)[:200]
+        print(json.dumps(out), flush=True)
 
     # ---- fused super-steps: K iterations per device dispatch --------
     # (runs on the CPU smoke too — the fused-vs-unfused pair is the
@@ -836,4 +1009,6 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--serve-only" in sys.argv:
+        sys.exit(serve_only())
     sys.exit(main())

@@ -4,7 +4,9 @@ Capability parity with the reference CLI (``src/application/
 application.cpp:30``, ``src/main.cpp``): ``key=value`` args merged over
 an optional config file, dispatch on ``task`` = train / predict /
 convert_model / refit, reading the reference's ``.conf`` format
-verbatim (the ``examples/*/train.conf`` files run unmodified).
+verbatim (the ``examples/*/train.conf`` files run unmodified); plus
+``task=serve`` — the online micro-batching endpoint the reference has
+no analog of (``lightgbm_tpu/serve/``).
 """
 from __future__ import annotations
 
@@ -197,6 +199,24 @@ def _task_convert_model(params: Dict[str, str], config: Config) -> None:
              config.convert_model)
 
 
+def _task_serve(params: Dict[str, str], config: Config) -> None:
+    """Online serving: load the model, publish it to the registry
+    (flatten + pre-warm), serve the threaded JSON endpoint until
+    interrupted (``serve/http.py``)."""
+    from .basic import Booster
+    from .serve import Server, ServeConfig
+    from .serve.http import serve_http
+
+    if not config.input_model:
+        Log.fatal("No model file: set input_model=<file>")
+    booster = Booster(model_file=config.input_model)
+    server = Server(booster, config=ServeConfig.from_params(config))
+    try:
+        serve_http(server)
+    finally:
+        server.stop()
+
+
 def _task_refit(params: Dict[str, str], config: Config) -> None:
     from .basic import Booster
     from .io.parser import parse_file
@@ -224,7 +244,7 @@ def main(argv: List[str] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
-        print("tasks: train | predict | convert_model | refit")
+        print("tasks: train | predict | convert_model | refit | serve")
         return 0
     params = _parse_args(argv)
     config = Config(params)
@@ -237,6 +257,8 @@ def main(argv: List[str] = None) -> int:
         _task_convert_model(params, config)
     elif task in ("refit", "refit_tree"):
         _task_refit(params, config)
+    elif task == "serve":
+        _task_serve(params, config)
     else:
         Log.fatal("unknown task %r", task)
     return 0

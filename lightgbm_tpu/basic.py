@@ -616,6 +616,15 @@ class Booster:
             return obj.convert_output(raw) if obj is not None else raw
         return self._gbdt.predict(mat, ni, **eng)
 
+    def predict_cache_info(self) -> Dict[str, int]:
+        """Inference-engine compile-cache counters (hits / misses /
+        evictions / entries / capacity / traces).  The engine is
+        process-wide — boosters with identical layouts share compiled
+        predictors — so these are process counters, not per-booster;
+        the serve layer and tests use them to pin cache behavior."""
+        from .ops.predict import get_engine
+        return get_engine().cache_info()
+
     # ------------------------------------------------------------------
     def _objective_string(self) -> str:
         obj = self.config.objective

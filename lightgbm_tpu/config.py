@@ -42,7 +42,7 @@ PARAMS: List[Param] = [
     # ---- core ----
     _p("config", "", str, ("config_file",), "path to config file"),
     _p("task", "train", str, ("task_type",),
-       "train, predict, convert_model, refit"),
+       "train, predict, convert_model, refit, serve"),
     _p("objective", "regression", str,
        ("objective_type", "app", "application", "loss"),
        "regression, regression_l1, huber, fair, poisson, quantile, mape, "
@@ -237,6 +237,15 @@ PARAMS: List[Param] = [
        "row-chunk size of the batched inference engine; chunks are "
        "padded to power-of-two buckets that key the compile cache",
        group="io", check=">0"),
+    _p("predict_cache_slots", 16, int, ("predict_cache_size",),
+       "capacity of the inference engine's compiled-kernel LRU "
+       "(ops/predict.py).  One slot holds the jitted predictors for "
+       "one (row bucket, tree layout) shape; serving a wider shape "
+       "mix than this thrashes the cache (visible as "
+       "predict_cache_evictions in telemetry and triage_run.py).  "
+       "The engine is process-wide, so the last booster to predict "
+       "wins; inspect with Booster.predict_cache_info()",
+       group="io", check=">0"),
     _p("telemetry_file", "", str, ("telemetry", "telemetry_filename"),
        "append schema-versioned JSONL run records to this path: "
        "per-iteration phase timings, XLA compile/retrace counters, "
@@ -395,6 +404,47 @@ PARAMS: List[Param] = [
        "iteration and negates the fusion win; prefer a constant "
        "learning_rate with fused_iters",
        group="device", check=">=1"),
+    # ---- serve (online serving subsystem, lightgbm_tpu/serve/) ----
+    _p("serve_host", "127.0.0.1", str, (),
+       "bind address of the task=serve HTTP endpoint", group="serve"),
+    _p("serve_port", 9595, int, (),
+       "port of the task=serve HTTP endpoint (0 = ephemeral)",
+       group="serve", check=">=0"),
+    _p("serve_max_batch_rows", 1024, int, ("serve_batch_rows",),
+       "micro-batcher row cap: concurrent requests coalesce into one "
+       "device batch of at most this many rows, and it doubles as the "
+       "engine row-chunk for serving — the servable bucket set is the "
+       "power-of-two ladder {512, ..., serve_max_batch_rows}, all "
+       "pre-warmed at publish so steady-state serving never compiles",
+       group="serve", check=">0"),
+    _p("serve_batch_wait_ms", 2.0, float, ("serve_max_wait_ms",),
+       "micro-batcher max wait: a batch closes when it reaches "
+       "serve_max_batch_rows or when the OLDEST admitted request has "
+       "waited this long — the latency/throughput knob (0 = dispatch "
+       "immediately)", group="serve", check=">=0"),
+    _p("serve_queue_rows", 16384, int, (),
+       "admission bound in ROWS: total rows pending in the serve "
+       "queue; beyond it requests are rejected with a retry-after "
+       "hint (HTTP 429) unless they outrank pending work",
+       group="serve", check=">0"),
+    _p("serve_queue_requests", 1024, int, (),
+       "admission bound in REQUESTS (guards against many tiny "
+       "requests exhausting queue slots under the row bound)",
+       group="serve", check=">0"),
+    _p("serve_timeout_ms", 2000.0, float, (),
+       "default per-request deadline: expired requests are swept "
+       "from the queue without wasting a dispatch (HTTP 504); "
+       "0 disables, per-request timeout_ms overrides",
+       group="serve", check=">=0"),
+    _p("serve_workers", 1, int, (),
+       "dispatcher threads draining the micro-batcher (each dispatch "
+       "is one engine call; >1 overlaps host-side assembly with "
+       "device compute)", group="serve", check=">=1"),
+    _p("serve_warmup", True, bool, (),
+       "pre-compile every bucket kernel when a model version is "
+       "published, BEFORE it becomes the admission target — the "
+       "zero-steady-state-compile contract; disable only for "
+       "debugging", group="serve"),
 ]
 
 _PARAM_BY_NAME: Dict[str, Param] = {p.name: p for p in PARAMS}

@@ -1683,6 +1683,17 @@ class GBDT:
             return bool(override)
         return bool(getattr(self.config, "predict_engine", True))
 
+    def _engine(self):
+        """The process-wide engine, with this booster's LRU capacity
+        preference applied (``predict_cache_slots``; last booster to
+        predict wins — the cache is shared by design)."""
+        from ..ops.predict import get_engine
+        eng = get_engine()
+        slots = int(getattr(self.config, "predict_cache_slots", 0) or 0)
+        if slots > 0 and slots != eng.cache_size:
+            eng.set_cache_size(slots)
+        return eng
+
     def _flat_forest(self):
         """Flattened SoA forest tables (ops/predict.py), cached until
         the model mutates — appends/pops change the tree count in the
@@ -1726,8 +1737,7 @@ class GBDT:
         used_engine = n_trees > 0 and X.shape[0] > 0 and \
             self._use_predict_engine(predict_engine)
         if used_engine:
-            from ..ops.predict import get_engine
-            out = get_engine().predict_raw(
+            out = self._engine().predict_raw(
                 self._flat_forest(), X, n_trees, early_stop=use_es,
                 early_stop_freq=early_stop_freq,
                 early_stop_margin=early_stop_margin,
@@ -1786,8 +1796,7 @@ class GBDT:
         used_engine = n_trees > 0 and X.shape[0] > 0 and \
             self._use_predict_engine(predict_engine)
         if used_engine:
-            from ..ops.predict import get_engine
-            out = get_engine().predict_leaf_index(
+            out = self._engine().predict_leaf_index(
                 self._flat_forest(), X, n_trees,
                 chunk_rows=predict_chunk_rows or
                 getattr(self.config, "predict_chunk_rows", 0))

@@ -175,22 +175,37 @@ def _dfs_layout(tree) -> Tuple[List[int], np.ndarray, np.ndarray]:
     return order, lo, hi
 
 
-_PREFIX_CACHE: Dict[Tuple[int, int], np.ndarray] = {}
+# bounded + locked: concurrent flattens (serve hot-swaps racing a
+# predict) share this module-level memo, and a pathological mix of
+# mask widths must not grow it without bound
+_PREFIX_CACHE: "OrderedDict[Tuple[int, int], np.ndarray]" = OrderedDict()
+_PREFIX_CACHE_SLOTS = 8
+_PREFIX_LOCK = threading.Lock()
 
 
 def _prefix_table(W: int, wbits: int) -> np.ndarray:
     """prefix[j] = words with bits [0, j) set; forest-constant, so
     memoized (flatten calls this once per TREE otherwise)."""
     key = (W, wbits)
-    if key not in _PREFIX_CACHE:
-        n_bits = W * wbits
-        prefix = np.zeros((n_bits + 1, W), np.uint64)
-        for j in range(1, n_bits + 1):
-            prefix[j] = prefix[j - 1]
-            w, b = divmod(j - 1, wbits)
-            prefix[j, w] |= np.uint64(1) << np.uint64(b)
+    with _PREFIX_LOCK:
+        hit = _PREFIX_CACHE.get(key)
+        if hit is not None:
+            _PREFIX_CACHE.move_to_end(key)
+            return hit
+    # build outside the lock (pure + idempotent; a racing duplicate
+    # build just overwrites with an identical table)
+    n_bits = W * wbits
+    prefix = np.zeros((n_bits + 1, W), np.uint64)
+    for j in range(1, n_bits + 1):
+        prefix[j] = prefix[j - 1]
+        w, b = divmod(j - 1, wbits)
+        prefix[j, w] |= np.uint64(1) << np.uint64(b)
+    prefix.setflags(write=False)      # shared across threads: freeze
+    with _PREFIX_LOCK:
         _PREFIX_CACHE[key] = prefix
-    return _PREFIX_CACHE[key]
+        while len(_PREFIX_CACHE) > _PREFIX_CACHE_SLOTS:
+            _PREFIX_CACHE.popitem(last=False)
+    return prefix
 
 
 def _range_masks(lo, hi, W: int, wbits: int) -> np.ndarray:
@@ -499,10 +514,23 @@ class PredictEngine:
                 _tele_counters.incr("predict_cache_evictions")
             return kernels
 
+    def set_cache_size(self, n: int) -> None:
+        """Resize the compiled-kernel LRU (``predict_cache_slots``
+        config param).  The engine is process-wide, so the last caller
+        wins; shrinking evicts immediately (oldest first)."""
+        n = max(int(n), 1)
+        with self._cache_lock:
+            self.cache_size = n
+            while len(self._cache) > n:
+                self._cache.popitem(last=False)
+                self.evictions += 1
+                _tele_counters.incr("predict_cache_evictions")
+
     def cache_info(self) -> Dict[str, int]:
         return {"hits": self.hits, "misses": self.misses,
                 "evictions": self.evictions,
-                "entries": len(self._cache), "traces": TRACE_COUNT}
+                "entries": len(self._cache),
+                "capacity": self.cache_size, "traces": TRACE_COUNT}
 
     # -- bucketing -------------------------------------------------------
     def _max_chunk(self, flat: FlatForest,
@@ -524,6 +552,28 @@ class PredictEngine:
             rem = n - pos
             b = 1 << (rem - 1).bit_length()
             yield pos, rem, min(max(b, _MIN_BUCKET), max_chunk)
+
+    def bucket_set(self, flat: FlatForest,
+                   chunk_rows: Optional[int] = None) -> List[int]:
+        """Every padded row-bucket size a request can hit for this
+        layout: the power-of-two ladder from ``_MIN_BUCKET`` up to the
+        max chunk, plus the max chunk itself.  The serve layer warms
+        exactly this set so steady-state serving never compiles."""
+        mx = self._max_chunk(flat, chunk_rows)
+        out = []
+        b = _MIN_BUCKET
+        while b < mx:
+            out.append(b)
+            b <<= 1
+        out.append(mx)
+        return out
+
+    def padded_rows(self, flat: FlatForest, n: int,
+                    chunk_rows: Optional[int] = None) -> int:
+        """Total device rows ``n`` input rows occupy after chunk
+        padding — the serve batch-occupancy denominator."""
+        mx = self._max_chunk(flat, chunk_rows)
+        return sum(b for _, _, b in self._buckets(n, mx))
 
     def _tree_chunk(self, flat: FlatForest, early_stop: bool,
                     freq: int, n_trees: int) -> int:
@@ -583,12 +633,19 @@ class PredictEngine:
                     blk = pad
                 xt = jnp.asarray(np.ascontiguousarray(blk.T))
                 xmat = xmat_fn(xt, flat.used_variants)
+                # fetch the FULL padded output and slice host-side: a
+                # device-side r[:, :rows] would compile one
+                # dynamic_slice executable per distinct request size,
+                # breaking the serving layer's zero-steady-state-
+                # compile contract (the padded tail is < one bucket of
+                # f64 — transfer noise)
                 if want_leaf:
-                    r = leaf_k(xmat, tabs)          # (C*Tc, B)
-                    outs.append(np.asarray(r[:n_trees, :rows]))
+                    r = np.asarray(leaf_k(xmat, tabs))  # (C*Tc, B)
+                    outs.append(r[:n_trees, :rows])
                 else:
-                    r = raw_k(xmat, tabs, jnp.float64(margin))
-                    outs.append(np.asarray(r[:, :rows]))
+                    r = np.asarray(raw_k(xmat, tabs,
+                                         jnp.float64(margin)))
+                    outs.append(r[:, :rows])
         return np.concatenate(outs, axis=1)
 
     def predict_raw(self, flat: FlatForest, X: np.ndarray,

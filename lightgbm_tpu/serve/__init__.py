@@ -1,0 +1,37 @@
+"""Online serving subsystem: micro-batching over the jitted predictor.
+
+The reference C++ stack stops at batch prediction (``task=predict``
+reads a file, writes a file); this package is the request-level layer
+that turns the flattened jitted inference engine (``ops/predict.py``)
+into an online service, following the micro-batching / continuous-
+serving playbook of accelerator inference stacks (PAPERS.md:
+"Fine-Tuning and Serving Gemma on Cloud TPU"; "GPU-acceleration for
+Large-scale Tree Boosting" for the low-latency inference focus):
+
+- :mod:`.admission`  — bounded request queue, backpressure
+  (reject-with-retry-after), priority load-shedding, deadline sweep.
+- :mod:`.batcher`    — coalesces concurrent requests into exactly the
+  power-of-two row buckets the engine already compiles for, so
+  steady-state serving incurs ZERO new XLA compiles.
+- :mod:`.registry`   — versioned models with atomic hot-swap: a new
+  version is flattened and pre-warmed against the live bucket set
+  BEFORE it becomes visible; in-flight requests complete against the
+  version they were admitted under.
+- :mod:`.server`     — the in-process front (``Server(booster)``) and
+  the dispatcher loop feeding per-request ``serve`` telemetry records
+  (``utils/telemetry.py``).
+- :mod:`.http`       — stdlib threaded JSON endpoint
+  (``python -m lightgbm_tpu task=serve input_model=...``).
+"""
+from .admission import (AdmissionQueue, QueueSaturated, Request,
+                        RequestShed, RequestTimeout, ServeError,
+                        ServerClosed)
+from .config import ServeConfig
+from .registry import ModelRegistry, ModelVersion
+from .server import Server
+
+__all__ = [
+    "Server", "ServeConfig", "ModelRegistry", "ModelVersion",
+    "AdmissionQueue", "Request", "ServeError", "QueueSaturated",
+    "RequestShed", "RequestTimeout", "ServerClosed",
+]

@@ -1,0 +1,263 @@
+"""Admission control: bounded request queue with priority load-shedding.
+
+The serving front admits a request only when the queue has room —
+bounded both in ROWS (the real resource: device batch slots) and in
+request count.  A saturated queue rejects with a retry-after hint
+(backpressure the HTTP front surfaces as ``Retry-After``), unless the
+incoming request outranks pending work, in which case the
+lowest-priority most-recently-admitted pending request is shed instead
+(graceful degradation: cheap traffic is dropped first, high-priority
+traffic keeps its latency).  Expired requests are swept at drain time
+so a stale deadline never wastes a device dispatch.
+
+The queue is also the coalescing point: :meth:`AdmissionQueue.drain_batch`
+blocks until work is available, gives concurrent submitters
+``batch_wait`` to pile on, then hands the dispatcher a FIFO run of
+same-version requests totalling at most ``max_batch_rows`` rows
+(version grouping is what lets a hot-swap proceed while old-version
+requests are still in flight).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class ServeError(RuntimeError):
+    """Base class of serving-front errors."""
+
+
+class QueueSaturated(ServeError):
+    """Admission rejected: queue full (backpressure)."""
+
+    def __init__(self, msg: str, retry_after_ms: float = 0.0):
+        super().__init__(msg)
+        self.retry_after_ms = float(retry_after_ms)
+
+
+class RequestShed(ServeError):
+    """Request was load-shed by a higher-priority admission."""
+
+
+class RequestTimeout(ServeError):
+    """Request deadline expired before completion."""
+
+
+class ServerClosed(ServeError):
+    """Server is not accepting requests."""
+
+
+class Request:
+    """One predict request; completion is an event the submitting
+    thread (or HTTP handler) waits on.  ``version`` is pinned at
+    ADMISSION — a later hot-swap never changes which model this
+    request is scored by."""
+
+    __slots__ = ("rid", "X", "raw", "priority", "deadline", "t_admit",
+                 "version", "status", "result", "error",
+                 "retry_after_ms", "timings", "_done", "_finish_lock")
+
+    def __init__(self, rid: int, X: np.ndarray, raw: bool,
+                 priority: int, deadline: Optional[float], version):
+        self.rid = rid
+        self.X = X
+        self.raw = bool(raw)
+        self.priority = int(priority)
+        self.deadline = deadline        # absolute time.monotonic(), or None
+        self.t_admit = time.monotonic()
+        self.version = version          # ModelVersion pinned at admission
+        self.status = "pending"         # -> ok|shed|timeout|rejected|error
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[str] = None
+        self.retry_after_ms = 0.0
+        self.timings: Dict[str, float] = {}
+        self._done = threading.Event()
+        self._finish_lock = threading.Lock()
+
+    @property
+    def rows(self) -> int:
+        return int(self.X.shape[0])
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return self.deadline is not None and \
+            (time.monotonic() if now is None else now) >= self.deadline
+
+    # -- completion ------------------------------------------------------
+    def finish(self, status: str, result: Optional[np.ndarray] = None,
+               error: Optional[str] = None,
+               retry_after_ms: float = 0.0) -> bool:
+        """Complete the request; FIRST writer wins (the dispatcher and
+        the wedged-worker guard can race).  Returns False when the
+        request was already finished — the caller must then skip its
+        telemetry emit, or one request double-counts."""
+        with self._finish_lock:
+            if self._done.is_set():
+                return False
+            self.status = status
+            self.result = result
+            self.error = error
+            self.retry_after_ms = float(retry_after_ms)
+            self.timings.setdefault(
+                "total_ms", (time.monotonic() - self.t_admit) * 1e3)
+            self._done.set()
+        return True
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def value(self) -> np.ndarray:
+        """Block for the result or raise the failure (the Python-API
+        surface; the HTTP front maps these to status codes)."""
+        self._done.wait()
+        if self.status == "ok":
+            return self.result
+        if self.status == "timeout":
+            raise RequestTimeout(self.error or "request timed out")
+        if self.status == "shed":
+            raise RequestShed(self.error or "request shed under load")
+        if self.status == "rejected":
+            raise QueueSaturated(self.error or "queue saturated",
+                                 self.retry_after_ms)
+        raise ServeError(self.error or f"request failed ({self.status})")
+
+
+class AdmissionQueue:
+    """Bounded FIFO with priority shedding and batch coalescing."""
+
+    def __init__(self, max_rows: int, max_requests: int,
+                 batch_rows_hint: int = 1024):
+        self.max_rows = int(max_rows)
+        self.max_requests = int(max_requests)
+        self.batch_rows_hint = max(int(batch_rows_hint), 1)
+        self.cond = threading.Condition()
+        self._dq: "deque[Request]" = deque()
+        self._rows = 0
+        self._closed = False
+        # EWMA of batch service time, maintained by the dispatcher —
+        # the retry-after hint converts backlog depth into milliseconds
+        self.service_ms_hint = 10.0
+
+    # -- introspection ---------------------------------------------------
+    def depth(self) -> Tuple[int, int]:
+        with self.cond:
+            return len(self._dq), self._rows
+
+    def closed(self) -> bool:
+        return self._closed
+
+    def retry_after_ms(self) -> float:
+        # backlog in batches (plus the one being formed) x service EWMA
+        batches = self._rows / self.batch_rows_hint + 1.0
+        return round(batches * max(self.service_ms_hint, 1.0), 1)
+
+    # -- admission -------------------------------------------------------
+    def admit(self, req: Request) -> List[Request]:
+        """Admit ``req`` or raise :class:`QueueSaturated`.  Returns the
+        requests shed to make room (already finished with status
+        ``shed``; the caller emits their telemetry)."""
+        shed: List[Request] = []
+        with self.cond:
+            if self._closed:
+                raise ServerClosed("server is shutting down")
+            # an oversize request on an EMPTY queue is always admitted
+            # (it could never fit otherwise); the engine chunks it
+            while self._dq and (
+                    self._rows + req.rows > self.max_rows or
+                    len(self._dq) + 1 > self.max_requests):
+                victim = self._lowest_priority_below(req.priority)
+                if victim is None:
+                    raise QueueSaturated(
+                        f"queue saturated ({len(self._dq)} requests / "
+                        f"{self._rows} rows pending)",
+                        self.retry_after_ms())
+                self._dq.remove(victim)
+                self._rows -= victim.rows
+                shed.append(victim)
+            self._dq.append(req)
+            self._rows += req.rows
+            self.cond.notify_all()
+        for v in shed:
+            v.finish("shed", error="shed by higher-priority admission")
+        return shed
+
+    def _lowest_priority_below(self, priority: int) -> Optional[Request]:
+        """The shedding victim: lowest priority strictly below the
+        incoming one; ties broken toward the MOST RECENT admission
+        (oldest work keeps its place)."""
+        victim = None
+        for r in self._dq:
+            if r.priority >= priority:
+                continue
+            if victim is None or r.priority <= victim.priority:
+                victim = r
+        return victim
+
+    # -- coalescing drain ------------------------------------------------
+    def drain_batch(self, max_batch_rows: int, wait_s: float,
+                    stop: threading.Event
+                    ) -> Tuple[List[Request], List[Request]]:
+        """Coalesce the next batch.  Returns ``(batch, timed_out)``;
+        ``timed_out`` requests are already finished (status
+        ``timeout``) — the caller emits their telemetry.  Returns
+        ``([], [])`` when stopped/closed with an empty queue."""
+        timed: List[Request] = []
+        out: List[Request] = []
+        with self.cond:
+            while not self._dq:
+                if stop.is_set() or self._closed:
+                    return [], []
+                self.cond.wait(0.05)
+            head = self._dq[0]
+            # coalescing window: concurrent submitters get wait_s
+            # (counted from the OLDEST pending admission) to pile on
+            t_dead = head.t_admit + wait_s
+            while (not stop.is_set()
+                   and self._front_rows(head.version) < max_batch_rows):
+                left = t_dead - time.monotonic()
+                if left <= 0:
+                    break
+                self.cond.wait(left)
+            now = time.monotonic()
+            rows = 0
+            while self._dq:
+                r = self._dq[0]
+                if r.expired(now):
+                    self._dq.popleft()
+                    self._rows -= r.rows
+                    timed.append(r)
+                    continue
+                if out and (r.version is not out[0].version or
+                            rows + r.rows > max_batch_rows):
+                    break
+                self._dq.popleft()
+                self._rows -= r.rows
+                out.append(r)
+                rows += r.rows
+                if rows >= max_batch_rows:
+                    break
+            self.cond.notify_all()
+        for t in timed:
+            t.finish("timeout", error="deadline expired in queue")
+        return out, timed
+
+    def _front_rows(self, version) -> int:
+        """Rows in the batchable FIFO prefix (same version, capped
+        scan — the queue bound keeps this short)."""
+        rows = 0
+        for i, r in enumerate(self._dq):
+            if r.version is not version or i >= 512:
+                break
+            rows += r.rows
+        return rows
+
+    def close(self) -> None:
+        with self.cond:
+            self._closed = True
+            self.cond.notify_all()

@@ -1,0 +1,74 @@
+"""Typed serving-layer configuration.
+
+The canonical parameter definitions (names, defaults, aliases, docs)
+live in the single-source registry — ``lightgbm_tpu/config.py``, group
+``serve`` — so ``docs/Parameters.md`` and CLI alias resolution cover
+them like every other knob.  This dataclass is the resolved subset the
+serve package passes around; build it with :meth:`ServeConfig.from_params`
+from a raw params dict, a resolved :class:`~lightgbm_tpu.config.Config`,
+or nothing (defaults).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Union
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    host: str = "127.0.0.1"
+    port: int = 9595
+    # coalescer: batches close at max_batch_rows or when the oldest
+    # pending request has waited batch_wait_ms, whichever first.
+    # max_batch_rows doubles as the engine row-chunk for serving, so
+    # the servable bucket set is {512, 1024, ..., max_batch_rows}
+    max_batch_rows: int = 1024
+    batch_wait_ms: float = 2.0
+    # admission bounds (rows is the real resource — device batch slots)
+    queue_rows: int = 16384
+    queue_requests: int = 1024
+    # default per-request deadline; 0 disables
+    timeout_ms: float = 2000.0
+    workers: int = 1
+    # pre-compile every bucket kernel at publish time, before the
+    # version becomes visible (the zero-steady-state-compile contract)
+    warmup: bool = True
+    # engine compile-cache LRU capacity (must cover the layouts x
+    # buckets being served; the serve path bypasses GBDT, so the
+    # Server applies this itself at construction)
+    predict_cache_slots: int = 16
+    telemetry_file: str = ""
+
+    @classmethod
+    def from_params(cls, params: Union[None, Dict[str, Any], Any] = None
+                    ) -> "ServeConfig":
+        from ..config import Config
+        if params is None:
+            cfg = Config()
+        elif isinstance(params, Config):
+            cfg = params
+        else:
+            cfg = Config(dict(params))
+        return cls(
+            host=str(cfg.serve_host),
+            port=int(cfg.serve_port),
+            max_batch_rows=int(cfg.serve_max_batch_rows),
+            batch_wait_ms=float(cfg.serve_batch_wait_ms),
+            queue_rows=int(cfg.serve_queue_rows),
+            queue_requests=int(cfg.serve_queue_requests),
+            timeout_ms=float(cfg.serve_timeout_ms),
+            workers=int(cfg.serve_workers),
+            warmup=bool(cfg.serve_warmup),
+            predict_cache_slots=int(cfg.predict_cache_slots),
+            telemetry_file=str(cfg.telemetry_file or ""))
+
+    def validate(self) -> None:
+        if self.max_batch_rows <= 0:
+            raise ValueError("serve_max_batch_rows must be > 0")
+        if self.queue_rows < self.max_batch_rows:
+            raise ValueError("serve_queue_rows must be >= "
+                             "serve_max_batch_rows")
+        if self.workers < 1:
+            raise ValueError("serve_workers must be >= 1")
+        if self.batch_wait_ms < 0 or self.timeout_ms < 0:
+            raise ValueError("serve wait/timeout must be >= 0")

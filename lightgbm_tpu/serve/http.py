@@ -1,0 +1,175 @@
+"""Stdlib threaded JSON endpoint over :class:`~.server.Server`.
+
+No framework dependency — ``http.server.ThreadingHTTPServer`` with one
+handler thread per connection blocking on the request future, which is
+exactly the shape the micro-batcher wants (many concurrent submitters
+to coalesce).  Routes:
+
+- ``POST /predict``  ``{"rows": [[...], ...], "raw": false,
+  "priority": 0, "timeout_ms": 500}`` ->
+  ``{"predictions": [...], "version": v, "total_ms": t}``;
+  429 + ``Retry-After`` on backpressure, 503 on shed, 504 on timeout.
+- ``POST /swap``     ``{"model_file": path}`` or ``{"model_str": s}``
+  -> ``{"version": v}`` (blocks through flatten + pre-warm; in-flight
+  requests finish on their admitted version).
+- ``GET /healthz``   liveness + active version.
+- ``GET /stats``     queue depth, latency percentiles, engine cache.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..utils.log import Log
+from .admission import (QueueSaturated, RequestShed, RequestTimeout,
+                        ServeError, ServerClosed)
+from .server import Server
+
+
+def _json_handler_for(server: Server):
+    class ServeHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        # -- plumbing --------------------------------------------------
+        def _send(self, code: int, obj: Dict[str, Any],
+                  headers: Optional[Dict[str, str]] = None) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _read_json(self) -> Optional[Dict[str, Any]]:
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n) or b"{}")
+            except (ValueError, TypeError):
+                return None
+
+        def log_message(self, fmt, *args):  # route through our logger
+            Log.debug("serve http: " + fmt, *args)
+
+        # -- routes ----------------------------------------------------
+        def do_GET(self):
+            if self.path == "/healthz":
+                depth_reqs, depth_rows = server.queue.depth()
+                self._send(200, {"ok": True,
+                                 "version": server.version(),
+                                 "queue_requests": depth_reqs,
+                                 "queue_rows": depth_rows})
+            elif self.path == "/stats":
+                self._send(200, server.stats())
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if self.path == "/predict":
+                self._predict()
+            elif self.path == "/swap":
+                self._swap()
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+        def _predict(self):
+            body = self._read_json()
+            if body is None or "rows" not in body:
+                self._send(400, {"error": "body must be JSON with "
+                                          "a 'rows' matrix"})
+                return
+            try:
+                X = np.asarray(body["rows"], np.float64)
+            except (ValueError, TypeError) as exc:
+                self._send(400, {"error": f"bad rows: {exc}"})
+                return
+            try:
+                req = server.submit(
+                    X, priority=int(body.get("priority", 0)),
+                    timeout_ms=body.get("timeout_ms"),
+                    raw=bool(body.get("raw", False)))
+                out = req.value()
+            except QueueSaturated as exc:
+                # RFC 7231 Retry-After is integer seconds; the precise
+                # hint rides in the JSON retry_after_ms field
+                retry_s = max(int(-(-exc.retry_after_ms // 1e3)), 1)
+                self._send(429, {"error": str(exc),
+                                 "retry_after_ms": exc.retry_after_ms},
+                           headers={"Retry-After": str(retry_s)})
+                return
+            except RequestTimeout as exc:
+                self._send(504, {"error": str(exc)})
+                return
+            except (RequestShed, ServerClosed) as exc:
+                self._send(503, {"error": str(exc)})
+                return
+            except ValueError as exc:      # malformed input: client fault
+                self._send(400, {"error": str(exc)})
+                return
+            except ServeError as exc:      # dispatch failed: server fault
+                self._send(500, {"error": str(exc)})
+                return
+            self._send(200, {
+                "predictions": np.asarray(out).tolist(),
+                "version": req.version.version,
+                "total_ms": round(req.timings.get("total_ms", 0.0), 3)})
+
+        def _swap(self):
+            body = self._read_json()
+            if body is None or not (body.get("model_file") or
+                                    body.get("model_str")):
+                self._send(400, {"error": "body must carry model_file "
+                                          "or model_str"})
+                return
+            try:
+                v = server.swap(model_file=body.get("model_file"),
+                                model_str=body.get("model_str"))
+            except Exception as exc:
+                self._send(400, {"error": f"swap failed: {exc}"})
+                return
+            self._send(200, {"version": v})
+
+    return ServeHandler
+
+
+def make_http_server(server: Server, host: Optional[str] = None,
+                     port: Optional[int] = None) -> ThreadingHTTPServer:
+    """Bind (not yet serving) — call ``serve_forever()`` or use
+    :func:`serve_http`.  ``port=0`` binds an ephemeral port."""
+    host = server.config.host if host is None else host
+    port = server.config.port if port is None else port
+    httpd = ThreadingHTTPServer((host, port), _json_handler_for(server))
+    httpd.daemon_threads = True
+    return httpd
+
+
+def serve_http(server: Server, host: Optional[str] = None,
+               port: Optional[int] = None,
+               background: bool = False
+               ) -> Tuple[ThreadingHTTPServer, Optional[threading.Thread]]:
+    """Start the Server's dispatchers and the HTTP front.  With
+    ``background=True`` the accept loop runs in a daemon thread and
+    the pair ``(httpd, thread)`` returns immediately (the test /
+    loadgen mode); otherwise this blocks until interrupted."""
+    server.start()
+    httpd = make_http_server(server, host, port)
+    Log.info("serve: listening on http://%s:%d (model v%s)",
+             *httpd.server_address[:2], server.version())
+    if background:
+        t = threading.Thread(target=httpd.serve_forever,
+                             name="ltpu-serve-http", daemon=True)
+        t.start()
+        return httpd, t
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        Log.info("serve: interrupted, draining")
+    finally:
+        httpd.shutdown()
+        server.stop()
+    return httpd, None

@@ -1,0 +1,175 @@
+"""Versioned model registry with atomic pre-warmed hot-swap.
+
+Publishing a new model version is a three-step transaction:
+
+1. **flatten** — the booster's forest is packed into the SoA device
+   tables the engine scores from (``ops/predict.py flatten_forest``,
+   via the booster's own cached ``_flat_forest``);
+2. **pre-warm** — every kernel the live serve bucket set can hit
+   (``PredictEngine.bucket_set``) is compiled by running a real
+   predict per bucket, BEFORE the version becomes visible;
+3. **swap** — one atomic pointer assignment makes the version the
+   admission target.
+
+Because requests pin their :class:`ModelVersion` at admission and the
+old version object stays alive as long as any in-flight request
+references it, a swap never drops or mixes responses: old-version
+batches keep completing against the old tables while new admissions
+score against the new ones.  Steady-state compile count stays flat
+across swaps of same-layout models (the engine compile cache is keyed
+by layout statics, not by version), and a layout-changing swap pays
+its compiles inside ``publish()``, never on the request path.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.log import Log
+from ..utils.telemetry import counters as _tele_counters
+from ..utils.telemetry import counters_snapshot
+
+
+class ModelVersion:
+    """One immutable published model: booster + flattened tables."""
+
+    def __init__(self, version: int, booster, chunk_rows: int):
+        self.version = int(version)
+        self.booster = booster
+        self.chunk_rows = int(chunk_rows)
+        # the flattened tables ARE the version snapshot: flatten_forest
+        # builds fresh arrays, so later mutations of the booster
+        # (continue-training, refit, DART renorm) never reach scoring
+        # through this version — requests admitted under it really do
+        # complete against the model as published
+        self.flat = booster._gbdt._flat_forest()
+        self._objective = booster._gbdt.objective
+        self.average_output = bool(getattr(booster._gbdt,
+                                           "average_output", False))
+        self.n_trees = self.flat.n_trees
+        self.k = self.flat.k
+        self.num_features = self.flat.num_features
+        self.requires_features = self.flat.requires_features
+        self.published_at = time.time()
+        self.warmup_info: Optional[Dict[str, Any]] = None
+
+    # -- scoring ---------------------------------------------------------
+    def predict_raw_batch(self, X: np.ndarray) -> np.ndarray:
+        """Raw scores for an assembled batch, straight from the PINNED
+        flattened tables — same semantics as ``GBDT.predict_raw``
+        (engine scoring, average_output normalization, (rows,) /
+        (rows, k) shape) but immune to post-publish booster mutation.
+        The serve path is engine-only: ``LTPU_PREDICT_ENGINE=0``
+        (the offline oracle toggle) does not apply here."""
+        from ..ops.predict import get_engine
+        X = np.ascontiguousarray(np.asarray(X, np.float64))
+        out = get_engine().predict_raw(self.flat, X, self.n_trees,
+                                       chunk_rows=self.chunk_rows)
+        if self.average_output and self.n_trees:
+            out = out / max(self.n_trees // self.k, 1)
+        return out[0] if self.k == 1 else out.T
+
+    def convert(self, raw: np.ndarray) -> np.ndarray:
+        """Raw -> output space (sigmoid/softmax/exp per objective)."""
+        obj = self._objective
+        return obj.convert_output(raw) if obj is not None else raw
+
+    def padded_rows(self, n: int, chunk_rows: Optional[int] = None
+                    ) -> int:
+        from ..ops.predict import get_engine
+        return get_engine().padded_rows(self.flat, n,
+                                        chunk_rows or self.chunk_rows)
+
+    # -- warmup ----------------------------------------------------------
+    def warmup(self) -> Dict[str, Any]:
+        """Compile every kernel the serve bucket set can hit for this
+        layout; returns ``{buckets, xla_compiles, warmup_s}`` so the
+        caller can record what the swap cost off the request path."""
+        from ..ops.predict import get_engine
+        from ..utils.telemetry import install_jax_hooks
+        engine = get_engine()
+        buckets = engine.bucket_set(self.flat, self.chunk_rows)
+        # the compile counter only counts once the jax.monitoring
+        # hooks exist; a recorder-less Server never installed them,
+        # which made every warmup report 0 compiles (idempotent)
+        install_jax_hooks()
+        base = counters_snapshot()
+        t0 = time.monotonic()
+        for b in buckets:
+            self.predict_raw_batch(np.zeros((b, self.num_features)))
+        now = counters_snapshot()
+        info = {
+            "buckets": list(buckets),
+            "xla_compiles": now.get("xla_compiles", 0.0) -
+            base.get("xla_compiles", 0.0),
+            "warmup_s": round(time.monotonic() - t0, 3),
+        }
+        self.warmup_info = info
+        return info
+
+    def meta(self) -> Dict[str, Any]:
+        return {"version": self.version, "n_trees": self.n_trees,
+                "num_features": self.num_features,
+                "published_at": round(self.published_at, 3),
+                "warmup": self.warmup_info}
+
+
+class ModelRegistry:
+    """Holds the active :class:`ModelVersion`; swaps are serialized
+    and atomic (one pointer assignment under the lock)."""
+
+    def __init__(self, chunk_rows: int = 1024, warm: bool = True):
+        self.chunk_rows = int(chunk_rows)
+        self.warm = bool(warm)
+        self._lock = threading.Lock()          # guards _active/_history
+        self._publish_lock = threading.Lock()  # serializes publishes
+        self._active: Optional[ModelVersion] = None
+        self._next_version = 1
+        self._history: List[Dict[str, Any]] = []
+
+    # -- publish / swap --------------------------------------------------
+    def publish(self, booster=None, model_file: Optional[str] = None,
+                model_str: Optional[str] = None) -> ModelVersion:
+        """Flatten + pre-warm + atomically swap in a new version.
+        Accepts a live :class:`~lightgbm_tpu.basic.Booster`, a model
+        file path, or a model string."""
+        with self._publish_lock:
+            if booster is None:
+                from ..basic import Booster
+                booster = Booster(model_file=model_file,
+                                  model_str=model_str)
+            ver = ModelVersion(self._next_version, booster,
+                               self.chunk_rows)
+            if self.warm:
+                info = ver.warmup()
+                Log.info("serve: warmed model v%d (%d trees) — "
+                         "buckets %s, %d compiles, %.2fs",
+                         ver.version, ver.n_trees,
+                         info["buckets"], int(info["xla_compiles"]),
+                         info["warmup_s"])
+            with self._lock:
+                self._active = ver
+                self._next_version += 1
+                self._history.append(ver.meta())
+                del self._history[:-16]
+            _tele_counters.incr("serve_swaps")
+            return ver
+
+    # -- lookup ----------------------------------------------------------
+    def current(self) -> Optional[ModelVersion]:
+        with self._lock:
+            return self._active
+
+    def require(self) -> ModelVersion:
+        ver = self.current()
+        if ver is None:
+            from .admission import ServeError
+            raise ServeError("no model published to the registry")
+        return ver
+
+    def history(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._history)

@@ -1,0 +1,303 @@
+"""The serving front: dispatcher loop + in-process Python API.
+
+``Server(booster)`` owns the admission queue, the micro-batcher, the
+model registry and the dispatcher thread(s); ``predict()`` is the
+blocking client surface (``submit()`` returns the request future).
+Every request — completed, shed, timed out or rejected — feeds one
+``serve`` telemetry record (``utils/telemetry.py``) carrying the
+queue-wait / batch-assembly / dispatch / total latency split, the
+batch occupancy, and the version that scored it; the recorder's
+``run_end`` summary rolls up p50/p95/p99 latency and shed/timeout
+counts.  Steady-state serving re-runs only cached XLA programs: the
+batcher packs to warmed buckets and swaps pre-warm off the request
+path, so the ``xla_compiles`` counter stays flat after warmup (pinned
+in ``tests/test_serve.py``).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.log import Log
+from ..utils.telemetry import counters as _tele_counters
+from ..utils.telemetry import percentile as _percentile
+from .admission import (AdmissionQueue, QueueSaturated, Request,
+                        ServerClosed)
+from .batcher import Batch, MicroBatcher
+from .config import ServeConfig
+from .registry import ModelRegistry
+
+
+class Server:
+    """In-process online predict server over the jitted engine."""
+
+    def __init__(self, booster=None,
+                 params: Optional[Dict[str, Any]] = None,
+                 config: Optional[ServeConfig] = None,
+                 telemetry=None):
+        self.config = config or ServeConfig.from_params(params)
+        self.config.validate()
+        self.queue = AdmissionQueue(
+            self.config.queue_rows, self.config.queue_requests,
+            batch_rows_hint=self.config.max_batch_rows)
+        self.batcher = MicroBatcher(self.queue, self.config)
+        self.registry = ModelRegistry(
+            chunk_rows=self.config.max_batch_rows,
+            warm=self.config.warmup)
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._rid = 0
+        self._rid_lock = threading.Lock()
+        self._lat_ring: "deque[float]" = deque(maxlen=4096)
+        self._counts: Dict[str, int] = {}
+        self._counts_lock = threading.Lock()
+        self._recorder = self._make_recorder(telemetry)
+        self._owns_recorder = telemetry is None and \
+            self._recorder is not None
+        # the serve path scores through the engine directly (pinned
+        # flat tables, not GBDT.predict_raw), so the LRU-capacity knob
+        # must be applied here — GBDT._engine() never runs
+        if self.config.predict_cache_slots > 0:
+            from ..ops.predict import get_engine
+            get_engine().set_cache_size(self.config.predict_cache_slots)
+        if booster is not None:
+            self.registry.publish(booster)
+
+    def _make_recorder(self, telemetry):
+        from ..utils import telemetry as _t
+        if telemetry is not None:
+            return telemetry                     # caller-owned recorder
+        if not self.config.telemetry_file:
+            return None
+        info: Dict[str, Any] = {"task": "serve"}
+        try:
+            import jax
+            info["backend"] = jax.default_backend()
+        except Exception:
+            info["backend"] = "unknown"
+        return _t.RunRecorder(self.config.telemetry_file, run_info=info)
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "Server":
+        if self._threads:
+            return self
+        self._stop.clear()
+        for i in range(self.config.workers):
+            t = threading.Thread(target=self._worker,
+                                 name=f"ltpu-serve-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Stop admissions, drain pending work, join the dispatchers,
+        flush telemetry.  Idempotent."""
+        self.queue.close()
+        for t in self._threads:
+            t.join(timeout)
+        self._threads = []
+        self._stop.set()
+        # anything a dead worker left behind fails loudly, not silently
+        while True:
+            leftovers, _ = self.queue.drain_batch(1 << 30, 0.0,
+                                                  self._stop)
+            if not leftovers:
+                break
+            for r in leftovers:
+                if r.finish("error", error="server stopped"):
+                    self._emit(r)
+        if self._owns_recorder and self._recorder is not None:
+            self._recorder.close()
+            self._recorder = None
+
+    def __enter__(self) -> "Server":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- model management ------------------------------------------------
+    def swap(self, booster=None, model_file: Optional[str] = None,
+             model_str: Optional[str] = None) -> int:
+        """Publish a new model version (flatten + pre-warm + atomic
+        swap).  In-flight requests complete against their admitted
+        version; only new admissions see the new one."""
+        t0 = time.monotonic()
+        ver = self.registry.publish(booster=booster,
+                                    model_file=model_file,
+                                    model_str=model_str)
+        if self._recorder is not None:
+            self._recorder.emit(
+                "serve", status="swap", rows=0,
+                total_ms=round((time.monotonic() - t0) * 1e3, 3),
+                version=ver.version,
+                warmup=ver.warmup_info)
+        return ver.version
+
+    def version(self) -> Optional[int]:
+        ver = self.registry.current()
+        return ver.version if ver is not None else None
+
+    # -- client surface --------------------------------------------------
+    def submit(self, data, priority: int = 0,
+               timeout_ms: Optional[float] = None,
+               raw: bool = False) -> Request:
+        """Admit one predict request; returns the request future
+        (``.value()`` blocks for the result or raises).  Raises
+        :class:`QueueSaturated` immediately on backpressure."""
+        if not self._threads:
+            raise ServerClosed("server not started (call start())")
+        ver = self.registry.require()
+        X = np.ascontiguousarray(np.asarray(data, np.float64))
+        if X.ndim == 1:
+            X = X[None, :]
+        if X.ndim != 2 or X.shape[0] == 0:
+            raise ValueError(f"expected a non-empty 2-D matrix, got "
+                             f"shape {X.shape}")
+        if X.shape[1] < ver.requires_features:
+            raise ValueError(
+                f"input has {X.shape[1]} features but model v"
+                f"{ver.version} references feature "
+                f"{ver.requires_features - 1}")
+        if X.shape[1] != ver.num_features:
+            # width-normalize so requests concatenate into one batch;
+            # extra columns are ignored exactly as the engine would
+            fixed = np.zeros((X.shape[0], ver.num_features))
+            w = min(X.shape[1], ver.num_features)
+            fixed[:, :w] = X[:, :w]
+            X = fixed
+        tmo = self.config.timeout_ms if timeout_ms is None \
+            else float(timeout_ms)
+        deadline = time.monotonic() + tmo / 1e3 if tmo > 0 else None
+        with self._rid_lock:
+            self._rid += 1
+            rid = self._rid
+        req = Request(rid, X, raw, priority, deadline, ver)
+        try:
+            shed = self.queue.admit(req)
+        except QueueSaturated as exc:
+            req.finish("rejected", error=str(exc),
+                       retry_after_ms=exc.retry_after_ms)
+            self._emit(req)
+            raise
+        for v in shed:
+            self._emit(v)
+        return req
+
+    def predict(self, data, priority: int = 0,
+                timeout_ms: Optional[float] = None,
+                raw: bool = False) -> np.ndarray:
+        """Blocking predict through the micro-batching scheduler.
+        Output matches ``Booster.predict`` (``raw=True`` matches
+        ``raw_score=True``)."""
+        req = self.submit(data, priority=priority,
+                          timeout_ms=timeout_ms, raw=raw)
+        # grace beyond the deadline: the dispatcher times the request
+        # out itself; this guard only catches a wedged worker
+        grace = None
+        if req.deadline is not None:
+            grace = max(req.deadline - time.monotonic(), 0.0) + 60.0
+        if not req.wait(grace):
+            # finish() is first-writer-wins: if the dispatcher beat us
+            # between wait() and here, this is a no-op and no second
+            # telemetry record is emitted
+            if req.finish("error", error="dispatcher stalled"):
+                self._emit(req)
+        return req.value()
+
+    # -- dispatcher ------------------------------------------------------
+    def _worker(self) -> None:
+        while True:
+            batch, timed = self.batcher.next_batch(self._stop)
+            for t in timed:
+                self._emit(t)
+            if batch is None:
+                if (self._stop.is_set() or self.queue.closed()) \
+                        and self.queue.depth()[0] == 0:
+                    return
+                continue
+            self._dispatch(batch)
+
+    def _dispatch(self, batch: Batch) -> None:
+        t0 = time.monotonic()
+        try:
+            raw = batch.version.predict_raw_batch(batch.X)
+        except Exception as exc:  # batch fails as a unit, loudly
+            Log.warning("serve: batch dispatch failed: %s", exc)
+            for r in batch.requests:
+                r.timings["dispatch_ms"] = \
+                    round((time.monotonic() - t0) * 1e3, 3)
+                if r.finish("error", error=f"dispatch failed: {exc}"):
+                    self._emit(r, batch)
+            return
+        dispatch_ms = round((time.monotonic() - t0) * 1e3, 3)
+        # EWMA service-time hint drives the retry-after backpressure
+        self.queue.service_ms_hint = round(
+            0.8 * self.queue.service_ms_hint + 0.2 * dispatch_ms, 3)
+        pos = 0
+        for r in batch.requests:
+            sl = raw[pos:pos + r.rows]
+            pos += r.rows
+            out = sl if r.raw else batch.version.convert(sl)
+            r.timings["dispatch_ms"] = dispatch_ms
+            if r.finish("ok", result=out):
+                self._emit(r, batch)
+        _tele_counters.incr("serve_batches")
+        _tele_counters.incr("serve_batch_rows", batch.rows)
+        _tele_counters.incr("serve_padded_rows", batch.bucket_rows)
+
+    # -- telemetry / stats -----------------------------------------------
+    def _emit(self, req: Request, batch: Optional[Batch] = None) -> None:
+        status = req.status
+        _tele_counters.incr("serve_requests")
+        if status != "ok":
+            _tele_counters.incr(f"serve_{status}")
+        with self._counts_lock:
+            self._counts[status] = self._counts.get(status, 0) + 1
+            if status == "ok":
+                self._lat_ring.append(req.timings.get("total_ms", 0.0))
+        if self._recorder is None:
+            return
+        fields: Dict[str, Any] = {
+            "status": status, "rows": req.rows,
+            "total_ms": round(req.timings.get("total_ms", 0.0), 3),
+            "priority": req.priority,
+        }
+        for key in ("queue_ms", "assemble_ms", "dispatch_ms"):
+            if key in req.timings:
+                fields[key] = req.timings[key]
+        if req.version is not None:
+            fields["version"] = req.version.version
+        if batch is not None:
+            fields["batch_rows"] = batch.rows
+            fields["bucket_rows"] = batch.bucket_rows
+            fields["occupancy"] = round(batch.occupancy, 4)
+        if req.error and status not in ("ok",):
+            fields["error"] = str(req.error)[:200]
+        self._recorder.emit("serve", **fields)
+
+    def stats(self) -> Dict[str, Any]:
+        from ..ops.predict import get_engine
+        with self._counts_lock:
+            counts = dict(self._counts)
+            lat = sorted(self._lat_ring)
+        depth_reqs, depth_rows = self.queue.depth()
+        ver = self.registry.current()
+        return {
+            "version": ver.version if ver else None,
+            "queue_requests": depth_reqs,
+            "queue_rows": depth_rows,
+            "requests": counts,
+            "latency_ms": {
+                "p50": round(_percentile(lat, 0.50), 3),
+                "p95": round(_percentile(lat, 0.95), 3),
+                "p99": round(_percentile(lat, 0.99), 3),
+            },
+            "retry_after_ms": self.queue.retry_after_ms(),
+            "engine_cache": get_engine().cache_info(),
+            "versions": self.registry.history(),
+        }

@@ -57,13 +57,23 @@ __all__ = [
     "SCHEMA_VERSION", "RECORD_TYPES", "RunRecorder", "counters",
     "counters_snapshot", "install_jax_hooks", "validate_record",
     "lint_file", "read_records", "parse_bench_artifact",
-    "latest_good_bench", "get_recorder", "set_recorder",
+    "latest_good_bench", "get_recorder", "set_recorder", "percentile",
 ]
+
+
+def percentile(sorted_vals, q: float) -> float:
+    """Index-based percentile over an ascending-sorted sequence — the
+    ONE implementation every latency rollup shares (run_end summary,
+    serve stats, bench, loadgen), so their p50/p95/p99 agree."""
+    if not sorted_vals:
+        return 0.0
+    return float(sorted_vals[min(int(q * len(sorted_vals)),
+                                 len(sorted_vals) - 1)])
 
 SCHEMA_VERSION = 1
 
 RECORD_TYPES = ("run_start", "iteration", "superstep", "eval", "predict",
-                "run_end")
+                "serve", "run_end")
 
 # per-type required fields on top of the common envelope; values are
 # (field, type-or-types) pairs the lint enforces
@@ -80,6 +90,14 @@ _TYPE_FIELDS: Dict[str, Tuple[Tuple[str, Any], ...]] = {
                   ("duration_ms", (int, float))),
     "eval": (("iter", int), ("results", list)),
     "predict": (("rows", int), ("n_trees", int), ("engine", bool)),
+    # one record per ONLINE serving request (serve/server.py):
+    # ``status`` is ok|shed|timeout|rejected|error|swap; ok records
+    # carry the queue_ms/assemble_ms/dispatch_ms latency split plus
+    # batch_rows/bucket_rows/occupancy for their dispatch unit, and
+    # the model ``version`` that scored them.  The run_end summary
+    # rolls up p50/p95/p99 total latency and shed/timeout counts.
+    "serve": (("status", str), ("rows", int),
+              ("total_ms", (int, float))),
     "run_end": (("summary", dict),),
 }
 
@@ -218,6 +236,14 @@ class RunRecorder:
         self._phase_totals: Dict[str, float] = {}
         self._tier: Optional[str] = None
         self._backend: Optional[str] = None
+        # serve-latency ring for the close-time p50/p95/p99 rollup:
+        # bounded (long-running servers must not grow the recorder)
+        # and holding the most RECENT 64k samples, so the rollup
+        # reflects current behavior, not the first hour's
+        self._serve_lat: List[float] = []
+        self._serve_lat_n = 0
+        self._serve_occ_sum = 0.0
+        self._serve_occ_n = 0
         self._base = counters.snapshot()
         install_jax_hooks()
         with _OPEN_LOCK:
@@ -283,6 +309,30 @@ class RunRecorder:
             self._agg["collective_bytes"] = \
                 self._agg.get("collective_bytes", 0.0) + \
                 float(rec.get("collective_bytes", 0.0))
+        elif t == "serve":
+            status = rec.get("status")
+            if status == "swap":
+                self._agg["serve_swaps"] = \
+                    self._agg.get("serve_swaps", 0) + 1
+                return
+            self._agg["serve_requests"] = \
+                self._agg.get("serve_requests", 0) + 1
+            self._agg["serve_rows"] = \
+                self._agg.get("serve_rows", 0) + int(rec.get("rows", 0))
+            if status != "ok":
+                self._agg[f"serve_{status}"] = \
+                    self._agg.get(f"serve_{status}", 0) + 1
+                return
+            v = float(rec.get("total_ms", 0.0))
+            if len(self._serve_lat) < 65536:
+                self._serve_lat.append(v)
+            else:
+                self._serve_lat[self._serve_lat_n % 65536] = v
+            self._serve_lat_n += 1
+            occ = rec.get("occupancy")
+            if occ is not None:
+                self._serve_occ_sum += float(occ)
+                self._serve_occ_n += 1
         elif t == "predict":
             self._agg["predicts"] = self._agg.get("predicts", 0) + 1
             self._agg["predict_rows"] = \
@@ -305,6 +355,14 @@ class RunRecorder:
             }
             out.update({k: (round(v, 6) if isinstance(v, float) else v)
                         for k, v in self._agg.items()})
+            if self._serve_lat:
+                lat = sorted(self._serve_lat)
+                out["serve_total_ms_p50"] = round(percentile(lat, 0.50), 3)
+                out["serve_total_ms_p95"] = round(percentile(lat, 0.95), 3)
+                out["serve_total_ms_p99"] = round(percentile(lat, 0.99), 3)
+            if self._serve_occ_n:
+                out["serve_mean_occupancy"] = round(
+                    self._serve_occ_sum / self._serve_occ_n, 4)
             if self._phase_totals:
                 out["phase_totals_ms"] = {
                     k: round(v, 3) for k, v in sorted(
@@ -340,6 +398,14 @@ class RunRecorder:
                     f"{s['predicts']:.0f} predicts "
                     f"({s.get('predict_cache_hits', 0):.0f} cache hits / "
                     f"{s.get('predict_cache_misses', 0):.0f} misses)")
+            if s.get("serve_requests"):
+                parts.append(
+                    f"{s['serve_requests']:.0f} serve requests "
+                    f"(p50 {s.get('serve_total_ms_p50', 0):.1f} / "
+                    f"p99 {s.get('serve_total_ms_p99', 0):.1f} ms, "
+                    f"{s.get('serve_shed', 0):.0f} shed, "
+                    f"{s.get('serve_timeout', 0):.0f} timeout, "
+                    f"{s.get('serve_rejected', 0):.0f} rejected)")
             if self.path:
                 parts.append(f"records -> {self.path}")
             Log.info("%s", ", ".join(parts))

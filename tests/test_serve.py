@@ -1,0 +1,463 @@
+"""Online serving subsystem (lightgbm_tpu/serve/).
+
+Pins the PR-4 acceptance contract:
+
+- steady-state serving (after warmup, fixed bucket set) records ZERO
+  ``backend_compile`` events across >= 500 mixed-size requests
+  (telemetry counters are the instrument);
+- a mid-run hot-swap completes with zero failed in-flight requests,
+  no mixed-version responses, and no compile-count growth for
+  same-layout swaps;
+- admission control: backpressure with retry-after, priority
+  load-shedding, deadline timeout;
+- per-request ``serve`` telemetry records + close-time rollups;
+- the satellite fixes: configurable predict-engine LRU
+  (``predict_cache_slots`` + ``Booster.predict_cache_info``) and the
+  bounded/locked ``_PREFIX_CACHE``.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serve import (AdmissionQueue, QueueSaturated, Request,
+                                RequestTimeout, ServeConfig, Server)
+from lightgbm_tpu.utils.telemetry import (counters_snapshot, lint_file,
+                                          validate_record)
+
+
+def _train(n_rounds=4, seed=0, rows=2000, leaves=15):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(rows, 8)
+    y = (X[:, 0] + 0.4 * rng.randn(rows) > 0).astype(float)
+    d = lgb.Dataset(X, label=y,
+                    params={"objective": "binary", "verbose": -1})
+    bst = lgb.train({"objective": "binary", "num_leaves": leaves,
+                     "verbose": -1, "metric": "None"},
+                    d, num_boost_round=n_rounds)
+    return bst, X
+
+
+@pytest.fixture(scope="module")
+def model_pair():
+    """Two same-layout boosters (swap targets) + their input matrix."""
+    b1, X = _train(n_rounds=4)
+    b2, _ = _train(n_rounds=7, seed=1)
+    return b1, b2, X
+
+
+@pytest.fixture(scope="module")
+def warm_server(model_pair):
+    """A started server (bucket set {512, 1024}) shared by the
+    read-only tests; mutating tests build their own."""
+    b1, _, _ = model_pair
+    srv = Server(b1, config=ServeConfig(max_batch_rows=1024,
+                                        batch_wait_ms=0.5,
+                                        timeout_ms=30000)).start()
+    yield srv
+    srv.stop()
+
+
+# ----------------------------------------------------------------------
+# parity with the offline surface
+# ----------------------------------------------------------------------
+def test_serve_matches_offline_predict(warm_server, model_pair):
+    b1, _, X = model_pair
+    for n in (1, 7, 100, 511, 513, 1024, 2000):
+        out = warm_server.predict(X[:n])
+        np.testing.assert_allclose(out, b1.predict(X[:n]),
+                                   rtol=1e-12, atol=1e-12)
+    raw = warm_server.predict(X[:64], raw=True)
+    np.testing.assert_allclose(raw, b1.predict(X[:64], raw_score=True),
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_submit_future_and_width_normalization(warm_server, model_pair):
+    b1, _, X = model_pair
+    req = warm_server.submit(X[:3])
+    np.testing.assert_allclose(req.value(), b1.predict(X[:3]),
+                               rtol=1e-12, atol=1e-12)
+    # a 1-D row is a single-row request
+    one = warm_server.predict(X[0])
+    np.testing.assert_allclose(one, b1.predict(X[:1]),
+                               rtol=1e-12, atol=1e-12)
+    # extra trailing columns are ignored exactly as the engine would
+    wide = np.concatenate([X[:5], np.ones((5, 3))], axis=1)
+    np.testing.assert_allclose(warm_server.predict(wide),
+                               b1.predict(X[:5]), rtol=1e-12, atol=1e-12)
+    with pytest.raises(ValueError):
+        warm_server.predict(X[:5, :2])   # fewer than model references
+
+
+def test_warmup_covers_bucket_set(warm_server):
+    from lightgbm_tpu.ops.predict import get_engine
+    ver = warm_server.registry.current()
+    info = ver.warmup_info
+    assert info is not None
+    expect = get_engine().bucket_set(ver.flat, 1024)
+    assert info["buckets"] == expect == [512, 1024]
+
+
+# ----------------------------------------------------------------------
+# ACCEPTANCE: zero steady-state compiles across 500+ mixed requests
+# ----------------------------------------------------------------------
+def test_steady_state_zero_compiles_500_mixed(warm_server, model_pair):
+    _, _, X = model_pair
+    warm_server.predict(X[:17])          # settle any lazy first-touch
+    base = counters_snapshot()
+    n_threads, per_thread = 8, 63        # 504 requests, mixed sizes
+    failures = []
+
+    def client(tid):
+        # disjoint per-thread ranges: all 504 sizes are DISTINCT and
+        # first-seen, so a per-size compile anywhere on the request
+        # path (the dynamic_slice regression this PR fixed in
+        # ops/predict.py) cannot hide behind the process-global jit
+        # cache; the mix spans both warmed buckets
+        for j in range(per_thread):
+            n = 1 + tid * per_thread * 2 + j * 2 + (tid + j) % 2
+            try:
+                out = warm_server.predict(X[:n])
+                if out.shape != (n,):
+                    failures.append(("shape", n, out.shape))
+            except Exception as exc:     # noqa: BLE001 - recorded
+                failures.append(("error", n, str(exc)))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    now = counters_snapshot()
+    assert not failures, failures[:5]
+    assert now.get("xla_compiles", 0) == base.get("xla_compiles", 0), \
+        "steady-state serving must not compile"
+    assert now.get("jax_traces", 0) == base.get("jax_traces", 0), \
+        "steady-state serving must not retrace"
+    assert now.get("serve_requests", 0) - base.get("serve_requests", 0) \
+        >= n_threads * per_thread
+
+
+# ----------------------------------------------------------------------
+# hot-swap: atomicity, version pinning, no compile growth
+# ----------------------------------------------------------------------
+def test_concurrent_hotswap_no_mixed_versions(model_pair):
+    b1, b2, X = model_pair
+    by_booster = {id(b1): b1.predict(X), id(b2): b2.predict(X)}
+    srv = Server(b1, config=ServeConfig(max_batch_rows=512,
+                                        batch_wait_ms=0.5,
+                                        timeout_ms=30000)).start()
+    try:
+        srv.predict(X[:8])
+        base = counters_snapshot()
+        stop = threading.Event()
+        failures = []
+
+        def client(tid):
+            r = np.random.RandomState(100 + tid)
+            while not stop.is_set():
+                lo = int(r.randint(0, len(X) - 64))
+                n = int(r.randint(1, 64))
+                req = srv.submit(X[lo:lo + n])
+                out = req.value()
+                exp = by_booster[id(req.version.booster)][lo:lo + n]
+                if not np.allclose(out, exp, rtol=1e-12, atol=1e-12):
+                    failures.append((tid, req.version.version, lo, n))
+                    stop.set()
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        n_swaps = 6
+        for i in range(n_swaps):
+            time.sleep(0.08)
+            srv.swap(booster=b2 if i % 2 == 0 else b1)
+        time.sleep(0.15)
+        stop.set()
+        for t in threads:
+            t.join()
+        now = counters_snapshot()
+        assert not failures, failures[:5]
+        counts = srv.stats()["requests"]
+        assert set(counts) == {"ok"}, counts   # zero failed in-flight
+        # same-layout swaps share compiled kernels: compile count is
+        # FLAT across all six swaps (satellite pin)
+        assert now.get("xla_compiles", 0) == base.get("xla_compiles", 0)
+        assert now.get("serve_swaps", 0) - \
+            base.get("serve_swaps", 0) == n_swaps
+        assert srv.version() == 1 + n_swaps
+    finally:
+        srv.stop()
+
+
+def test_version_pinned_against_booster_mutation():
+    """A published version scores from its own flattened snapshot:
+    mutating the booster AFTER publish (continue-training) must not
+    leak into requests admitted under the old version."""
+    bst, X = _train(n_rounds=3, rows=800, leaves=7)
+    before = bst.predict(X[:50])
+    srv = Server(bst, config=ServeConfig(max_batch_rows=512,
+                                         batch_wait_ms=0.0,
+                                         timeout_ms=30000)).start()
+    try:
+        bst.update()                     # grows the live model in place
+        assert not np.allclose(bst.predict(X[:50]), before)
+        out = srv.predict(X[:50])        # still v1: the snapshot
+        np.testing.assert_allclose(out, before, rtol=1e-12, atol=1e-12)
+        srv.swap(booster=bst)            # republish picks up the tree
+        np.testing.assert_allclose(srv.predict(X[:50]),
+                                   bst.predict(X[:50]),
+                                   rtol=1e-12, atol=1e-12)
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------------------------------
+# admission control (host-only unit tests: no jax, no dispatcher)
+# ----------------------------------------------------------------------
+def _req(rows=8, priority=0, deadline=None, version="v1", rid=0):
+    return Request(rid, np.zeros((rows, 4)), False, priority, deadline,
+                   version)
+
+
+def test_admission_backpressure_retry_after():
+    q = AdmissionQueue(max_rows=64, max_requests=4, batch_rows_hint=32)
+    for i in range(4):
+        q.admit(_req(rows=16, rid=i))
+    with pytest.raises(QueueSaturated) as exc:
+        q.admit(_req(rows=16, rid=9))
+    assert exc.value.retry_after_ms > 0
+    assert q.depth() == (4, 64)
+
+
+def test_admission_sheds_lowest_priority_first():
+    q = AdmissionQueue(max_rows=64, max_requests=8)
+    low = _req(rows=32, priority=0, rid=1)
+    mid = _req(rows=32, priority=1, rid=2)
+    q.admit(low)
+    q.admit(mid)
+    high = _req(rows=32, priority=2, rid=3)
+    shed = q.admit(high)                  # must evict `low`, not `mid`
+    assert shed == [low] and low.status == "shed"
+    with pytest.raises((QueueSaturated, Exception)):
+        low.value()
+    # equal priority never sheds: saturated again -> backpressure
+    with pytest.raises(QueueSaturated):
+        q.admit(_req(rows=32, priority=1, rid=4))
+
+
+def test_oversize_request_admitted_on_empty_queue():
+    q = AdmissionQueue(max_rows=64, max_requests=4)
+    q.admit(_req(rows=1000, rid=1))       # engine chunks it downstream
+    assert q.depth() == (1, 1000)
+
+
+def test_drain_batch_coalesces_and_times_out():
+    q = AdmissionQueue(max_rows=4096, max_requests=64)
+    stop = threading.Event()
+    expired = _req(rows=8, deadline=time.monotonic() - 1.0, rid=1)
+    a = _req(rows=8, rid=2)
+    b = _req(rows=8, rid=3)
+    other = _req(rows=8, version="v2", rid=4)
+    for r in (expired, a, b, other):
+        q.admit(r)
+    batch, timed = q.drain_batch(1024, 0.0, stop)
+    assert timed == [expired] and expired.status == "timeout"
+    assert batch == [a, b]                # v2 never mixes into a v1 batch
+    batch2, _ = q.drain_batch(1024, 0.0, stop)
+    assert batch2 == [other]
+
+
+def test_drain_batch_respects_row_cap():
+    q = AdmissionQueue(max_rows=4096, max_requests=64)
+    stop = threading.Event()
+    reqs = [_req(rows=300, rid=i) for i in range(5)]
+    for r in reqs:
+        q.admit(r)
+    batch, _ = q.drain_batch(1024, 0.0, stop)
+    assert batch == reqs[:3]              # 900 rows; a 4th would be 1200
+    assert sum(r.rows for r in batch) <= 1024
+
+
+def test_request_timeout_surfaces(model_pair):
+    b1, _, X = model_pair
+    srv = Server(b1, config=ServeConfig(max_batch_rows=512,
+                                        batch_wait_ms=0.0,
+                                        timeout_ms=30000)).start()
+    try:
+        req = srv.submit(X[:4], timeout_ms=0.001)  # expires in queue
+        with pytest.raises(RequestTimeout):
+            req.value()
+        assert req.status == "timeout"
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------------------------------
+# telemetry: per-request records + close-time rollups
+# ----------------------------------------------------------------------
+def test_serve_telemetry_records_and_rollups(model_pair, tmp_path):
+    b1, b2, X = model_pair
+    path = str(tmp_path / "serve.jsonl")
+    cfg = ServeConfig(max_batch_rows=512, batch_wait_ms=0.5,
+                      timeout_ms=30000, telemetry_file=path)
+    srv = Server(b1, config=cfg).start()
+    for n in (1, 32, 600):
+        srv.predict(X[:n])
+    srv.swap(booster=b2)
+    srv.predict(X[:8])
+    req = srv.submit(X[:4], timeout_ms=0.001)
+    req.wait(5.0)
+    srv.stop()
+
+    n_rec, errs = lint_file(path)         # triage_run.py --check gate
+    assert not errs, errs[:5]
+    recs = [json.loads(line) for line in open(path)]
+    assert all(not validate_record(r) for r in recs)
+    serves = [r for r in recs if r["type"] == "serve"]
+    oks = [r for r in serves if r["status"] == "ok"]
+    assert len(oks) == 4
+    for r in oks:
+        assert {"queue_ms", "dispatch_ms", "batch_rows", "bucket_rows",
+                "occupancy", "version"} <= set(r)
+        assert 0 < r["occupancy"] <= 1.0
+    assert [r for r in serves if r["status"] == "swap"]
+    assert [r for r in serves if r["status"] == "timeout"]
+    end = [r for r in recs if r["type"] == "run_end"][-1]
+    s = end["summary"]
+    assert s["serve_requests"] == 5       # 4 ok + 1 timeout
+    assert s["serve_timeout"] == 1
+    assert s["serve_swaps"] == 1
+    assert s["serve_total_ms_p50"] > 0
+    assert s["serve_total_ms_p99"] >= s["serve_total_ms_p50"]
+    assert 0 < s["serve_mean_occupancy"] <= 1.0
+
+
+# ----------------------------------------------------------------------
+# HTTP front
+# ----------------------------------------------------------------------
+def _post(port, path, obj, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        r = urllib.request.urlopen(req, timeout=timeout)
+        return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_http_endpoint_predict_swap_health(model_pair):
+    from lightgbm_tpu.serve.http import serve_http
+    b1, b2, X = model_pair
+    srv = Server(b1, config=ServeConfig(max_batch_rows=512,
+                                        batch_wait_ms=0.5,
+                                        timeout_ms=30000, port=0))
+    httpd, _ = serve_http(srv, port=0, background=True)
+    try:
+        port = httpd.server_address[1]
+        st, out = _post(port, "/predict", {"rows": X[:5].tolist()})
+        assert st == 200 and out["version"] == 1
+        np.testing.assert_allclose(out["predictions"], b1.predict(X[:5]),
+                                   rtol=1e-10, atol=1e-10)
+        st, out = _post(port, "/predict", {"rows": "garbage"})
+        assert st == 400
+        st, out = _post(port, "/swap",
+                        {"model_str": b2.model_to_string()})
+        assert st == 200 and out["version"] == 2
+        st, out = _post(port, "/predict",
+                        {"rows": X[:5].tolist(), "raw": True})
+        assert st == 200 and out["version"] == 2
+        np.testing.assert_allclose(
+            out["predictions"], b2.predict(X[:5], raw_score=True),
+            rtol=1e-10, atol=1e-10)
+        health = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+        assert health["ok"] and health["version"] == 2
+        stats = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats", timeout=10).read())
+        assert stats["requests"]["ok"] >= 2
+        assert "engine_cache" in stats
+    finally:
+        httpd.shutdown()
+        srv.stop()
+
+
+# ----------------------------------------------------------------------
+# satellites: engine LRU capacity + bounded prefix cache
+# ----------------------------------------------------------------------
+def test_predict_cache_slots_and_booster_cache_info(model_pair):
+    from lightgbm_tpu.ops.predict import get_engine
+    b1, _, X = model_pair
+    eng = get_engine()
+    old = eng.cache_size
+    try:
+        info = b1.predict_cache_info()
+        assert {"hits", "misses", "evictions", "entries", "capacity",
+                "traces"} <= set(info)
+        b1._gbdt.config.predict_cache_slots = 3
+        b1.predict(X[:16])
+        assert eng.cache_size == 3
+        assert len(eng._cache) <= 3
+        assert b1.predict_cache_info()["capacity"] == 3
+    finally:
+        b1._gbdt.config.predict_cache_slots = old
+        eng.set_cache_size(old)
+
+
+def test_predict_cache_slots_param_registered():
+    from lightgbm_tpu.config import Config
+    cfg = Config({"predict_cache_slots": 5})
+    assert cfg.predict_cache_slots == 5
+    assert Config({"predict_cache_size": 7}).predict_cache_slots == 7
+
+
+def test_prefix_cache_bounded_and_threadsafe():
+    from lightgbm_tpu.ops import predict as P
+    with P._PREFIX_LOCK:
+        P._PREFIX_CACHE.clear()
+    keys = [(w, bits) for bits in (32, 64) for w in (1, 2, 3, 4, 5, 6)]
+    errs = []
+
+    def hammer(tid):
+        r = np.random.RandomState(tid)
+        for _ in range(200):
+            W, wbits = keys[int(r.randint(len(keys)))]
+            tab = P._prefix_table(W, wbits)
+            if tab.shape != (W * wbits + 1, W):
+                errs.append((W, wbits, tab.shape))
+
+    threads = [threading.Thread(target=hammer, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert len(P._PREFIX_CACHE) <= P._PREFIX_CACHE_SLOTS
+    # correctness after all the churn: bit j of prefix[j+1] is set
+    tab = P._prefix_table(2, 32)
+    assert tab[1, 0] == 1 and tab[33, 1] == 1
+    assert not tab.flags.writeable
+
+
+def test_serve_config_from_params_and_validation():
+    cfg = ServeConfig.from_params({"serve_max_batch_rows": 2048,
+                                   "serve_batch_wait_ms": 5,
+                                   "serve_queue_rows": 65536,
+                                   "serve_port": 0})
+    assert cfg.max_batch_rows == 2048 and cfg.batch_wait_ms == 5.0
+    cfg.validate()
+    bad = ServeConfig(max_batch_rows=0)
+    with pytest.raises(ValueError):
+        bad.validate()
+    with pytest.raises(ValueError):
+        ServeConfig(queue_rows=10, max_batch_rows=100).validate()

@@ -1,0 +1,229 @@
+"""Load generator for the online serving endpoint (serve/http.py).
+
+Drives mixed row-count predict requests from concurrent clients,
+optionally fires one mid-run hot-swap, and prints a JSON summary line
+(latency percentiles, throughput, status counts).  Two modes:
+
+    # drive an already-running server
+    python tools/loadgen_serve.py --url http://127.0.0.1:9595
+
+    # CI smoke: train two tiny model versions, start the HTTP server
+    # in-process on an ephemeral port (telemetry JSONL for
+    # triage_run.py --check), drive it, assert zero failed requests
+    python tools/loadgen_serve.py --selftest --requests 200 \
+        --telemetry serve_telemetry.jsonl --out serve_loadgen.json
+
+Exit code is non-zero when any request fails with something other
+than backpressure (HTTP 429 is the server doing its job under load —
+the client retries after the hinted delay), or when the mid-run
+hot-swap drops an in-flight request.
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _post(url, path, obj, timeout=60):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        r = urllib.request.urlopen(req, timeout=timeout)
+        return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read())
+        except ValueError:
+            return e.code, {"error": "unparseable body"}
+    except (urllib.error.URLError, OSError) as e:
+        # transport failure (refused/reset/timeout) must be COUNTED,
+        # not kill the client thread — a wedged server has to fail
+        # the run, not pass it with fewer requests
+        return 599, {"error": f"transport: {e}"}
+
+
+def _get(url, path, timeout=30):
+    r = urllib.request.urlopen(url + path, timeout=timeout)
+    return json.loads(r.read())
+
+
+from lightgbm_tpu.utils.telemetry import (  # noqa: E402 - jax-free
+    percentile as _percentile)
+
+
+def drive(url, n_requests, n_threads, rows_max, n_features, seed=0,
+          swap_model_file=None, priority_mix=False):
+    """Issue ``n_requests`` mixed-size requests from ``n_threads``
+    clients; fire one hot-swap halfway through when
+    ``swap_model_file`` is given.  Returns the summary dict."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    lock = threading.Lock()
+    lat, counts, errors = [], {}, []
+    issued = [0]
+    swap_at = n_requests // 2
+    swap_result = {}
+
+    def bump(key):
+        with lock:
+            counts[key] = counts.get(key, 0) + 1
+
+    def client(tid):
+        r = np.random.RandomState(1000 + tid)
+        while True:
+            with lock:
+                if issued[0] >= n_requests:
+                    return
+                issued[0] += 1
+                i = issued[0]
+            if swap_model_file and i == swap_at:
+                t0 = time.monotonic()
+                st, out = _post(url, "/swap",
+                                {"model_file": swap_model_file})
+                swap_result.update(
+                    status=st, version=out.get("version"),
+                    swap_ms=round((time.monotonic() - t0) * 1e3, 1))
+                continue
+            n = int(r.randint(1, rows_max + 1))
+            body = {"rows": r.randn(n, n_features).tolist()}
+            if priority_mix:
+                body["priority"] = int(r.randint(0, 3))
+            t0 = time.monotonic()
+            st, out = _post(url, "/predict", body)
+            ms = (time.monotonic() - t0) * 1e3
+            if st == 200:
+                bump("ok")
+                if len(out.get("predictions", ())) != n:
+                    errors.append(f"short response: {n} rows -> "
+                                  f"{len(out.get('predictions', ()))}")
+                with lock:
+                    lat.append(ms)
+            elif st == 429:
+                bump("rejected")
+                time.sleep(max(float(out.get("retry_after_ms", 10)),
+                               1.0) / 1e3)
+            elif st in (503, 504):
+                bump("shed" if st == 503 else "timeout")
+            else:
+                bump(f"http_{st}")
+                errors.append(f"HTTP {st}: "
+                              f"{str(out.get('error', ''))[:120]}")
+
+    t_start = time.monotonic()
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.monotonic() - t_start
+    lat.sort()
+    out = {
+        "requests": sum(v for k, v in counts.items()),
+        "counts": counts,
+        "wall_s": round(wall_s, 3),
+        "req_per_s": round(counts.get("ok", 0) / max(wall_s, 1e-9), 1),
+        "p50_ms": round(_percentile(lat, 0.50), 2),
+        "p95_ms": round(_percentile(lat, 0.95), 2),
+        "p99_ms": round(_percentile(lat, 0.99), 2),
+        "errors": errors[:10],
+    }
+    if swap_result:
+        out["swap"] = swap_result
+    return out
+
+
+def selftest(args):
+    """Train v1/v2, serve in-process, drive through real HTTP."""
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serve import ServeConfig, Server
+    from lightgbm_tpu.serve.http import serve_http
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(2000, 8)
+    y = (X[:, 0] + 0.4 * rng.randn(2000) > 0).astype(float)
+
+    def train(rounds, seed):
+        d = lgb.Dataset(X, label=y, params={"objective": "binary",
+                                            "verbose": -1})
+        return lgb.train({"objective": "binary", "num_leaves": 15,
+                          "verbose": -1, "metric": "None",
+                          "seed": seed}, d, num_boost_round=rounds)
+
+    b1, b2 = train(4, 1), train(7, 2)
+    swap_file = os.path.abspath("loadgen_swap_model.txt")
+    b2.save_model(swap_file)
+    cfg = ServeConfig(max_batch_rows=512, batch_wait_ms=1.0,
+                      timeout_ms=30000, port=0,
+                      telemetry_file=args.telemetry or "")
+    server = Server(b1, config=cfg)
+    httpd, _ = serve_http(server, port=0, background=True)
+    url = "http://127.0.0.1:%d" % httpd.server_address[1]
+    try:
+        res = drive(url, args.requests, args.threads, args.rows_max,
+                    n_features=8, swap_model_file=swap_file)
+        res["stats"] = _get(url, "/stats")
+    finally:
+        httpd.shutdown()
+        server.stop()
+        try:
+            os.remove(swap_file)
+        except OSError:
+            pass
+    res["mode"] = "selftest"
+    ok = (not res["errors"]
+          and res["counts"].get("ok", 0) > 0
+          and res.get("swap", {}).get("status") == 200
+          and res["counts"].get("shed", 0) == 0
+          and res["counts"].get("timeout", 0) == 0)
+    res["passed"] = ok
+    return res, 0 if ok else 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--url", help="serve endpoint to drive")
+    ap.add_argument("--selftest", action="store_true",
+                    help="train + serve in-process (CI smoke)")
+    ap.add_argument("--requests", type=int, default=300)
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--rows-max", type=int, default=600)
+    ap.add_argument("--features", type=int, default=8,
+                    help="feature count for --url mode payloads")
+    ap.add_argument("--swap-model", help="model file to hot-swap in "
+                                         "mid-run (--url mode)")
+    ap.add_argument("--telemetry", default="",
+                    help="selftest: server telemetry JSONL path")
+    ap.add_argument("--out", help="also write the summary JSON here")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        res, rc = selftest(args)
+    elif args.url:
+        res = drive(args.url.rstrip("/"), args.requests, args.threads,
+                    args.rows_max, args.features,
+                    swap_model_file=args.swap_model)
+        res["mode"] = "url"
+        rc = 0 if not res["errors"] and res["counts"].get("ok") else 1
+        res["passed"] = rc == 0
+    else:
+        ap.error("need --url or --selftest")
+    print(json.dumps(res), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(res, f, indent=1, sort_keys=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

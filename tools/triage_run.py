@@ -143,8 +143,28 @@ def scan_anomalies(records):
         if cache.get("evictions", 0) > 0:
             out.append(("MED", f"predict compile-cache thrash: "
                                f"{cache['evictions']} evictions "
-                               f"(cache_size too small for the serving "
-                               f"shape mix)"))
+                               f"(predict_cache_slots too small for "
+                               f"the serving shape mix)"))
+    serves = [r for r in records if r.get("type") == "serve"
+              and r.get("status") != "swap"]
+    if serves:
+        n = len(serves)
+        bad = sum(1 for r in serves
+                  if r.get("status") in ("shed", "timeout", "rejected"))
+        if bad and bad / n > 0.05:
+            out.append(("MED", f"serving under pressure: {bad}/{n} "
+                               f"requests shed/timed-out/rejected — "
+                               f"raise serve_queue_rows or add "
+                               f"serve_workers, or the clients must "
+                               f"honor retry-after"))
+        occ = [r["occupancy"] for r in serves
+               if r.get("status") == "ok" and "occupancy" in r]
+        if occ and len(occ) >= 20 and sum(occ) / len(occ) < 0.05:
+            out.append(("MED", f"serve batch occupancy "
+                               f"{sum(occ) / len(occ):.3f} — batches "
+                               f"are nearly all padding; shrink "
+                               f"serve_max_batch_rows or raise "
+                               f"serve_batch_wait_ms"))
     for r in records:
         if r.get("type") == "run_start" and r.get("backend_degraded"):
             out.append(("HIGH", "backend identity unavailable at "
@@ -206,6 +226,18 @@ def triage(records, baseline=None):
             lines.append(f"collectives : "
                          f"{s['collective_bytes'] / 1e6:.1f} MB moved "
                          f"(estimate)")
+        if s.get("serve_requests"):
+            lines.append(
+                f"serve       : {s['serve_requests']:.0f} requests "
+                f"({s.get('serve_rows', 0):.0f} rows), p50/p95/p99 "
+                f"{s.get('serve_total_ms_p50', 0):.1f}/"
+                f"{s.get('serve_total_ms_p95', 0):.1f}/"
+                f"{s.get('serve_total_ms_p99', 0):.1f} ms, "
+                f"{s.get('serve_shed', 0):.0f} shed / "
+                f"{s.get('serve_timeout', 0):.0f} timeout / "
+                f"{s.get('serve_rejected', 0):.0f} rejected, "
+                f"occupancy {s.get('serve_mean_occupancy', 0):.2f}, "
+                f"{s.get('serve_swaps', 0):.0f} swaps")
     anomalies = scan_anomalies(records)
     lines.append("anomalies   : " + ("none" if not anomalies else ""))
     for sev, msg in anomalies:
