@@ -434,6 +434,115 @@ def serve_only():
     return 0
 
 
+def ckpt_only():
+    """Fast path (``python bench.py --ckpt-only``): measure the
+    checkpoint subsystem's cost envelope on the CPU backend and write
+    BENCH_ckpt_cpu.json — per-snapshot save wall/bytes, load/restore
+    time, the resume path's warmup compiles, and the save overhead as
+    a fraction of train wall time (triage_run.py flags runs past 5%).
+    One cell per training path (sequential, fused super-steps), since
+    a mid-fused-block save exercises the alignment replay."""
+    import datetime
+    import tempfile
+
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.ckpt import CheckpointManager
+    from lightgbm_tpu.utils import telemetry as _telemetry
+    _telemetry.install_jax_hooks()
+
+    n_rows = int(os.environ.get("BENCH_CKPT_ROWS", "20000"))
+    n_features = 28
+    rounds = int(os.environ.get("BENCH_CKPT_ROUNDS", "40"))
+    freq = 10
+    rng = np.random.RandomState(0)
+    X = rng.randn(n_rows, n_features).astype(np.float32)
+    w = rng.randn(n_features).astype(np.float32)
+    y = (1.0 / (1.0 + np.exp(-(X @ w) * 0.5)) >
+         rng.random_sample(n_rows)).astype(np.float32)
+
+    def run_cell(label, extra):
+        cell = {"label": label}
+        with tempfile.TemporaryDirectory() as td:
+            ck = os.path.join(td, "ck")
+            tele = os.path.join(td, "tele.jsonl")
+            p = {"objective": "binary", "num_leaves": 31,
+                 "verbose": -1, "metric": "None",
+                 "num_iterations": rounds, "checkpoint_dir": ck,
+                 "snapshot_freq": freq, "keep_last_n": 3,
+                 "telemetry_file": tele}
+            p.update(extra)
+            d = lgb.Dataset(X, label=y, params=p)
+            t0 = time.time()
+            bst = lgb.train(p, d, verbose_eval=False)
+            train_wall = time.time() - t0
+            bst._gbdt._telemetry.close(log=False)
+            recs = _telemetry.read_records(tele)
+            saves = [r for r in recs if r.get("type") == "checkpoint"
+                     and r.get("event") == "save"]
+            save_ms = [float(r["duration_ms"]) for r in saves]
+            train_ms = sum(float(r.get("duration_ms", 0.0))
+                           for r in recs
+                           if r.get("type") in ("iteration",
+                                                "superstep"))
+            cell.update({
+                "saves": len(saves),
+                "save_ms_mean": round(sum(save_ms) /
+                                      max(len(save_ms), 1), 2),
+                "save_ms_max": round(max(save_ms), 2) if save_ms
+                else None,
+                "ckpt_bytes": int(saves[-1]["bytes"]) if saves else 0,
+                "train_wall_s": round(train_wall, 3),
+                "save_overhead_pct": round(
+                    100.0 * sum(save_ms) / max(train_ms, 1e-9), 2),
+            })
+            mgr = CheckpointManager(ck)
+            t0 = time.time()
+            loaded = mgr.load_latest()
+            cell["load_ms"] = round((time.time() - t0) * 1e3, 2)
+            assert loaded is not None
+            # resume warmup: in-process continuation (new Booster +
+            # restore + 5 iterations).  Same-shape programs hit the
+            # process executable cache, so the compile count here is
+            # the RESUME-SPECIFIC delta; a fresh replacement machine
+            # additionally pays the normal first-run compile bill
+            base = _telemetry.counters_snapshot()
+            t0 = time.time()
+            p2 = dict(p, num_iterations=rounds + 5)
+            p2.pop("telemetry_file")
+            d2 = lgb.Dataset(X, label=y, params=p2)
+            lgb.train(p2, d2, verbose_eval=False, resume_from="auto")
+            now = _telemetry.counters_snapshot()
+            cell["resume_warmup_s"] = round(time.time() - t0, 3)
+            cell["resume_xla_compiles"] = int(
+                now.get("xla_compiles", 0) - base.get("xla_compiles", 0))
+        print(json.dumps({"ckpt_cell": label, **cell}), flush=True)
+        return cell
+
+    cells = [run_cell("sequential", {}),
+             run_cell("fused_iters=4", {"fused_iters": 4})]
+    out = {
+        "metric": "checkpoint_overhead_cpu",
+        "unit": "ms",
+        "backend": "cpu",
+        "date": datetime.date.today().isoformat(),
+        "source": "JAX_PLATFORMS=cpu python bench.py --ckpt-only",
+        "env": "2-core CPU container",
+        "forest": (f"31-leaf binary forest, {n_rows} x {n_features} "
+                   f"train matrix, {rounds} iterations"),
+        "config": {"rows": n_rows, "features": n_features,
+                   "rounds": rounds, "snapshot_freq": freq,
+                   "keep_last_n": 3},
+        "cells": cells,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_ckpt_cpu.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    print(json.dumps({"wrote": os.path.basename(path)}), flush=True)
+    return 0
+
+
 def main():
     t_start = time.time()
     budget = float(os.environ.get("BENCH_TIME_BUDGET_S", "240"))
@@ -1011,4 +1120,6 @@ def main():
 if __name__ == "__main__":
     if "--serve-only" in sys.argv:
         sys.exit(serve_only())
+    if "--ckpt-only" in sys.argv:
+        sys.exit(ckpt_only())
     sys.exit(main())

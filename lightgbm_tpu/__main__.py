@@ -96,7 +96,10 @@ def _task_train(params: Dict[str, str], config: Config) -> None:
             valid_names.append(os.path.basename(path))
 
     callbacks = []
-    if config.snapshot_freq > 0:
+    if config.snapshot_freq > 0 and not config.checkpoint_dir:
+        # reference save_period behavior: model-text snapshots.  With
+        # checkpoint_dir set, snapshot_freq instead drives the full
+        # resumable checkpoints inside engine.train (ckpt/manager.py)
         freq, out_path = config.snapshot_freq, config.output_model
 
         def _snapshot(env):
@@ -208,9 +211,16 @@ def _task_serve(params: Dict[str, str], config: Config) -> None:
     from .serve.http import serve_http
 
     if not config.input_model:
-        Log.fatal("No model file: set input_model=<file>")
-    booster = Booster(model_file=config.input_model)
-    server = Server(booster, config=ServeConfig.from_params(config))
+        Log.fatal("No model file: set input_model=<file> (a model "
+                  "file, a ckpt_* checkpoint directory, or a "
+                  "checkpoint root)")
+    server = Server(config=ServeConfig.from_params(config))
+    if os.path.isdir(config.input_model):
+        # serve straight from a training checkpoint directory/root:
+        # manifest-validated, newest-valid-wins (ckpt/manager.py)
+        server.registry.publish_from_checkpoint(config.input_model)
+    else:
+        server.registry.publish(Booster(model_file=config.input_model))
     try:
         serve_http(server)
     finally:

@@ -670,9 +670,11 @@ class Booster:
     def save_model(self, filename: str,
                    num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> "Booster":
-        from .utils.file_io import open_output
-        with open_output(str(filename)) as f:
-            f.write(self.model_to_string(num_iteration, start_iteration))
+        # atomic for local paths (ckpt writer: temp + fsync + rename) —
+        # a crash mid-save never leaves a truncated model file
+        model_io.write_model_file(
+            str(filename),
+            self.model_to_string(num_iteration, start_iteration))
         return self
 
     def dump_model(self, num_iteration: Optional[int] = None,

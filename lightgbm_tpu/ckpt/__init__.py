@@ -1,0 +1,15 @@
+"""Fault-tolerant checkpoint/resume subsystem (``docs/Checkpointing.md``).
+
+Preemption-safe training: :class:`CheckpointManager` writes atomic,
+schema-versioned, content-hashed snapshots of the COMPLETE training
+state (tree tables, score carries, host PRNG streams, sampling-cycle
+position, early-stopping state), and ``engine.train`` resumes from
+them to a bit-identical continuation of the uninterrupted run —
+pinned by ``tests/test_checkpoint.py`` across objectives x sampling
+modes x fused/unfused super-step paths.
+"""
+from .atomic import atomic_write_bytes, atomic_write_text
+from .manager import CheckpointError, CheckpointManager, SCHEMA_VERSION
+
+__all__ = ["CheckpointManager", "CheckpointError", "SCHEMA_VERSION",
+           "atomic_write_bytes", "atomic_write_text"]

@@ -1,0 +1,132 @@
+"""Atomic durable file writes + the checkpoint fault-injection hook.
+
+The torn-write discipline every checkpoint (and model) file in this
+package follows: write to a temporary sibling in the SAME directory,
+``fsync`` the file, ``os.replace`` onto the final name, then ``fsync``
+the parent directory so the rename itself survives a crash.  A reader
+therefore only ever sees either the complete old bytes or the complete
+new bytes — never a prefix.  (The reference's ``SaveModelToFile`` has
+no such contract: a crash mid-save leaves a truncated model file.)
+
+Fault injection (tests / CI only) is env-gated so the recovery path is
+provable, not just plausible:
+
+- ``LTPU_CKPT_FAULT=crash_blob``      — die mid-blob-write (partial
+  temp file, no manifest): the checkpoint directory never finalizes.
+- ``LTPU_CKPT_FAULT=crash_manifest``  — die after the blobs but before
+  the manifest: same outcome, later in the stream.
+- ``LTPU_CKPT_FAULT=truncate_blob``   — finalize normally, then tear
+  bytes off a blob in the FINAL directory (simulating lost pages):
+  the loader must detect the size/hash mismatch and fall back.
+- ``LTPU_CKPT_FAULT_AT=<n>``          — trigger on the n-th save of
+  the process (1-based, default 1); other saves run clean.
+
+``InjectedFault`` deliberately subclasses ``BaseException``: the save
+path's ``except Exception`` cleanup must NOT swallow it (a real
+SIGKILL wouldn't run cleanup either).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import tempfile
+
+__all__ = ["atomic_write_bytes", "atomic_write_text", "fsync_dir",
+           "sha256_file", "InjectedFault", "fault_armed",
+           "consume_fault", "reset_fault_counter"]
+
+
+class InjectedFault(BaseException):
+    """Simulated mid-write crash (env-gated, tests only)."""
+
+
+_fault_saves_seen = 0
+
+
+def reset_fault_counter() -> None:
+    global _fault_saves_seen
+    _fault_saves_seen = 0
+
+
+def fault_armed() -> str:
+    """The fault mode armed for the CURRENT save, or ''.  Call once
+    per save attempt — the call advances the save ordinal that
+    ``LTPU_CKPT_FAULT_AT`` matches against."""
+    global _fault_saves_seen
+    mode = os.environ.get("LTPU_CKPT_FAULT", "")
+    if not mode:
+        return ""
+    _fault_saves_seen += 1
+    at = int(os.environ.get("LTPU_CKPT_FAULT_AT", "1") or 1)
+    return mode if _fault_saves_seen == at else ""
+
+
+def consume_fault(mode: str, point: str, path: str) -> None:
+    """Fire the armed fault when the writer reaches ``point``."""
+    if mode == "crash_blob" and point == "blob":
+        with open(path, "wb") as f:
+            f.write(b"\x00" * 7)   # the torn partial write
+        raise InjectedFault(f"injected crash mid-blob at {path}")
+    if mode == "crash_manifest" and point == "manifest":
+        raise InjectedFault(f"injected crash before manifest at {path}")
+    if mode == "truncate_blob" and point == "post_finalize":
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+
+
+def fsync_dir(path: str) -> None:
+    """Durably record directory-entry changes (renames, creates)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return   # e.g. platforms without directory fds
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> int:
+    """temp + fsync + rename + parent fsync; returns bytes written."""
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(prefix=".tmp_" + os.path.basename(path),
+                               dir=directory)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        # mkstemp creates 0600; a model file must keep the perms a
+        # plain open() would have produced (existing mode, else
+        # umask-derived) or cross-user readers lose access on reload
+        try:
+            mode = os.stat(path).st_mode & 0o777
+        except OSError:
+            umask = os.umask(0)
+            os.umask(umask)
+            mode = 0o666 & ~umask
+        os.chmod(tmp, mode)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    fsync_dir(directory)
+    return len(data)
+
+
+def atomic_write_text(path: str, text: str) -> int:
+    return atomic_write_bytes(path, text.encode("utf-8"))
+
+
+def sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()

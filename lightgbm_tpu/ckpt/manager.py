@@ -1,0 +1,348 @@
+"""Atomic, schema-versioned, content-hashed training checkpoints.
+
+Directory layout (one directory per checkpoint under the checkpoint
+root, named by the iteration it is aligned to)::
+
+    <checkpoint_dir>/
+      ckpt_00000040/
+        state.npz       # bit-exact training state (ckpt/state.py)
+        model.txt       # reference-format model text (serving, CLI)
+        extra.json      # RNG scalars, eval history, best-score state
+        manifest.json   # written LAST: schema + blob sizes + sha256
+      ckpt_00000080/ ...
+      .tmp_*            # torn writes land here; loaders ignore them
+
+Write protocol: every blob is written into a ``.tmp_*`` staging
+directory and fsynced; the manifest — the checkpoint's commit record —
+is written last; then ONE ``os.replace`` publishes the directory and
+the parent is fsynced.  A crash at any point leaves either no new
+directory or a complete one, never a half-checkpoint under a final
+name.
+
+Read protocol: candidates are scanned newest-first; a candidate is
+accepted only if its manifest parses, carries the supported schema,
+and every blob matches its manifested size AND sha256.  Anything else
+(truncated manifest, torn blob, bit rot) is rejected with a telemetry
+``checkpoint``/``fallback`` record and the scan falls back to the next
+older snapshot — the acceptance criterion "an injected mid-write crash
+never leaves an unloadable checkpoint directory".
+
+Retention: ``keep_last_n`` newest VALID checkpoints survive each save;
+older ones (and stale staging directories) are pruned.
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import shutil
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.log import Log
+from ..utils import telemetry as _telemetry
+from . import atomic
+
+__all__ = ["CheckpointError", "CheckpointManager", "SCHEMA_VERSION"]
+
+SCHEMA_VERSION = 1
+_MANIFEST = "manifest.json"
+_STATE = "state.npz"
+_MODEL = "model.txt"
+_EXTRA = "extra.json"
+_NAME_RE = re.compile(r"^ckpt_(\d{8})$")
+
+
+class CheckpointError(Exception):
+    """A checkpoint directory failed validation or restore."""
+
+
+def _fsync_write(path: str, data: bytes) -> int:
+    """Plain write + fsync (inside a staging dir — the atomicity comes
+    from the directory rename, not per-file renames)."""
+    with open(path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    return len(data)
+
+
+class CheckpointManager:
+    """Writes/loads training checkpoints under one root directory."""
+
+    def __init__(self, directory: str, keep_last_n: int = 2,
+                 recorder=None):
+        self.directory = str(directory)
+        self.keep_last_n = max(int(keep_last_n), 1)
+        self.recorder = recorder
+        os.makedirs(self.directory, exist_ok=True)
+
+    # ------------------------------------------------------------------
+    # telemetry
+    # ------------------------------------------------------------------
+    def _emit(self, event: str, **fields) -> None:
+        _telemetry.counters.incr(f"ckpt_{event}s")
+        rec = self.recorder or _telemetry.get_recorder()
+        if rec is not None:
+            fields.setdefault("duration_ms", 0.0)
+            rec.emit("checkpoint", event=event, **fields)
+
+    # ------------------------------------------------------------------
+    # discovery / validation
+    # ------------------------------------------------------------------
+    @staticmethod
+    def is_checkpoint_dir(path: str) -> bool:
+        return os.path.isfile(os.path.join(path, _MANIFEST))
+
+    def candidates(self) -> List[Tuple[int, str]]:
+        """(iteration, path) of finalized checkpoints, oldest first."""
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        for name in names:
+            m = _NAME_RE.match(name)
+            if m:
+                out.append((int(m.group(1)),
+                            os.path.join(self.directory, name)))
+        return sorted(out)
+
+    @staticmethod
+    def validate(path: str) -> List[str]:
+        """Problems with one checkpoint directory (empty = valid)."""
+        mpath = os.path.join(path, _MANIFEST)
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except OSError as exc:
+            return [f"manifest unreadable: {exc}"]
+        except ValueError as exc:
+            return [f"manifest corrupt/truncated: {exc}"]
+        errs: List[str] = []
+        if not isinstance(manifest, dict):
+            return ["manifest is not a JSON object"]
+        if manifest.get("schema") != SCHEMA_VERSION:
+            errs.append(f"unsupported schema {manifest.get('schema')!r}")
+        blobs = manifest.get("blobs")
+        if not isinstance(blobs, dict) or not blobs:
+            return errs + ["manifest lists no blobs"]
+        for name, info in blobs.items():
+            bpath = os.path.join(path, name)
+            if not os.path.isfile(bpath):
+                errs.append(f"blob {name} missing")
+                continue
+            size = os.path.getsize(bpath)
+            if size != int(info.get("bytes", -1)):
+                errs.append(f"blob {name} truncated: {size} bytes vs "
+                            f"{info.get('bytes')} manifested")
+                continue
+            digest = atomic.sha256_file(bpath)
+            if digest != info.get("sha256"):
+                errs.append(f"blob {name} content hash mismatch")
+        return errs
+
+    # ------------------------------------------------------------------
+    # save
+    # ------------------------------------------------------------------
+    def save(self, booster, reason: str = "periodic",
+             eval_history: Optional[List] = None) -> str:
+        """Write one checkpoint aligned to the booster's last COMPLETED
+        iteration (mid-fused-block state is aligned by the snapshot
+        capture).  Returns the finalized checkpoint path."""
+        from . import state as state_mod
+        t0 = time.perf_counter()
+        fault = atomic.fault_armed()
+        snap = booster._gbdt.training_snapshot()
+        arrays, meta = state_mod.snapshot_to_blobs(snap)
+        iteration = int(meta["iter"])
+        g = booster._gbdt
+        meta.update({
+            "schema": SCHEMA_VERSION,
+            "reason": str(reason),
+            "created": round(time.time(), 3),
+            "num_class": int(g.num_class),
+            "num_tree_per_iteration": int(g.num_tree_per_iteration),
+            "num_data": int(g.num_data) if g.train_set is not None else 0,
+            "objective": str(g.config.objective),
+            "boosting": str(g.config.boosting),
+            "best_iteration": int(booster.best_iteration),
+            "best_score": booster.best_score,
+            "eval_history": eval_history or [],
+        })
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        state_bytes = buf.getvalue()
+        model_text = booster.model_to_string(num_iteration=-1)
+        extra_bytes = json.dumps(meta, sort_keys=True).encode("utf-8")
+
+        final = os.path.join(self.directory, f"ckpt_{iteration:08d}")
+        staging = os.path.join(self.directory,
+                               f".tmp_ckpt_{iteration:08d}_{os.getpid()}")
+        if os.path.isdir(staging):
+            shutil.rmtree(staging)
+        os.makedirs(staging)
+        blobs: Dict[str, Dict[str, Any]] = {}
+        for name, data in ((_STATE, state_bytes),
+                           (_MODEL, model_text.encode("utf-8")),
+                           (_EXTRA, extra_bytes)):
+            bpath = os.path.join(staging, name)
+            atomic.consume_fault(fault, "blob", bpath)
+            n = _fsync_write(bpath, data)
+            blobs[name] = {"bytes": n, "sha256": atomic.sha256_file(bpath)}
+        atomic.consume_fault(fault, "manifest",
+                             os.path.join(staging, _MANIFEST))
+        manifest = {"schema": SCHEMA_VERSION, "iteration": iteration,
+                    "reason": str(reason), "created": meta["created"],
+                    "blobs": blobs}
+        _fsync_write(os.path.join(staging, _MANIFEST),
+                     json.dumps(manifest, sort_keys=True,
+                                indent=1).encode("utf-8"))
+        # the staging DIRECTORY's entries must be durable before the
+        # publishing rename, or a power loss can surface a final-named
+        # dir with missing blob entries
+        atomic.fsync_dir(staging)
+        if os.path.isdir(final):
+            # a re-save of the same boundary (resume overlap): the new
+            # bytes win; the brief .old window is covered by the OTHER
+            # retained checkpoints
+            old = final + ".old"
+            if os.path.isdir(old):
+                shutil.rmtree(old)
+            os.replace(final, old)
+            os.replace(staging, final)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.replace(staging, final)
+        atomic.fsync_dir(self.directory)
+        atomic.consume_fault(fault, "post_finalize",
+                             os.path.join(final, _STATE))
+        self._retain(keep=final)
+        total = sum(b["bytes"] for b in blobs.values())
+        dur = (time.perf_counter() - t0) * 1e3
+        _telemetry.counters.incr("ckpt_save_bytes", total)
+        self._emit("save", duration_ms=round(dur, 3), iter=iteration,
+                   reason=str(reason), bytes=total,
+                   path=os.path.basename(final))
+        Log.info("checkpoint: saved iteration %d (%s, %.1f KB, %.0f ms)"
+                 " -> %s", iteration, reason, total / 1e3, dur, final)
+        return final
+
+    def _retain(self, keep: str) -> None:
+        cands = self.candidates()
+        if len(cands) > self.keep_last_n:
+            for _, path in cands[:-self.keep_last_n]:
+                if os.path.abspath(path) != os.path.abspath(keep):
+                    shutil.rmtree(path, ignore_errors=True)
+        # stale staging dirs from crashed writers, and .old dirs a
+        # crash mid re-save-swap left behind
+        for name in os.listdir(self.directory):
+            if name.startswith(".tmp_ckpt_") or \
+                    (name.startswith("ckpt_") and name.endswith(".old")):
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
+
+    # ------------------------------------------------------------------
+    # load
+    # ------------------------------------------------------------------
+    def load_dir(self, path: str) -> Dict[str, Any]:
+        """Validate + parse ONE checkpoint directory into
+        ``{"path", "meta", "snapshot"}``; raises :class:`CheckpointError`
+        on any validation failure."""
+        from . import state as state_mod
+        t0 = time.perf_counter()
+        errs = self.validate(path)
+        if errs:
+            raise CheckpointError(f"{path}: " + "; ".join(errs))
+        with np.load(os.path.join(path, _STATE)) as z:
+            arrays = {k: z[k] for k in z.files}
+        with open(os.path.join(path, _EXTRA)) as f:
+            meta = json.load(f)
+        snap = state_mod.blobs_to_snapshot(arrays, meta)
+        dur = (time.perf_counter() - t0) * 1e3
+        self._emit("load", duration_ms=round(dur, 3),
+                   iter=int(meta.get("iter", -1)),
+                   bytes=int(os.path.getsize(os.path.join(path, _STATE))),
+                   path=os.path.basename(path))
+        return {"path": path, "meta": meta, "snapshot": snap}
+
+    def newest_valid(self) -> Optional[str]:
+        """Path of the newest manifest-valid checkpoint, emitting a
+        ``fallback`` record (with the real validation errors) for
+        every rejected newer candidate — the validation-only half of
+        :meth:`load_latest`, shared with the serving tier."""
+        for _, path in reversed(self.candidates()):
+            errs = self.validate(path)
+            if not errs:
+                return path
+            Log.warning("checkpoint: %s: %s — falling back to the "
+                        "previous snapshot", path, "; ".join(errs))
+            self._emit("fallback", path=os.path.basename(path),
+                       error="; ".join(errs)[:300])
+        return None
+
+    def load_latest(self) -> Optional[Dict[str, Any]]:
+        """Newest valid checkpoint, falling back past corrupt/truncated
+        candidates (each rejection emits a ``fallback`` record)."""
+        path = self.newest_valid()
+        return self.load_dir(path) if path is not None else None
+
+    def resolve(self, target: str) -> Optional[Dict[str, Any]]:
+        """Load ``target``: a finalized checkpoint directory (strict —
+        corruption raises), a checkpoint root (newest valid wins, with
+        fallback), or ``auto``/``latest`` (this manager's root)."""
+        if target in ("auto", "latest", ""):
+            return self.load_latest()
+        if self.is_checkpoint_dir(target):
+            return self.load_dir(target)
+        if os.path.isdir(target):
+            return CheckpointManager(target, self.keep_last_n,
+                                     self.recorder).load_latest()
+        raise CheckpointError(f"resume_from={target!r}: no such "
+                              f"checkpoint directory")
+
+    # ------------------------------------------------------------------
+    # restore
+    # ------------------------------------------------------------------
+    def restore(self, booster, loaded: Dict[str, Any]) -> int:
+        """Install a loaded checkpoint into a freshly-constructed
+        booster (valid sets must already be registered — their scores
+        are overwritten from the snapshot).  Returns the iteration to
+        resume from."""
+        meta = loaded["meta"]
+        g = booster._gbdt
+        if int(meta["num_tree_per_iteration"]) != g.num_tree_per_iteration:
+            Log.fatal("checkpoint has num_tree_per_iteration=%s, "
+                      "booster has %d", meta["num_tree_per_iteration"],
+                      g.num_tree_per_iteration)
+        if meta.get("num_data") and int(meta["num_data"]) != g.num_data:
+            Log.fatal("checkpoint was taken on %s training rows, the "
+                      "current dataset has %d — resume needs the same "
+                      "training data", meta["num_data"], g.num_data)
+        alias = {"gbrt": "gbdt", "random_forest": "rf"}
+        ck_boost = alias.get(meta.get("boosting", "gbdt"),
+                             meta.get("boosting", "gbdt"))
+        cur_boost = alias.get(g.config.boosting, g.config.boosting)
+        if meta.get("boosting") is not None and ck_boost != cur_boost:
+            # a DART checkpoint restored into a plain-GBDT booster
+            # would silently drop the drop-RNG/weight state and stop
+            # renormalizing — wrong model, no error
+            Log.fatal("checkpoint was taken with boosting=%s, the "
+                      "booster is configured with boosting=%s",
+                      meta.get("boosting"), g.config.boosting)
+        if meta.get("objective") != g.config.objective:
+            Log.warning("checkpoint objective %r differs from configured "
+                        "%r", meta.get("objective"), g.config.objective)
+        raw = None
+        if booster.train_set is not None:
+            raw = booster.train_set.raw_mat
+        g.restore_training_snapshot(loaded["snapshot"], raw=raw)
+        booster.best_iteration = int(meta.get("best_iteration", -1))
+        best = meta.get("best_score") or {}
+        booster.best_score = {d: dict(m) for d, m in best.items()}
+        Log.info("checkpoint: resumed at iteration %d from %s",
+                 int(meta["iter"]), loaded["path"])
+        return int(meta["iter"])

@@ -1,0 +1,188 @@
+"""Bit-exact training-state (de)serialization for checkpoints.
+
+The numbers that make resume *bit-exact* rather than approximate never
+go through a text format: tree tables, the f32 score carry, f64 valid
+scores and the Mersenne-Twister key vectors are stored as raw numpy
+arrays in one ``state.npz`` blob.  Rebuilding a :class:`Tree` from its
+arrays restores EVERY field the training paths read (``threshold_bin``
+for device replay, ``leaf_count`` for two-column count restoration,
+``shrinkage`` for DART reweighting) — the model-text round trip, by
+contrast, renders ``split_gain``/``internal_value``/``shrinkage`` at
+``%g`` and recovers ``threshold_bin`` by casting, which is fine for a
+servable model but not for a continuation that must equal the
+uninterrupted run to the last bit.  A ``model.txt`` in the reference
+format still rides along in every checkpoint for serving and
+inspection (``serve.ModelRegistry.publish_from_checkpoint``).
+
+Host-RNG states (feature-fraction draws, DART drops) are captured as
+``numpy.random.RandomState.get_state()`` tuples: the (624,) uint32 key
+vector goes into the npz, position/gauss scalars into the JSON meta.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from ..models.tree import Tree
+
+__all__ = ["pack_trees", "unpack_trees", "snapshot_to_blobs",
+           "blobs_to_snapshot", "rng_state_split", "rng_state_join"]
+
+# Tree fields stored per internal node / per leaf, trimmed to the
+# tree's live node count (entries past num_leaves are construction
+# zeros, pinned by the round-trip test)
+_INNER_FIELDS = ("split_feature", "split_gain", "threshold",
+                 "threshold_bin", "decision_type", "left_child",
+                 "right_child", "internal_value", "internal_weight",
+                 "internal_count")
+_LEAF_FIELDS = ("leaf_value", "leaf_weight", "leaf_count",
+                "leaf_parent", "leaf_depth")
+
+
+def pack_trees(models: List[Tree]) -> Dict[str, np.ndarray]:
+    """Concatenated struct-of-arrays layout for a tree list."""
+    T = len(models)
+    out: Dict[str, np.ndarray] = {
+        "tree_num_leaves": np.asarray(
+            [t.num_leaves for t in models], np.int32),
+        "tree_max_leaves": np.asarray(
+            [t.max_leaves for t in models], np.int32),
+        "tree_num_cat": np.asarray([t.num_cat for t in models], np.int32),
+        "tree_shrinkage": np.asarray(
+            [t.shrinkage for t in models], np.float64),
+    }
+    for name in _INNER_FIELDS:
+        parts = [getattr(t, name)[:max(t.num_leaves - 1, 0)]
+                 for t in models]
+        out["tree_" + name] = np.concatenate(parts) if parts else \
+            np.zeros(0)
+    for name in _LEAF_FIELDS:
+        parts = [getattr(t, name)[:t.num_leaves] for t in models]
+        out["tree_" + name] = np.concatenate(parts) if parts else \
+            np.zeros(0)
+    cb = [np.asarray(t.cat_boundaries, np.int64) for t in models]
+    ct = [np.asarray(t.cat_threshold, np.int64) for t in models]
+    out["tree_cat_boundaries"] = np.concatenate(cb) if T else \
+        np.zeros(0, np.int64)
+    out["tree_cat_boundaries_len"] = np.asarray(
+        [len(x) for x in cb], np.int64)
+    out["tree_cat_threshold"] = np.concatenate(ct) if T else \
+        np.zeros(0, np.int64)
+    out["tree_cat_threshold_len"] = np.asarray(
+        [len(x) for x in ct], np.int64)
+    return out
+
+
+def unpack_trees(d: Dict[str, np.ndarray]) -> List[Tree]:
+    nl = np.asarray(d["tree_num_leaves"], np.int32)
+    ml = np.asarray(d["tree_max_leaves"], np.int32)
+    nc = np.asarray(d["tree_num_cat"], np.int32)
+    sh = np.asarray(d["tree_shrinkage"], np.float64)
+    inner_off = np.concatenate(
+        [[0], np.cumsum(np.maximum(nl - 1, 0))]).astype(np.int64)
+    leaf_off = np.concatenate([[0], np.cumsum(nl)]).astype(np.int64)
+    cb_off = np.concatenate(
+        [[0], np.cumsum(d["tree_cat_boundaries_len"])]).astype(np.int64)
+    ct_off = np.concatenate(
+        [[0], np.cumsum(d["tree_cat_threshold_len"])]).astype(np.int64)
+    models: List[Tree] = []
+    for i in range(len(nl)):
+        t = Tree(int(ml[i]))
+        t.num_leaves = int(nl[i])
+        t.num_cat = int(nc[i])
+        t.shrinkage = float(sh[i])
+        i0, i1 = inner_off[i], inner_off[i + 1]
+        for name in _INNER_FIELDS:
+            dst = getattr(t, name)
+            dst[:i1 - i0] = np.asarray(d["tree_" + name][i0:i1],
+                                       dst.dtype)
+        l0, l1 = leaf_off[i], leaf_off[i + 1]
+        for name in _LEAF_FIELDS:
+            dst = getattr(t, name)
+            dst[:l1 - l0] = np.asarray(d["tree_" + name][l0:l1],
+                                       dst.dtype)
+        t.cat_boundaries = [int(x) for x in
+                            d["tree_cat_boundaries"][cb_off[i]:cb_off[i + 1]]]
+        t.cat_threshold = [int(x) for x in
+                           d["tree_cat_threshold"][ct_off[i]:ct_off[i + 1]]]
+        if not t.cat_boundaries:
+            t.cat_boundaries = [0]
+        models.append(t)
+    return models
+
+
+# ----------------------------------------------------------------------
+# host RNG state <-> (json scalars, npz key vector)
+# ----------------------------------------------------------------------
+def rng_state_split(state: Tuple) -> Tuple[Dict[str, Any], np.ndarray]:
+    """``RandomState.get_state()`` -> (json-able scalars, key array)."""
+    algo, keys, pos, has_gauss, cached = state
+    return ({"algo": str(algo), "pos": int(pos),
+             "has_gauss": int(has_gauss), "cached_gaussian": float(cached)},
+            np.asarray(keys, np.uint32))
+
+
+def rng_state_join(meta: Dict[str, Any], keys: np.ndarray) -> Tuple:
+    return (meta["algo"], np.asarray(keys, np.uint32), int(meta["pos"]),
+            int(meta["has_gauss"]), float(meta["cached_gaussian"]))
+
+
+# ----------------------------------------------------------------------
+# snapshot dict (GBDT.training_snapshot) <-> (npz arrays, json meta)
+# ----------------------------------------------------------------------
+def snapshot_to_blobs(snap: Dict[str, Any]
+                      ) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    arrays: Dict[str, np.ndarray] = {}
+    meta: Dict[str, Any] = {
+        "iter": int(snap["iter"]),
+        "trees_dispatched": int(snap["trees_dispatched"]),
+        "shrinkage_rate": float(snap["shrinkage_rate"]),
+        "stopped": bool(snap.get("stopped", False)),
+        "n_models": len(snap["models"]),
+    }
+    arrays.update(pack_trees(snap["models"]))
+    arrays["score"] = np.asarray(snap["score"], np.float32)
+    rng_meta, rng_keys = rng_state_split(snap["rng_feature"])
+    meta["rng_feature"] = rng_meta
+    arrays["rng_feature_keys"] = rng_keys
+    meta["valid_names"] = sorted(snap.get("valid_scores", {}))
+    for name in meta["valid_names"]:
+        arrays["valid_score__" + name] = np.asarray(
+            snap["valid_scores"][name], np.float64)
+    extra = dict(snap.get("extra") or {})
+    if "rng_drop" in extra:    # DART drop RNG
+        drop_meta, drop_keys = rng_state_split(extra.pop("rng_drop"))
+        meta["rng_drop"] = drop_meta
+        arrays["rng_drop_keys"] = drop_keys
+    if "tree_weight" in extra:
+        arrays["dart_tree_weight"] = np.asarray(
+            extra.pop("tree_weight"), np.float64)
+    meta["extra"] = extra      # remaining json-able scalars
+    return arrays, meta
+
+
+def blobs_to_snapshot(arrays: Dict[str, np.ndarray],
+                      meta: Dict[str, Any]) -> Dict[str, Any]:
+    snap: Dict[str, Any] = {
+        "iter": int(meta["iter"]),
+        "trees_dispatched": int(meta["trees_dispatched"]),
+        "shrinkage_rate": float(meta["shrinkage_rate"]),
+        "stopped": bool(meta.get("stopped", False)),
+        "models": unpack_trees(arrays),
+        "score": np.asarray(arrays["score"], np.float32),
+        "rng_feature": rng_state_join(meta["rng_feature"],
+                                      arrays["rng_feature_keys"]),
+        "valid_scores": {name: np.asarray(arrays["valid_score__" + name],
+                                          np.float64)
+                         for name in meta.get("valid_names", [])},
+    }
+    extra = dict(meta.get("extra") or {})
+    if "rng_drop" in meta:
+        extra["rng_drop"] = rng_state_join(meta["rng_drop"],
+                                           arrays["rng_drop_keys"])
+    if "dart_tree_weight" in arrays:
+        extra["tree_weight"] = [float(x)
+                                for x in arrays["dart_tree_weight"]]
+    snap["extra"] = extra
+    return snap

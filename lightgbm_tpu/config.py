@@ -167,7 +167,34 @@ PARAMS: List[Param] = [
     _p("output_model", "LightGBM_model.txt", str,
        ("model_output", "model_out"), "output model filename", group="io"),
     _p("snapshot_freq", -1, int, ("save_period",),
-       "save model snapshot every k iterations", group="io"),
+       "snapshot cadence in iterations: with checkpoint_dir set, a "
+       "full training checkpoint (lightgbm_tpu/ckpt/, resumable "
+       "bit-exactly) is written every k iterations; without it, the "
+       "CLI falls back to the reference's model-text snapshots "
+       "(<output_model>.snapshot_iter_k).  <=0 disables periodic "
+       "snapshots (a final/preemption checkpoint is still written "
+       "when checkpoint_dir is set)", group="io"),
+    _p("checkpoint_dir", "", str, ("ckpt_dir", "checkpoint_path"),
+       "root directory for fault-tolerant training checkpoints "
+       "(docs/Checkpointing.md): atomic temp+fsync+rename snapshot "
+       "directories carrying the complete training state (tree "
+       "tables, score carries, PRNG streams, bagging-cycle position, "
+       "early-stopping state) with a content-hashed manifest; "
+       "enables the now-live snapshot_freq cadence, a SIGTERM/SIGINT "
+       "best-effort final checkpoint, and resume_from; '' disables "
+       "checkpointing", group="io"),
+    _p("keep_last_n", 2, int, ("checkpoint_keep_last_n", "keep_last"),
+       "checkpoint retention: only the newest n valid checkpoints "
+       "survive each save (older directories are pruned)",
+       group="io", check=">=1"),
+    _p("resume_from", "", str, ("resume", "resume_checkpoint"),
+       "resume training from a checkpoint: a finalized ckpt_* "
+       "directory, a checkpoint root (newest VALID snapshot wins, "
+       "falling back past corrupt/truncated ones), or 'auto'/'latest' "
+       "to discover inside checkpoint_dir (starting fresh when none "
+       "exists yet — the preemptible-fleet loop's idempotent form).  "
+       "The continuation is bit-exact: trees, scores and RNG streams "
+       "match the uninterrupted run", group="io"),
     _p("input_model", "", str, ("model_input", "model_in"),
        "input model path (continue train / predict)", group="io"),
     _p("output_result", "LightGBM_predict_result.txt", str,

@@ -6,6 +6,9 @@ from __future__ import annotations
 
 import collections
 import copy
+import os
+import signal
+import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -16,6 +19,74 @@ from .callback import CallbackEnv, EarlyStopException
 from .utils.log import Log
 
 __all__ = ["train", "cv", "CVBooster"]
+
+
+class _PreemptGuard:
+    """SIGTERM/SIGINT -> graceful checkpoint-at-the-next-boundary.
+
+    The first signal only sets a flag — the training loop observes it
+    after the in-flight iteration completes, takes a best-effort
+    checkpoint (``reason=preempt``) and stops.  A second signal
+    restores the original handlers and re-raises, so a stuck save can
+    still be force-killed.  Signal handlers are process-global state:
+    the guard installs only on the main thread and always restores."""
+
+    def __init__(self):
+        self.signum: Optional[int] = None
+        self._orig: Dict[int, Any] = {}
+
+    def install(self) -> "_PreemptGuard":
+        if threading.current_thread() is not threading.main_thread():
+            return self
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                self._orig[sig] = signal.signal(sig, self._handle)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+        return self
+
+    def _handle(self, signum, frame):
+        if self.signum is not None:
+            self.restore()
+            signal.raise_signal(signum)
+            return
+        self.signum = signum
+        Log.warning("received signal %d: checkpointing at the next "
+                    "iteration boundary, then stopping", signum)
+
+    def restore(self) -> None:
+        for sig, handler in self._orig.items():
+            try:
+                signal.signal(sig, handler)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+        self._orig = {}
+
+
+def _replay_eval_history(eval_history, cbs_after, booster, params,
+                         num_boost_round):
+    """Rebuild stateful callback state (early stopping best-rounds,
+    ``record_evaluation`` dicts) by replaying the checkpointed eval
+    stream.  Only the framework's own stateful callbacks are replayed
+    — user callbacks with external side effects must not fire twice.
+    Returns True when the replay raised an early stop (the resumed
+    run is already complete)."""
+    replayable = (callback_mod._EarlyStopping,
+                  callback_mod._RecordEvaluation)
+    for it, results in eval_history:
+        ev = [(d, m, float(v), bool(h)) for d, m, v, h in results]
+        try:
+            for cb in cbs_after:
+                if isinstance(cb, replayable):
+                    cb(CallbackEnv(booster, params, int(it), 0,
+                                   num_boost_round, ev))
+        except EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1
+            for item in e.best_score:
+                booster.best_score.setdefault(
+                    item[0], {})[item[1]] = item[2]
+            return True
+    return False
 
 
 def train(params: Dict[str, Any], train_set: Dataset,
@@ -29,8 +100,16 @@ def train(params: Dict[str, Any], train_set: Dataset,
           evals_result: Optional[Dict] = None,
           verbose_eval: Union[bool, int] = True,
           learning_rates=None, keep_training_booster: bool = True,
-          callbacks: Optional[List[Callable]] = None, mesh=None) -> Booster:
-    """Train a booster (``engine.py:19`` in the reference)."""
+          callbacks: Optional[List[Callable]] = None, mesh=None,
+          resume_from: Optional[str] = None) -> Booster:
+    """Train a booster (``engine.py:19`` in the reference).
+
+    With ``checkpoint_dir`` set (params or config file) training is
+    preemption-safe: atomic checkpoints every ``snapshot_freq``
+    iterations plus a best-effort final one on SIGTERM/SIGINT, and
+    ``resume_from`` (param or keyword; ``'auto'`` discovers the newest
+    valid snapshot) continues BIT-EXACTLY from the saved boundary —
+    see ``docs/Checkpointing.md``."""
     params = dict(params)
     # canonical name first, then aliases (Config resolution order);
     # num_boost_round is accepted for reference-python compatibility
@@ -67,6 +146,42 @@ def train(params: Dict[str, Any], train_set: Dataset,
         params["objective"] = "none"
     booster = Booster(params=params, train_set=train_set, mesh=mesh)
 
+    # ---- checkpoint/resume (lightgbm_tpu/ckpt/) ----------------------
+    cfg = booster.config
+    ckpt_dir = getattr(cfg, "checkpoint_dir", "") or ""
+    resume = resume_from if resume_from is not None \
+        else (getattr(cfg, "resume_from", "") or "")
+    snapshot_freq = int(getattr(cfg, "snapshot_freq", -1) or -1)
+    ckpt_mgr = None
+    ckpt_loader = None
+    loaded_ckpt = None
+    if ckpt_dir or resume:
+        from .ckpt import CheckpointError, CheckpointManager
+        recorder = getattr(booster._gbdt, "_telemetry", None)
+        keep_n = int(getattr(cfg, "keep_last_n", 2) or 2)
+        if ckpt_dir:
+            ckpt_mgr = CheckpointManager(ckpt_dir, keep_n, recorder)
+        if resume:
+            ckpt_loader = ckpt_mgr
+            if ckpt_loader is None:
+                if not os.path.isdir(resume):
+                    Log.fatal("resume_from=%r: no such checkpoint "
+                              "directory (set checkpoint_dir to use "
+                              "'auto')", resume)
+                ckpt_loader = CheckpointManager(resume, keep_n,
+                                                recorder)
+            try:
+                loaded_ckpt = ckpt_loader.resolve(resume)
+            except CheckpointError as exc:
+                Log.fatal("cannot resume: %s", exc)
+            if loaded_ckpt is None:
+                Log.warning("resume_from=%r: no valid checkpoint found; "
+                            "training from scratch", resume)
+
+    if init_model is not None and loaded_ckpt is not None:
+        Log.warning("init_model is ignored: resuming from checkpoint %s",
+                    loaded_ckpt["path"])
+        init_model = None
     if init_model is not None:
         prev = init_model if isinstance(init_model, Booster) \
             else Booster(model_file=str(init_model))
@@ -103,43 +218,90 @@ def train(params: Dict[str, Any], train_set: Dataset,
     cbs_before.sort(key=lambda c: getattr(c, "order", 0))
     cbs_after.sort(key=lambda c: getattr(c, "order", 0))
 
+    # resume: install the snapshot AFTER valid sets registered (their
+    # path-dependent scores are overwritten from the checkpoint) and
+    # replay the recorded eval stream through the stateful callbacks
+    start_iter = 0
+    eval_history: List = []
+    if loaded_ckpt is not None:
+        start_iter = ckpt_loader.restore(booster, loaded_ckpt)
+        eval_history = [(int(it), [tuple(e) for e in ev]) for it, ev in
+                        (loaded_ckpt["meta"].get("eval_history") or [])]
+        if _replay_eval_history(eval_history, cbs_after, booster,
+                                params, num_boost_round):
+            return booster
+    guard = _PreemptGuard()
+    if ckpt_mgr is not None:
+        guard.install()
+    saved_at = start_iter if loaded_ckpt is not None else -1
+
+    def _save_ckpt(reason):
+        nonlocal saved_at
+        try:
+            ckpt_mgr.save(booster, reason=reason,
+                          eval_history=[[it, [list(e) for e in ev]]
+                                        for it, ev in eval_history])
+            saved_at = booster._gbdt.completed_iterations()
+        except Exception as exc:  # a full disk must not kill training
+            Log.warning("checkpoint save failed (%s): %s", reason, exc)
+
     import time as _time
     from .utils.profiling import timed
     t_train0 = _time.perf_counter()
-    for i in range(num_boost_round):
-        for cb in cbs_before:
-            cb(CallbackEnv(booster, params, i, 0, num_boost_round, None))
-        should_stop = booster.update(fobj=fobj)
-        # per-iteration wall clock (GBDT::Train, gbdt.cpp:253-256)
-        Log.debug("%.6f seconds elapsed, finished iteration %d",
-                  _time.perf_counter() - t_train0, i + 1)
-        evaluation_result_list = []
-        if booster._gbdt.metrics and (booster._gbdt.valid_sets or
-                                      booster.config.is_provide_training_metric):
-            with timed("eval/metrics"):
-                evaluation_result_list = booster.eval_set()
-        if feval is not None:
-            evaluation_result_list.extend(
-                _run_feval(feval, booster, train_set, valid_sets,
-                           valid_names))
-        _telemetry_rec = getattr(booster._gbdt, "_telemetry", None)
-        if _telemetry_rec is not None and evaluation_result_list:
-            # metric stream rides the run record (telemetry JSONL is
-            # the artifact docs/Benchmarks.md-class documents come from)
-            _telemetry_rec.emit("eval", iter=i, results=[
-                [d, m, float(v), bool(h)]
-                for d, m, v, h in evaluation_result_list])
-        try:
-            for cb in cbs_after:
-                cb(CallbackEnv(booster, params, i, 0, num_boost_round,
-                               evaluation_result_list))
-        except EarlyStopException as e:
-            booster.best_iteration = e.best_iteration + 1
-            for item in e.best_score:
-                booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
-            break
-        if should_stop:
-            break
+    try:
+        for i in range(start_iter, num_boost_round):
+            for cb in cbs_before:
+                cb(CallbackEnv(booster, params, i, 0, num_boost_round, None))
+            should_stop = booster.update(fobj=fobj)
+            # per-iteration wall clock (GBDT::Train, gbdt.cpp:253-256)
+            Log.debug("%.6f seconds elapsed, finished iteration %d",
+                      _time.perf_counter() - t_train0, i + 1)
+            evaluation_result_list = []
+            if booster._gbdt.metrics and (booster._gbdt.valid_sets or
+                                          booster.config.is_provide_training_metric):
+                with timed("eval/metrics"):
+                    evaluation_result_list = booster.eval_set()
+            if feval is not None:
+                evaluation_result_list.extend(
+                    _run_feval(feval, booster, train_set, valid_sets,
+                               valid_names))
+            _telemetry_rec = getattr(booster._gbdt, "_telemetry", None)
+            if _telemetry_rec is not None and evaluation_result_list:
+                # metric stream rides the run record (telemetry JSONL is
+                # the artifact docs/Benchmarks.md-class documents come from)
+                _telemetry_rec.emit("eval", iter=i, results=[
+                    [d, m, float(v), bool(h)]
+                    for d, m, v, h in evaluation_result_list])
+            if ckpt_mgr is not None and evaluation_result_list:
+                eval_history.append(
+                    (i, [(d, m, float(v), bool(h))
+                         for d, m, v, h in evaluation_result_list]))
+            try:
+                for cb in cbs_after:
+                    cb(CallbackEnv(booster, params, i, 0, num_boost_round,
+                                   evaluation_result_list))
+            except EarlyStopException as e:
+                booster.best_iteration = e.best_iteration + 1
+                for item in e.best_score:
+                    booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
+                break
+            if ckpt_mgr is not None:
+                if guard.signum is not None:
+                    _save_ckpt("preempt")
+                    break
+                if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0 \
+                        and i + 1 < num_boost_round:
+                    _save_ckpt("periodic")
+            if should_stop:
+                break
+        if ckpt_mgr is not None and \
+                booster._gbdt.completed_iterations() != saved_at:
+            _save_ckpt("preempt" if guard.signum is not None
+                       else "final")
+    finally:
+        # handlers are process-global: restore them even when an
+        # update/eval/callback raises mid-loop
+        guard.restore()
     if booster.best_iteration <= 0:
         for item in (booster.eval_set() if booster._gbdt.metrics else []):
             booster.best_score.setdefault(item[0], {})[item[1]] = item[2]

@@ -195,6 +195,21 @@ class DART(GBDT):
                             for i in range(self.iter)]
         self.sum_weight = float(sum(self.tree_weight))
 
+    # -- checkpoint/resume: drop RNG + per-tree weight state ----------
+    def _extra_ckpt_state(self):
+        return {"rng_drop": self._rng_drop.get_state(),
+                "tree_weight": list(self.tree_weight),
+                "sum_weight": float(self.sum_weight)}
+
+    def _restore_extra_ckpt_state(self, extra, raw) -> None:
+        if "rng_drop" in extra:
+            self._rng_drop.set_state(extra["rng_drop"])
+        self.tree_weight = [float(w)
+                            for w in extra.get("tree_weight", [])]
+        self.sum_weight = float(extra.get("sum_weight", 0.0))
+        self._drop_index = []
+        self._dart_undo = None
+
     # -- per-tree train contribution from the stored leaf assignment --
     def _train_contrib(self, model_idx: int):
         import jax.numpy as jnp

@@ -1623,6 +1623,138 @@ class GBDT:
                         child_count(tree.right_child[node])
         return tree
 
+    # ---- checkpoint/resume (lightgbm_tpu/ckpt/) ----------------------
+    def completed_iterations(self) -> int:
+        """Iterations fully materialized on the host — mid-fused-block
+        this is the SERVED boundary, not the block-end state the
+        device score holds."""
+        blk = getattr(self, "_fused_block", None)
+        if blk is not None and blk["served"] < len(blk["trees"]):
+            return blk["start_iter"] + blk["served"]
+        return self.iter
+
+    def training_snapshot(self) -> Dict:
+        """Model-consistent training state at the last COMPLETED
+        iteration, as host arrays — the capture side of the checkpoint
+        subsystem.  Mid-fused-block, the state is aligned to the
+        served boundary exactly the way :meth:`_fused_restore` would
+        land there (partial score replay, host-RNG re-advance), but
+        WITHOUT disturbing the in-flight block: training continues
+        serving from it after the save."""
+        blk = getattr(self, "_fused_block", None)
+        if blk is not None and blk["served"] < len(blk["trees"]):
+            served = blk["served"]
+            score, _ = self._fused_replay_score(served)
+            it = blk["start_iter"] + served
+            tid = blk["start_tid"] + served
+            cur = self._rng_feature.get_state()
+            self._rng_feature.set_state(blk["rng_state"])
+            for _ in range(served):
+                self._feature_fraction_mask()
+            rng_state = self._rng_feature.get_state()
+            self._rng_feature.set_state(cur)
+        else:
+            _ = self.models            # flush any pipelined tree
+            score = self._score
+            it = self.iter
+            tid = self._trees_dispatched
+            rng_state = self._rng_feature.get_state()
+        return {
+            "iter": int(it),
+            "trees_dispatched": int(tid),
+            "shrinkage_rate": float(self.shrinkage_rate),
+            "stopped": bool(self._stop_flag),
+            "score": np.asarray(score),
+            "rng_feature": rng_state,
+            "models": list(self._models),
+            "valid_scores": {vs.name: np.asarray(vs.score)
+                             for vs in self.valid_sets},
+            "extra": self._extra_ckpt_state(),
+        }
+
+    def _extra_ckpt_state(self) -> Dict:
+        """Subclass hook: boosting-mode state beyond the base carry
+        (DART's drop RNG/weights, models/boosting.py)."""
+        return {}
+
+    def _restore_extra_ckpt_state(self, extra: Dict, raw) -> None:
+        pass
+
+    def restore_training_snapshot(self, snap: Dict, raw=None) -> None:
+        """Install a :meth:`training_snapshot` into this (freshly
+        constructed) booster so the next ``train_one_iter`` continues
+        bit-identically to the run the snapshot was taken from: exact
+        device score carry, host-RNG stream position, quantization
+        stream position, and the bagging-cycle cache recomputed from
+        its defining PRNG fold.  Valid sets must already be
+        registered; their accumulated scores (path-dependent under
+        DART renormalization) are overwritten from the snapshot."""
+        import jax.numpy as jnp
+        self._fused_block = None
+        self._pending = None
+        self._stop_flag = bool(snap.get("stopped", False))
+        self.models = list(snap["models"])   # setter bumps the predictor
+        self.iter = int(snap["iter"])
+        self._trees_dispatched = int(snap["trees_dispatched"])
+        self.shrinkage_rate = float(snap["shrinkage_rate"])
+        self._score = jnp.asarray(np.asarray(snap["score"], np.float32))
+        self._prev_score = None
+        self._prev_valid_scores = []
+        self._rng_feature.set_state(snap["rng_feature"])
+        cfg = self.config
+        if (self._bagging_active() and self.iter > 0 and
+                type(self)._bagging_mask is GBDT._bagging_mask):
+            # the bernoulli/stratified cache is a pure function of the
+            # last bagging_freq boundary (same recompute as
+            # _fused_restore); GOSS/MVS masks are functions of the
+            # iteration's gradients and need no cache
+            last_draw = (self.iter - 1) // cfg.bagging_freq * \
+                cfg.bagging_freq
+            self._cached_bag = self._draw_bag_mask(last_draw)
+        vsc = snap.get("valid_scores") or {}
+        k = max(self.num_tree_per_iteration, 1)
+        for vs in self.valid_sets:
+            if vs.name in vsc:
+                arr = np.asarray(vsc[vs.name], np.float64)
+                if arr.size != vs.score.size:
+                    Log.fatal("checkpointed valid set %r has %d scores, "
+                              "the registered one needs %d — resume "
+                              "requires the same validation data",
+                              vs.name, arr.size, vs.score.size)
+                vs.score = arr.reshape(vs.score.shape)
+            else:
+                # registered at resume but absent from the checkpoint:
+                # add_valid replayed ZERO trees (it ran before this
+                # restore installed them), so replay the model now —
+                # the same continue-training semantics add_valid gives
+                # an init_model (scores from this point on accumulate
+                # incrementally like any fresh registration)
+                Log.warning("valid set %r was not registered when the "
+                            "checkpoint was taken; replaying the "
+                            "restored model into its score", vs.name)
+                for i, tree in enumerate(self._models):
+                    vs.score[i % k] += tree.predict(vs.raw)
+        if self._track_train_leaf:
+            # per-tree leaf assignments are discrete and recomputable
+            # exactly from the restored trees (init_from_model does
+            # the same); constant trees keep their None sentinel
+            if raw is None:
+                Log.fatal("resuming %s requires the training set's raw "
+                          "matrix (free_raw_data=False)",
+                          type(self).__name__)
+            dt = np.uint8 if cfg.num_leaves <= 256 else np.uint16
+            self._train_leaf_idx = [
+                None if t.num_leaves <= 1 else
+                t.predict_leaf_index(raw).astype(dt)
+                for t in self._models]
+            for vs in self.valid_sets:
+                vs.leaf_idx_per_tree = [
+                    None if t.num_leaves <= 1 else
+                    t.predict_leaf_index(vs.raw).astype(dt)
+                    for t in self._models]
+        self._restore_extra_ckpt_state(dict(snap.get("extra") or {}),
+                                       raw)
+
     # ------------------------------------------------------------------
     @property
     def train_score(self) -> np.ndarray:

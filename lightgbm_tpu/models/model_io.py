@@ -19,6 +19,23 @@ from .tree import Tree
 _EOT = "end of trees"
 
 
+def write_model_file(filename: str, text: str) -> None:
+    """Write model text ATOMICALLY: temp + fsync + rename via the
+    checkpoint writer (``ckpt/atomic.py``), so a crash mid-save can
+    never leave a truncated model file — the reader sees the complete
+    old model or the complete new one.  Remote (hdfs://) targets keep
+    the upload-on-close path of ``utils/file_io.py`` (their atomicity
+    is the filesystem's contract, not ours)."""
+    from ..utils.file_io import is_remote, open_output
+    filename = str(filename)
+    if is_remote(filename):
+        with open_output(filename) as f:
+            f.write(text)
+        return
+    from ..ckpt.atomic import atomic_write_text
+    atomic_write_text(filename, text)
+
+
 def save_model_to_string(models: List[Tree], *, num_class: int,
                          num_tree_per_iteration: int, label_index: int,
                          max_feature_idx: int, objective_str: str,

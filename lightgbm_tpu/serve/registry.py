@@ -158,6 +158,54 @@ class ModelRegistry:
             _tele_counters.incr("serve_swaps")
             return ver
 
+    def publish_from_checkpoint(self, path: str) -> ModelVersion:
+        """Hot-swap straight from a TRAINING checkpoint directory
+        (``lightgbm_tpu/ckpt/``): accepts one finalized ``ckpt_*``
+        directory or a checkpoint root, where the newest VALID
+        snapshot wins — corrupt/truncated candidates are skipped with
+        the loader's fallback telemetry, so a serving tier pointed at
+        a live training job's checkpoint_dir always publishes a
+        loadable model.  The checkpoint's ``model.txt`` (validated
+        against the manifest's content hash) becomes a Booster and
+        goes through the normal flatten -> pre-warm -> atomic swap
+        publish."""
+        import os
+
+        from ..ckpt import CheckpointError, CheckpointManager
+        path = str(path)
+        explicit = CheckpointManager.is_checkpoint_dir(path)
+        model_str = None
+        ckpt = None
+        # a live trainer re-saving the same boundary swaps the dir
+        # out from under us between validate and read (os.replace to
+        # .old, then the fresh dir in) — retry the scan on OSError
+        # instead of crashing the publish
+        for attempt in range(3):
+            if explicit:
+                errs = CheckpointManager.validate(path)
+                if errs:
+                    raise CheckpointError(f"{path}: " + "; ".join(errs))
+                ckpt = path
+            else:
+                ckpt = CheckpointManager(path).newest_valid()
+                if ckpt is None:
+                    raise CheckpointError(
+                        f"{path}: no valid checkpoint to publish")
+            try:
+                with open(os.path.join(ckpt, "model.txt")) as f:
+                    model_str = f.read()
+                break
+            except OSError as exc:
+                if attempt == 2:
+                    raise CheckpointError(
+                        f"{ckpt}: checkpoint disappeared mid-publish "
+                        f"({exc})")
+                time.sleep(0.05)
+        ver = self.publish(model_str=model_str)
+        Log.info("serve: published v%d from checkpoint %s",
+                 ver.version, ckpt)
+        return ver
+
     # -- lookup ----------------------------------------------------------
     def current(self) -> Optional[ModelVersion]:
         with self._lock:

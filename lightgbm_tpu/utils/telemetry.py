@@ -73,7 +73,7 @@ def percentile(sorted_vals, q: float) -> float:
 SCHEMA_VERSION = 1
 
 RECORD_TYPES = ("run_start", "iteration", "superstep", "eval", "predict",
-                "serve", "run_end")
+                "serve", "checkpoint", "run_end")
 
 # per-type required fields on top of the common envelope; values are
 # (field, type-or-types) pairs the lint enforces
@@ -98,6 +98,13 @@ _TYPE_FIELDS: Dict[str, Tuple[Tuple[str, Any], ...]] = {
     # rolls up p50/p95/p99 total latency and shed/timeout counts.
     "serve": (("status", str), ("rows", int),
               ("total_ms", (int, float))),
+    # one record per checkpoint event (ckpt/manager.py): ``event`` is
+    # save|load|fallback; saves carry iter/reason(periodic|preempt|
+    # final)/bytes, loads carry iter/bytes, fallbacks carry the
+    # rejected path + validation error.  The run_end summary rolls up
+    # counts, total bytes and total save/load time; triage_run.py
+    # flags fallbacks and save overhead > 5% of train wall time.
+    "checkpoint": (("event", str), ("duration_ms", (int, float))),
     "run_end": (("summary", dict),),
 }
 
@@ -333,6 +340,19 @@ class RunRecorder:
             if occ is not None:
                 self._serve_occ_sum += float(occ)
                 self._serve_occ_n += 1
+        elif t == "checkpoint":
+            event = rec.get("event")
+            if event in ("save", "load", "fallback"):
+                self._agg[f"ckpt_{event}s"] = \
+                    self._agg.get(f"ckpt_{event}s", 0) + 1
+            if event in ("save", "load"):
+                self._agg[f"ckpt_{event}_ms"] = round(
+                    self._agg.get(f"ckpt_{event}_ms", 0.0) +
+                    float(rec.get("duration_ms", 0.0)), 3)
+            if event == "save":
+                self._agg["ckpt_bytes"] = \
+                    self._agg.get("ckpt_bytes", 0) + \
+                    int(rec.get("bytes", 0))
         elif t == "predict":
             self._agg["predicts"] = self._agg.get("predicts", 0) + 1
             self._agg["predict_rows"] = \
@@ -398,6 +418,13 @@ class RunRecorder:
                     f"{s['predicts']:.0f} predicts "
                     f"({s.get('predict_cache_hits', 0):.0f} cache hits / "
                     f"{s.get('predict_cache_misses', 0):.0f} misses)")
+            if s.get("ckpt_saves") or s.get("ckpt_loads"):
+                parts.append(
+                    f"{s.get('ckpt_saves', 0):.0f} checkpoints "
+                    f"({s.get('ckpt_bytes', 0) / 1e6:.1f} MB, "
+                    f"{s.get('ckpt_save_ms', 0.0):.0f} ms), "
+                    f"{s.get('ckpt_loads', 0):.0f} loads, "
+                    f"{s.get('ckpt_fallbacks', 0):.0f} fallbacks")
             if s.get("serve_requests"):
                 parts.append(
                     f"{s['serve_requests']:.0f} serve requests "

@@ -165,6 +165,25 @@ def scan_anomalies(records):
                                f"are nearly all padding; shrink "
                                f"serve_max_batch_rows or raise "
                                f"serve_batch_wait_ms"))
+    ckpts = [r for r in records if r.get("type") == "checkpoint"]
+    if ckpts:
+        fallbacks = [r for r in ckpts if r.get("event") == "fallback"]
+        if fallbacks:
+            out.append(("HIGH", f"checkpoint fallback: {len(fallbacks)} "
+                                f"candidate(s) rejected "
+                                f"(corrupt/truncated) — loader fell "
+                                f"back to an older snapshot; last: "
+                                f"{fallbacks[-1].get('error', '?')}"))
+        save_ms = sum(float(r.get("duration_ms", 0.0)) for r in ckpts
+                      if r.get("event") == "save")
+        train_ms = sum(float(r.get("duration_ms", 0.0)) for r in records
+                       if r.get("type") in ("iteration", "superstep"))
+        if train_ms > 0 and save_ms > 0.05 * train_ms:
+            out.append(("MED", f"checkpoint save overhead "
+                               f"{100 * save_ms / train_ms:.1f}% of "
+                               f"train wall time ({save_ms:.0f} of "
+                               f"{train_ms:.0f} ms) — raise "
+                               f"snapshot_freq or shrink keep_last_n"))
     for r in records:
         if r.get("type") == "run_start" and r.get("backend_degraded"):
             out.append(("HIGH", "backend identity unavailable at "
@@ -226,6 +245,22 @@ def triage(records, baseline=None):
             lines.append(f"collectives : "
                          f"{s['collective_bytes'] / 1e6:.1f} MB moved "
                          f"(estimate)")
+        if s.get("ckpt_saves") or s.get("ckpt_loads") or \
+                s.get("ckpt_fallbacks"):
+            reasons = {}
+            for r in records:
+                if r.get("type") == "checkpoint" and \
+                        r.get("event") == "save":
+                    reasons[r.get("reason", "?")] = \
+                        reasons.get(r.get("reason", "?"), 0) + 1
+            rs = "/".join(f"{k}:{v}" for k, v in sorted(reasons.items()))
+            lines.append(
+                f"checkpoints : {s.get('ckpt_saves', 0):.0f} saves "
+                f"({rs or '-'}, {s.get('ckpt_bytes', 0) / 1e6:.2f} MB, "
+                f"{s.get('ckpt_save_ms', 0.0):.0f} ms), "
+                f"{s.get('ckpt_loads', 0):.0f} loads "
+                f"({s.get('ckpt_load_ms', 0.0):.0f} ms), "
+                f"{s.get('ckpt_fallbacks', 0):.0f} fallbacks")
         if s.get("serve_requests"):
             lines.append(
                 f"serve       : {s['serve_requests']:.0f} requests "
