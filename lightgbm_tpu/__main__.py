@@ -204,10 +204,16 @@ def _task_convert_model(params: Dict[str, str], config: Config) -> None:
 
 def _task_serve(params: Dict[str, str], config: Config) -> None:
     """Online serving: load the model, publish it to the registry
-    (flatten + pre-warm), serve the threaded JSON endpoint until
-    interrupted (``serve/http.py``)."""
+    (flatten + pre-warm), serve the threaded JSON endpoint until a
+    SIGTERM/SIGINT triggers the graceful drain (``serve/http.py``).
+    Pointed at a checkpoint ROOT, a watcher thread additionally
+    tracks the root: each new snapshot is manifest-verified and
+    canary-scored before auto-publish, with telemetry-driven rollback
+    (``serve/watcher.py``, ``docs/Resilience.md``)."""
     from .basic import Booster
-    from .serve import Server, ServeConfig
+    from .ckpt import CheckpointManager
+    from .serve import (CheckpointWatcher, FleetConfig, RegistryTarget,
+                        Server, ServeConfig)
     from .serve.http import serve_http
 
     if not config.input_model:
@@ -215,15 +221,26 @@ def _task_serve(params: Dict[str, str], config: Config) -> None:
                   "file, a ckpt_* checkpoint directory, or a "
                   "checkpoint root)")
     server = Server(config=ServeConfig.from_params(config))
+    watcher = None
     if os.path.isdir(config.input_model):
         # serve straight from a training checkpoint directory/root:
         # manifest-validated, newest-valid-wins (ckpt/manager.py)
         server.registry.publish_from_checkpoint(config.input_model)
+        if not CheckpointManager.is_checkpoint_dir(config.input_model):
+            # a ROOT is a live deploy pipeline: watch it (validated
+            # auto-publish + rollback); an explicit ckpt_* dir is a
+            # one-shot serve
+            watcher = CheckpointWatcher(
+                config.input_model, RegistryTarget(server),
+                config=FleetConfig.from_params(config),
+                recorder=server._recorder).start()
     else:
         server.registry.publish(Booster(model_file=config.input_model))
     try:
         serve_http(server)
     finally:
+        if watcher is not None:
+            watcher.stop()
         server.stop()
 
 

@@ -8,8 +8,10 @@ therefore only ever sees either the complete old bytes or the complete
 new bytes — never a prefix.  (The reference's ``SaveModelToFile`` has
 no such contract: a crash mid-save leaves a truncated model file.)
 
-Fault injection (tests / CI only) is env-gated so the recovery path is
-provable, not just plausible:
+Fault injection routes through the unified registry
+(``utils/faults.py``, point ``ckpt.save``) so checkpoint crashes
+compose with serve/watcher/fleet faults in one chaos spec.  The PR 5
+env pair keeps working (the registry folds it in):
 
 - ``LTPU_CKPT_FAULT=crash_blob``      — die mid-blob-write (partial
   temp file, no manifest): the checkpoint directory never finalizes.
@@ -21,6 +23,10 @@ provable, not just plausible:
 - ``LTPU_CKPT_FAULT_AT=<n>``          — trigger on the n-th save of
   the process (1-based, default 1); other saves run clean.
 
+The new-style equivalent is ``LTPU_FAULTS=ckpt.save:crash_blob@n``;
+the hit counter advances once per SAVE (``fault_armed`` fires the
+point), preserving the save-ordinal semantics.
+
 ``InjectedFault`` deliberately subclasses ``BaseException``: the save
 path's ``except Exception`` cleanup must NOT swallow it (a real
 SIGKILL wouldn't run cleanup either).
@@ -31,34 +37,24 @@ import hashlib
 import os
 import tempfile
 
+from ..utils import faults as _faults
+from ..utils.faults import InjectedFault
+
 __all__ = ["atomic_write_bytes", "atomic_write_text", "fsync_dir",
            "sha256_file", "InjectedFault", "fault_armed",
            "consume_fault", "reset_fault_counter"]
 
 
-class InjectedFault(BaseException):
-    """Simulated mid-write crash (env-gated, tests only)."""
-
-
-_fault_saves_seen = 0
-
-
 def reset_fault_counter() -> None:
-    global _fault_saves_seen
-    _fault_saves_seen = 0
+    _faults.reset("ckpt.save")
 
 
 def fault_armed() -> str:
     """The fault mode armed for the CURRENT save, or ''.  Call once
     per save attempt — the call advances the save ordinal that
-    ``LTPU_CKPT_FAULT_AT`` matches against."""
-    global _fault_saves_seen
-    mode = os.environ.get("LTPU_CKPT_FAULT", "")
-    if not mode:
-        return ""
-    _fault_saves_seen += 1
-    at = int(os.environ.get("LTPU_CKPT_FAULT_AT", "1") or 1)
-    return mode if _fault_saves_seen == at else ""
+    ``LTPU_CKPT_FAULT_AT`` (or a ``ckpt.save:...@n`` spec) matches
+    against."""
+    return _faults.fire("ckpt.save")
 
 
 def consume_fault(mode: str, point: str, path: str) -> None:

@@ -472,6 +472,106 @@ PARAMS: List[Param] = [
        "published, BEFORE it becomes the admission target — the "
        "zero-steady-state-compile contract; disable only for "
        "debugging", group="serve"),
+    _p("serve_max_body_bytes", 33554432, int, ("serve_max_body",),
+       "HTTP front body-size bound: requests with a larger "
+       "Content-Length are rejected with a structured 413 before the "
+       "body is read (hardening against oversized/abusive payloads)",
+       group="serve", check=">0"),
+    _p("serve_drain_grace_s", 10.0, float, ("serve_drain_grace",),
+       "graceful-drain window on SIGTERM/SIGINT: the server stops "
+       "admitting (503 + Retry-After), finishes already-admitted "
+       "requests for up to this long, then exits — so supervisor-"
+       "driven restarts never drop admitted requests",
+       group="serve", check=">=0"),
+    _p("serve_port_file", "", str, (),
+       "when set, the HTTP front writes its bound port to this file "
+       "once listening — ephemeral-port (serve_port=0) discovery for "
+       "the fleet supervisor", group="serve"),
+    _p("serve_debug_faults", False, bool, (),
+       "expose POST/GET /faults, the remote driving surface of the "
+       "fault-injection registry (utils/faults.py) — chaos tests "
+       "only, NEVER in production", group="serve"),
+    # ---- fleet (resilience layer: serve/fleet.py, serve/watcher.py) ----
+    _p("fleet_replicas", 2, int, ("serve_replicas",),
+       "serve processes the fleet supervisor runs; each replica pins "
+       "its own engine cache (shared-nothing)", group="fleet",
+       check=">=1"),
+    _p("fleet_probe_interval_s", 0.5, float, (),
+       "supervisor health-probe cadence (/healthz per replica)",
+       group="fleet", check=">0"),
+    _p("fleet_probe_timeout_s", 2.0, float, (),
+       "per-probe timeout; a hung replica (alive process, wedged "
+       "front) fails probes and is restarted like a crash",
+       group="fleet", check=">0"),
+    _p("fleet_fail_threshold", 3, int, (),
+       "consecutive failed probes before a live replica is declared "
+       "unhealthy and restarted (a dead process restarts immediately)",
+       group="fleet", check=">=1"),
+    _p("fleet_backoff_base_s", 0.5, float, (),
+       "restart backoff base: attempt n waits base * 2^(n-1) seconds "
+       "(capped at fleet_backoff_max_s) plus deterministic jitter",
+       group="fleet", check=">=0"),
+    _p("fleet_backoff_max_s", 30.0, float, (),
+       "restart backoff cap", group="fleet", check=">=0"),
+    _p("fleet_backoff_jitter", 0.2, float, (),
+       "jitter fraction on the restart backoff (deterministic per "
+       "slot/attempt, seeded by `seed` — avoids thundering-herd "
+       "restarts without making tests flaky)", group="fleet",
+       check=">=0"),
+    _p("fleet_circuit_failures", 5, int, (),
+       "circuit breaker: consecutive failed restart attempts before "
+       "the replica slot is removed from rotation (the fleet degrades "
+       "gracefully instead of burning CPU on a crash loop)",
+       group="fleet", check=">=1"),
+    _p("fleet_circuit_cooldown_s", 60.0, float, (),
+       "after this long an open circuit half-opens and one restart is "
+       "retried; 0 keeps the slot out until operator action",
+       group="fleet", check=">=0"),
+    _p("watch_poll_s", 2.0, float, ("watch_interval_s",),
+       "checkpoint-root watcher poll cadence: new finalized ckpt_* "
+       "snapshots are validated (manifest hashes + canary scoring) "
+       "and auto-published; corrupt or mis-scoring snapshots are "
+       "skipped with a telemetry anomaly", group="fleet", check=">0"),
+    _p("canary_file", "", str, (),
+       "npz of pinned reference rows the watcher scores every "
+       "candidate snapshot on before publishing: array 'X' (rows), "
+       "optional 'expected' (predictions pinned within "
+       "canary_tolerance) and/or 'label' (quality gate via "
+       "canary_min_auc)", group="fleet"),
+    _p("canary_min_auc", 0.0, float, (),
+       "minimum AUC of canary predictions against the canary 'label' "
+       "array; a snapshot scoring below it is NOT published "
+       "(0 disables the quality gate)", group="fleet", check=">=0"),
+    _p("canary_tolerance", 1e-6, float, (),
+       "relative+absolute tolerance for pinned 'expected' canary "
+       "predictions", group="fleet", check=">=0"),
+    _p("rollback_window_s", 10.0, float, (),
+       "post-publish observation window: after it elapses the "
+       "rollback controller compares the window's serve telemetry "
+       "rollups against the pre-publish window", group="fleet",
+       check=">0"),
+    _p("rollback_min_requests", 50, int, (),
+       "minimum requests inside the observation window before a "
+       "verdict is reached (too little traffic extends the window "
+       "instead of deciding on noise)", group="fleet", check=">=1"),
+    _p("rollback_error_rate", 0.05, float, (),
+       "rollback trigger: post-publish bad-request rate (shed/timeout"
+       "/error/5xx per request) exceeding the pre-publish rate by "
+       "this much republishes the previous version", group="fleet",
+       check=">=0"),
+    _p("rollback_p99_factor", 3.0, float, (),
+       "rollback trigger: post-publish p99 latency above factor x "
+       "the pre-publish p99 (and above rollback_p99_floor_ms)",
+       group="fleet", check=">0"),
+    _p("rollback_p99_floor_ms", 5.0, float, (),
+       "p99 regressions below this absolute latency never trigger a "
+       "rollback (sub-floor jitter is noise, not a regression)",
+       group="fleet", check=">=0"),
+    _p("rollback_holddown_s", 60.0, float, (),
+       "after a rollback, snapshots with the rolled-back model's "
+       "fingerprint are skipped (reason=holddown) for this long — a "
+       "regressing deploy cannot flap back in", group="fleet",
+       check=">=0"),
 ]
 
 _PARAM_BY_NAME: Dict[str, Param] = {p.name: p for p in PARAMS}

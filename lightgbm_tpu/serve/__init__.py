@@ -22,16 +22,28 @@ Large-scale Tree Boosting" for the low-latency inference focus):
   (``utils/telemetry.py``).
 - :mod:`.http`       — stdlib threaded JSON endpoint
   (``python -m lightgbm_tpu task=serve input_model=...``).
+- :mod:`.fleet`      — replica supervisor: health probing, restart
+  with backoff + jitter, circuit breaker, desired-model
+  reconciliation (``docs/Resilience.md``).
+- :mod:`.watcher`    — checkpoint-root watcher (manifest verify +
+  canary scoring before auto-publish) and the telemetry-driven
+  rollback controller.
 """
 from .admission import (AdmissionQueue, QueueSaturated, Request,
                         RequestShed, RequestTimeout, ServeError,
                         ServerClosed)
-from .config import ServeConfig
-from .registry import ModelRegistry, ModelVersion
+from .config import FleetConfig, ServeConfig
+from .fleet import FleetSupervisor, InprocReplica, ProcessReplica
+from .registry import ModelRegistry, ModelVersion, model_fingerprint
 from .server import Server
+from .watcher import (CanarySet, CheckpointWatcher, FleetTarget,
+                      RegistryTarget)
 
 __all__ = [
-    "Server", "ServeConfig", "ModelRegistry", "ModelVersion",
-    "AdmissionQueue", "Request", "ServeError", "QueueSaturated",
-    "RequestShed", "RequestTimeout", "ServerClosed",
+    "Server", "ServeConfig", "FleetConfig", "ModelRegistry",
+    "ModelVersion", "model_fingerprint", "AdmissionQueue", "Request",
+    "ServeError", "QueueSaturated", "RequestShed", "RequestTimeout",
+    "ServerClosed", "FleetSupervisor", "InprocReplica",
+    "ProcessReplica", "CanarySet", "CheckpointWatcher", "FleetTarget",
+    "RegistryTarget",
 ]

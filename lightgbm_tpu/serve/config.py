@@ -38,6 +38,18 @@ class ServeConfig:
     # Server applies this itself at construction)
     predict_cache_slots: int = 16
     telemetry_file: str = ""
+    # HTTP front hardening: reject request bodies beyond this many
+    # bytes with a structured 413 before reading them
+    max_body_bytes: int = 33554432
+    # graceful drain (SIGTERM / supervisor restart): how long to wait
+    # for admitted requests to complete before hard-stopping
+    drain_grace_s: float = 10.0
+    # when set, the HTTP front writes its bound port here once
+    # listening (ephemeral-port discovery for the fleet supervisor)
+    port_file: str = ""
+    # expose POST/GET /faults (the fault-injection harness's remote
+    # driving surface, utils/faults.py) — chaos tests only
+    debug_faults: bool = False
 
     @classmethod
     def from_params(cls, params: Union[None, Dict[str, Any], Any] = None
@@ -60,7 +72,11 @@ class ServeConfig:
             workers=int(cfg.serve_workers),
             warmup=bool(cfg.serve_warmup),
             predict_cache_slots=int(cfg.predict_cache_slots),
-            telemetry_file=str(cfg.telemetry_file or ""))
+            telemetry_file=str(cfg.telemetry_file or ""),
+            max_body_bytes=int(cfg.serve_max_body_bytes),
+            drain_grace_s=float(cfg.serve_drain_grace_s),
+            port_file=str(cfg.serve_port_file or ""),
+            debug_faults=bool(cfg.serve_debug_faults))
 
     def validate(self) -> None:
         if self.max_batch_rows <= 0:
@@ -72,3 +88,92 @@ class ServeConfig:
             raise ValueError("serve_workers must be >= 1")
         if self.batch_wait_ms < 0 or self.timeout_ms < 0:
             raise ValueError("serve wait/timeout must be >= 0")
+        if self.max_body_bytes <= 0:
+            raise ValueError("serve_max_body_bytes must be > 0")
+        if self.drain_grace_s < 0:
+            raise ValueError("serve_drain_grace_s must be >= 0")
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Resolved knobs of the resilience layer: the replica supervisor
+    (``serve/fleet.py``), the checkpoint watcher and the rollback
+    controller (``serve/watcher.py``).  Canonical definitions live in
+    the ``fleet`` group of the ``lightgbm_tpu/config.py`` registry."""
+
+    replicas: int = 2
+    # supervisor health probing
+    probe_interval_s: float = 0.5
+    probe_timeout_s: float = 2.0
+    fail_threshold: int = 3
+    # restart policy: exponential backoff with deterministic jitter
+    backoff_base_s: float = 0.5
+    backoff_max_s: float = 30.0
+    backoff_jitter: float = 0.2
+    # circuit breaker: after this many consecutive failed restarts the
+    # replica leaves the rotation; cooldown 0 keeps it out for good
+    circuit_failures: int = 5
+    circuit_cooldown_s: float = 60.0
+    seed: int = 0
+    # checkpoint watcher
+    watch_poll_s: float = 2.0
+    canary_file: str = ""
+    canary_min_auc: float = 0.0
+    canary_tolerance: float = 1e-6
+    # telemetry-driven rollback
+    rollback_window_s: float = 10.0
+    rollback_min_requests: int = 50
+    rollback_error_rate: float = 0.05
+    rollback_p99_factor: float = 3.0
+    rollback_p99_floor_ms: float = 5.0
+    rollback_holddown_s: float = 60.0
+
+    @classmethod
+    def from_params(cls, params: Union[None, Dict[str, Any], Any] = None
+                    ) -> "FleetConfig":
+        from ..config import Config
+        if params is None:
+            cfg = Config()
+        elif isinstance(params, Config):
+            cfg = params
+        else:
+            cfg = Config(dict(params))
+        return cls(
+            replicas=int(cfg.fleet_replicas),
+            probe_interval_s=float(cfg.fleet_probe_interval_s),
+            probe_timeout_s=float(cfg.fleet_probe_timeout_s),
+            fail_threshold=int(cfg.fleet_fail_threshold),
+            backoff_base_s=float(cfg.fleet_backoff_base_s),
+            backoff_max_s=float(cfg.fleet_backoff_max_s),
+            backoff_jitter=float(cfg.fleet_backoff_jitter),
+            circuit_failures=int(cfg.fleet_circuit_failures),
+            circuit_cooldown_s=float(cfg.fleet_circuit_cooldown_s),
+            seed=int(cfg.seed) if cfg.seed is not None else 0,
+            watch_poll_s=float(cfg.watch_poll_s),
+            canary_file=str(cfg.canary_file or ""),
+            canary_min_auc=float(cfg.canary_min_auc),
+            canary_tolerance=float(cfg.canary_tolerance),
+            rollback_window_s=float(cfg.rollback_window_s),
+            rollback_min_requests=int(cfg.rollback_min_requests),
+            rollback_error_rate=float(cfg.rollback_error_rate),
+            rollback_p99_factor=float(cfg.rollback_p99_factor),
+            rollback_p99_floor_ms=float(cfg.rollback_p99_floor_ms),
+            rollback_holddown_s=float(cfg.rollback_holddown_s))
+
+    def validate(self) -> None:
+        if self.replicas < 1:
+            raise ValueError("fleet_replicas must be >= 1")
+        if self.probe_interval_s <= 0 or self.probe_timeout_s <= 0:
+            raise ValueError("fleet probe interval/timeout must be > 0")
+        if self.fail_threshold < 1 or self.circuit_failures < 1:
+            raise ValueError("fleet failure thresholds must be >= 1")
+        if self.backoff_base_s < 0 or self.backoff_max_s < \
+                self.backoff_base_s:
+            raise ValueError("fleet backoff must satisfy 0 <= base "
+                             "<= max")
+        if not 0 <= self.backoff_jitter <= 1:
+            raise ValueError("fleet_backoff_jitter must be in [0, 1]")
+        if self.rollback_min_requests < 1:
+            raise ValueError("rollback_min_requests must be >= 1")
+        if self.rollback_error_rate < 0 or self.rollback_p99_factor <= 0:
+            raise ValueError("rollback thresholds must be positive")

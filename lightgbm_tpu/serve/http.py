@@ -7,27 +7,56 @@ to coalesce).  Routes:
 
 - ``POST /predict``  ``{"rows": [[...], ...], "raw": false,
   "priority": 0, "timeout_ms": 500}`` ->
-  ``{"predictions": [...], "version": v, "total_ms": t}``;
-  429 + ``Retry-After`` on backpressure, 503 on shed, 504 on timeout.
+  ``{"predictions": [...], "version": v, "model_id": id,
+  "total_ms": t}``; 429 + ``Retry-After`` on backpressure, 503 on
+  shed/drain, 504 on timeout.  Errors are STRUCTURED: every non-200
+  body is ``{"error": msg, "code": slug}`` — malformed JSON, wrong
+  dtypes and oversized bodies map to 400/413, never to a 500
+  traceback.
 - ``POST /swap``     ``{"model_file": path}`` or ``{"model_str": s}``
-  -> ``{"version": v}`` (blocks through flatten + pre-warm; in-flight
-  requests finish on their admitted version).
-- ``GET /healthz``   liveness + active version.
+  -> ``{"version": v, "model_id": id}`` (blocks through flatten +
+  pre-warm; in-flight requests finish on their admitted version).
+- ``GET /healthz``   liveness + active version/model_id; 503 with
+  ``{"draining": true}`` once a drain begins, so supervisors and load
+  balancers stop routing to a replica that is going away.
 - ``GET /stats``     queue depth, latency percentiles, engine cache.
+- ``GET /model``     the active version's reference-format model text
+  (the watcher's rollback-baseline capture).
+- ``POST/GET /faults``  remote driving surface of the fault-injection
+  registry (``utils/faults.py``) — only with
+  ``serve_debug_faults=true``, 403 otherwise.
+
+Graceful drain: :func:`serve_http` in foreground mode installs
+SIGTERM/SIGINT handlers that run admit-stop -> finish-admitted ->
+exit (``Server.drain``), so a supervisor-driven restart never drops a
+request the queue already accepted.
 """
 from __future__ import annotations
 
 import json
+import os
+import signal
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from ..utils import faults as _faults
 from ..utils.log import Log
 from .admission import (QueueSaturated, RequestShed, RequestTimeout,
                         ServeError, ServerClosed)
 from .server import Server
+
+
+class _BadRequest(Exception):
+    """Client fault mapped to a structured 400/413 response."""
+
+    def __init__(self, code: int, slug: str, msg: str):
+        super().__init__(msg)
+        self.http_code = int(code)
+        self.slug = str(slug)
 
 
 def _json_handler_for(server: Server):
@@ -46,93 +75,227 @@ def _json_handler_for(server: Server):
             self.end_headers()
             self.wfile.write(body)
 
-        def _read_json(self) -> Optional[Dict[str, Any]]:
+        def _read_json(self) -> Dict[str, Any]:
+            """Parse the request body, hardened: a bounded read and
+            structured failures — an abusive payload must cost one
+            cheap rejection, not memory or a traceback."""
             try:
                 n = int(self.headers.get("Content-Length", 0))
-                return json.loads(self.rfile.read(n) or b"{}")
-            except (ValueError, TypeError):
-                return None
+            except (TypeError, ValueError):
+                raise _BadRequest(400, "bad_content_length",
+                                  "Content-Length is not an integer")
+            if n < 0:
+                raise _BadRequest(400, "bad_content_length",
+                                  "negative Content-Length")
+            if n > server.config.max_body_bytes:
+                raise _BadRequest(
+                    413, "body_too_large",
+                    f"request body {n} bytes exceeds "
+                    f"serve_max_body_bytes="
+                    f"{server.config.max_body_bytes}")
+            try:
+                raw = self.rfile.read(n) if n else b"{}"
+            except OSError as exc:
+                raise _BadRequest(400, "body_read_failed",
+                                  f"could not read body: {exc}")
+            try:
+                obj = json.loads(raw or b"{}")
+            except ValueError as exc:
+                raise _BadRequest(400, "bad_json",
+                                  f"body is not valid JSON: {exc}")
+            if not isinstance(obj, dict):
+                raise _BadRequest(400, "bad_json",
+                                  "body must be a JSON object")
+            return obj
+
+        def _drain_reject(self) -> bool:
+            """503 + Retry-After for new work once draining began."""
+            if not server.draining:
+                return False
+            self._send(503, {"error": "server is draining",
+                             "code": "draining",
+                             "draining": True},
+                       headers={"Retry-After": "1"})
+            return True
 
         def log_message(self, fmt, *args):  # route through our logger
             Log.debug("serve http: " + fmt, *args)
 
+        def _guarded(self, fn) -> None:
+            """Route wrapper: client faults -> structured 4xx, anything
+            unexpected -> structured 500 (never a traceback into the
+            socket)."""
+            try:
+                fn()
+            except _BadRequest as exc:
+                # the body may be unread (413 / bad Content-Length):
+                # close, or the keep-alive stream would parse the
+                # leftover body bytes as the next request line
+                self.close_connection = True
+                self._send(exc.http_code, {"error": str(exc),
+                                           "code": exc.slug})
+            except (BrokenPipeError, ConnectionResetError):
+                pass                      # client went away mid-response
+            except Exception as exc:      # noqa: BLE001 - last resort
+                Log.warning("serve http: unhandled %s: %s",
+                            type(exc).__name__, exc)
+                try:
+                    self._send(500, {"error": f"internal error: {exc}",
+                                     "code": "internal"})
+                except Exception:         # noqa: BLE001 - socket dead
+                    pass
+
         # -- routes ----------------------------------------------------
         def do_GET(self):
-            if self.path == "/healthz":
-                depth_reqs, depth_rows = server.queue.depth()
-                self._send(200, {"ok": True,
-                                 "version": server.version(),
-                                 "queue_requests": depth_reqs,
-                                 "queue_rows": depth_rows})
-            elif self.path == "/stats":
-                self._send(200, server.stats())
-            else:
-                self._send(404, {"error": f"no route {self.path}"})
+            self._guarded(self._get)
 
         def do_POST(self):
+            self._guarded(self._post)
+
+        def _get(self):
+            if self.path == "/healthz":
+                depth_reqs, depth_rows = server.queue.depth()
+                ver = server.registry.current()
+                body = {"ok": not server.draining,
+                        "draining": server.draining,
+                        "version": ver.version if ver else None,
+                        "model_id": ver.model_id if ver else None,
+                        "queue_requests": depth_reqs,
+                        "queue_rows": depth_rows}
+                self._send(503 if server.draining else 200, body)
+            elif self.path == "/stats":
+                self._send(200, server.stats())
+            elif self.path == "/model":
+                ver = server.registry.current()
+                if ver is None:
+                    self._send(404, {"error": "no model published",
+                                     "code": "no_model"})
+                else:
+                    self._send(200, {"version": ver.version,
+                                     "model_id": ver.model_id,
+                                     "model_str": ver.model_text})
+            elif self.path == "/faults":
+                if not server.config.debug_faults:
+                    self._send(403, {"error": "serve_debug_faults is "
+                                              "off", "code": "forbidden"})
+                else:
+                    self._send(200, _faults.snapshot())
+            else:
+                self._send(404, {"error": f"no route {self.path}",
+                                 "code": "no_route"})
+
+        def _post(self):
             if self.path == "/predict":
                 self._predict()
             elif self.path == "/swap":
                 self._swap()
+            elif self.path == "/faults":
+                self._faults()
             else:
-                self._send(404, {"error": f"no route {self.path}"})
+                self._send(404, {"error": f"no route {self.path}",
+                                 "code": "no_route"})
 
         def _predict(self):
-            body = self._read_json()
-            if body is None or "rows" not in body:
-                self._send(400, {"error": "body must be JSON with "
-                                          "a 'rows' matrix"})
+            # fault-injection point ``http.request``: "error" answers
+            # a structured 500; "drop" closes the connection with no
+            # response (a client-visible transport failure)
+            mode = _faults.fire("http.request")
+            if mode == "error":
+                self._send(500, {"error": "injected fault "
+                                          "(http.request:error)",
+                                 "code": "injected"})
                 return
+            if mode == "drop":
+                self.close_connection = True
+                return
+            if self._drain_reject():
+                return
+            body = self._read_json()
+            if "rows" not in body:
+                raise _BadRequest(400, "missing_rows",
+                                  "body must carry a 'rows' matrix")
             try:
                 X = np.asarray(body["rows"], np.float64)
             except (ValueError, TypeError) as exc:
-                self._send(400, {"error": f"bad rows: {exc}"})
-                return
+                raise _BadRequest(400, "bad_rows",
+                                  f"'rows' is not a numeric matrix: "
+                                  f"{exc}")
             try:
-                req = server.submit(
-                    X, priority=int(body.get("priority", 0)),
-                    timeout_ms=body.get("timeout_ms"),
-                    raw=bool(body.get("raw", False)))
+                priority = int(body.get("priority", 0))
+                timeout_ms = body.get("timeout_ms")
+                if timeout_ms is not None:
+                    timeout_ms = float(timeout_ms)
+                raw = bool(body.get("raw", False))
+            except (ValueError, TypeError) as exc:
+                raise _BadRequest(400, "bad_field",
+                                  f"priority/timeout_ms malformed: "
+                                  f"{exc}")
+            try:
+                req = server.submit(X, priority=priority,
+                                    timeout_ms=timeout_ms, raw=raw)
                 out = req.value()
             except QueueSaturated as exc:
                 # RFC 7231 Retry-After is integer seconds; the precise
                 # hint rides in the JSON retry_after_ms field
                 retry_s = max(int(-(-exc.retry_after_ms // 1e3)), 1)
                 self._send(429, {"error": str(exc),
+                                 "code": "backpressure",
                                  "retry_after_ms": exc.retry_after_ms},
                            headers={"Retry-After": str(retry_s)})
                 return
             except RequestTimeout as exc:
-                self._send(504, {"error": str(exc)})
+                self._send(504, {"error": str(exc), "code": "timeout"})
                 return
             except (RequestShed, ServerClosed) as exc:
-                self._send(503, {"error": str(exc)})
+                self._send(503, {"error": str(exc), "code": "shed"},
+                           headers={"Retry-After": "1"})
                 return
-            except ValueError as exc:      # malformed input: client fault
-                self._send(400, {"error": str(exc)})
-                return
+            except (ValueError, TypeError) as exc:  # malformed input
+                raise _BadRequest(400, "bad_rows", str(exc))
             except ServeError as exc:      # dispatch failed: server fault
-                self._send(500, {"error": str(exc)})
+                self._send(500, {"error": str(exc), "code": "dispatch"})
                 return
             self._send(200, {
                 "predictions": np.asarray(out).tolist(),
                 "version": req.version.version,
+                "model_id": req.version.model_id,
                 "total_ms": round(req.timings.get("total_ms", 0.0), 3)})
 
         def _swap(self):
-            body = self._read_json()
-            if body is None or not (body.get("model_file") or
-                                    body.get("model_str")):
-                self._send(400, {"error": "body must carry model_file "
-                                          "or model_str"})
+            if self._drain_reject():
                 return
+            body = self._read_json()
+            if not (body.get("model_file") or body.get("model_str")):
+                raise _BadRequest(400, "missing_model",
+                                  "body must carry model_file or "
+                                  "model_str")
             try:
                 v = server.swap(model_file=body.get("model_file"),
                                 model_str=body.get("model_str"))
-            except Exception as exc:
-                self._send(400, {"error": f"swap failed: {exc}"})
+            except Exception as exc:      # noqa: BLE001 - client input
+                self._send(400, {"error": f"swap failed: {exc}",
+                                 "code": "swap_failed"})
                 return
-            self._send(200, {"version": v})
+            ver = server.registry.current()
+            self._send(200, {"version": v,
+                             "model_id": ver.model_id if ver else None})
+
+        def _faults(self):
+            if not server.config.debug_faults:
+                self._send(403, {"error": "serve_debug_faults is off",
+                                 "code": "forbidden"})
+                return
+            body = self._read_json()
+            spec = body.get("spec", "")
+            try:
+                parsed = _faults.configure(str(spec))
+            except ValueError as exc:
+                raise _BadRequest(400, "bad_spec", str(exc))
+            if body.get("reset"):
+                _faults.reset()
+            self._send(200, {"ok": True,
+                             "specs": [repr(s) for s in parsed],
+                             "snapshot": _faults.snapshot()})
 
     return ServeHandler
 
@@ -145,6 +308,13 @@ def make_http_server(server: Server, host: Optional[str] = None,
     port = server.config.port if port is None else port
     httpd = ThreadingHTTPServer((host, port), _json_handler_for(server))
     httpd.daemon_threads = True
+    if server.config.port_file:
+        # ephemeral-port discovery for the fleet supervisor: write to
+        # a temp sibling + rename so a reader never sees a torn write
+        tmp = server.config.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("%d\n" % httpd.server_address[1])
+        os.replace(tmp, server.config.port_file)
     return httpd
 
 
@@ -155,21 +325,49 @@ def serve_http(server: Server, host: Optional[str] = None,
     """Start the Server's dispatchers and the HTTP front.  With
     ``background=True`` the accept loop runs in a daemon thread and
     the pair ``(httpd, thread)`` returns immediately (the test /
-    loadgen mode); otherwise this blocks until interrupted."""
+    loadgen / replica-handle mode); otherwise this blocks until a
+    SIGTERM/SIGINT triggers the graceful drain: stop admitting (503 +
+    Retry-After), finish admitted requests within
+    ``serve_drain_grace_s``, then return."""
     server.start()
     httpd = make_http_server(server, host, port)
     Log.info("serve: listening on http://%s:%d (model v%s)",
              *httpd.server_address[:2], server.version())
+    accept = threading.Thread(target=httpd.serve_forever,
+                              name="ltpu-serve-http", daemon=True)
+    accept.start()
     if background:
-        t = threading.Thread(target=httpd.serve_forever,
-                             name="ltpu-serve-http", daemon=True)
-        t.start()
-        return httpd, t
+        return httpd, accept
+
+    stop_evt = threading.Event()
+    previous: Dict[int, Any] = {}
+
+    def _on_signal(signum, frame):
+        Log.info("serve: signal %d — draining (grace %.1fs)",
+                 signum, server.config.drain_grace_s)
+        stop_evt.set()
+
+    installed = False
+    if threading.current_thread() is threading.main_thread():
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            previous[sig] = signal.signal(sig, _on_signal)
+        installed = True
     try:
-        httpd.serve_forever()
-    except KeyboardInterrupt:
-        Log.info("serve: interrupted, draining")
+        stop_evt.wait()
+    except KeyboardInterrupt:             # handlers not installed
+        pass
     finally:
-        httpd.shutdown()
-        server.stop()
+        try:
+            server.drain()                # 503 new work, finish admitted
+            # drained requests are complete; give their handler
+            # threads a beat to serialize responses before the accept
+            # loop (and likely the process) goes away
+            time.sleep(0.2)
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            if installed:
+                for sig, old in previous.items():
+                    signal.signal(sig, old)
+    Log.info("serve: drained and stopped")
     return httpd, None

@@ -22,6 +22,7 @@ its compiles inside ``publish()``, never on the request path.
 """
 from __future__ import annotations
 
+import hashlib
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -31,6 +32,16 @@ import numpy as np
 from ..utils.log import Log
 from ..utils.telemetry import counters as _tele_counters
 from ..utils.telemetry import counters_snapshot
+
+
+def model_fingerprint(model_text: str) -> str:
+    """Content-addressed model identity: sha256 of the reference-format
+    model text, truncated.  Unlike the per-registry ``version`` integer
+    (which restarts from 1 with each replica process), the fingerprint
+    is stable across the whole fleet — it is how the watcher, the
+    rollback controller and the load generator agree on WHICH model a
+    response was scored by."""
+    return hashlib.sha256(model_text.encode("utf-8")).hexdigest()[:12]
 
 
 class ModelVersion:
@@ -53,6 +64,11 @@ class ModelVersion:
         self.k = self.flat.k
         self.num_features = self.flat.num_features
         self.requires_features = self.flat.requires_features
+        # the model text is retained on the version: it serves
+        # GET /model (the watcher's rollback baseline capture) and is
+        # what the fingerprint — the fleet-wide identity — is taken of
+        self.model_text: str = booster.model_to_string(num_iteration=-1)
+        self.model_id: str = model_fingerprint(self.model_text)
         self.published_at = time.time()
         self.warmup_info: Optional[Dict[str, Any]] = None
 
@@ -111,7 +127,8 @@ class ModelVersion:
         return info
 
     def meta(self) -> Dict[str, Any]:
-        return {"version": self.version, "n_trees": self.n_trees,
+        return {"version": self.version, "model_id": self.model_id,
+                "n_trees": self.n_trees,
                 "num_features": self.num_features,
                 "published_at": round(self.published_at, 3),
                 "warmup": self.warmup_info}

@@ -22,6 +22,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..utils import faults as _faults
 from ..utils.log import Log
 from ..utils.telemetry import counters as _tele_counters
 from ..utils.telemetry import percentile as _percentile
@@ -49,6 +50,7 @@ class Server:
             chunk_rows=self.config.max_batch_rows,
             warm=self.config.warmup)
         self._stop = threading.Event()
+        self.draining = False
         self._threads: List[threading.Thread] = []
         self._rid = 0
         self._rid_lock = threading.Lock()
@@ -114,6 +116,21 @@ class Server:
             self._recorder.close()
             self._recorder = None
 
+    def drain(self, grace_s: Optional[float] = None) -> None:
+        """Graceful drain: stop admitting (the HTTP front answers 503
+        + Retry-After while ``draining`` is set), finish every
+        already-admitted request, then stop.  This is what a SIGTERM
+        triggers, so supervisor-driven restarts never drop admitted
+        work.  Idempotent."""
+        self.draining = True
+        grace = self.config.drain_grace_s if grace_s is None \
+            else float(grace_s)
+        self.queue.close()                 # new submits raise ServerClosed
+        deadline = time.monotonic() + max(grace, 0.0)
+        while self.queue.depth()[0] > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        self.stop(timeout=max(deadline - time.monotonic(), 0.1))
+
     def __enter__(self) -> "Server":
         return self.start()
 
@@ -134,7 +151,7 @@ class Server:
             self._recorder.emit(
                 "serve", status="swap", rows=0,
                 total_ms=round((time.monotonic() - t0) * 1e3, 3),
-                version=ver.version,
+                version=ver.version, model_id=ver.model_id,
                 warmup=ver.warmup_info)
         return ver.version
 
@@ -225,6 +242,16 @@ class Server:
     def _dispatch(self, batch: Batch) -> None:
         t0 = time.monotonic()
         try:
+            # fault-injection point ``serve.dispatch`` (utils/faults.py):
+            # "error" exercises the real failure path below; "sleep_<ms>"
+            # degrades latency so the rollback controller's p99 trigger
+            # is testable without a genuinely slow model
+            mode = _faults.fire("serve.dispatch")
+            if mode.startswith("sleep_"):
+                time.sleep(max(float(mode.split("_", 1)[1]), 0.0) / 1e3)
+            elif mode == "error":
+                raise RuntimeError(
+                    "injected fault (serve.dispatch:error)")
             raw = batch.version.predict_raw_batch(batch.X)
         except Exception as exc:  # batch fails as a unit, loudly
             Log.warning("serve: batch dispatch failed: %s", exc)
@@ -272,6 +299,7 @@ class Server:
                 fields[key] = req.timings[key]
         if req.version is not None:
             fields["version"] = req.version.version
+            fields["model_id"] = req.version.model_id
         if batch is not None:
             fields["batch_rows"] = batch.rows
             fields["bucket_rows"] = batch.bucket_rows
@@ -289,6 +317,8 @@ class Server:
         ver = self.registry.current()
         return {
             "version": ver.version if ver else None,
+            "model_id": ver.model_id if ver else None,
+            "draining": self.draining,
             "queue_requests": depth_reqs,
             "queue_rows": depth_rows,
             "requests": counts,

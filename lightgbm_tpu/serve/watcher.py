@@ -1,0 +1,537 @@
+"""Checkpoint-root watcher: continuous deployment with a safety gate,
+plus the telemetry-driven rollback controller.
+
+``CheckpointWatcher`` polls a training job's ``checkpoint_dir`` for
+new finalized ``ckpt_*`` snapshots (``ckpt/manager.py`` write
+protocol: a finalized name implies a complete directory) and runs each
+one through a validation pipeline BEFORE it can reach the serving
+tier:
+
+1. **manifest verify** — every blob must match its manifested size and
+   sha256 (``CheckpointManager.validate``); a corrupt/truncated
+   snapshot is skipped with a ``fleet``/``publish_skip`` telemetry
+   record (``reason=manifest``) and the previous version keeps
+   serving.
+2. **canary scoring** — the snapshot's model scores pinned reference
+   rows (:class:`CanarySet`): predictions must be finite, match
+   pinned ``expected`` outputs within tolerance when given, and clear
+   a label-AUC quality bar when given.  A mis-scoring model is skipped
+   (``reason=canary``) — it parsed fine, it is just WRONG, which no
+   hash can catch.
+3. **publish** — only then does the model text go to the publish
+   target (an in-process :class:`RegistryTarget` or the whole fleet
+   via :class:`FleetTarget` -> ``FleetSupervisor.publish_model``).
+
+After every publish the **rollback controller** watches the serve
+telemetry rollups: once the observation window has both elapsed
+(``rollback_window_s``) and seen ``rollback_min_requests`` requests,
+the post-publish bad-request rate (shed/timeout/error per request) and
+p99 latency are compared against the pre-publish window.  A regression
+republishes the pre-publish model (captured in memory at publish time,
+independent of checkpoint retention pruning) and puts the bad model's
+fingerprint in hold-down so it cannot flap back in.  ``rollback``
+records and skips surface as triage anomalies
+(``tools/triage_run.py``).
+
+Fault-injection points: ``watcher.validate`` (mode ``reject``) and
+``watcher.canary`` (mode ``fail``) force each skip path — the CI chaos
+job drives both (``utils/faults.py``).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..ckpt.manager import CheckpointManager
+from ..utils import faults as _faults
+from ..utils.log import Log
+from .config import FleetConfig
+from .registry import model_fingerprint
+
+__all__ = ["CanarySet", "CheckpointWatcher", "RegistryTarget",
+           "FleetTarget", "auc_score"]
+
+
+def auc_score(labels, scores) -> float:
+    """Rank-based AUC (ties averaged) — the canary quality gate's
+    metric, dependency-free."""
+    labels = np.asarray(labels, np.float64).ravel()
+    scores = np.asarray(scores, np.float64).ravel()
+    pos = labels > 0
+    n_pos = int(pos.sum())
+    n_neg = labels.size - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return 0.5
+    order = np.argsort(scores, kind="mergesort")
+    ranks = np.empty(labels.size, np.float64)
+    ranks[order] = np.arange(1, labels.size + 1)
+    # average ranks across tied scores so the gate is permutation-stable
+    sorted_scores = scores[order]
+    i = 0
+    while i < labels.size:
+        j = i
+        while j + 1 < labels.size and \
+                sorted_scores[j + 1] == sorted_scores[i]:
+            j += 1
+        if j > i:
+            ranks[order[i:j + 1]] = 0.5 * (i + 1 + j + 1)
+        i = j + 1
+    return float((ranks[pos].sum() - n_pos * (n_pos + 1) / 2.0)
+                 / (n_pos * n_neg))
+
+
+class CanarySet:
+    """Pinned reference rows every candidate snapshot must score
+    correctly before publishing.
+
+    - ``expected`` (optional): predictions pinned within ``tol``
+      (relative+absolute) — the bit-rot / wrong-artifact detector.
+    - ``labels`` + ``min_auc`` (optional): a quality gate that holds
+      across retrains — a newly trained (different) model passes as
+      long as it actually ranks the canary rows.
+    """
+
+    def __init__(self, X, expected=None, labels=None,
+                 min_auc: float = 0.0, tol: float = 1e-6):
+        self.X = np.ascontiguousarray(np.asarray(X, np.float64))
+        if self.X.ndim != 2 or self.X.shape[0] == 0:
+            raise ValueError("canary X must be a non-empty 2-D matrix")
+        self.expected = None if expected is None else \
+            np.asarray(expected, np.float64)
+        self.labels = None if labels is None else \
+            np.asarray(labels, np.float64).ravel()
+        if self.labels is not None and \
+                self.labels.size != self.X.shape[0]:
+            raise ValueError("canary labels length != rows")
+        self.min_auc = float(min_auc)
+        self.tol = float(tol)
+
+    @classmethod
+    def from_file(cls, path: str, min_auc: float = 0.0,
+                  tol: float = 1e-6) -> "CanarySet":
+        """Load ``canary_file``: npz with ``X`` and optional
+        ``expected`` / ``label`` arrays."""
+        with np.load(path) as z:
+            X = z["X"]
+            expected = z["expected"] if "expected" in z.files else None
+            labels = z["label"] if "label" in z.files else (
+                z["labels"] if "labels" in z.files else None)
+        return cls(X, expected=expected, labels=labels,
+                   min_auc=min_auc, tol=tol)
+
+    def check(self, booster) -> List[str]:
+        """Score the canary rows; returns problems (empty = pass)."""
+        errs: List[str] = []
+        try:
+            preds = np.asarray(booster.predict(self.X), np.float64)
+        except Exception as exc:           # noqa: BLE001 - model's fault
+            return [f"canary predict raised: {exc}"]
+        if _faults.fire("watcher.canary") == "fail":
+            errs.append("injected fault (watcher.canary:fail)")
+        if not np.all(np.isfinite(preds)):
+            errs.append(f"canary predictions contain "
+                        f"{int((~np.isfinite(preds)).sum())} "
+                        f"non-finite values")
+        if self.expected is not None:
+            if preds.shape != self.expected.shape:
+                errs.append(f"canary shape {preds.shape} != expected "
+                            f"{self.expected.shape}")
+            elif not np.allclose(preds, self.expected, rtol=self.tol,
+                                 atol=self.tol):
+                worst = float(np.max(np.abs(preds - self.expected)))
+                errs.append(f"canary predictions deviate from pinned "
+                            f"expected outputs (max abs diff "
+                            f"{worst:.3g} > tol {self.tol:g})")
+        if self.labels is not None and self.min_auc > 0 \
+                and preds.ndim == 1:
+            auc = auc_score(self.labels, preds)
+            if auc < self.min_auc:
+                errs.append(f"canary AUC {auc:.4f} below the "
+                            f"canary_min_auc={self.min_auc:g} quality "
+                            f"bar")
+        return errs
+
+
+# ----------------------------------------------------------------------
+# publish targets
+# ----------------------------------------------------------------------
+class RegistryTarget:
+    """Publish target over one in-process :class:`~.server.Server`."""
+
+    def __init__(self, server):
+        self.server = server
+
+    def active_model(self) -> Optional[Tuple[str, str]]:
+        ver = self.server.registry.current()
+        return None if ver is None else (ver.model_id, ver.model_text)
+
+    def publish_model(self, model_text: str, source: str = "") -> str:
+        self.server.swap(model_str=model_text)
+        return self.server.registry.current().model_id
+
+    def active_ids(self) -> List[str]:
+        ver = self.server.registry.current()
+        return [] if ver is None else [ver.model_id]
+
+    def stats_probe(self) -> Dict[str, float]:
+        s = self.server.stats()
+        counts = s.get("requests") or {}
+        return {
+            "requests": float(sum(int(v) for v in counts.values())),
+            "bad": float(sum(int(counts.get(k, 0))
+                             for k in ("shed", "timeout", "error"))),
+            "p99_ms": float((s.get("latency_ms") or {})
+                            .get("p99", 0.0)),
+        }
+
+
+class FleetTarget:
+    """Publish target over a :class:`~.fleet.FleetSupervisor`: publish
+    swaps every healthy replica (the supervisor reconciles restarts),
+    probes aggregate across the fleet."""
+
+    def __init__(self, supervisor):
+        self.supervisor = supervisor
+
+    def active_model(self) -> Optional[Tuple[str, str]]:
+        import json as _json
+        import urllib.request
+        for url in self.supervisor.endpoints():
+            try:
+                with urllib.request.urlopen(url + "/model",
+                                            timeout=10) as r:
+                    obj = _json.loads(r.read())
+                return obj["model_id"], obj["model_str"]
+            except Exception:              # noqa: BLE001 - try the next
+                continue
+        return None
+
+    def publish_model(self, model_text: str, source: str = "") -> str:
+        return self.supervisor.publish_model(model_text, source)
+
+    def active_ids(self) -> List[str]:
+        return [mid for mid in
+                self.supervisor.active_models().values()
+                if mid is not None]
+
+    def stats_probe(self) -> Dict[str, float]:
+        return self.supervisor.stats_probe()
+
+
+# ----------------------------------------------------------------------
+# the watcher + rollback controller
+# ----------------------------------------------------------------------
+class CheckpointWatcher:
+    """Polls a checkpoint root, validates, canaries, publishes, and
+    rolls back regressions.  ``poll_once()`` is the deterministic unit
+    tests drive directly; ``start()`` runs it on a daemon thread every
+    ``watch_poll_s``."""
+
+    def __init__(self, root: str, target,
+                 config: Optional[FleetConfig] = None,
+                 canary: Optional[CanarySet] = None, recorder=None):
+        self.root = str(root)
+        self.target = target
+        self.config = config or FleetConfig()
+        self.canary = canary
+        if self.canary is None and self.config.canary_file:
+            self.canary = CanarySet.from_file(
+                self.config.canary_file,
+                min_auc=self.config.canary_min_auc,
+                tol=self.config.canary_tolerance)
+        self.recorder = recorder
+        self.mgr = CheckpointManager(self.root)
+        self._last_iter = -1
+        self._holddown: Dict[str, float] = {}  # model_id -> until (mono)
+        self._baseline: Optional[Tuple[str, str]] = None
+        self._watchdog: Optional[Dict[str, Any]] = None
+        self._probes: "deque[Tuple[float, Dict[str, float]]]" = \
+            deque(maxlen=256)
+        self._published: List[Dict[str, Any]] = []   # audit trail
+        self._last_prev: Optional[Tuple[str, str]] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "CheckpointWatcher":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop,
+                                            name="ltpu-watcher",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.watch_poll_s):
+            try:
+                self.poll_once()
+            except Exception as exc:       # noqa: BLE001 - keep polling
+                Log.warning("watcher: poll failed: %s", exc)
+                self._emit("watch_error", error=str(exc)[:200])
+
+    # -- telemetry -----------------------------------------------------
+    def _emit(self, event: str, **fields) -> None:
+        if self.recorder is not None:
+            self.recorder.emit("fleet", event=event, **fields)
+
+    # -- one poll ------------------------------------------------------
+    def poll_once(self, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        try:
+            self._probes.append((now, self.target.stats_probe()))
+        except Exception:                  # noqa: BLE001 - fleet warming
+            pass
+        self._check_watchdog(now)
+        if self._baseline is None:
+            try:
+                self._baseline = self.target.active_model()
+            except Exception:              # noqa: BLE001
+                pass
+        for iter_, path in self.mgr.candidates():
+            if iter_ <= self._last_iter:
+                continue
+            # publishing is sequential: while a deploy is under
+            # observation, newer snapshots wait their turn (a rollback
+            # must restore a KNOWN-good version, not race a new one)
+            if self._watchdog is not None:
+                break
+            self._process(iter_, path, now)
+
+    def _process(self, iter_: int, path: str, now: float) -> None:
+        self._last_iter = iter_            # a bad snapshot is not retried
+        name = os.path.basename(path)
+        mode = _faults.fire("watcher.validate")
+        errs = ["injected fault (watcher.validate:reject)"] \
+            if mode == "reject" else CheckpointManager.validate(path)
+        if errs:
+            msg = "; ".join(errs)[:300]
+            Log.warning("watcher: SKIP %s — manifest validation "
+                        "failed: %s", name, msg)
+            self._emit("publish_skip", reason="manifest", path=name,
+                       iter=iter_, error=msg)
+            return
+        try:
+            with open(os.path.join(path, "model.txt")) as f:
+                model_text = f.read()
+        except OSError as exc:
+            self._emit("publish_skip", reason="manifest", path=name,
+                       iter=iter_, error=f"model.txt unreadable: {exc}")
+            return
+        mid = model_fingerprint(model_text)
+        until = self._holddown.get(mid, 0.0)
+        if until > now:
+            Log.warning("watcher: SKIP %s — model %s is in rollback "
+                        "hold-down for %.0fs more", name, mid,
+                        until - now)
+            self._emit("publish_skip", reason="holddown", path=name,
+                       iter=iter_, model_id=mid)
+            return
+        active = None
+        try:
+            active = self.target.active_model()
+        except Exception:                  # noqa: BLE001
+            pass
+        if active is not None and active[0] == mid:
+            return                         # already serving this model
+        if self.canary is not None:
+            from ..basic import Booster
+            try:
+                booster = Booster(model_str=model_text)
+            except Exception as exc:       # noqa: BLE001 - bad model
+                self._emit("publish_skip", reason="canary", path=name,
+                           iter=iter_,
+                           error=f"model parse failed: {exc}"[:300])
+                return
+            errs = self.canary.check(booster)
+            if errs:
+                msg = "; ".join(errs)[:300]
+                Log.warning("watcher: SKIP %s — canary failed: %s",
+                            name, msg)
+                self._emit("publish_skip", reason="canary", path=name,
+                           iter=iter_, model_id=mid, error=msg)
+                return
+        # pre-publish capture: the window stats AND the version to
+        # roll back to (kept in memory — immune to checkpoint
+        # retention pruning the previous snapshot directory)
+        try:
+            pre = self.target.stats_probe()
+        except Exception:                  # noqa: BLE001
+            pre = {"requests": 0.0, "bad": 0.0, "p99_ms": 0.0}
+        prev = active if active is not None else self._baseline
+        t0 = time.monotonic()
+        try:
+            pub_id = self.target.publish_model(model_text, source=path)
+        except Exception as exc:           # noqa: BLE001 - target down
+            Log.warning("watcher: publish of %s failed: %s", name, exc)
+            self._emit("publish_skip", reason="error", path=name,
+                       iter=iter_, model_id=mid,
+                       error=str(exc)[:300])
+            return
+        self._emit("publish", path=name, iter=iter_, model_id=pub_id,
+                   duration_ms=round((time.monotonic() - t0) * 1e3, 3))
+        Log.info("watcher: published %s (model %s)", name, pub_id)
+        self._published.append({"path": path, "iter": iter_,
+                                "model_id": pub_id})
+        self._last_prev = prev             # force_rollback's target
+        self._watchdog = {
+            "model_id": pub_id, "model_text": model_text,
+            "published_at": now, "pre": pre,
+            "pre_rate": self._window_rate_before(now, pre),
+            "prev": prev, "path": name,
+        }
+
+    # -- rollback controller -------------------------------------------
+    def _window_rate_before(self, now: float,
+                            pre: Dict[str, float]) -> float:
+        """Bad-request rate over the window BEFORE ``now``: the
+        current cumulative probe diffed against the probe closest to
+        one observation window ago."""
+        target_t = now - self.config.rollback_window_s
+        older = None
+        for t, probe in self._probes:
+            if t <= target_t:
+                older = probe
+            else:
+                break
+        if older is None and self._probes:
+            older = self._probes[0][1]
+        if older is None:
+            return 0.0
+        dreq = pre["requests"] - older["requests"]
+        dbad = pre["bad"] - older["bad"]
+        return (dbad / dreq) if dreq > 0 else 0.0
+
+    def _check_watchdog(self, now: float) -> None:
+        wd = self._watchdog
+        if wd is None:
+            return
+        cfg = self.config
+        elapsed = now - wd["published_at"]
+        if elapsed < cfg.rollback_window_s:
+            return
+        try:
+            post = self.target.stats_probe()
+        except Exception:                  # noqa: BLE001
+            return
+        dreq = post["requests"] - wd["pre"]["requests"]
+        dbad = post["bad"] - wd["pre"]["bad"]
+        if dreq < 0 or dbad < 0:
+            # cumulative counters went BACKWARDS: replicas crashed and
+            # restarted after the publish — that is itself the
+            # regression signal (and the deltas below would be garbage)
+            self._rollback(wd, "stats_reset",
+                           "serve counters went backwards (replica "
+                           "crash/restart after the publish)", now)
+            return
+        if dreq < cfg.rollback_min_requests:
+            if elapsed < 4 * cfg.rollback_window_s:
+                return                     # not enough evidence yet
+            # evidence never arrived (idle fleet, or the deploy killed
+            # traffic entirely): do NOT bless the deploy — release the
+            # pipeline but keep the previous version as the rollback
+            # baseline/target
+            self._watchdog = None
+            self._emit("publish_unverified", model_id=wd["model_id"],
+                       path=wd["path"], window_requests=int(dreq))
+            Log.warning("watcher: deploy %s UNVERIFIED — only %d "
+                        "requests in %.0fs of observation; the "
+                        "previous version stays the rollback baseline",
+                        wd["model_id"], int(dreq), elapsed)
+            return
+        post_rate = dbad / dreq
+        pre_rate = wd["pre_rate"]
+        pre_p99 = wd["pre"]["p99_ms"]
+        post_p99 = post["p99_ms"]
+        reason = None
+        if post_rate > pre_rate + cfg.rollback_error_rate:
+            reason = "error_rate"
+        elif post_p99 > cfg.rollback_p99_floor_ms and \
+                post_p99 > cfg.rollback_p99_factor * max(pre_p99, 0.1):
+            reason = "p99"
+        if reason is None:
+            self._watchdog = None
+            self._baseline = (wd["model_id"], wd["model_text"])
+            self._emit("publish_verified", model_id=wd["model_id"],
+                       path=wd["path"], window_requests=int(dreq),
+                       bad_rate=round(post_rate, 4),
+                       p99_ms=round(post_p99, 3))
+            Log.info("watcher: deploy %s verified (%d requests, bad "
+                     "rate %.3f, p99 %.1f ms)", wd["model_id"],
+                     int(dreq), post_rate, post_p99)
+            return
+        detail = (f"bad rate {post_rate:.3f} vs pre {pre_rate:.3f}"
+                  if reason == "error_rate" else
+                  f"p99 {post_p99:.1f} ms vs pre {pre_p99:.1f} ms")
+        self._rollback(wd, reason, detail, now)
+
+    def _rollback(self, wd: Dict[str, Any], reason: str, detail: str,
+                  now: float) -> None:
+        prev = wd.get("prev")
+        self._watchdog = None
+        self._holddown[wd["model_id"]] = \
+            now + self.config.rollback_holddown_s
+        if prev is None:
+            Log.warning("watcher: deploy %s regressed (%s) but no "
+                        "previous version is known — cannot roll back",
+                        wd["model_id"], detail)
+            self._emit("watch_error",
+                       error=f"regression ({reason}: {detail}) with "
+                             f"no rollback target")
+            return
+        prev_id, prev_text = prev
+        try:
+            self.target.publish_model(prev_text, source="rollback")
+        except Exception as exc:           # noqa: BLE001
+            Log.warning("watcher: ROLLBACK of %s failed: %s",
+                        wd["model_id"], exc)
+            self._emit("watch_error",
+                       error=f"rollback publish failed: {exc}"[:300])
+            return
+        self._baseline = prev
+        self._emit("rollback", reason=reason, detail=detail[:200],
+                   from_id=wd["model_id"], to_id=prev_id,
+                   path=wd.get("path"))
+        Log.warning("watcher: ROLLED BACK deploy %s -> %s (%s: %s)",
+                    wd["model_id"], prev_id, reason, detail)
+
+    def force_rollback(self, reason: str = "forced") -> bool:
+        """Operator-commanded rollback: undo the deploy under
+        observation, or — with none pending — republish the version
+        that was serving BEFORE the last publish (even one that
+        already verified clean).  Returns True if a republish
+        happened."""
+        now = time.monotonic()
+        if self._watchdog is not None:
+            self._rollback(self._watchdog, reason, "operator command",
+                           now)
+            return True
+        try:
+            active = self.target.active_model()
+        except Exception:                  # noqa: BLE001
+            active = None
+        prev = self._last_prev or self._baseline
+        if prev is not None and active is not None and \
+                active[0] != prev[0]:
+            self.target.publish_model(prev[1], source="rollback")
+            self._holddown[active[0]] = \
+                now + self.config.rollback_holddown_s
+            self._baseline = prev
+            self._emit("rollback", reason=reason,
+                       detail="operator command",
+                       from_id=active[0], to_id=prev[0])
+            Log.warning("watcher: FORCED rollback %s -> %s",
+                        active[0], prev[0])
+            return True
+        return False

@@ -1,0 +1,239 @@
+"""Unified deterministic fault-injection registry.
+
+PR 5 proved a recovery path is only trustworthy once an injected fault
+has actually exercised it (``LTPU_CKPT_FAULT``); this module
+generalizes that env hook into ONE registry of named injection points
+shared by every resilience layer — checkpoint writes/loads
+(``ckpt/``), watcher snapshot validation (``serve/watcher.py``),
+replica dispatch (``serve/server.py``), the HTTP front
+(``serve/http.py``) and replica spawn (``serve/fleet.py``) — so tests
+and CI chaos jobs drive crash/corruption/latency scenarios
+deterministically instead of asserting recovery by hand.
+
+Injection points (defined by their call sites; the registry itself is
+point-agnostic):
+
+=====================  =================================================
+point                  modes its call site interprets
+=====================  =================================================
+``ckpt.save``          arms ONE whole checkpoint save (the hit counter
+                       advances per save, preserving the PR 5
+                       ``LTPU_CKPT_FAULT_AT`` semantics):
+                       ``crash_blob`` / ``crash_manifest`` /
+                       ``truncate_blob`` (``ckpt/atomic.py``)
+``watcher.validate``   ``reject`` — the watcher treats the candidate
+                       snapshot as manifest-invalid
+``watcher.canary``     ``fail`` — canary scoring reports a mismatch
+``serve.dispatch``     ``error`` — the batch dispatch raises (requests
+                       finish with status ``error``); ``sleep_<ms>`` —
+                       adds latency to every dispatch (p99 regression)
+``http.request``       ``error`` — the front answers a structured 500;
+                       ``drop`` — the connection closes with no
+                       response (client-visible transport failure)
+``fleet.spawn``        ``fail`` — the replica spawn raises (exercises
+                       restart backoff and the circuit breaker)
+=====================  =================================================
+
+Spec syntax (``LTPU_FAULTS`` env var or :func:`configure`), comma
+separated::
+
+    point:mode          fire on the 1st hit of ``point`` only
+    point:mode@4        fire on the 4th hit only
+    point:mode@4+       fire on every hit from the 4th on
+    point:mode@*        fire on every hit
+
+Hits are counted per point, process-wide, under a lock — the n-th hit
+is the n-th call to :func:`fire` for that point, whatever thread makes
+it — so a spec names ONE deterministic event in the process's
+execution, not a probability.  The legacy ``LTPU_CKPT_FAULT`` /
+``LTPU_CKPT_FAULT_AT`` env pair keeps working: it is folded in as
+``ckpt.save:<mode>@<at>``.
+
+Remote driving: with ``serve_debug_faults=true`` the HTTP front
+exposes ``POST /faults {"spec": ...}`` / ``GET /faults``, so a chaos
+harness (``tools/loadgen_serve.py --fleet``) can arm dispatch faults
+inside live replica processes.  The endpoint is OFF by default.
+
+``InjectedFault`` deliberately subclasses ``BaseException``: cleanup
+paths guarded by ``except Exception`` must NOT swallow it (a real
+SIGKILL would not run them either).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["InjectedFault", "FaultSpec", "configure", "arm", "clear",
+           "reset", "fire", "hits", "snapshot", "parse_specs",
+           "active_spec"]
+
+
+class InjectedFault(BaseException):
+    """Simulated crash raised at an injection point (tests/CI only)."""
+
+
+class FaultSpec:
+    """One parsed ``point:mode@ordinal`` spec."""
+
+    __slots__ = ("point", "mode", "start", "open_ended")
+
+    def __init__(self, point: str, mode: str, start: int = 1,
+                 open_ended: bool = False):
+        self.point = str(point)
+        self.mode = str(mode)
+        self.start = max(int(start), 1)
+        self.open_ended = bool(open_ended)
+
+    def matches(self, hit: int) -> bool:
+        return hit >= self.start if self.open_ended else hit == self.start
+
+    def __repr__(self) -> str:
+        at = "*" if (self.open_ended and self.start == 1) else (
+            f"{self.start}+" if self.open_ended else str(self.start))
+        return f"{self.point}:{self.mode}@{at}"
+
+
+def parse_specs(text: str) -> List[FaultSpec]:
+    """Parse a comma-separated spec string; raises ValueError on a
+    malformed entry (a typo'd chaos spec must fail loudly, not inject
+    nothing)."""
+    out: List[FaultSpec] = []
+    for part in (text or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" not in part:
+            raise ValueError(f"fault spec {part!r}: expected point:mode")
+        point, rest = part.split(":", 1)
+        mode, at = rest, "1"
+        if "@" in rest:
+            mode, at = rest.rsplit("@", 1)
+        if not point.strip() or not mode.strip():
+            raise ValueError(f"fault spec {part!r}: empty point or mode")
+        at = at.strip()
+        if at == "*":
+            out.append(FaultSpec(point.strip(), mode.strip(), 1, True))
+        elif at.endswith("+"):
+            out.append(FaultSpec(point.strip(), mode.strip(),
+                                 int(at[:-1]), True))
+        else:
+            out.append(FaultSpec(point.strip(), mode.strip(), int(at)))
+    return out
+
+
+class FaultRegistry:
+    """Process-wide registry: programmatic specs + env specs + the
+    legacy checkpoint env pair, with per-point hit counters."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hits: Dict[str, int] = {}
+        self._specs: List[FaultSpec] = []
+        # env parse cache: (raw string) -> parsed list
+        self._env_cache: Tuple[str, List[FaultSpec]] = ("", [])
+        self._legacy_cache: Tuple[Tuple[str, str], List[FaultSpec]] = \
+            (("", ""), [])
+
+    # -- configuration -------------------------------------------------
+    def configure(self, spec: str) -> List[FaultSpec]:
+        """Replace the programmatic specs with ``spec`` (empty string
+        clears them).  Hit counters are NOT reset — an already-burned
+        ordinal stays burned unless :meth:`reset` is called."""
+        parsed = parse_specs(spec)
+        with self._lock:
+            self._specs = parsed
+        return parsed
+
+    def arm(self, point: str, mode: str, at: str = "1") -> None:
+        """Append one programmatic spec (``at`` as in the spec syntax:
+        ``"3"``, ``"3+"`` or ``"*"``)."""
+        spec = parse_specs(f"{point}:{mode}@{at}")[0]
+        with self._lock:
+            self._specs.append(spec)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._specs = []
+
+    def reset(self, point: Optional[str] = None) -> None:
+        """Reset hit counters (one point, or all)."""
+        with self._lock:
+            if point is None:
+                self._hits = {}
+            else:
+                self._hits.pop(point, None)
+
+    # -- env merging ---------------------------------------------------
+    def _env_specs(self) -> List[FaultSpec]:
+        raw = os.environ.get("LTPU_FAULTS", "")
+        if raw != self._env_cache[0]:
+            try:
+                parsed = parse_specs(raw)
+            except ValueError:
+                from .log import Log
+                Log.warning("faults: ignoring malformed LTPU_FAULTS=%r",
+                            raw)
+                parsed = []
+            self._env_cache = (raw, parsed)
+        return self._env_cache[1]
+
+    def _legacy_specs(self) -> List[FaultSpec]:
+        mode = os.environ.get("LTPU_CKPT_FAULT", "")
+        at = os.environ.get("LTPU_CKPT_FAULT_AT", "1") or "1"
+        if not mode:
+            return []
+        if (mode, at) != self._legacy_cache[0]:
+            try:
+                parsed = [FaultSpec("ckpt.save", mode, int(at))]
+            except ValueError:
+                parsed = [FaultSpec("ckpt.save", mode, 1)]
+            self._legacy_cache = ((mode, at), parsed)
+        return self._legacy_cache[1]
+
+    # -- firing --------------------------------------------------------
+    def fire(self, point: str) -> str:
+        """Advance ``point``'s hit counter and return the armed mode
+        for THIS hit, or ``''``.  First matching spec wins
+        (programmatic before env before legacy)."""
+        with self._lock:
+            self._hits[point] = self._hits.get(point, 0) + 1
+            n = self._hits[point]
+            specs = list(self._specs)
+        for spec in specs + self._env_specs() + self._legacy_specs():
+            if spec.point == point and spec.matches(n):
+                return spec.mode
+        return ""
+
+    def active_spec(self, point: str) -> Optional[FaultSpec]:
+        """The first spec registered for ``point`` (introspection —
+        does not advance the counter)."""
+        with self._lock:
+            specs = list(self._specs)
+        for spec in specs + self._env_specs() + self._legacy_specs():
+            if spec.point == point:
+                return spec
+        return None
+
+    def hits(self, point: str) -> int:
+        with self._lock:
+            return self._hits.get(point, 0)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"hits": dict(self._hits),
+                    "specs": [repr(s) for s in self._specs],
+                    "env": os.environ.get("LTPU_FAULTS", ""),
+                    "legacy": os.environ.get("LTPU_CKPT_FAULT", "")}
+
+
+_REGISTRY = FaultRegistry()
+
+configure = _REGISTRY.configure
+arm = _REGISTRY.arm
+clear = _REGISTRY.clear
+reset = _REGISTRY.reset
+fire = _REGISTRY.fire
+hits = _REGISTRY.hits
+snapshot = _REGISTRY.snapshot
+active_spec = _REGISTRY.active_spec

@@ -73,7 +73,7 @@ def percentile(sorted_vals, q: float) -> float:
 SCHEMA_VERSION = 1
 
 RECORD_TYPES = ("run_start", "iteration", "superstep", "eval", "predict",
-                "serve", "checkpoint", "run_end")
+                "serve", "checkpoint", "fleet", "run_end")
 
 # per-type required fields on top of the common envelope; values are
 # (field, type-or-types) pairs the lint enforces
@@ -105,6 +105,17 @@ _TYPE_FIELDS: Dict[str, Tuple[Tuple[str, Any], ...]] = {
     # counts, total bytes and total save/load time; triage_run.py
     # flags fallbacks and save overhead > 5% of train wall time.
     "checkpoint": (("event", str), ("duration_ms", (int, float))),
+    # one record per resilience-layer event (serve/fleet.py,
+    # serve/watcher.py): ``event`` is replica_start|replica_exit|
+    # replica_restart|circuit_open|circuit_half_open (supervisor) or
+    # publish|publish_verified|publish_unverified|publish_skip|
+    # rollback|watch_error (watcher / rollback controller).  publish
+    # records carry model_id/path/iter; publish_skip carries
+    # reason=manifest|canary|holddown|error + the validation error;
+    # rollback carries reason=error_rate|p99|stats_reset|forced +
+    # from_id/to_id.  triage_run.py
+    # summarizes them and flags skips, rollbacks and open circuits.
+    "fleet": (("event", str),),
     "run_end": (("summary", dict),),
 }
 
@@ -353,6 +364,21 @@ class RunRecorder:
                 self._agg["ckpt_bytes"] = \
                     self._agg.get("ckpt_bytes", 0) + \
                     int(rec.get("bytes", 0))
+        elif t == "fleet":
+            key = {
+                "replica_start": "fleet_replica_starts",
+                "replica_exit": "fleet_replica_exits",
+                "replica_restart": "fleet_restarts",
+                "circuit_open": "fleet_circuit_opens",
+                "publish": "fleet_publishes",
+                "publish_verified": "fleet_publish_verified",
+                "publish_unverified": "fleet_publish_unverified",
+                "publish_skip": "fleet_skips",
+                "rollback": "fleet_rollbacks",
+                "watch_error": "fleet_watch_errors",
+            }.get(rec.get("event"))
+            if key:
+                self._agg[key] = self._agg.get(key, 0) + 1
         elif t == "predict":
             self._agg["predicts"] = self._agg.get("predicts", 0) + 1
             self._agg["predict_rows"] = \
@@ -425,6 +451,13 @@ class RunRecorder:
                     f"{s.get('ckpt_save_ms', 0.0):.0f} ms), "
                     f"{s.get('ckpt_loads', 0):.0f} loads, "
                     f"{s.get('ckpt_fallbacks', 0):.0f} fallbacks")
+            if s.get("fleet_publishes") or s.get("fleet_restarts") or \
+                    s.get("fleet_skips") or s.get("fleet_rollbacks"):
+                parts.append(
+                    f"fleet: {s.get('fleet_publishes', 0):.0f} "
+                    f"publishes, {s.get('fleet_skips', 0):.0f} skips, "
+                    f"{s.get('fleet_rollbacks', 0):.0f} rollbacks, "
+                    f"{s.get('fleet_restarts', 0):.0f} restarts")
             if s.get("serve_requests"):
                 parts.append(
                     f"{s['serve_requests']:.0f} serve requests "

@@ -1,0 +1,782 @@
+"""Resilience layer (serve/fleet.py, serve/watcher.py, utils/faults.py).
+
+Pins the PR-6 acceptance contract:
+
+- unified deterministic fault injection: named points, per-point hit
+  ordinals, env + legacy-env merging, remote /faults driving;
+- watcher skip paths (the satellite pin): a corrupt-newest and a
+  canary-failing snapshot in the checkpoint root leave the previous
+  version serving, each with a lint-clean telemetry anomaly record
+  that ``triage_run.py`` flags;
+- validated auto-publish + telemetry-driven rollback (error-rate
+  regression under injected dispatch faults) + hold-down + forced
+  rollback;
+- fleet supervision: a killed replica is detected and restarted with
+  backoff, the desired model is reconciled onto restarted replicas
+  before they rejoin, and a crash loop opens the circuit breaker
+  (fleet degrades, keeps serving);
+- graceful drain: admitted requests complete, new work gets 503 +
+  Retry-After, /healthz flips to draining;
+- HTTP front hardening: oversized bodies, malformed JSON and wrong
+  dtypes map to structured 4xx, never a 500 traceback.
+"""
+import json
+import os
+import shutil
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serve import (CanarySet, CheckpointWatcher,
+                                FleetConfig, FleetSupervisor,
+                                InprocReplica, RegistryTarget,
+                                ServeConfig, Server, model_fingerprint)
+from lightgbm_tpu.serve.watcher import auc_score
+from lightgbm_tpu.utils import faults
+from lightgbm_tpu.utils.telemetry import RunRecorder, lint_file
+
+sys_path_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """The registry is process-global: every test starts and ends with
+    no armed specs and fresh counters (except ckpt.save, whose ordinal
+    other modules manage via reset_fault_counter)."""
+    faults.clear()
+    faults.reset()
+    yield
+    faults.clear()
+    faults.reset()
+
+
+def _train(rounds=4, seed=0, labels=None, ckdir=None, rows=1500):
+    rng = np.random.RandomState(0)
+    X = rng.randn(rows, 8)
+    y = (X[:, 0] + 0.4 * rng.randn(rows) > 0).astype(float) \
+        if labels is None else labels
+    p = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+         "metric": "None", "seed": seed}
+    if ckdir:
+        p.update({"checkpoint_dir": ckdir, "snapshot_freq": rounds})
+    d = lgb.Dataset(X, label=y, params=p)
+    return lgb.train(p, d, num_boost_round=rounds), X, y
+
+
+@pytest.fixture(scope="module")
+def models(tmp_path_factory):
+    """v1 booster + real training checkpoints for a good and a
+    canary-failing candidate, shared by the watcher tests."""
+    root = tmp_path_factory.mktemp("fleet_models")
+    b1, X, y = _train(4, seed=1)
+    _train(6, seed=2, ckdir=str(root / "ck_good"))
+    rng = np.random.RandomState(7)
+    y_shuffled = y.copy()
+    rng.shuffle(y_shuffled)
+    _train(6, seed=3, labels=y_shuffled, ckdir=str(root / "ck_bad"))
+
+    def newest(sub):
+        d = root / sub
+        return str(d / sorted(p for p in os.listdir(d)
+                              if p.startswith("ckpt_"))[-1])
+
+    return {"b1": b1, "X": X, "y": y, "good": newest("ck_good"),
+            "bad": newest("ck_bad")}
+
+
+def _drop(src, watch_root, name, corrupt=False):
+    """Deliver a snapshot the way the ckpt writer does: staged copy +
+    one rename, so the watcher never sees a half-copied directory."""
+    stage = os.path.join(watch_root, ".tmp_stage_" + name)
+    shutil.rmtree(stage, ignore_errors=True)
+    shutil.copytree(src, stage)
+    if corrupt:
+        with open(os.path.join(stage, "state.npz"), "r+b") as f:
+            f.truncate(64)
+    dst = os.path.join(watch_root, name)
+    os.rename(stage, dst)
+    return dst
+
+
+# ----------------------------------------------------------------------
+# fault-injection registry
+# ----------------------------------------------------------------------
+def test_fault_spec_parsing_and_ordinals():
+    specs = faults.parse_specs(
+        "a.b:crash@3, c.d:fail, e.f:sleep_50@2+, g.h:x@*")
+    assert [repr(s) for s in specs] == \
+        ["a.b:crash@3", "c.d:fail@1", "e.f:sleep_50@2+", "g.h:x@*"]
+    faults.configure("a.b:crash@3")
+    assert [faults.fire("a.b") for _ in range(4)] == \
+        ["", "", "crash", ""]
+    faults.configure("e.f:sleep_9@2+")
+    assert [faults.fire("e.f") for _ in range(4)] == \
+        ["", "sleep_9", "sleep_9", "sleep_9"]
+    faults.configure("g.h:x@*")
+    assert faults.fire("g.h") == "x"
+    # reset re-burns ordinals; clear removes specs
+    faults.configure("a.b:crash@1")
+    faults.reset("a.b")
+    assert faults.fire("a.b") == "crash"
+    faults.clear()
+    assert faults.fire("a.b") == ""
+    with pytest.raises(ValueError):
+        faults.parse_specs("no-colon-here")
+    with pytest.raises(ValueError):
+        faults.parse_specs("point:")
+
+
+def test_fault_env_and_legacy_ckpt_mapping(monkeypatch):
+    monkeypatch.setenv("LTPU_FAULTS", "x.y:boom@2")
+    faults.reset("x.y")
+    assert [faults.fire("x.y") for _ in range(3)] == ["", "boom", ""]
+    monkeypatch.delenv("LTPU_FAULTS")
+    # the PR 5 env pair folds into point ckpt.save
+    monkeypatch.setenv("LTPU_CKPT_FAULT", "crash_blob")
+    monkeypatch.setenv("LTPU_CKPT_FAULT_AT", "2")
+    faults.reset("ckpt.save")
+    from lightgbm_tpu.ckpt import atomic
+    assert atomic.fault_armed() == ""
+    assert atomic.fault_armed() == "crash_blob"
+    assert atomic.fault_armed() == ""
+    atomic.reset_fault_counter()
+    assert atomic.fault_armed() == ""
+
+
+def test_fault_snapshot_reports_hits():
+    faults.configure("p.q:z@*")
+    faults.fire("p.q")
+    faults.fire("p.q")
+    snap = faults.snapshot()
+    assert snap["hits"]["p.q"] == 2
+    assert snap["specs"] == ["p.q:z@*"]
+
+
+# ----------------------------------------------------------------------
+# canary scoring
+# ----------------------------------------------------------------------
+def test_auc_score_basics():
+    assert auc_score([0, 0, 1, 1], [0.1, 0.2, 0.8, 0.9]) == 1.0
+    assert auc_score([0, 0, 1, 1], [0.9, 0.8, 0.2, 0.1]) == 0.0
+    assert auc_score([0, 1, 0, 1], [0.5, 0.5, 0.5, 0.5]) == 0.5
+    assert auc_score([1, 1, 1], [0.1, 0.2, 0.3]) == 0.5  # degenerate
+
+
+def test_canary_set_modes(models):
+    b1, X, y = models["b1"], models["X"], models["y"]
+    preds = b1.predict(X[:128])
+    # pinned-expected mode: the same model passes, a perturbed
+    # expectation fails
+    good = CanarySet(X[:128], expected=preds, tol=1e-9)
+    assert good.check(b1) == []
+    bad = CanarySet(X[:128], expected=preds + 0.5, tol=1e-9)
+    assert any("deviate" in e for e in bad.check(b1))
+    # label-AUC mode: a real model passes, shuffled labels fail
+    gate = CanarySet(X[:256], labels=y[:256], min_auc=0.75)
+    assert gate.check(b1) == []
+    rng = np.random.RandomState(3)
+    ysh = y[:256].copy()
+    rng.shuffle(ysh)
+    gate_bad = CanarySet(X[:256], labels=ysh, min_auc=0.75)
+    assert any("AUC" in e for e in gate_bad.check(b1))
+    # injected canary fault forces a failure on a passing model
+    faults.configure("watcher.canary:fail@*")
+    assert any("injected" in e for e in gate.check(b1))
+
+
+def test_canary_from_file(models, tmp_path):
+    b1, X, y = models["b1"], models["X"], models["y"]
+    path = str(tmp_path / "canary.npz")
+    np.savez(path, X=X[:64], label=y[:64],
+             expected=b1.predict(X[:64]))
+    c = CanarySet.from_file(path, min_auc=0.6, tol=1e-8)
+    assert c.check(b1) == []
+    assert c.labels is not None and c.expected is not None
+
+
+# ----------------------------------------------------------------------
+# watcher: skip paths (satellite pin), publish, rollback, hold-down
+# ----------------------------------------------------------------------
+def _watch_setup(models, tmp_path, **cfg_over):
+    watch = str(tmp_path / "watch")
+    os.makedirs(watch, exist_ok=True)
+    tele = str(tmp_path / "fleet.jsonl")
+    rec = RunRecorder(tele, run_info={"task": "fleet"},
+                      keep_records=True)
+    srv = Server(models["b1"],
+                 config=ServeConfig(max_batch_rows=512,
+                                    batch_wait_ms=0.2,
+                                    timeout_ms=30000)).start()
+    # p99 floor pinned sky-high: these tests drive so few requests
+    # that real scheduling jitter sits right at the 5 ms default
+    # floor — error rate is the deterministic trigger here
+    cfg_over.setdefault("rollback_p99_floor_ms", 1e9)
+    cfg = FleetConfig(watch_poll_s=0.05, rollback_window_s=0.2,
+                      rollback_min_requests=5, rollback_error_rate=0.2,
+                      rollback_holddown_s=60.0, **cfg_over)
+    canary = CanarySet(models["X"][:256], labels=models["y"][:256],
+                       min_auc=0.7)
+    w = CheckpointWatcher(watch, RegistryTarget(srv), config=cfg,
+                          canary=canary, recorder=rec)
+    return watch, tele, rec, srv, w
+
+
+def _events(rec, kind, **match):
+    return [r for r in rec.records
+            if r.get("type") == "fleet" and r.get("event") == kind
+            and all(r.get(k) == v for k, v in match.items())]
+
+
+def test_watcher_skips_corrupt_and_canary_then_publishes(
+        models, tmp_path):
+    watch, tele, rec, srv, w = _watch_setup(models, tmp_path)
+    try:
+        fp1 = srv.registry.current().model_id
+        w.poll_once()
+        assert w._baseline[0] == fp1
+
+        # corrupt-newest: manifest verify rejects, v1 keeps serving
+        _drop(models["good"], watch, "ckpt_00000100", corrupt=True)
+        w.poll_once()
+        assert srv.registry.current().model_id == fp1
+        skips = _events(rec, "publish_skip", reason="manifest")
+        assert len(skips) == 1 and "truncated" in skips[0]["error"]
+
+        # canary-failing: parses fine, scores wrong, not published
+        _drop(models["bad"], watch, "ckpt_00000200")
+        w.poll_once()
+        assert srv.registry.current().model_id == fp1
+        skips = _events(rec, "publish_skip", reason="canary")
+        assert len(skips) == 1 and "AUC" in skips[0]["error"]
+
+        # a valid snapshot then publishes
+        _drop(models["good"], watch, "ckpt_00000300")
+        w.poll_once()
+        fp2 = srv.registry.current().model_id
+        assert fp2 != fp1
+        pubs = _events(rec, "publish", model_id=fp2)
+        assert len(pubs) == 1 and pubs[0]["path"] == "ckpt_00000300"
+    finally:
+        srv.stop()
+        rec.close()
+
+    # the satellite pin: records are lint-clean AND triage flags them
+    n, errs = lint_file(tele)
+    assert not errs, errs[:5]
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "triage_run", os.path.join(sys_path_repo, "tools",
+                                   "triage_run.py"))
+    triage = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(triage)
+    records = [json.loads(line) for line in open(tele)]
+    anomalies = triage.scan_anomalies(records)
+    msgs = [m for _, m in anomalies]
+    assert any("CORRUPT" in m for m in msgs), msgs
+    assert any("canary" in m for m in msgs), msgs
+    sevs = {m: s for s, m in anomalies}
+    assert any(s == "HIGH" for s, m in anomalies if "CORRUPT" in m)
+
+
+def test_watcher_injected_validate_fault(models, tmp_path):
+    watch, tele, rec, srv, w = _watch_setup(models, tmp_path)
+    try:
+        fp1 = srv.registry.current().model_id
+        faults.configure("watcher.validate:reject@*")
+        _drop(models["good"], watch, "ckpt_00000100")
+        w.poll_once()
+        assert srv.registry.current().model_id == fp1
+        skips = _events(rec, "publish_skip", reason="manifest")
+        assert skips and "injected" in skips[0]["error"]
+    finally:
+        srv.stop()
+        rec.close()
+
+
+def test_watcher_rollback_on_error_rate_and_holddown(models, tmp_path):
+    X = models["X"]
+    watch, tele, rec, srv, w = _watch_setup(models, tmp_path)
+    try:
+        fp1 = srv.registry.current().model_id
+        t = 1000.0
+        w.poll_once(now=t)
+        # healthy traffic before the deploy
+        for _ in range(8):
+            srv.predict(X[:4])
+        w.poll_once(now=t + 0.1)
+        _drop(models["good"], watch, "ckpt_00000300")
+        w.poll_once(now=t + 0.2)          # publishes, arms watchdog
+        fp2 = srv.registry.current().model_id
+        assert fp2 != fp1
+        # the deploy "regresses": injected dispatch faults error every
+        # request in the observation window
+        faults.configure("serve.dispatch:error@*")
+        for _ in range(10):
+            with pytest.raises(Exception):
+                srv.predict(X[:4])
+        faults.clear()
+        w.poll_once(now=t + 0.5)          # window elapsed -> verdict
+        assert srv.registry.current().model_id == fp1, \
+            "rollback must restore the pre-publish version"
+        rb = _events(rec, "rollback", reason="error_rate")
+        assert len(rb) == 1
+        assert rb[0]["from_id"] == fp2 and rb[0]["to_id"] == fp1
+        # hold-down: the same snapshot content cannot flap back in
+        _drop(models["good"], watch, "ckpt_00000400")
+        w.poll_once(now=t + 1.0)
+        assert srv.registry.current().model_id == fp1
+        assert _events(rec, "publish_skip", reason="holddown")
+    finally:
+        srv.stop()
+        rec.close()
+    n, errs = lint_file(tele)
+    assert not errs, errs[:5]
+
+
+def test_watcher_verify_then_forced_rollback(models, tmp_path):
+    X = models["X"]
+    watch, tele, rec, srv, w = _watch_setup(models, tmp_path)
+    try:
+        fp1 = srv.registry.current().model_id
+        t = 2000.0
+        w.poll_once(now=t)
+        _drop(models["good"], watch, "ckpt_00000300")
+        w.poll_once(now=t + 0.1)
+        fp2 = srv.registry.current().model_id
+        # clean traffic through the observation window -> verified
+        for _ in range(8):
+            srv.predict(X[:4])
+        w.poll_once(now=t + 0.5)
+        assert _events(rec, "publish_verified", model_id=fp2)
+        assert w._baseline[0] == fp2
+        # forced rollback round-trips to the pre-deploy version,
+        # even though the deploy verified clean
+        assert w.force_rollback("forced") is True
+        assert srv.registry.current().model_id == fp1
+        rb = _events(rec, "rollback", reason="forced")
+        assert rb and rb[0]["from_id"] == fp2 and rb[0]["to_id"] == fp1
+        assert w.force_rollback("forced") is False   # already there
+    finally:
+        srv.stop()
+        rec.close()
+
+
+def test_watcher_unverified_when_no_evidence(models, tmp_path):
+    """A window that never sees rollback_min_requests must NOT bless
+    the deploy: the pipeline is released as publish_unverified and the
+    previous version stays the rollback baseline."""
+    watch, tele, rec, srv, w = _watch_setup(models, tmp_path)
+    try:
+        fp1 = srv.registry.current().model_id
+        t = 5000.0
+        w.poll_once(now=t)
+        _drop(models["good"], watch, "ckpt_00000300")
+        w.poll_once(now=t + 0.1)
+        fp2 = srv.registry.current().model_id
+        assert fp2 != fp1
+        # zero traffic through 4x the observation window
+        w.poll_once(now=t + 2.0)
+        assert w._watchdog is None
+        assert _events(rec, "publish_unverified", model_id=fp2)
+        assert not _events(rec, "publish_verified")
+        assert w._baseline[0] == fp1
+        # forced rollback still round-trips to the pre-deploy version
+        assert w.force_rollback("forced") is True
+        assert srv.registry.current().model_id == fp1
+    finally:
+        srv.stop()
+        rec.close()
+    n, errs = lint_file(tele)
+    assert not errs, errs[:5]
+
+
+def test_watcher_stats_reset_rolls_back(models, tmp_path):
+    """Cumulative serve counters going backwards mid-observation
+    (replicas crashed and restarted after the publish) is a regression
+    verdict, not garbage deltas silently verified."""
+    X = models["X"]
+    watch, tele, rec, srv, w = _watch_setup(models, tmp_path)
+    try:
+        fp1 = srv.registry.current().model_id
+        t = 6000.0
+        w.poll_once(now=t)
+        for _ in range(8):
+            srv.predict(X[:4])
+        _drop(models["good"], watch, "ckpt_00000300")
+        w.poll_once(now=t + 0.1)           # publishes, pre requests >= 8
+        fp2 = srv.registry.current().model_id
+        assert fp2 != fp1
+        # simulate the whole fleet restarting: counters reset to zero
+        w.target.stats_probe = lambda: {"requests": 0.0, "bad": 0.0,
+                                        "p99_ms": 0.0}
+        w.poll_once(now=t + 0.5)
+        rb = _events(rec, "rollback", reason="stats_reset")
+        assert len(rb) == 1 and rb[0]["from_id"] == fp2
+        assert srv.registry.current().model_id == fp1
+    finally:
+        srv.stop()
+        rec.close()
+    n, errs = lint_file(tele)
+    assert not errs, errs[:5]
+
+
+def test_watcher_waits_out_observation_before_next_publish(
+        models, tmp_path):
+    """While a deploy is under observation, newer snapshots queue: a
+    rollback must restore a known-good version, not race a newer one."""
+    watch, tele, rec, srv, w = _watch_setup(models, tmp_path)
+    try:
+        t = 3000.0
+        w.poll_once(now=t)
+        _drop(models["good"], watch, "ckpt_00000300")
+        w.poll_once(now=t + 0.01)
+        fp2 = srv.registry.current().model_id
+        assert w._watchdog is not None
+        # a second snapshot arrives mid-observation: NOT processed yet
+        _drop(models["bad"], watch, "ckpt_00000400")
+        w.poll_once(now=t + 0.05)
+        assert not _events(rec, "publish_skip", reason="canary")
+        # once the window closes with enough traffic (verified), the
+        # queued snapshot is evaluated (and canary-skipped)
+        for _ in range(6):
+            srv.predict(models["X"][:4])
+        w.poll_once(now=t + 1.0)
+        assert w._watchdog is None
+        w.poll_once(now=t + 1.1)
+        assert _events(rec, "publish_skip", reason="canary")
+        assert srv.registry.current().model_id == fp2
+    finally:
+        srv.stop()
+        rec.close()
+
+
+# ----------------------------------------------------------------------
+# fleet supervisor (in-process replicas)
+# ----------------------------------------------------------------------
+def _inproc_factory(booster):
+    def factory(i):
+        return InprocReplica(
+            booster=booster,
+            config=ServeConfig(port=0, batch_wait_ms=0.2,
+                               timeout_ms=30000))
+    return factory
+
+
+def _http_predict(url, rows):
+    req = urllib.request.Request(
+        url + "/predict", data=json.dumps({"rows": rows}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _wait(cond, timeout_s, desc):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.02)
+    raise AssertionError(f"timeout waiting for {desc}")
+
+
+def test_supervisor_restarts_killed_replica_and_reconciles(models):
+    b1, X, _ = models["b1"], models["X"], models["y"]
+    b2, _, _ = _train(6, seed=9)
+    cfg = FleetConfig(replicas=2, probe_interval_s=0.05,
+                      probe_timeout_s=2.0, fail_threshold=2,
+                      backoff_base_s=0.05, backoff_max_s=0.2,
+                      circuit_failures=10, seed=1)
+    rec = RunRecorder(None, run_info={"task": "fleet"})
+    sup = FleetSupervisor(_inproc_factory(b1), cfg, rec)
+    try:
+        sup.start(wait_healthy_s=30)
+        assert len(sup.endpoints()) == 2
+        out = _http_predict(sup.endpoints()[0], X[:3].tolist())
+        fp1 = out["model_id"]
+        np.testing.assert_allclose(out["predictions"],
+                                   b1.predict(X[:3]), rtol=1e-9)
+        # fleet-wide publish
+        text2 = b2.model_to_string(num_iteration=-1)
+        fp2 = sup.publish_model(text2)
+        assert fp2 == model_fingerprint(text2) != fp1
+        _wait(lambda: set(sup.active_models().values()) == {fp2}, 20,
+              "fleet convergence on v2")
+        # kill a replica: detected, restarted, and re-swapped to the
+        # DESIRED model before rejoining the rotation.  The monitor
+        # can complete the whole fail->restart->rejoin cycle while
+        # kill() is still tearing the old stack down, so detection is
+        # observed via telemetry events, not endpoint-count sampling.
+        sup.handle(0).kill()
+        _wait(lambda: _events(rec, "replica_exit"), 20,
+              "crash detection")
+        _wait(lambda: len(sup.endpoints()) == 2, 30, "restart")
+        ids = {_http_predict(u, X[:2].tolist())["model_id"]
+               for u in sup.endpoints()}
+        assert ids == {fp2}, ids
+        assert _events(rec, "replica_restart")
+        assert _events(rec, "replica_exit")
+    finally:
+        sup.stop()
+        rec.close()
+
+
+def test_supervisor_circuit_breaker_and_half_open(models):
+    b1 = models["b1"]
+    cfg = FleetConfig(replicas=1, probe_interval_s=0.05,
+                      probe_timeout_s=2.0, fail_threshold=2,
+                      backoff_base_s=0.02, backoff_max_s=0.05,
+                      circuit_failures=3, circuit_cooldown_s=0.5,
+                      seed=1)
+    rec = RunRecorder(None, run_info={"task": "fleet"})
+    sup = FleetSupervisor(_inproc_factory(b1), cfg, rec)
+    try:
+        sup.start(wait_healthy_s=30)
+        assert len(sup.endpoints()) == 1
+        # persistent spawn failure -> backoff escalates -> circuit opens
+        faults.configure("fleet.spawn:fail@*")
+        sup.handle(0).kill()
+        _wait(lambda: sup.slots()[0]["state"] == "circuit_open", 30,
+              "circuit open")
+        assert sup.endpoints() == []       # degraded: out of rotation
+        assert _events(rec, "circuit_open")
+        # cooldown elapses -> half-open -> a now-working spawn recovers
+        faults.clear()
+        _wait(lambda: sup.slots()[0]["state"] == "healthy", 30,
+              "half-open recovery")
+        assert _events(rec, "circuit_half_open")
+        assert len(sup.endpoints()) == 1
+    finally:
+        sup.stop()
+        rec.close()
+
+
+def test_supervisor_leaves_draining_replica_alone(models):
+    """A draining replica (healthz 503 {"draining": true}) leaves the
+    rotation but is NOT kill-restarted mid-drain — SIGKILLing it would
+    drop the admitted requests the drain exists to protect."""
+    cfg = FleetConfig(replicas=1, probe_interval_s=0.05,
+                      probe_timeout_s=2.0, fail_threshold=2,
+                      backoff_base_s=0.05, backoff_max_s=0.2,
+                      circuit_failures=10, seed=1)
+    rec = RunRecorder(None, run_info={"task": "fleet"})
+    sup = FleetSupervisor(_inproc_factory(models["b1"]), cfg, rec)
+    try:
+        sup.start(wait_healthy_s=30)
+        rep = sup.handle(0)
+        rep.server.draining = True         # healthz flips to 503
+        _wait(lambda: not sup.endpoints(), 20, "out of rotation")
+        time.sleep(0.5)                    # many probe intervals
+        assert sup.handle(0) is rep        # same handle: never killed
+        assert not _events(rec, "replica_exit")
+        rep.server.draining = False        # drain "finished"
+        _wait(lambda: len(sup.endpoints()) == 1, 20,
+              "back in rotation")
+    finally:
+        sup.stop()
+        rec.close()
+
+
+def test_supervisor_backoff_deterministic_and_bounded():
+    cfg = FleetConfig(backoff_base_s=0.5, backoff_max_s=4.0,
+                      backoff_jitter=0.2, seed=42)
+    sup = FleetSupervisor(lambda i: None, cfg)
+    slot = sup._slots[0]
+    vals = []
+    for failures in (1, 2, 3, 4, 5, 6):
+        slot.failures = failures
+        vals.append(sup._backoff_s(slot))
+    # exponential then capped; jitter stays within its fraction
+    base = [0.5, 1.0, 2.0, 4.0, 4.0, 4.0]
+    for v, b in zip(vals, base):
+        assert b <= v <= b * 1.2 + 1e-9
+    # deterministic: same seed/slot/attempt -> same jitter
+    slot.failures = 3
+    assert sup._backoff_s(slot) == sup._backoff_s(slot)
+
+
+# ----------------------------------------------------------------------
+# graceful drain
+# ----------------------------------------------------------------------
+def test_drain_completes_admitted_then_503s(models):
+    from lightgbm_tpu.serve.http import serve_http
+    b1, X = models["b1"], models["X"]
+    srv = Server(b1, config=ServeConfig(max_batch_rows=512,
+                                        batch_wait_ms=50.0,
+                                        timeout_ms=30000, port=0))
+    httpd, _ = serve_http(srv, port=0, background=True)
+    port = httpd.server_address[1]
+    url = f"http://127.0.0.1:{port}"
+    try:
+        results = {}
+
+        def submit_before():
+            # admitted BEFORE the drain begins; the 50ms batch wait
+            # keeps it in-flight while drain() runs
+            try:
+                results["pre"] = _http_predict(url, X[:4].tolist())
+            except Exception as exc:       # noqa: BLE001
+                results["pre_err"] = str(exc)
+
+        t = threading.Thread(target=submit_before)
+        t.start()
+        time.sleep(0.01)                   # let it get admitted
+        drained = threading.Thread(target=srv.drain, args=(10.0,))
+        drained.start()
+        time.sleep(0.02)
+        # new work during the drain: 503 + Retry-After, structured
+        req = urllib.request.Request(
+            url + "/predict",
+            data=json.dumps({"rows": X[:2].tolist()}).encode())
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 503
+        assert exc.value.headers.get("Retry-After")
+        body = json.loads(exc.value.read())
+        assert body["code"] in ("draining", "shed")
+        # healthz flips to draining (load balancers stop routing)
+        with pytest.raises(urllib.error.HTTPError) as hexc:
+            urllib.request.urlopen(url + "/healthz", timeout=10)
+        assert hexc.value.code == 503
+        assert json.loads(hexc.value.read())["draining"] is True
+        drained.join(timeout=30)
+        t.join(timeout=30)
+        # the admitted request completed with correct results
+        assert "pre" in results, results
+        np.testing.assert_allclose(results["pre"]["predictions"],
+                                   b1.predict(X[:4]), rtol=1e-9)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        srv.stop()
+
+
+# ----------------------------------------------------------------------
+# HTTP front hardening + /faults + model identity
+# ----------------------------------------------------------------------
+@pytest.fixture()
+def http_server(models):
+    from lightgbm_tpu.serve.http import serve_http
+    srv = Server(models["b1"],
+                 config=ServeConfig(max_batch_rows=512,
+                                    batch_wait_ms=0.2,
+                                    timeout_ms=30000, port=0,
+                                    max_body_bytes=64 * 1024))
+    httpd, _ = serve_http(srv, port=0, background=True)
+    yield srv, f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    httpd.server_close()
+    srv.stop()
+
+
+def _post_raw(url, path, data, headers=None):
+    req = urllib.request.Request(url + path, data=data,
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_http_hardening_structured_errors(http_server, models):
+    srv, url = http_server
+    X = models["X"]
+    st, out = _post_raw(url, "/predict", b"{not json")
+    assert (st, out["code"]) == (400, "bad_json")
+    st, out = _post_raw(url, "/predict", json.dumps(
+        {"rows": [["a", "b"]]}).encode())
+    assert (st, out["code"]) == (400, "bad_rows")
+    st, out = _post_raw(url, "/predict", json.dumps(
+        {"rows": {"not": "a matrix"}}).encode())
+    assert (st, out["code"]) == (400, "bad_rows")
+    st, out = _post_raw(url, "/predict", json.dumps(
+        {"nope": 1}).encode())
+    assert (st, out["code"]) == (400, "missing_rows")
+    st, out = _post_raw(url, "/predict", json.dumps(
+        {"rows": X[:2].tolist(), "priority": {"a": 1}}).encode())
+    assert (st, out["code"]) == (400, "bad_field")
+    st, out = _post_raw(url, "/predict", json.dumps(
+        {"rows": X[:2].tolist(), "timeout_ms": "soon"}).encode())
+    assert (st, out["code"]) == (400, "bad_field")
+    # a JSON array body is rejected as an object-shape violation
+    st, out = _post_raw(url, "/predict", b"[1, 2, 3]")
+    assert (st, out["code"]) == (400, "bad_json")
+    # too few features is still a structured 400
+    st, out = _post_raw(url, "/predict", json.dumps(
+        {"rows": [[1.0]]}).encode())
+    assert st == 400
+
+
+def test_http_body_size_bound(http_server):
+    srv, url = http_server
+    big = b"x" * (64 * 1024 + 1)
+    st, out = _post_raw(url, "/predict", big)
+    assert (st, out["code"]) == (413, "body_too_large")
+    # bound is config-driven: a small body passes the size gate
+    st, out = _post_raw(url, "/predict", b"{}")
+    assert (st, out["code"]) == (400, "missing_rows")
+
+
+def test_http_faults_endpoint_gated(http_server):
+    srv, url = http_server
+    st, out = _post_raw(url, "/faults",
+                        json.dumps({"spec": "x:y@1"}).encode())
+    assert (st, out["code"]) == (403, "forbidden")
+    srv.config.debug_faults = True
+    try:
+        st, out = _post_raw(url, "/faults", json.dumps(
+            {"spec": "http.request:error@*", "reset": True}).encode())
+        assert st == 200 and out["specs"] == ["http.request:error@*"]
+        st, out = _post_raw(url, "/predict",
+                            json.dumps({"rows": [[0.0] * 8]}).encode())
+        assert (st, out["code"]) == (500, "injected")
+        st, out = _post_raw(url, "/faults", json.dumps(
+            {"spec": "", "reset": True}).encode())
+        assert st == 200 and out["specs"] == []
+        with urllib.request.urlopen(url + "/faults", timeout=10) as r:
+            snap = json.loads(r.read())
+        assert "hits" in snap
+    finally:
+        srv.config.debug_faults = False
+        faults.clear()
+        faults.reset()
+
+
+def test_model_identity_exposed(http_server, models):
+    srv, url = http_server
+    b1, X = models["b1"], models["X"]
+    fp = model_fingerprint(b1.model_to_string(num_iteration=-1))
+    with urllib.request.urlopen(url + "/healthz", timeout=10) as r:
+        health = json.loads(r.read())
+    assert health["model_id"] == fp
+    out = _http_predict(url, X[:2].tolist())
+    assert out["model_id"] == fp
+    with urllib.request.urlopen(url + "/model", timeout=10) as r:
+        model = json.loads(r.read())
+    assert model["model_id"] == fp
+    assert model_fingerprint(model["model_str"]) == fp
+    stats = json.loads(urllib.request.urlopen(
+        url + "/stats", timeout=10).read())
+    assert stats["model_id"] == fp and stats["draining"] is False
+
+
+def test_injected_dispatch_fault_fails_requests_loudly(models):
+    b1, X = models["b1"], models["X"]
+    srv = Server(b1, config=ServeConfig(max_batch_rows=512,
+                                        batch_wait_ms=0.2,
+                                        timeout_ms=30000)).start()
+    try:
+        faults.configure("serve.dispatch:error@2")
+        srv.predict(X[:4])                 # hit 1: clean
+        from lightgbm_tpu.serve import ServeError
+        with pytest.raises(ServeError):
+            srv.predict(X[:4])             # hit 2: injected
+        srv.predict(X[:4])                 # hit 3: clean again
+    finally:
+        srv.stop()

@@ -2,7 +2,7 @@
 
 Drives mixed row-count predict requests from concurrent clients,
 optionally fires one mid-run hot-swap, and prints a JSON summary line
-(latency percentiles, throughput, status counts).  Two modes:
+(latency percentiles, throughput, status counts).  Three modes:
 
     # drive an already-running server
     python tools/loadgen_serve.py --url http://127.0.0.1:9595
@@ -13,10 +13,21 @@ optionally fires one mid-run hot-swap, and prints a JSON summary line
     python tools/loadgen_serve.py --selftest --requests 200 \
         --telemetry serve_telemetry.jsonl --out serve_loadgen.json
 
+    # CI chaos: a 2-replica PROCESS fleet under supervision
+    # (serve/fleet.py) with the checkpoint watcher + rollback
+    # controller (serve/watcher.py), driven through a mid-run
+    # replica SIGKILL, a corrupt snapshot, a canary-failing snapshot,
+    # a validated auto-publish, a telemetry-driven rollback (injected
+    # single-replica dispatch brownout) and a forced rollback —
+    # exiting nonzero on any dropped or mixed-version response
+    python tools/loadgen_serve.py --fleet \
+        --telemetry fleet_telemetry.jsonl --out fleet_chaos.json
+
 Exit code is non-zero when any request fails with something other
 than backpressure (HTTP 429 is the server doing its job under load —
-the client retries after the hinted delay), or when the mid-run
-hot-swap drops an in-flight request.
+the client retries after the hinted delay), when a hot-swap/failover
+drops a response, or when a response's predictions do not match the
+model fingerprint it claims (mixed-version detection).
 """
 import argparse
 import json
@@ -190,11 +201,338 @@ def selftest(args):
     return res, 0 if ok else 1
 
 
+def _wait_until(cond, timeout_s, desc, poll=0.1):
+    """Poll ``cond`` until truthy; returns its value or None on
+    timeout (the caller records the failed check instead of raising —
+    a chaos run should report EVERYTHING that went wrong)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(poll)
+    print(f"fleet: TIMEOUT waiting for {desc}", flush=True)
+    return None
+
+
+def fleet_selftest(args):
+    """The chaos e2e: supervised 2-replica process fleet + watcher +
+    rollback, with fault injection at every resilience seam."""
+    import shutil
+
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serve import (CanarySet, CheckpointWatcher,
+                                    FleetConfig, FleetSupervisor,
+                                    FleetTarget, ProcessReplica,
+                                    model_fingerprint)
+    from lightgbm_tpu.utils.telemetry import RunRecorder
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    work = os.path.abspath(args.workdir or "fleet_work")
+    shutil.rmtree(work, ignore_errors=True)
+    os.makedirs(work)
+    watch_root = os.path.join(work, "watch")
+    os.makedirs(watch_root)
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(2000, 8)
+    y = (X[:, 0] + 0.4 * rng.randn(2000) > 0).astype(float)
+    y_shuffled = y.copy()
+    rng.shuffle(y_shuffled)
+
+    def train(rounds, seed, labels, ckdir=None):
+        p = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+             "metric": "None", "seed": seed}
+        if ckdir:
+            p.update({"checkpoint_dir": ckdir, "snapshot_freq": rounds})
+        d = lgb.Dataset(X, label=labels, params=p)
+        return lgb.train(p, d, num_boost_round=rounds)
+
+    print("fleet: training v1 + candidate snapshots", flush=True)
+    b1 = train(4, 1, y)
+    m1 = os.path.join(work, "model_v1.txt")
+    b1.save_model(m1)
+    ck_good = os.path.join(work, "ck_good")    # a REAL training ckpt
+    train(6, 2, y, ck_good)
+    ck_good2 = os.path.join(work, "ck_good2")  # second valid deploy
+    train(8, 5, y, ck_good2)
+    ck_bad = os.path.join(work, "ck_bad")      # trained on garbage
+    train(6, 3, y_shuffled, ck_bad)
+
+    def newest(root):
+        return sorted(p for p in os.listdir(root)
+                      if p.startswith("ckpt_"))[-1]
+
+    def drop_snapshot(src, name, corrupt=False):
+        """Deliver a snapshot into the watch root the way the ckpt
+        writer does: stage under a .tmp_* name (which candidates()
+        ignores) and publish with ONE rename — the watcher must never
+        see a half-copied directory."""
+        import shutil as _sh
+        stage = os.path.join(watch_root, ".tmp_stage_" + name)
+        _sh.rmtree(stage, ignore_errors=True)
+        _sh.copytree(src, stage)
+        if corrupt:
+            with open(os.path.join(stage, "state.npz"), "r+b") as f:
+                f.truncate(64)
+        dst = os.path.join(watch_root, name)
+        os.rename(stage, dst)
+        return dst
+
+    good_dir = os.path.join(ck_good, newest(ck_good))
+    good2_dir = os.path.join(ck_good2, newest(ck_good2))
+    bad_dir = os.path.join(ck_bad, newest(ck_bad))
+
+    # oracle: per-fingerprint expected predictions, keyed the same way
+    # replicas key /predict's model_id (fingerprint of the LOADED
+    # booster's model text, so file round-trips agree)
+    def fp_and_preds(model_file):
+        bst = lgb.Booster(model_file=model_file)
+        return (model_fingerprint(bst.model_to_string(num_iteration=-1)),
+                bst.predict(X))
+
+    fp1, preds1 = fp_and_preds(m1)
+    fp2, preds2 = fp_and_preds(os.path.join(good_dir, "model.txt"))
+    fp3, preds3 = fp_and_preds(os.path.join(good2_dir, "model.txt"))
+    fpbad, _ = fp_and_preds(os.path.join(bad_dir, "model.txt"))
+    oracle = {fp1: preds1, fp2: preds2, fp3: preds3}
+    print(f"fleet: fingerprints v1={fp1} good={fp2} good2={fp3} "
+          f"bad={fpbad}", flush=True)
+
+    recorder = RunRecorder(args.telemetry or None,
+                           run_info={"task": "fleet"},
+                           keep_records=True)
+    cfg = FleetConfig(
+        replicas=2, probe_interval_s=0.2, probe_timeout_s=5.0,
+        fail_threshold=3, backoff_base_s=0.2, backoff_max_s=2.0,
+        circuit_failures=10, watch_poll_s=0.3,
+        rollback_window_s=6.0, rollback_min_requests=30,
+        rollback_error_rate=0.1, rollback_p99_factor=50.0,
+        rollback_p99_floor_ms=1e9,   # error-rate is the trigger here
+        rollback_holddown_s=600.0)
+
+    def factory(i):
+        return ProcessReplica(
+            m1, work, slot=i,
+            params={"serve_debug_faults": "true",
+                    "serve_drain_grace_s": "5",
+                    "serve_batch_wait_ms": "1",
+                    "serve_timeout_ms": "30000"},
+            env={"PYTHONPATH": repo + os.pathsep +
+                 os.environ.get("PYTHONPATH", "")})
+
+    checks = {}
+    counts = {"ok": 0, "backpressure": 0, "failover_retries": 0,
+              "dropped": 0, "mixed_version": 0, "brownout_5xx": 0}
+    lock = threading.Lock()
+    stop = threading.Event()
+    errors = []
+
+    sup = FleetSupervisor(factory, cfg, recorder)
+    print("fleet: starting 2 process replicas", flush=True)
+    sup.start(wait_healthy_s=180)
+    checks["fleet_started"] = len(sup.endpoints()) == 2
+    canary = CanarySet(X[:256], labels=y[:256], min_auc=0.75)
+    target = FleetTarget(sup)
+    watcher = CheckpointWatcher(watch_root, target, config=cfg,
+                                canary=canary, recorder=recorder)
+    watcher.start()
+
+    def events(kind, **match):
+        out = []
+        for r in recorder.records:
+            if r.get("type") != "fleet" or r.get("event") != kind:
+                continue
+            if all(r.get(k) == v for k, v in match.items()):
+                out.append(r)
+        return out
+
+    def client(tid):
+        r = np.random.RandomState(1000 + tid)
+        while not stop.is_set():
+            eps = sup.endpoints()
+            if not eps:
+                time.sleep(0.1)
+                continue
+            lo = int(r.randint(0, len(X) - 64))
+            n = int(r.randint(1, 64))
+            body = {"rows": X[lo:lo + n].tolist()}
+            # failover retry loop: a single replica crash/brownout
+            # must never surface to the caller while a healthy
+            # replica exists
+            done = False
+            for attempt in range(5):
+                eps = sup.endpoints() or eps
+                url = eps[(tid + attempt) % len(eps)]
+                st, out = _post(url, "/predict", body, timeout=60)
+                if st == 200:
+                    mid = out.get("model_id")
+                    exp = oracle.get(mid)
+                    got = np.asarray(out.get("predictions", ()))
+                    if exp is None or got.shape != (n,) or \
+                            not np.allclose(got, exp[lo:lo + n],
+                                            rtol=1e-9, atol=1e-9):
+                        with lock:
+                            counts["mixed_version"] += 1
+                            errors.append(
+                                f"response model_id {mid} does not "
+                                f"match its predictions (rows "
+                                f"{lo}:{lo + n})")
+                    else:
+                        with lock:
+                            counts["ok"] += 1
+                    done = True
+                    break
+                if st == 429:
+                    with lock:
+                        counts["backpressure"] += 1
+                    time.sleep(max(float(out.get("retry_after_ms", 10)),
+                                   1.0) / 1e3)
+                    done = True
+                    break
+                with lock:
+                    counts["failover_retries"] += 1
+                    if st == 500:
+                        counts["brownout_5xx"] += 1
+                time.sleep(0.02)
+            if not done:
+                with lock:
+                    counts["dropped"] += 1
+                    errors.append("request dropped after 5 attempts")
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(args.threads)]
+    for t in threads:
+        t.start()
+
+    def all_on(fp):
+        def cond():
+            ids = list(sup.active_models().values())
+            return len(ids) == 2 and set(ids) == {fp}
+        return cond
+
+    try:
+        # phase 0: steady traffic on v1
+        checks["warm_traffic"] = bool(
+            _wait_until(lambda: counts["ok"] >= 50, 60,
+                        "50 ok responses on v1"))
+
+        # phase 1: SIGKILL replica 0 -> supervisor detects + restarts
+        print("fleet: phase 1 — killing replica 0", flush=True)
+        sup.handle(0).kill()
+        _wait_until(lambda: len(sup.endpoints()) < 2, 30,
+                    "crash detection")
+        checks["replica_restarted"] = bool(
+            _wait_until(lambda: len(sup.endpoints()) == 2, 60,
+                        "replica restart"))
+        checks["restart_event"] = bool(events("replica_restart"))
+
+        # phase 2: corrupt snapshot -> watcher skips, v1 keeps serving
+        print("fleet: phase 2 — corrupt snapshot", flush=True)
+        drop_snapshot(good_dir, "ckpt_00000100", corrupt=True)
+        checks["corrupt_skipped"] = bool(
+            _wait_until(lambda: events("publish_skip",
+                                       reason="manifest"), 30,
+                        "manifest skip"))
+        checks["corrupt_not_published"] = \
+            set(sup.active_models().values()) == {fp1}
+
+        # phase 3: canary-failing snapshot -> skipped
+        print("fleet: phase 3 — canary-failing snapshot", flush=True)
+        drop_snapshot(bad_dir, "ckpt_00000200")
+        checks["canary_skipped"] = bool(
+            _wait_until(lambda: events("publish_skip", reason="canary"),
+                        30, "canary skip"))
+        checks["bad_model_never_served"] = \
+            fpbad not in set(sup.active_models().values())
+
+        # phase 4: valid snapshot -> validated auto-publish fleet-wide,
+        # then the observation window closes clean (verified)
+        print("fleet: phase 4 — valid snapshot auto-publish", flush=True)
+        drop_snapshot(good_dir, "ckpt_00000300")
+        checks["auto_published"] = bool(
+            _wait_until(all_on(fp2), 60, f"fleet on {fp2}"))
+        checks["publish_verified"] = bool(
+            _wait_until(lambda: events("publish_verified",
+                                       model_id=fp2), 90,
+                        "deploy verification"))
+
+        # phase 5: FORCED rollback round trip — the verified deploy is
+        # commanded back to the pre-deploy version
+        print("fleet: phase 5 — forced rollback", flush=True)
+        watcher.force_rollback("forced")
+        checks["forced_rollback"] = bool(
+            _wait_until(all_on(fp1), 60, "forced rollback to v1"))
+        checks["forced_rollback_event"] = bool(
+            events("rollback", reason="forced"))
+
+        # phase 6: regressing deploy -> telemetry-driven rollback.
+        # A single-replica brownout is armed (injected dispatch
+        # errors: that replica 5xxes, clients fail over to the other),
+        # then a fresh valid snapshot publishes into the brownout —
+        # the rollback controller sees the post-publish error-rate
+        # regression and republishes the previous version
+        print("fleet: phase 6 — regressing deploy -> rollback",
+              flush=True)
+        drop_snapshot(good2_dir, "ckpt_00000400")
+        ep0 = sup.endpoints()[0]
+        st, out = _post(ep0, "/faults",
+                        {"spec": "serve.dispatch:error@*",
+                         "reset": True})
+        checks["fault_armed"] = st == 200
+        checks["regressing_published"] = bool(
+            _wait_until(lambda: events("publish", model_id=fp3), 60,
+                        f"publish of {fp3}"))
+        rolled = _wait_until(
+            lambda: events("rollback", reason="error_rate"), 120,
+            "telemetry-driven rollback")
+        checks["rollback_fired"] = bool(rolled)
+        for url in sup.endpoints():
+            _post(url, "/faults", {"spec": "", "reset": True})
+        checks["rollback_restored_v1"] = bool(
+            _wait_until(all_on(fp1), 60, f"fleet back on {fp1}"))
+
+        # final: steady traffic after all the chaos
+        base_ok = counts["ok"]
+        checks["serving_after_chaos"] = bool(
+            _wait_until(lambda: counts["ok"] >= base_ok + 30, 60,
+                        "post-chaos traffic"))
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        watcher.stop()
+        sup.stop()
+        recorder.close()
+
+    checks["zero_dropped"] = counts["dropped"] == 0
+    checks["zero_mixed_version"] = counts["mixed_version"] == 0
+    res = {
+        "mode": "fleet",
+        "counts": counts,
+        "checks": checks,
+        "errors": errors[:10],
+        "events": {k: len(events(k)) for k in
+                   ("replica_start", "replica_restart", "publish",
+                    "publish_skip", "publish_verified", "rollback")},
+        "passed": all(checks.values()),
+    }
+    return res, 0 if res["passed"] else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--url", help="serve endpoint to drive")
     ap.add_argument("--selftest", action="store_true",
                     help="train + serve in-process (CI smoke)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="supervised replica-fleet chaos e2e (CI)")
+    ap.add_argument("--workdir", default="fleet_work",
+                    help="--fleet: scratch directory (models, "
+                         "checkpoints, replica logs)")
     ap.add_argument("--requests", type=int, default=300)
     ap.add_argument("--threads", type=int, default=8)
     ap.add_argument("--rows-max", type=int, default=600)
@@ -207,7 +545,9 @@ def main(argv=None):
     ap.add_argument("--out", help="also write the summary JSON here")
     args = ap.parse_args(argv)
 
-    if args.selftest:
+    if args.fleet:
+        res, rc = fleet_selftest(args)
+    elif args.selftest:
         res, rc = selftest(args)
     elif args.url:
         res = drive(args.url.rstrip("/"), args.requests, args.threads,
@@ -217,7 +557,7 @@ def main(argv=None):
         rc = 0 if not res["errors"] and res["counts"].get("ok") else 1
         res["passed"] = rc == 0
     else:
-        ap.error("need --url or --selftest")
+        ap.error("need --url, --selftest or --fleet")
     print(json.dumps(res), flush=True)
     if args.out:
         with open(args.out, "w") as f:

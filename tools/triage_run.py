@@ -165,6 +165,56 @@ def scan_anomalies(records):
                                f"are nearly all padding; shrink "
                                f"serve_max_batch_rows or raise "
                                f"serve_batch_wait_ms"))
+    fleet = [r for r in records if r.get("type") == "fleet"]
+    if fleet:
+        skips = [r for r in fleet if r.get("event") == "publish_skip"]
+        corrupt = [r for r in skips if r.get("reason") == "manifest"]
+        canary = [r for r in skips if r.get("reason") == "canary"]
+        if corrupt:
+            out.append(("HIGH", f"deploy pipeline produced "
+                                f"{len(corrupt)} CORRUPT snapshot(s) "
+                                f"the watcher refused to publish; "
+                                f"last: {corrupt[-1].get('path', '?')} "
+                                f"({str(corrupt[-1].get('error', '?'))[:120]})"))
+        if canary:
+            out.append(("MED", f"{len(canary)} snapshot(s) failed "
+                               f"canary scoring and were not "
+                               f"published; last: "
+                               f"{canary[-1].get('path', '?')} "
+                               f"({str(canary[-1].get('error', '?'))[:120]})"))
+        rollbacks = [r for r in fleet if r.get("event") == "rollback"]
+        if rollbacks:
+            last = rollbacks[-1]
+            out.append(("HIGH", f"deploy ROLLED BACK {len(rollbacks)} "
+                                f"time(s): {last.get('from_id', '?')} "
+                                f"-> {last.get('to_id', '?')} "
+                                f"({last.get('reason', '?')}: "
+                                f"{str(last.get('detail', ''))[:120]})"))
+        circuits = [r for r in fleet if r.get("event") == "circuit_open"]
+        if circuits:
+            out.append(("HIGH", f"replica circuit breaker OPEN on "
+                                f"slot(s) "
+                                f"{sorted({r.get('slot') for r in circuits})}"
+                                f" — fleet is degraded (crash loop?)"))
+        restarts = [r for r in fleet
+                    if r.get("event") == "replica_restart"]
+        if restarts:
+            out.append(("MED", f"{len(restarts)} replica restart(s) — "
+                               f"replicas crashed or hung under "
+                               f"supervision"))
+        unverified = [r for r in fleet
+                      if r.get("event") == "publish_unverified"]
+        if unverified:
+            out.append(("MED", f"{len(unverified)} deploy(s) closed "
+                               f"their observation window UNVERIFIED "
+                               f"(too little traffic for a verdict); "
+                               f"last: "
+                               f"{unverified[-1].get('model_id', '?')}"))
+        errors = [r for r in fleet if r.get("event") == "watch_error"]
+        if errors:
+            out.append(("MED", f"{len(errors)} watcher error(s); "
+                               f"last: "
+                               f"{str(errors[-1].get('error', '?'))[:140]}"))
     ckpts = [r for r in records if r.get("type") == "checkpoint"]
     if ckpts:
         fallbacks = [r for r in ckpts if r.get("event") == "fallback"]
@@ -261,6 +311,19 @@ def triage(records, baseline=None):
                 f"{s.get('ckpt_loads', 0):.0f} loads "
                 f"({s.get('ckpt_load_ms', 0.0):.0f} ms), "
                 f"{s.get('ckpt_fallbacks', 0):.0f} fallbacks")
+        if any(s.get(k) for k in ("fleet_publishes", "fleet_skips",
+                                  "fleet_rollbacks", "fleet_restarts",
+                                  "fleet_replica_starts",
+                                  "fleet_circuit_opens")):
+            lines.append(
+                f"fleet       : "
+                f"{s.get('fleet_replica_starts', 0):.0f} replica "
+                f"starts, {s.get('fleet_restarts', 0):.0f} restarts, "
+                f"{s.get('fleet_circuit_opens', 0):.0f} circuit-opens, "
+                f"{s.get('fleet_publishes', 0):.0f} publishes "
+                f"({s.get('fleet_publish_verified', 0):.0f} verified), "
+                f"{s.get('fleet_skips', 0):.0f} skips, "
+                f"{s.get('fleet_rollbacks', 0):.0f} rollbacks")
         if s.get("serve_requests"):
             lines.append(
                 f"serve       : {s['serve_requests']:.0f} requests "
