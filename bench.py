@@ -82,19 +82,22 @@ def resolve_backend():
     because an explicitly-requested accelerator platform was never
     verified before ``jax.default_backend()`` ran in-process).
 
-    Returns ``(degraded, probe_error)``:
+    Returns ``(degraded, probe_error, platform)``:
 
-    - ``(False, None)``  backend is up (explicit or auto-detected).
-    - ``(True, err)``    no explicit accelerator request and the probe
-      failed — degraded to the CPU backend.
-    - ``(None, err)``    UNRECOVERABLE: the caller asked for an
+    - ``(False, None, name)``  backend is up (explicit or
+      auto-detected); ``name`` is the probed platform ("cpu", "tpu",
+      ...), so callers can tell an auto-detected CPU resolution from
+      an accelerator one.
+    - ``(True, err, "cpu")``   no explicit accelerator request and the
+      probe failed — degraded to the CPU backend.
+    - ``(None, err, None)``    UNRECOVERABLE: the caller asked for an
       accelerator platform that cannot initialize; the bench must emit
       the structured ``tpu_unavailable`` artifact, not a traceback.
     """
     explicit = os.environ.get("JAX_PLATFORMS", "")
     if explicit and set(p.strip() for p in explicit.split(",")
                         if p.strip()) <= {"cpu"}:
-        return False, None        # CPU-only request: nothing to probe
+        return False, None, "cpu"  # CPU-only request: nothing to probe
     budget = float(os.environ.get("BENCH_BACKEND_PROBE_S", "120"))
     retry_s = float(os.environ.get("BENCH_BACKEND_RETRY_S", "15"))
     deadline = time.time() + budget
@@ -107,7 +110,7 @@ def resolve_backend():
                  "import jax; print(jax.default_backend())"],
                 timeout=left, capture_output=True, text=True)
             if r.returncode == 0 and r.stdout.strip():
-                return False, None
+                return False, None, r.stdout.strip().splitlines()[-1]
             msg = (r.stderr or r.stdout or "").strip()
             last_err = msg.splitlines()[-1][:300] if msg \
                 else "backend probe failed"
@@ -117,19 +120,21 @@ def resolve_backend():
             break
         time.sleep(retry_s)
     if explicit and "cpu" not in explicit:
-        return None, last_err
+        return None, last_err, None
     os.environ["JAX_PLATFORMS"] = "cpu"
-    return True, last_err
+    return True, last_err, "cpu"
 
 
-def emit_unavailable(probe_error, phase="probe"):
+def emit_unavailable(probe_error, phase="probe", variant="train"):
     """The outage story: a PARSEABLE artifact carrying the failure and
     the last good round's rows, so a chip outage is distinguishable
     from broken code without reading tracebacks.  ``phase`` records
     WHERE init died: "probe" (the subprocess probe never came up) or
     "in_process" (the probe succeeded but the tunnel died before the
     in-process backend init — the exact race BENCH_r05.json recorded
-    as a raw rc-1 traceback)."""
+    as a raw rc-1 traceback).  ``variant`` names the entry point
+    (train | serve | ckpt | weakscale) so a missed artifact is
+    attributable to its section."""
     from lightgbm_tpu.utils.telemetry import latest_good_bench
     root = os.path.dirname(os.path.abspath(__file__))
     src, rows = latest_good_bench(root)
@@ -139,11 +144,55 @@ def emit_unavailable(probe_error, phase="probe"):
         "tpu_unavailable": True,
         "probe_error": (probe_error or "")[:500],
         "probe_phase": phase,
+        "variant": variant,
         "requested_platform": os.environ.get("JAX_PLATFORMS", ""),
         "last_good_source": src,
         "last_good": rows,
     }
     print(json.dumps(out), flush=True)
+
+
+def ensure_backend(variant="train", force_host_devices=0):
+    """The ONE backend-acquisition path every bench entry point must
+    use: subprocess probe (``resolve_backend``), then the guarded
+    in-process ``jax.default_backend()`` — the exact call BENCH_r05
+    recorded dying with a raw traceback when the tunnel fell over
+    between the probe and the in-process init.  Any failure emits the
+    structured ``tpu_unavailable`` artifact and returns ``None`` (the
+    caller exits 0); a live backend returns
+    ``(backend, degraded, probe_error)``.
+
+    ``force_host_devices``: on a CPU-resolved run, force that many
+    virtual host devices (``--xla_force_host_platform_device_count``)
+    BEFORE the first jax import — the weak-scale grid needs the mesh
+    even on a host with one physical device."""
+    degraded, probe_error, platform = resolve_backend()
+    if degraded is None:
+        # explicit accelerator request, backend down past the retry
+        # window: structured artifact, rc 0 (VERDICT r5 "weak" #1)
+        emit_unavailable(probe_error, variant=variant)
+        return None
+    if force_host_devices and platform == "cpu":
+        # covers explicit JAX_PLATFORMS=cpu, degraded fallback AND a
+        # probe that auto-detected cpu on an accelerator-free host —
+        # the weak-scale grid needs the virtual mesh in all three
+        from lightgbm_tpu.utils.env import force_host_platform_devices
+        force_host_platform_devices(int(force_host_devices))
+    try:
+        # outage fault injection for the regression tests: the probe
+        # subprocess can succeed while the in-process init still dies
+        # (tunnel raced between the two) — that path must emit the
+        # same structured artifact, never a traceback
+        if os.environ.get("BENCH_SIM_INPROC_FAIL"):
+            raise RuntimeError("simulated in-process backend init "
+                               "failure (BENCH_SIM_INPROC_FAIL)")
+        import jax
+        backend = jax.default_backend()
+    except Exception as exc:  # probe raced a dying tunnel
+        emit_unavailable(f"in-process init failed: {exc}",
+                         phase="in_process", variant=variant)
+        return None
+    return backend, degraded, probe_error
 
 
 def bench_predict(booster, X, reps=3):
@@ -380,6 +429,8 @@ def serve_only():
     only meaningful per-backend, like the other *_cpu artifacts."""
     import datetime
 
+    if ensure_backend(variant="serve") is None:
+        return 0
     import numpy as np
     import lightgbm_tpu as lgb
     from lightgbm_tpu.utils import telemetry as _telemetry
@@ -445,6 +496,8 @@ def ckpt_only():
     import datetime
     import tempfile
 
+    if ensure_backend(variant="ckpt") is None:
+        return 0
     import numpy as np
     import lightgbm_tpu as lgb
     from lightgbm_tpu.ckpt import CheckpointManager
@@ -543,32 +596,232 @@ def ckpt_only():
     return 0
 
 
+def weakscale_curve(shards=(1, 2, 4, 8), rows_per_shard=2048,
+                    n_features=8, num_leaves=15, max_bin=63,
+                    fused_iters=8, iters=16, reps=2,
+                    telemetry_file=None):
+    """Measure the weak-scaling curve of the SHARDED FUSED super-step:
+    per-iteration time at a FIXED per-shard row count as the data-
+    parallel mesh widens, with collective accounting and the device-
+    call budget per iteration.  Shared by ``bench.py --weakscale-only``
+    and ``tests/test_weak_scaling.py`` (one generator, one schema — the
+    committed WEAKSCALE.json can never drift from the test's).
+
+    Three series per point, because the dryrun mesh timeshares
+    physical cores:
+
+    - ``iter_s``              wall per iteration (the headline on real
+      chips; on a virtual mesh with shards > cores it necessarily
+      grows with the oversubscription factor),
+    - ``cpu_s_per_shard_iter`` process-CPU seconds per shard per
+      iteration — flat iff per-shard cost is O(1) in the mesh size
+      (the dryrun-meaningful weak-scaling pin: the per-shard dispatch
+      overhead WEAKSCALE measured through r05 made it grow with D),
+    - ``device_calls_per_iter`` measured host->device dispatches per
+      iteration (2/K for the fused scan at ANY mesh size, vs ~5 PER
+      SHARD per iteration on the pre-refactor per-call path).
+
+    ``shards == 1`` runs the serial learner (the 1-shard anchor);
+    wider points run ``tree_learner=data`` over a mesh of the first D
+    devices.  D=1 and D=8 at the same rows/shard is the acceptance
+    comparison."""
+    import time as _time
+
+    import numpy as np
+    import jax
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.ops.grow import collective_bytes_per_pass
+    from lightgbm_tpu.utils import telemetry as _telemetry
+
+    rec = None
+    if telemetry_file:
+        rec = _telemetry.RunRecorder(
+            str(telemetry_file),
+            run_info={"backend": jax.default_backend(),
+                      "bench": "weakscale"})
+    avail = len(jax.devices())
+    skipped = [D for D in shards if D > avail]
+    live = [D for D in shards if D <= avail]
+    boosters = {}
+    for D in live:
+        rng = np.random.RandomState(0)
+        N = rows_per_shard * D
+        X = rng.random_sample((N, n_features)).astype(np.float32)
+        y = (X[:, 0] + 0.5 * (X[:, 1] > 0.5) +
+             0.1 * rng.randn(N) > 0.7).astype(np.float32)
+        params = {"objective": "binary", "num_leaves": num_leaves,
+                  "max_bin": max_bin, "verbose": -1, "metric": "None",
+                  "fused_iters": fused_iters,
+                  # no tail block inside the measured window
+                  "num_iterations": 1_000_000,
+                  "tree_learner": "serial" if D == 1 else "data"}
+        mesh = None
+        if D > 1:
+            mesh = jax.sharding.Mesh(np.asarray(jax.devices()[:D]),
+                                     ("shard",))
+        d = lgb.Dataset(X, label=y, params=params)
+        d.construct()
+        bst = lgb.Booster(params=params, train_set=d, mesh=mesh)
+        if rec is not None:
+            bst._gbdt.attach_telemetry(rec)
+        # warmup: bias iteration + TWO whole blocks — the first block
+        # consumes the single-device score the unfused bias iteration
+        # left behind and the second runs on the mesh-replicated carry,
+        # so both XLA executables (same trace, different input
+        # sharding) are compiled before the measured window
+        for _ in range(1 + 2 * fused_iters):
+            bst.update()
+        boosters[D] = bst
+    if rec is not None:
+        # re-baseline every cell's counter snapshot AFTER all warmups:
+        # the compile counters are process-wide, so without this the
+        # first measured block of each cell would absorb the OTHER
+        # cells' warmup compiles into its superstep record and read as
+        # a retrace storm in triage
+        for bst in boosters.values():
+            bst._gbdt._tele_counters_last = \
+                _telemetry.counters_snapshot()
+    # interleaved reps (the docs/Benchmarks.md protocol discipline:
+    # this container's clock jitters 20-40% minute to minute, so
+    # back-to-back cells measure the machine, not the mesh size);
+    # min-per-cell estimates each point's noise floor
+    wall_min = {D: float("inf") for D in live}
+    cpu_min = {D: float("inf") for D in live}
+    calls = {D: 0.0 for D in live}
+    for _ in range(reps):
+        for D in live:
+            bst = boosters[D]
+            c0 = _telemetry.counters_snapshot()
+            t0, p0 = _time.time(), _time.process_time()
+            for _ in range(iters):
+                bst.update()
+            wall_min[D] = min(wall_min[D],
+                              (_time.time() - t0) / iters)
+            cpu_min[D] = min(cpu_min[D],
+                             (_time.process_time() - p0) / iters)
+            c1 = _telemetry.counters_snapshot()
+            calls[D] += (c1.get("superstep_dispatches", 0) -
+                         c0.get("superstep_dispatches", 0) +
+                         c1.get("superstep_fetches", 0) -
+                         c0.get("superstep_fetches", 0))
+    curve = []
+    for D in live:
+        g = boosters[D]._gbdt
+        # per-SHARD per-iteration collective estimate, mirroring the
+        # superstep telemetry accounting (grow.py estimate x one pass
+        # per split + the leaf-assignment gather's per-shard send)
+        cb = co = 0
+        if g._dist is not None:
+            est = collective_bytes_per_pass(g._dist.params, g._F_pad,
+                                            g._n_pad)
+            passes = max(num_leaves, 1)
+            cb = est["total"] * passes + \
+                (g._n_pad // g._dist.num_shards) * 4
+            co = est["ops"] * passes + 1
+        curve.append({
+            "shards": int(D),
+            "rows_per_shard": int(rows_per_shard),
+            "collective_bytes": int(cb),
+            "collective_ops": int(co),
+            "iter_s": round(wall_min[D], 4),
+            "cpu_s_per_shard_iter": round(cpu_min[D] / D, 4),
+            "device_calls_per_iter": round(calls[D] / (reps * iters),
+                                           3),
+        })
+    if rec is not None:
+        rec.close(log=False)
+    cores = os.cpu_count() or 1
+    pts = {c["shards"]: c for c in curve}
+    lo, hi = min(pts), max(pts)
+    out = {
+        "metric": "weak_scaling_fixed_rows_per_shard",
+        "learner": "data+fused_scan" if len(pts) > 1 else "serial",
+        "fused_iters": int(fused_iters),
+        "cores": int(cores),
+        "source": "python bench.py --weakscale-only",
+        "curve": curve,
+    }
+    if len(pts) > 1:
+        out["flat_ratio_wall"] = round(
+            pts[hi]["iter_s"] / max(pts[lo]["iter_s"], 1e-9), 3)
+        out["flat_ratio_cpu_per_shard"] = round(
+            pts[hi]["cpu_s_per_shard_iter"] /
+            max(pts[lo]["cpu_s_per_shard_iter"], 1e-9), 3)
+        sharded = sorted(d for d in pts if d > 1)
+        if len(sharded) > 1:
+            # the scaling-law ratio among SHARDED points: the 1-shard
+            # anchor is the serial program (no collectives at all), so
+            # lo->hi mixes the one-time serial->sharded collective
+            # cost into the curve; widest-vs-narrowest MESH is the
+            # per-shard-cost-O(1)-in-D pin proper
+            out["flat_ratio_cpu_per_shard_sharded"] = round(
+                pts[sharded[-1]]["cpu_s_per_shard_iter"] /
+                max(pts[sharded[0]]["cpu_s_per_shard_iter"], 1e-9), 3)
+        out["oversubscription"] = round(max(hi / cores, 1.0), 2)
+        out["note"] = (
+            "wall iter_s on a virtual CPU mesh timeshares "
+            f"{hi} shards over {cores} core(s); the dryrun weak-"
+            "scaling pin is cpu_s_per_shard_iter (per-shard cost flat "
+            "in mesh size) and the flat device_calls_per_iter — wall "
+            "flatness is only meaningful with one real device per "
+            "shard")
+    if skipped:
+        out["skipped_shards"] = skipped
+    return out
+
+
+def weakscale_only():
+    """Fast path (``python bench.py --weakscale-only``): regenerate
+    WEAKSCALE.json from the sharded fused super-step on a
+    host-platform-device-count mesh (or real devices when present),
+    plus a telemetry JSONL carrying the per-block collective counters
+    for ``tools/triage_run.py``.  ``tools/render_benchmarks.py``
+    renders the curve + ideal line into docs/Benchmarks.md."""
+    max_shards = int(os.environ.get("BENCH_WEAKSCALE_SHARDS", "8"))
+    if ensure_backend(variant="weakscale",
+                      force_host_devices=max_shards) is None:
+        return 0
+    from lightgbm_tpu.utils import telemetry as _telemetry
+    _telemetry.install_jax_hooks()
+    shards = tuple(d for d in (1, 2, 4, 8, 16, 32, 64, 128, 256)
+                   if d <= max_shards)
+    root = os.path.dirname(os.path.abspath(__file__))
+    tele = os.environ.get("BENCH_WEAKSCALE_TELEMETRY",
+                          os.path.join(root, "WEAKSCALE_telemetry.jsonl"))
+    try:
+        if tele and os.path.exists(tele):
+            os.remove(tele)
+    except OSError:
+        tele = ""
+    out = weakscale_curve(
+        shards=shards,
+        rows_per_shard=int(os.environ.get("BENCH_WEAKSCALE_ROWS",
+                                          "2048")),
+        iters=int(os.environ.get("BENCH_WEAKSCALE_ITERS", "16")),
+        reps=int(os.environ.get("BENCH_WEAKSCALE_REPS", "3")),
+        telemetry_file=tele or None)
+    print(json.dumps(out), flush=True)
+    path = os.environ.get("BENCH_WEAKSCALE_OUT",
+                          os.path.join(root, "WEAKSCALE.json"))
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print(json.dumps({"wrote": os.path.basename(path),
+                      "telemetry": os.path.basename(tele) if tele
+                      else None}), flush=True)
+    return 0
+
+
 def main():
     t_start = time.time()
     budget = float(os.environ.get("BENCH_TIME_BUDGET_S", "240"))
     n_rows = int(os.environ.get("BENCH_ROWS", str(N_ROWS)))
     n_meas = int(os.environ.get("BENCH_MEAS_ITERS", "20"))
 
-    degraded, probe_error = resolve_backend()
-    if degraded is None:
-        # explicit accelerator request, backend down past the retry
-        # window: structured artifact, rc 0 (VERDICT r5 "weak" #1)
-        emit_unavailable(probe_error)
+    resolved = ensure_backend(variant="train")
+    if resolved is None:
         return 0
-    try:
-        # outage fault injection for the regression test: the probe
-        # subprocess can succeed while the in-process init still dies
-        # (tunnel raced between the two) — that path must emit the
-        # same structured artifact, never a traceback
-        if os.environ.get("BENCH_SIM_INPROC_FAIL"):
-            raise RuntimeError("simulated in-process backend init "
-                               "failure (BENCH_SIM_INPROC_FAIL)")
-        import jax
-        backend = jax.default_backend()
-    except Exception as exc:  # probe raced a dying tunnel
-        emit_unavailable(f"in-process init failed: {exc}",
-                         phase="in_process")
-        return 0
+    backend, degraded, probe_error = resolved
     from lightgbm_tpu.utils import telemetry as _telemetry
     _telemetry.install_jax_hooks()   # compile/retrace counters
     cpu_smoke = backend == "cpu"
@@ -1122,4 +1375,6 @@ if __name__ == "__main__":
         sys.exit(serve_only())
     if "--ckpt-only" in sys.argv:
         sys.exit(ckpt_only())
+    if "--weakscale-only" in sys.argv:
+        sys.exit(weakscale_only())
     sys.exit(main())

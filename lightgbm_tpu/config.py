@@ -66,7 +66,12 @@ PARAMS: List[Param] = [
        "max number of leaves in one tree", check=">1"),
     _p("tree_learner", "serial", str,
        ("tree", "tree_type", "tree_learner_type"),
-       "serial, feature, data, voting"),
+       "serial, feature, data, voting.  Parallel learners run SPMD "
+       "over a 1-D device mesh (all devices, capped by num_machines; "
+       "or an explicit mesh= keyword) with the strategy collectives "
+       "in-program, and with fused_iters>1 the sharded build rides "
+       "inside the fused lax.scan super-step — see "
+       "docs/Distributed.md"),
     _p("num_threads", 0, int, ("num_thread", "nthread", "nthreads", "n_jobs"),
        "number of host threads (0 = default)"),
     _p("device_type", "tpu", str, ("device",), "tpu, cpu (XLA backend)",
@@ -414,13 +419,19 @@ PARAMS: List[Param] = [
        "O(iterations/K) Python dispatches and tunnel round-trips "
        "instead of O(iterations).  1 disables (the per-iteration "
        "path).  Bit-exact with the sequential path; parity is pinned "
-       "by tests/test_superstep.py.  Automatically falls back to "
-       "per-iteration training for: custom objectives (fobj), "
-       "objectives with leaf-renewal hooks (l1/quantile/mape), "
-       "multi-model-per-iteration objectives (multiclass), DART/RF "
-       "boosting, distributed tree learners, attached validation "
-       "sets or training metrics (their eval cadence — including "
-       "early stopping — needs per-iteration scores), and the "
+       "by tests/test_superstep.py.  Distributed tree learners "
+       "(tree_learner=data/feature/voting) FUSE: the same K-iteration "
+       "scan runs SPMD under shard_map over the learner's mesh with "
+       "the strategy collectives inside the one compiled program — K "
+       "iterations of sharded build + update cost one dispatch per "
+       "block at any mesh size (docs/Distributed.md; sharded parity "
+       "pinned by tests/test_sharded_superstep.py).  Automatically "
+       "falls back to per-iteration training for: custom objectives "
+       "(fobj), objectives with leaf-renewal hooks "
+       "(l1/quantile/mape), multi-model-per-iteration objectives "
+       "(multiclass), DART/RF boosting, attached validation sets or "
+       "training metrics (their eval cadence — including early "
+       "stopping — needs per-iteration scores), and the "
        "boost_from_average iteration 0 (which then runs unfused "
        "before fusion engages).  Super-steps are auto-sized down "
        "near the num_iterations boundary (the tail block runs a "

@@ -104,12 +104,21 @@ def train(params: Dict[str, Any], train_set: Dataset,
           resume_from: Optional[str] = None) -> Booster:
     """Train a booster (``engine.py:19`` in the reference).
 
+    ``mesh``: an explicit 1-D ``jax.sharding.Mesh`` for the parallel
+    tree learners (``tree_learner=data|feature|voting``); without it
+    the learner shards over all global devices, capped by
+    ``num_machines``.  Sharded training runs as ONE compiled SPMD
+    program — with ``fused_iters>1`` the whole K-iteration block rides
+    a single ``shard_map``-wrapped ``lax.scan`` — see
+    ``docs/Distributed.md``.
+
     With ``checkpoint_dir`` set (params or config file) training is
     preemption-safe: atomic checkpoints every ``snapshot_freq``
     iterations plus a best-effort final one on SIGTERM/SIGINT, and
     ``resume_from`` (param or keyword; ``'auto'`` discovers the newest
     valid snapshot) continues BIT-EXACTLY from the saved boundary —
-    see ``docs/Checkpointing.md``."""
+    even from a snapshot taken mid-fused-block under a sharded
+    learner — see ``docs/Checkpointing.md``."""
     params = dict(params)
     # canonical name first, then aliases (Config resolution order);
     # num_boost_round is accepted for reference-python compatibility

@@ -179,7 +179,9 @@ def collective_bytes_per_pass(params: GrowParams, num_features: int,
     - ``voting`` — ballot all-gather plus the elected-only (2k, B, 3)
       psum per scanned child.
 
-    Keys: hist / merge / route / total, all bytes.  Coarse-to-fine and
+    Keys: hist / merge / route / total (bytes) and ``ops`` (the number
+    of collective operations the pass issues — the count a weak-scaling
+    reader checks stays O(1) in shard count).  Coarse-to-fine and
     two-column passes stream fewer bins; this reports the full-
     resolution upper bound (telemetry consumers care about order of
     magnitude and trend, not exact wire bytes).
@@ -190,7 +192,7 @@ def collective_bytes_per_pass(params: GrowParams, num_features: int,
     F = max(num_features, 1)
     B = p.split.max_bin
     W = p.speculate if (p.wave and p.speculate > 1) else 1
-    out = {"hist": 0, "merge": 0, "route": 0, "total": 0}
+    out = {"hist": 0, "merge": 0, "route": 0, "total": 0, "ops": 0}
     if kind in ("serial", "") or D <= 1:
         return out
     # one _MERGE_KEYS record: gain f32 + feature/threshold i32 +
@@ -200,17 +202,21 @@ def collective_bytes_per_pass(params: GrowParams, num_features: int,
     if kind == "data":
         if p.wave:
             out["hist"] = W * F * B * 3 * 4
+            out["ops"] = 1                      # one whole-tensor psum
         else:
             out["hist"] = F * B * 3 * 4
             out["merge"] = rec_bytes * D
+            out["ops"] = 2                      # psum_scatter + merge
     elif kind == "feature":
         out["merge"] = n_children * rec_bytes * D
         out["route"] = num_rows * 4
+        out["ops"] = 2                          # merge + routing psum
     elif kind == "voting":
         n_vote = min(p.dist.top_k, F)
         n_elect = min(2 * p.dist.top_k, F)
         out["merge"] = n_children * n_vote * 4 * D
         out["hist"] = n_children * n_elect * B * 3 * 4
+        out["ops"] = 2                          # ballot gather + psum
     out["total"] = out["hist"] + out["merge"] + out["route"]
     return out
 

@@ -4,6 +4,22 @@ from __future__ import annotations
 import os
 
 
+def force_host_platform_devices(n: int) -> None:
+    """Append ``--xla_force_host_platform_device_count=n`` to
+    ``XLA_FLAGS`` so the CPU platform exposes ``n`` virtual devices —
+    the mesh the sharded tests/benches run on.  Must be called BEFORE
+    the first jax import; no-op when the flag is already present (an
+    explicit operator choice wins) or ``n <= 1``.  The flag only
+    affects the host platform, so it is safe to set even when an
+    accelerator backend ends up selected."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if n <= 1 or "xla_force_host_platform_device_count" in flags:
+        return
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={int(n)}"
+    ).strip()
+
+
 def strip_non_cpu_backends() -> None:
     """Drop accelerator backend factories registered by interpreter
     startup hooks (e.g. a site-wide PJRT plugin) so CPU-only runs can

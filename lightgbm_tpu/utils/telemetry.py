@@ -85,7 +85,12 @@ _TYPE_FIELDS: Dict[str, Tuple[Tuple[str, Any], ...]] = {
     # one record per fused K-iteration super-step (fused_iters > 1):
     # ``iter`` is the block's first iteration, ``k`` the block size,
     # ``duration_ms``/``phases_ms``/``counters`` cover the WHOLE block
-    # (per-iteration cost = value / k)
+    # (per-iteration cost = value / k).  SHARDED super-steps (a
+    # distributed tree learner running inside the fused scan,
+    # docs/Distributed.md) additionally carry ``learner``,
+    # ``num_shards``, ``mesh_shape`` and the per-block per-shard
+    # ``collective_bytes``/``collective_ops`` estimates — the series
+    # triage_run.py's weak-scaling anomaly reads
     "superstep": (("iter", int), ("k", int),
                   ("duration_ms", (int, float))),
     "eval": (("iter", int), ("results", list)),
@@ -327,6 +332,9 @@ class RunRecorder:
             self._agg["collective_bytes"] = \
                 self._agg.get("collective_bytes", 0.0) + \
                 float(rec.get("collective_bytes", 0.0))
+            self._agg["collective_ops"] = \
+                self._agg.get("collective_ops", 0.0) + \
+                float(rec.get("collective_ops", 0.0))
         elif t == "serve":
             status = rec.get("status")
             if status == "swap":

@@ -6,15 +6,13 @@ exercise sharded paths build a Mesh from these 8 virtual devices.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
 
 # Tests are CPU-hermetic and must not block on accelerator-tunnel
 # health (a site-registered PJRT plugin initializes in every process).
-from lightgbm_tpu.utils.env import strip_non_cpu_backends  # noqa: E402
+from lightgbm_tpu.utils.env import (  # noqa: E402
+    force_host_platform_devices, strip_non_cpu_backends)
 
+force_host_platform_devices(8)
 strip_non_cpu_backends()
 
 import numpy as np  # noqa: E402

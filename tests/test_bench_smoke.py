@@ -8,6 +8,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -75,6 +77,74 @@ def test_bench_outage_emits_structured_artifact():
     # the artifact carries the last good round's rows for the VERDICT
     assert d["last_good_source"] == "BENCH_r04.json"
     assert d["last_good"]["value"] == 412.45
+
+
+@pytest.mark.parametrize("flag", ["--serve-only", "--ckpt-only",
+                                  "--weakscale-only"])
+def test_bench_entrypoints_route_through_probe(flag, tmp_path):
+    """Every bench entry point — not just the training run — must
+    acquire the backend through the probe + guarded in-process init
+    (``ensure_backend``).  The BENCH_r05 class of crash was exactly a
+    ``jax.default_backend()`` call on an un-probed path dying with a
+    raw traceback; the sub-benches and the new weak-scale variant all
+    share the guard now."""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+                "BENCH_SIM_INPROC_FAIL": "1",
+                "BENCH_WEAKSCALE_OUT": str(tmp_path / "ws.json")})
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), flag],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "Traceback" not in out.stdout
+    lines = [l for l in out.stdout.strip().splitlines()
+             if l.startswith("{")]
+    assert lines, out.stdout[-2000:]
+    d = json.loads(lines[-1])
+    assert d["tpu_unavailable"] is True
+    assert d["probe_phase"] == "in_process"
+    assert d["variant"] == flag.strip("-").split("-")[0]
+    # the failed variant must not have written its artifact
+    assert not (tmp_path / "ws.json").exists()
+
+
+def test_bench_weakscale_writes_curve(tmp_path):
+    """`--weakscale-only` regenerates the WEAKSCALE artifact: a
+    shards x fixed-rows-per-shard grid on the host-platform mesh with
+    wall/per-shard-CPU/device-call series and a lint-clean telemetry
+    JSONL carrying the in-scan collective counters."""
+    ws = tmp_path / "ws.json"
+    tele = tmp_path / "ws_tele.jsonl"
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+                "BENCH_WEAKSCALE_SHARDS": "2",
+                "BENCH_WEAKSCALE_ROWS": "512",
+                "BENCH_WEAKSCALE_ITERS": "8",
+                "BENCH_WEAKSCALE_REPS": "1",
+                "BENCH_WEAKSCALE_OUT": str(ws),
+                "BENCH_WEAKSCALE_TELEMETRY": str(tele)})
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--weakscale-only"],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    d = json.loads(ws.read_text())
+    assert d["metric"] == "weak_scaling_fixed_rows_per_shard"
+    shards = [c["shards"] for c in d["curve"]]
+    assert shards == [1, 2]
+    for c in d["curve"]:
+        assert c["iter_s"] > 0
+        assert c["cpu_s_per_shard_iter"] > 0
+        # the fused-scan device-call budget: 2 per K-iteration block
+        # at ANY mesh size (the single-program property)
+        assert c["device_calls_per_iter"] == pytest.approx(
+            2.0 / d["fused_iters"])
+    assert d["curve"][1]["collective_bytes"] > 0
+    from lightgbm_tpu.utils.telemetry import lint_file
+    n, errs = lint_file(str(tele))
+    assert errs == [] and n > 0
 
 
 def test_bench_inprocess_init_failure_emits_structured_artifact():

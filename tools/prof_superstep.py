@@ -15,6 +15,13 @@ per block) plus the packed-record dispatch; the per-iteration path
 issues ~5 device calls per iteration (gradients, bagging draw, build
 dispatch, score update, record fetch/pack).
 
+A SHARDED cell (``--shards``, default 8 virtual host devices on CPU)
+runs the data-parallel learner through the same fused scan and pins
+that its per-block device-call budget MATCHES the serial fused path —
+the single-program property `docs/Distributed.md` documents (the
+pre-refactor per-call path issued ~5 dispatches per shard per
+iteration, the WEAKSCALE.json degradation).
+
     JAX_PLATFORMS=cpu python tools/prof_superstep.py            # write
     JAX_PLATFORMS=cpu python tools/prof_superstep.py --stdout
 """
@@ -32,7 +39,7 @@ OUT = os.path.join(ROOT, "BENCH_superstep_cpu.json")
 
 
 def measure(variants=(1, 4, 8), n_rows=5_000, n_feat=28, reps=6,
-            block=8):
+            block=8, learner="serial", num_shards=0):
     """Interleaved A/B: one booster per ``fused_iters`` variant, then
     round-robin 8-iteration blocks across them — the same-process
     interleaving discipline docs/Benchmarks.md's protocol notes
@@ -47,16 +54,22 @@ def measure(variants=(1, 4, 8), n_rows=5_000, n_feat=28, reps=6,
     rng = np.random.RandomState(0)
     X = rng.randn(n_rows, n_feat).astype(np.float32)
     y = (X[:, 0] + 0.4 * rng.randn(n_rows) > 0).astype(np.float32)
+    mesh = None
+    if learner != "serial" and num_shards > 1:
+        import jax
+        mesh = jax.sharding.Mesh(
+            np.asarray(jax.devices()[:num_shards]), ("shard",))
     boosters = {}
     for k in variants:
         params = {"objective": "binary",
                   "num_leaves": 15 if n_rows > 2500 else 7,
                   "max_bin": 63, "verbose": -1, "metric": "None",
                   "num_iterations": 10_000,  # no tail block in-window
+                  "tree_learner": learner,
                   "fused_iters": k}
         d = lgb.Dataset(X, label=y, params=params)
         d.construct()
-        bst = lgb.Booster(params=params, train_set=d)
+        bst = lgb.Booster(params=params, train_set=d, mesh=mesh)
         # warmup covers the XLA compiles: iteration 0 (unfused bias
         # iteration) plus the first whole fused block
         for _ in range(1 + max(k, 1)):
@@ -106,8 +119,19 @@ def main(argv=None):
     ap.add_argument("--stdout", action="store_true")
     ap.add_argument("--rows", type=int, default=5_000)
     ap.add_argument("--reps", type=int, default=6)
+    ap.add_argument("--shards", type=int, default=8,
+                    help="mesh width for the sharded fused cell "
+                         "(virtual host devices forced on CPU)")
     args = ap.parse_args(argv)
 
+    # the sharded cell needs the virtual mesh BEFORE the first jax
+    # backend init (same contract as tests/conftest.py); unconditional
+    # — the flag only affects the host platform, and gating it on an
+    # exact JAX_PLATFORMS match silently dropped the sharded cell (and
+    # its matches_serial_fused pin) from the artifact on hosts where
+    # cpu is auto-detected rather than requested
+    from lightgbm_tpu.utils.env import force_host_platform_devices
+    force_host_platform_devices(args.shards)
     import jax
     cells, budget = measure(n_rows=args.rows, reps=args.reps)
     base = cells[0]["iter_s"]
@@ -125,6 +149,25 @@ def main(argv=None):
         c["speedup_vs_unfused"] = round(tbase / max(c["iter_s"], 1e-9),
                                         2)
         c["shape"] = "2000 x 10, 7 leaves (dispatch-bound)"
+    # SHARDED fused super-step: the data-parallel learner rides the
+    # same K-iteration scan under shard_map, so its device-call budget
+    # per block must MATCH the serial fused path (2 calls per K
+    # iterations — one scan dispatch, one packed fetch), not the 5K
+    # per-shard dispatches of the pre-refactor per-call path.  Runs on
+    # the virtual host mesh when >= 2 devices are exposed.
+    sharded_cells, sharded_budget = [], None
+    D = min(len(jax.devices()), args.shards)
+    if D >= 2:
+        sharded_cells, sharded_budget = measure(
+            variants=(8,), n_rows=2_048 * D, n_feat=10, reps=args.reps,
+            learner="data", num_shards=D)
+        for c in sharded_cells:
+            c["shape"] = (f"{2048 * D} x 10, data-parallel over "
+                          f"{D} shards")
+        sharded_budget["num_shards"] = D
+        sharded_budget["matches_serial_fused"] = (
+            sharded_budget["observed_fused_device_calls"] ==
+            sharded_budget["expected_fused_device_calls"])
     out = {
         "metric": "fused_superstep_vs_periter_cpu",
         "unit": "s/iter",
@@ -139,6 +182,9 @@ def main(argv=None):
         "cells": cells,
         "dispatch_bound_cells": tiny,
     }
+    if sharded_cells:
+        out["sharded_cells"] = sharded_cells
+        out["sharded_device_call_budget"] = sharded_budget
     text = json.dumps(out, indent=2)
     if args.stdout:
         print(text)

@@ -70,6 +70,29 @@ def iter_durations(records):
     return out
 
 
+def _superstep_warmups(records):
+    """Yield ``(record, is_warmup)`` for every superstep record — the
+    ONE definition of which fused blocks are compile-bearing.  The
+    scan program compiles once per distinct block size k (the
+    auto-sized tail block is a shorter scan) AND per mesh identity (a
+    sharded run's scan is a different program per learner x shard
+    count — the weak-scale grid runs several in one file), so the
+    FIRST superstep of each (k, learner, shards) is per-shape warmup.
+    Sharded runs get TWO warmup blocks: block 1 consumes the
+    single-device score the unfused bias iteration left behind,
+    block 2 runs on the mesh-replicated carry — same trace, two XLA
+    executables by input sharding, both structural."""
+    seen = {}
+    for r in records:
+        if r.get("type") != "superstep":
+            continue
+        shards = int(r.get("num_shards", 1))
+        key = (int(r.get("k", 1)), r.get("learner", ""), shards)
+        n = seen.get(key, 0)
+        seen[key] = n + 1
+        yield r, n < (2 if shards > 1 else 1)
+
+
 def scan_anomalies(records):
     """Ordered (severity, message) anomaly list for one run."""
     out = []
@@ -77,20 +100,12 @@ def scan_anomalies(records):
     post_warmup = [r for r in iters if r.get("iter", 0) >= WARMUP_ITERS]
     compiles_late = sum((r.get("counters") or {}).get("xla_compiles", 0)
                        for r in post_warmup)
-    # fused super-steps: the scan program compiles once per distinct
-    # block size k (the auto-sized tail block is a shorter scan), so
-    # compiles on the FIRST superstep of each k are per-shape warmup;
-    # compiles on a REPEATED k are a real retrace storm
-    seen_k = set()
+    # compiles on a REPEATED (k, learner, shards) superstep are a real
+    # retrace storm (warmup rule: _superstep_warmups)
     ss_late, ss_secs = 0.0, 0.0
-    for r in records:
-        if r.get("type") != "superstep":
-            continue
-        k = int(r.get("k", 1))
-        first_of_k = k not in seen_k
-        seen_k.add(k)
+    for r, warm in _superstep_warmups(records):
         c = (r.get("counters") or {}).get("xla_compiles", 0)
-        if c and not first_of_k:
+        if c and not warm:
             ss_late += c
             ss_secs += (r.get("counters") or {}).get(
                 "xla_compile_secs", 0.0)
@@ -107,6 +122,42 @@ def scan_anomalies(records):
                             f"compiles ({secs:.1f}s) AFTER iteration "
                             f"{WARMUP_ITERS} — steady state should "
                             f"re-run cached programs"))
+    # weak-scaling regression: sharded super-steps at DIFFERENT mesh
+    # sizes in one run (the weak-scale bench grid, or a resumed run on
+    # a wider mesh) whose per-iteration time grows with the shard
+    # count while per-shard collective bytes stay ~constant — the
+    # dispatch/host-sync overhead signature WEAKSCALE.json measured
+    # through r05, which the single-program sharded scan exists to
+    # kill.  Ignores each mesh identity's compile-bearing warmup
+    # blocks (_superstep_warmups).
+    by_shards = {}
+    for r, warm in _superstep_warmups(records):
+        if warm or "num_shards" not in r:
+            continue
+        d = int(r["num_shards"])
+        k = _block_k(r)
+        ent = by_shards.setdefault(d, {"iter_ms": [], "bytes": []})
+        ent["iter_ms"].append(float(r.get("duration_ms", 0.0)) / k)
+        ent["bytes"].append(float(r.get("collective_bytes", 0.0)) / k)
+    if len(by_shards) >= 2:
+        lo_d, hi_d = min(by_shards), max(by_shards)
+        t_lo = _median(by_shards[lo_d]["iter_ms"])
+        t_hi = _median(by_shards[hi_d]["iter_ms"])
+        b_lo = _median(by_shards[lo_d]["bytes"])
+        b_hi = _median(by_shards[hi_d]["bytes"])
+        bytes_flat = b_lo <= 0 or abs(b_hi - b_lo) <= 0.25 * b_lo
+        if t_lo > 0 and t_hi > 1.5 * t_lo and bytes_flat:
+            out.append(("HIGH", f"weak-scaling regression: "
+                                f"{t_hi / t_lo:.1f}x per-iteration "
+                                f"time from {lo_d} to {hi_d} shards at "
+                                f"~constant per-shard collective bytes "
+                                f"({b_hi / 1e3:.0f} KB/iter) — "
+                                f"per-shard dispatch or host-sync "
+                                f"overhead, not the wire (expect flat "
+                                f"on one real device per shard; a "
+                                f"core-oversubscribed dryrun mesh "
+                                f"timeshares compute and trips this "
+                                f"by design)"))
     # steady-state per-iteration durations: unfused warmup iterations
     # AND the first superstep of each block size are compile-bearing
     # by design — only repeats count toward the spike check.  The two
@@ -114,21 +165,24 @@ def scan_anomalies(records):
     # plus a few legitimate unfused iterations after an eligibility
     # drift) would otherwise read the unfused iterations as spikes
     # against the K-fold-lower fused median.
-    steady_k = set()
-    steady_unfused, steady_fused = [], []
-    for r in records:
-        t = r.get("type")
-        if t == "iteration":
-            if r.get("iter", 0) >= WARMUP_ITERS:
-                steady_unfused.append(float(r.get("duration_ms", 0.0)))
-        elif t == "superstep":
-            k = _block_k(r)
-            if k in steady_k:
-                steady_fused.extend(
-                    [float(r.get("duration_ms", 0.0)) / k] * k)
-            steady_k.add(k)
-    for label, steady in (("iteration", steady_unfused),
-                          ("fused per-iteration", steady_fused)):
+    steady_unfused = [
+        float(r.get("duration_ms", 0.0)) for r in records
+        if r.get("type") == "iteration"
+        and r.get("iter", 0) >= WARMUP_ITERS]
+    steady_fused = {}          # per (learner, shards): different mesh
+    for r, warm in _superstep_warmups(records):  # sizes are different
+        if warm:                                 # cost populations
+            continue
+        k = _block_k(r)
+        mesh = (r.get("learner", ""), int(r.get("num_shards", 1)))
+        steady_fused.setdefault(mesh, []).extend(
+            [float(r.get("duration_ms", 0.0)) / k] * k)
+    pops = [("iteration", steady_unfused)]
+    for (learner, shards), vals in sorted(steady_fused.items()):
+        label = "fused per-iteration" if not learner else \
+            f"fused per-iteration ({learner}x{shards})"
+        pops.append((label, vals))
+    for label, steady in pops:
         if len(steady) <= WARMUP_ITERS:
             continue
         med = _median(steady)
@@ -273,6 +327,19 @@ def triage(records, baseline=None):
         lines.append(f"supersteps  : {len(supersteps)} fused blocks "
                      f"(k={'/'.join(str(k) for k in ks)}), covering "
                      f"{fused_iters} iterations")
+        sharded = [r for r in supersteps if "num_shards" in r]
+        if sharded:
+            meshes = sorted({(r.get("learner", "?"),
+                              int(r["num_shards"])) for r in sharded})
+            cb = sum(float(r.get("collective_bytes", 0.0))
+                     for r in sharded)
+            co = sum(float(r.get("collective_ops", 0.0))
+                     for r in sharded)
+            lines.append(
+                f"  sharded   : "
+                f"{', '.join(f'{l}x{d}' for l, d in meshes)} — "
+                f"{cb / 1e6:.1f} MB / {co:.0f} collective ops inside "
+                f"the fused scans (per-shard estimate)")
     meds = phase_medians(records)
     total = sum(meds.values()) or 1.0
     for name, ms in sorted(meds.items(), key=lambda kv: -kv[1])[:8]:
