@@ -596,6 +596,148 @@ def ckpt_only():
     return 0
 
 
+def continual_only():
+    """Fast path (``python bench.py --continual-only``): measure the
+    continual training daemon's steady-state cost envelope on the CPU
+    backend and write BENCH_continual_cpu.json — per-batch
+    ingest->validate->train->checkpoint wall time for extend vs refit
+    batches, the validation pipeline's overhead, and the watcher's
+    manifest+canary publish latency — the batch-to-publish figure a
+    live deployment plans around (``docs/Continual.md``)."""
+    import datetime
+    import tempfile
+
+    if ensure_backend(variant="continual") is None:
+        return 0
+    import numpy as np
+    from lightgbm_tpu.cont import (Batch, BatchValidator,
+                                   ContinualTrainer)
+    from lightgbm_tpu.serve import (CheckpointWatcher, RegistryTarget,
+                                    ServeConfig, Server)
+    from lightgbm_tpu.serve.config import FleetConfig
+    from lightgbm_tpu.serve.watcher import CanarySet
+    from lightgbm_tpu.utils import telemetry as _telemetry
+    _telemetry.install_jax_hooks()
+
+    n_batches = int(os.environ.get("BENCH_CONTINUAL_BATCHES", "5"))
+    rows = int(os.environ.get("BENCH_CONTINUAL_ROWS", "4000"))
+    n_features = 28
+    rounds = int(os.environ.get("BENCH_CONTINUAL_ROUNDS", "10"))
+
+    def write_stream(ingest):
+        for i in range(n_batches):
+            rng = np.random.RandomState(50 + i)
+            X = rng.randn(rows, n_features).astype(np.float32)
+            w = np.random.RandomState(7).randn(n_features)
+            y = (X @ w + 0.5 * rng.randn(rows)).astype(np.float32)
+            np.savez(os.path.join(ingest, f"batch_{i:03d}.npz"),
+                     X=X, y=y)
+
+    def run_cell(label, extra):
+        with tempfile.TemporaryDirectory() as td:
+            ingest = os.path.join(td, "ingest")
+            root = os.path.join(td, "ck")
+            os.makedirs(ingest)
+            write_stream(ingest)
+            tele = os.path.join(td, "tele.jsonl")
+            p = {"objective": "regression", "num_leaves": 31,
+                 "verbose": -1, "metric": "None",
+                 "checkpoint_dir": root,
+                 "continual_ingest_dir": ingest,
+                 "continual_rounds_per_batch": rounds,
+                 "continual_max_batches": n_batches,
+                 "continual_poll_s": 0.05}
+            p.update(extra)
+            rec = _telemetry.RunRecorder(tele)
+            trainer = ContinualTrainer(p, recorder=rec)
+            stats = trainer.run()
+            rec.close(log=False)
+            assert stats["batches"] == n_batches, stats
+            recs = _telemetry.read_records(tele)
+            by_mode = {}
+            for r in recs:
+                if r.get("type") == "continual" and \
+                        r.get("event") == "batch":
+                    by_mode.setdefault(r.get("mode", "?"), []).append(
+                        float(r["duration_ms"]))
+            # validation overhead: the same gates the daemon ran,
+            # re-timed against the same bytes (check is pure)
+            validator = BatchValidator()
+            v_ms = []
+            pdir = trainer.source.processed_dir
+            for name in sorted(os.listdir(pdir)):
+                with np.load(os.path.join(pdir, name)) as z:
+                    b = Batch(name, (), z["X"], z["y"])
+                    t0 = time.perf_counter()
+                    validator.check(b)
+                    v_ms.append((time.perf_counter() - t0) * 1e3)
+                    validator.observe(b)
+            # publish latency: manifest verify + canary + flatten +
+            # swap of the newest snapshot into a cold server
+            server = Server(config=ServeConfig(warmup=False)).start()
+            try:
+                canary = CanarySet(np.random.RandomState(1)
+                                   .randn(64, n_features))
+                watcher = CheckpointWatcher(
+                    root, RegistryTarget(server),
+                    config=FleetConfig(), canary=canary)
+                t0 = time.perf_counter()
+                watcher.poll_once()
+                publish_ms = (time.perf_counter() - t0) * 1e3
+                assert server.registry.current() is not None
+            finally:
+                server.stop()
+            steady = {m: vals[1:] if len(vals) > 1 else vals
+                      for m, vals in by_mode.items()}
+            mean_ms = {m: sum(v) / max(len(v), 1)
+                       for m, v in steady.items()}
+            primary = "refit" if label == "refit" else "extend"
+            batch_ms = mean_ms.get(primary, 0.0)
+            cell = {
+                "label": label,
+                "batches": stats["batches"],
+                "rows_per_batch": rows,
+                "rounds_per_batch": 0 if label == "refit" else rounds,
+                "batch_ms_mean": round(batch_ms, 2),
+                "batch_ms_by_mode": {m: round(v, 2)
+                                     for m, v in mean_ms.items()},
+                "validate_ms_mean": round(sum(v_ms) /
+                                          max(len(v_ms), 1), 3),
+                "validate_overhead_pct": round(
+                    100.0 * (sum(v_ms) / max(len(v_ms), 1)) /
+                    max(batch_ms, 1e-9), 3),
+                "publish_ms": round(publish_ms, 2),
+                "batch_to_publish_ms": round(batch_ms + publish_ms, 2),
+            }
+        print(json.dumps({"continual_cell": label, **cell}),
+              flush=True)
+        return cell
+
+    cells = [run_cell("extend", {}),
+             run_cell("extend fused_iters=5", {"fused_iters": 5}),
+             run_cell("refit", {"continual_refit_every": 1})]
+    out = {
+        "metric": "continual_batch_to_publish_cpu",
+        "unit": "ms",
+        "backend": "cpu",
+        "date": datetime.date.today().isoformat(),
+        "source": "JAX_PLATFORMS=cpu python bench.py --continual-only",
+        "env": "2-core CPU container",
+        "forest": (f"31-leaf regression forest, {rows} x "
+                   f"{n_features} rows/batch, {rounds} "
+                   f"rounds/extend-batch, {n_batches} batches"),
+        "config": {"batches": n_batches, "rows": rows,
+                   "features": n_features, "rounds": rounds},
+        "cells": cells,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_continual_cpu.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    print(json.dumps({"wrote": os.path.basename(path)}), flush=True)
+    return 0
+
+
 def weakscale_curve(shards=(1, 2, 4, 8), rows_per_shard=2048,
                     n_features=8, num_leaves=15, max_bin=63,
                     fused_iters=8, iters=16, reps=2,
@@ -1375,6 +1517,8 @@ if __name__ == "__main__":
         sys.exit(serve_only())
     if "--ckpt-only" in sys.argv:
         sys.exit(ckpt_only())
+    if "--continual-only" in sys.argv:
+        sys.exit(continual_only())
     if "--weakscale-only" in sys.argv:
         sys.exit(weakscale_only())
     sys.exit(main())

@@ -244,6 +244,40 @@ def _task_serve(params: Dict[str, str], config: Config) -> None:
         server.stop()
 
 
+def _task_continual(params: Dict[str, str], config: Config) -> None:
+    """Continual training daemon (``docs/Continual.md``): tail
+    ``continual_ingest_dir`` for batch shards, gate each through the
+    validation pipeline, extend/refit the model, checkpoint into
+    ``checkpoint_dir`` — which a serve-tier watcher (``task=serve``
+    pointed at the same root) canary-validates and auto-publishes.
+    SIGTERM/SIGINT checkpoint at the next served boundary and drain;
+    restart resumes bit-exactly."""
+    from . import engine as engine_mod
+    from .cont import ContinualTrainer
+    from .utils import telemetry as _telemetry
+
+    if not config.checkpoint_dir:
+        Log.fatal("task=continual requires checkpoint_dir (the "
+                  "checkpoint root doubles as the publish root)")
+    if not config.continual_ingest_dir:
+        Log.fatal("task=continual requires continual_ingest_dir")
+    recorder = None
+    if config.telemetry_file:
+        recorder = _telemetry.RunRecorder(config.telemetry_file)
+    # the guard owns SIGTERM/SIGINT on the MAIN thread and raises the
+    # process-wide preempt flag the worker-thread training loops
+    # observe (engine.request_preempt)
+    guard = engine_mod.install_preempt_guard()
+    trainer = ContinualTrainer(params, recorder=recorder)
+    try:
+        stats = trainer.run()
+    finally:
+        guard.restore()
+        if recorder is not None:
+            recorder.close()
+    Log.info("continual: exit (%s)", stats.get("status", "?"))
+
+
 def _task_refit(params: Dict[str, str], config: Config) -> None:
     from .basic import Booster
     from .io.parser import parse_file
@@ -271,7 +305,8 @@ def main(argv: List[str] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
-        print("tasks: train | predict | convert_model | refit | serve")
+        print("tasks: train | predict | convert_model | refit | serve "
+              "| continual")
         return 0
     params = _parse_args(argv)
     config = Config(params)
@@ -286,6 +321,8 @@ def main(argv: List[str] = None) -> int:
         _task_refit(params, config)
     elif task == "serve":
         _task_serve(params, config)
+    elif task in ("continual", "continual_train"):
+        _task_continual(params, config)
     else:
         Log.fatal("unknown task %r", task)
     return 0

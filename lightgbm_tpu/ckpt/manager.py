@@ -37,6 +37,7 @@ import json
 import os
 import re
 import shutil
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -180,8 +181,14 @@ class CheckpointManager:
         extra_bytes = json.dumps(meta, sort_keys=True).encode("utf-8")
 
         final = os.path.join(self.directory, f"ckpt_{iteration:08d}")
-        staging = os.path.join(self.directory,
-                               f".tmp_ckpt_{iteration:08d}_{os.getpid()}")
+        # staging is per (pid, thread): the continual daemon's stall
+        # watchdog can leave an abandoned attempt racing its retry in
+        # the SAME process at the same boundary — a pid-only name
+        # would let one writer rmtree the other's half-written staging
+        staging = os.path.join(
+            self.directory,
+            f".tmp_ckpt_{iteration:08d}_{os.getpid()}"
+            f"_{threading.get_ident()}")
         if os.path.isdir(staging):
             shutil.rmtree(staging)
         os.makedirs(staging)
@@ -244,6 +251,26 @@ class CheckpointManager:
                     (name.startswith("ckpt_") and name.endswith(".old")):
                 shutil.rmtree(os.path.join(self.directory, name),
                               ignore_errors=True)
+
+    def prune_after(self, iteration: int) -> List[str]:
+        """Delete finalized checkpoints NEWER than ``iteration`` — the
+        continual daemon's exact-rewind primitive: when a batch is
+        quarantined mid-train (non-finite guard, exhausted retries),
+        its in-flight snapshots must leave the lineage, or a restarted
+        daemon would resume from state the surviving batches never
+        produced.  Returns the pruned paths."""
+        pruned = []
+        for iter_, path in self.candidates():
+            if iter_ > int(iteration):
+                shutil.rmtree(path, ignore_errors=True)
+                pruned.append(path)
+                self._emit("prune", iter=iter_,
+                           path=os.path.basename(path))
+        if pruned:
+            Log.info("checkpoint: pruned %d snapshot(s) past iteration "
+                     "%d (quarantined-batch rewind)", len(pruned),
+                     iteration)
+        return pruned
 
     # ------------------------------------------------------------------
     # load

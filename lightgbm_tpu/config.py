@@ -42,7 +42,7 @@ PARAMS: List[Param] = [
     # ---- core ----
     _p("config", "", str, ("config_file",), "path to config file"),
     _p("task", "train", str, ("task_type",),
-       "train, predict, convert_model, refit, serve"),
+       "train, predict, convert_model, refit, serve, continual"),
     _p("objective", "regression", str,
        ("objective_type", "app", "application", "loss"),
        "regression, regression_l1, huber, fair, poisson, quantile, mape, "
@@ -583,6 +583,87 @@ PARAMS: List[Param] = [
        "fingerprint are skipped (reason=holddown) for this long — a "
        "regressing deploy cannot flap back in", group="fleet",
        check=">=0"),
+    # ---- continual (long-running trainer daemon, lightgbm_tpu/cont/) ----
+    _p("continual_ingest_dir", "", str, ("ingest_dir",),
+       "batch source directory of the continual training daemon "
+       "(task=continual, docs/Continual.md): npz shards (arrays X and "
+       "y/label, optional weight/group) or mmap .X.npy/.y.npy pairs, "
+       "consumed in name order.  Each accepted batch runs "
+       "ingest -> validate -> extend/refit -> checkpoint; the "
+       "checkpoint root doubles as the serve tier's watched publish "
+       "root", group="continual"),
+    _p("continual_quarantine_dir", "", str, (),
+       "where rejected batches are MOVED (schema/drift/non-finite "
+       "validation failures, unreadable files, batches that "
+       "repeatedly stall or crash the trainer); '' = "
+       "<continual_ingest_dir>/_quarantine.  Every move emits a "
+       "continual/quarantine telemetry record with the reason",
+       group="continual"),
+    _p("continual_processed_dir", "", str, (),
+       "where consumed batches are moved after their batch-end "
+       "checkpoint is durable; '' = <continual_ingest_dir>/_processed",
+       group="continual"),
+    _p("continual_rounds_per_batch", 10, int, ("rounds_per_batch",),
+       "boosting iterations the daemon trains per accepted batch in "
+       "extend mode (warm-start continue-training from the current "
+       "model)", group="continual", check=">=1"),
+    _p("continual_refit_every", 0, int, (),
+       "every Nth accepted batch is consumed as a REFIT (leaf-value "
+       "recalibration on the fresh batch, decay refit_decay_rate) "
+       "instead of growing trees; the refit snapshot re-saves the "
+       "current boundary and the watcher republishes it on the "
+       "fingerprint change.  0 = always extend", group="continual",
+       check=">=0"),
+    _p("continual_poll_s", 1.0, float, (),
+       "ingest-directory poll cadence when no batch is pending",
+       group="continual", check=">0"),
+    _p("continual_idle_exit_s", 0.0, float, (),
+       "exit the daemon after this long with no new batches (CI/"
+       "drain-and-stop mode); 0 = run until preempted",
+       group="continual", check=">=0"),
+    _p("continual_max_batches", 0, int, (),
+       "stop after consuming this many batches (tests/benchmarks); "
+       "0 = unbounded", group="continual", check=">=0"),
+    _p("continual_stall_timeout_s", 120.0, float, (),
+       "watchdog: a train step that goes this long without a "
+       "heartbeat (one per boosting iteration) is declared stalled — "
+       "the attempt is abandoned and the batch retries from the last "
+       "snapshot (continual/stall_restart telemetry).  0 disables",
+       group="continual", check=">=0"),
+    _p("continual_max_batch_retries", 2, int, (),
+       "stall/crash retries per batch before it is quarantined "
+       "(reason stall|error) and its in-flight checkpoints pruned",
+       group="continual", check=">=0"),
+    _p("continual_read_retries", 3, int, (),
+       "bounded retries for TRANSIENT batch-read failures (OSError) "
+       "before the file is quarantined (reason read)",
+       group="continual", check=">=0"),
+    _p("continual_backoff_base_s", 0.1, float, (),
+       "exponential-backoff base between ingest read retries "
+       "(attempt n sleeps base * 2^(n-1), capped)", group="continual",
+       check=">=0"),
+    _p("continual_backoff_max_s", 5.0, float, (),
+       "ingest read-retry backoff cap", group="continual", check=">=0"),
+    _p("continual_drift_sigma", 8.0, float, (),
+       "label-distribution drift gate: a batch whose label mean is "
+       "more than this many reference standard deviations from the "
+       "running reference (accepted batches so far) is quarantined; "
+       "0 disables", group="continual", check=">=0"),
+    _p("continual_range_factor", 10.0, float, (),
+       "feature-range drift gate: batch values outside the reference "
+       "min/max inflated by this factor of the per-feature span are "
+       "quarantined; 0 disables", group="continual", check=">=0"),
+    _p("continual_nonfinite_check", True, bool, (),
+       "ingest-side non-finite scan (NaN/inf in X or labels fails "
+       "validation).  Disabling it leaves the in-training numerical-"
+       "health guard (utils/health.py) as the only defense — the "
+       "guard rewinds exactly and quarantines the batch, but only "
+       "after paying for the doomed dispatch", group="continual"),
+    _p("continual_snapshot_freq", 0, int, (),
+       "in-batch periodic checkpoint cadence (iterations) while the "
+       "daemon trains a batch; 0 = checkpoint only at batch "
+       "boundaries (the default keeps the exact quarantine rewind "
+       "within keep_last_n retention)", group="continual", check=">=0"),
 ]
 
 _PARAM_BY_NAME: Dict[str, Param] = {p.name: p for p in PARAMS}

@@ -1,0 +1,20 @@
+"""Continual training daemon (``task=continual``): a preemption-safe,
+self-healing ingest -> validate -> train -> checkpoint -> publish loop.
+
+See ``docs/Continual.md`` for the architecture; the pieces:
+
+- :class:`~.source.DirectoryBatchSource` — tails a directory of
+  npz/mmap batch shards with bounded-backoff retries and quarantine.
+- :class:`~.validate.BatchValidator` — schema/dtype/shape, non-finite
+  scan, label-distribution and feature-range drift gates.
+- :class:`~.trainer.ContinualTrainer` — the daemon: warm-start extend
+  or leaf refit per batch, PR 5 checkpoints, stall watchdog,
+  numerical-health rewind, preemption drain.
+"""
+from .config import ContinualConfig
+from .source import Batch, BatchSource, DirectoryBatchSource
+from .trainer import ContinualTrainer
+from .validate import BatchValidator
+
+__all__ = ["Batch", "BatchSource", "BatchValidator", "ContinualConfig",
+           "ContinualTrainer", "DirectoryBatchSource"]

@@ -1,0 +1,97 @@
+"""Typed continual-training configuration.
+
+Canonical parameter definitions (names, defaults, aliases, docs) live
+in the single-source registry — ``lightgbm_tpu/config.py``, group
+``continual`` — so ``docs/Parameters.md`` and CLI alias resolution
+cover them like every other knob.  This dataclass is the resolved
+subset the daemon passes around; build it with
+:meth:`ContinualConfig.from_params` from a raw params dict, a resolved
+:class:`~lightgbm_tpu.config.Config`, or nothing (defaults).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Union
+
+
+@dataclasses.dataclass
+class ContinualConfig:
+    # batch source: directory of npz shards / mmap .npy pairs,
+    # consumed in name order
+    ingest_dir: str = ""
+    quarantine_dir: str = ""      # '' -> <ingest_dir>/_quarantine
+    processed_dir: str = ""       # '' -> <ingest_dir>/_processed
+    # per-batch training
+    rounds_per_batch: int = 10
+    refit_every: int = 0          # every Nth batch refits; 0 = never
+    # loop pacing / termination
+    poll_s: float = 1.0
+    idle_exit_s: float = 0.0      # 0 = run until preempted
+    max_batches: int = 0          # 0 = unbounded
+    # robustness
+    stall_timeout_s: float = 120.0
+    max_batch_retries: int = 2
+    read_retries: int = 3
+    backoff_base_s: float = 0.1
+    backoff_max_s: float = 5.0
+    # validation gates
+    drift_sigma: float = 8.0      # 0 disables the label-drift gate
+    range_factor: float = 10.0    # 0 disables the feature-range gate
+    nonfinite_check: bool = True
+    # in-batch periodic checkpoint cadence; 0 = batch boundaries only
+    snapshot_freq: int = 0
+
+    @classmethod
+    def from_params(cls, params: Union[None, Dict[str, Any], Any] = None
+                    ) -> "ContinualConfig":
+        from ..config import Config
+        if params is None:
+            cfg = Config()
+        elif isinstance(params, Config):
+            cfg = params
+        else:
+            cfg = Config(dict(params))
+        return cls(
+            ingest_dir=str(cfg.continual_ingest_dir or ""),
+            quarantine_dir=str(cfg.continual_quarantine_dir or ""),
+            processed_dir=str(cfg.continual_processed_dir or ""),
+            rounds_per_batch=int(cfg.continual_rounds_per_batch),
+            refit_every=int(cfg.continual_refit_every),
+            poll_s=float(cfg.continual_poll_s),
+            idle_exit_s=float(cfg.continual_idle_exit_s),
+            max_batches=int(cfg.continual_max_batches),
+            stall_timeout_s=float(cfg.continual_stall_timeout_s),
+            max_batch_retries=int(cfg.continual_max_batch_retries),
+            read_retries=int(cfg.continual_read_retries),
+            backoff_base_s=float(cfg.continual_backoff_base_s),
+            backoff_max_s=float(cfg.continual_backoff_max_s),
+            drift_sigma=float(cfg.continual_drift_sigma),
+            range_factor=float(cfg.continual_range_factor),
+            nonfinite_check=bool(cfg.continual_nonfinite_check),
+            snapshot_freq=int(cfg.continual_snapshot_freq))
+
+    def resolved_quarantine_dir(self) -> str:
+        return self.quarantine_dir or \
+            os.path.join(self.ingest_dir, "_quarantine")
+
+    def resolved_processed_dir(self) -> str:
+        return self.processed_dir or \
+            os.path.join(self.ingest_dir, "_processed")
+
+    def validate(self) -> None:
+        if not self.ingest_dir:
+            raise ValueError("continual_ingest_dir must be set")
+        if self.rounds_per_batch < 1:
+            raise ValueError("continual_rounds_per_batch must be >= 1")
+        if self.poll_s <= 0:
+            raise ValueError("continual_poll_s must be > 0")
+        if self.backoff_max_s < self.backoff_base_s:
+            raise ValueError("continual backoff must satisfy "
+                             "base <= max")
+        for name in ("idle_exit_s", "max_batches", "stall_timeout_s",
+                     "max_batch_retries", "read_retries",
+                     "backoff_base_s", "drift_sigma", "range_factor",
+                     "refit_every", "snapshot_freq"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"continual_{name} must be >= 0")

@@ -1,0 +1,210 @@
+"""Batch validation gates for the continual training daemon.
+
+Every batch passes through this pipeline BEFORE it can touch the
+model; a daemon that trains for days lives on the principle that bad
+input is quarantined at the door, not discovered as a NaN model at
+serve time.  Gates, in order:
+
+1. **schema/dtype/shape** — X is a non-empty 2-D numeric matrix, y a
+   matching 1-D numeric vector, optional weight/group consistent
+   (group sums to the row count), and the feature width matches the
+   reference established by previously-accepted batches.
+2. **non-finite scan** — NaN/inf anywhere in X or y fails the batch
+   (``continual_nonfinite_check``; the in-training numerical-health
+   guard, ``utils/health.py``, remains the backstop when this gate is
+   disabled or the corruption happens downstream of it).
+3. **label-distribution drift** — the batch's label mean must lie
+   within ``continual_drift_sigma`` reference standard deviations of
+   the running reference (Welford over all accepted rows); a feed that
+   silently flips its label convention fails here, not in production.
+4. **feature-range drift** — batch values outside the reference
+   per-feature min/max inflated by ``continual_range_factor`` x span
+   fail (a unit change — meters to millimeters — is drift, not noise).
+
+``check`` returns the problem list (empty = accept); ``observe``
+folds an ACCEPTED batch into the running reference.  The reference
+state round-trips through ``state()``/``restore_state()`` so a daemon
+restart keeps its drift baseline (the ledger carries it).
+
+Fault-injection point: ``ingest.validate`` (mode ``reject``).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils import faults as _faults
+
+__all__ = ["BatchValidator"]
+
+
+class BatchValidator:
+    """Stateful validation pipeline with a running drift reference."""
+
+    def __init__(self, drift_sigma: float = 8.0,
+                 range_factor: float = 10.0,
+                 nonfinite_check: bool = True,
+                 expected_features: Optional[int] = None):
+        self.drift_sigma = float(drift_sigma)
+        self.range_factor = float(range_factor)
+        self.nonfinite_check = bool(nonfinite_check)
+        self.expected_features = expected_features
+        # running reference over accepted batches (Welford on labels)
+        self._n = 0
+        self._label_mean = 0.0
+        self._label_m2 = 0.0
+        self._feat_min: Optional[np.ndarray] = None
+        self._feat_max: Optional[np.ndarray] = None
+
+    # ------------------------------------------------------------------
+    def check(self, batch) -> List[str]:
+        """Problems with one batch (empty = accept)."""
+        errs: List[str] = []
+        if _faults.fire("ingest.validate") == "reject":
+            errs.append("injected fault (ingest.validate:reject)")
+        X = np.asarray(batch.X)
+        y = np.asarray(batch.y)
+        if X.ndim != 2 or X.shape[0] == 0 or X.shape[1] == 0:
+            errs.append(f"X must be a non-empty 2-D matrix, got shape "
+                        f"{X.shape}")
+            return errs               # everything below needs rows
+        if not (np.issubdtype(X.dtype, np.floating) or
+                np.issubdtype(X.dtype, np.integer)):
+            errs.append(f"X dtype {X.dtype} is not numeric")
+            return errs
+        if y.ndim != 1 or y.shape[0] != X.shape[0]:
+            errs.append(f"y shape {y.shape} does not match "
+                        f"{X.shape[0]} rows")
+            return errs
+        if not (np.issubdtype(y.dtype, np.floating) or
+                np.issubdtype(y.dtype, np.integer)):
+            errs.append(f"y dtype {y.dtype} is not numeric")
+            return errs
+        w = getattr(batch, "weight", None)
+        if w is not None:
+            w = np.asarray(w)
+            if w.ravel().shape[0] != X.shape[0]:
+                errs.append(f"weight length {w.ravel().shape[0]} != "
+                            f"{X.shape[0]} rows")
+        g = getattr(batch, "group", None)
+        if g is not None:
+            g = np.asarray(g).ravel()
+            if not np.issubdtype(g.dtype, np.integer) and \
+                    not np.all(g == np.floor(g)):
+                errs.append("group contains non-integer counts")
+            elif int(g.sum()) != X.shape[0]:
+                errs.append(f"group counts sum to {int(g.sum())}, "
+                            f"batch has {X.shape[0]} rows")
+        n_feat = X.shape[1]
+        ref_feat = self._feat_min.shape[0] \
+            if self._feat_min is not None else self.expected_features
+        if ref_feat is not None and n_feat != int(ref_feat):
+            errs.append(f"feature width {n_feat} != reference "
+                        f"{int(ref_feat)}")
+            return errs
+        if self.nonfinite_check:
+            # scan in the NATIVE dtype: integer arrays are always
+            # finite, and isfinite on float32 avoids materializing a
+            # float64 copy of an mmap shard just to look at it
+            bad_x = 0 if np.issubdtype(X.dtype, np.integer) else \
+                int((~np.isfinite(X)).sum())
+            bad_y = 0 if np.issubdtype(y.dtype, np.integer) else \
+                int((~np.isfinite(y)).sum())
+            if bad_x or bad_y:
+                errs.append(f"non-finite values: {bad_x} in X, "
+                            f"{bad_y} in labels")
+                return errs           # drift stats on NaN are noise
+        if self._n > 0:
+            errs.extend(self._check_drift(X, y))
+        return errs
+
+    def _check_drift(self, X: np.ndarray, y: np.ndarray) -> List[str]:
+        errs: List[str] = []
+        if self.drift_sigma > 0 and self._n > 1:
+            ref_std = float(np.sqrt(self._label_m2 / (self._n - 1)))
+            # a degenerate (constant-label) reference can't scale a
+            # z-test; fall back to the label magnitude as the unit
+            scale = max(ref_std, 1e-3 * max(abs(self._label_mean), 1.0))
+            mean = float(np.mean(y, dtype=np.float64))
+            z = abs(mean - self._label_mean) / scale
+            if z > self.drift_sigma:
+                errs.append(
+                    f"label drift: batch mean {mean:.4g} is "
+                    f"{z:.1f} sigma from the reference mean "
+                    f"{self._label_mean:.4g} (bound "
+                    f"{self.drift_sigma:g})")
+        if self.range_factor > 0 and self._feat_min is not None:
+            span = np.maximum(self._feat_max - self._feat_min, 1e-12)
+            lo = self._feat_min - self.range_factor * span
+            hi = self._feat_max + self.range_factor * span
+            # comparisons against the f64 bounds upcast per ufunc
+            # buffer — no full float64 copy of the batch
+            viol = (X < lo) | (X > hi)
+            if self.nonfinite_check is False and \
+                    not np.issubdtype(X.dtype, np.integer):
+                viol &= np.isfinite(X)
+            n_viol = int(viol.sum())
+            if n_viol:
+                worst = int(np.argmax(viol.sum(axis=0)))
+                errs.append(
+                    f"feature range drift: {n_viol} value(s) outside "
+                    f"the reference range x{self.range_factor:g} "
+                    f"(worst feature {worst})")
+        return errs
+
+    # ------------------------------------------------------------------
+    def observe(self, batch) -> None:
+        """Fold an ACCEPTED batch into the running reference.
+        Reductions run in the batch's native dtype with float64
+        ACCUMULATORS — no float64 copy of a (possibly mmap) shard."""
+        X = np.asarray(batch.X)
+        y = np.asarray(batch.y).ravel()
+        # chunk-merged Welford (Chan et al.): exact pooled mean/M2
+        # without keeping per-row history
+        n_new = y.shape[0]
+        mean_new = float(np.mean(y, dtype=np.float64))
+        var_new = float(np.var(y, dtype=np.float64)) * n_new
+        if self._n == 0:
+            self._label_mean = mean_new
+            self._label_m2 = var_new
+        else:
+            delta = mean_new - self._label_mean
+            tot = self._n + n_new
+            self._label_mean += delta * n_new / tot
+            self._label_m2 += var_new + \
+                delta * delta * self._n * n_new / tot
+        self._n += n_new
+        fmin = np.min(X, axis=0).astype(np.float64)
+        fmax = np.max(X, axis=0).astype(np.float64)
+        if self._feat_min is None:
+            self._feat_min, self._feat_max = fmin, fmax
+        else:
+            self._feat_min = np.minimum(self._feat_min, fmin)
+            self._feat_max = np.maximum(self._feat_max, fmax)
+
+    # ------------------------------------------------------------------
+    def state(self) -> Dict[str, Any]:
+        """JSON-able reference state (the daemon ledger carries it)."""
+        return {
+            "n": int(self._n),
+            "label_mean": float(self._label_mean),
+            "label_m2": float(self._label_m2),
+            "feat_min": None if self._feat_min is None else
+            [float(v) for v in self._feat_min],
+            "feat_max": None if self._feat_max is None else
+            [float(v) for v in self._feat_max],
+        }
+
+    def restore_state(self, state: Optional[Dict[str, Any]]) -> None:
+        if not state:
+            return
+        self._n = int(state.get("n", 0))
+        self._label_mean = float(state.get("label_mean", 0.0))
+        self._label_m2 = float(state.get("label_m2", 0.0))
+        fmin = state.get("feat_min")
+        fmax = state.get("feat_max")
+        self._feat_min = None if fmin is None else \
+            np.asarray(fmin, np.float64)
+        self._feat_max = None if fmax is None else \
+            np.asarray(fmax, np.float64)

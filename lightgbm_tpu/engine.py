@@ -18,7 +18,44 @@ from .basic import Booster, Dataset
 from .callback import CallbackEnv, EarlyStopException
 from .utils.log import Log
 
-__all__ = ["train", "cv", "CVBooster"]
+__all__ = ["train", "cv", "CVBooster", "request_preempt",
+           "preempt_requested", "clear_preempt", "install_preempt_guard"]
+
+
+# ----------------------------------------------------------------------
+# process-wide preemption flag
+# ----------------------------------------------------------------------
+# Signal handlers are main-thread-only, but the continual daemon
+# (lightgbm_tpu/cont/) trains on worker threads: whichever guard DID
+# install handlers (the CLI entry point, a test via request_preempt)
+# raises this shared flag, and every training loop — whatever thread it
+# runs on — observes it at the next served iteration boundary and
+# checkpoints-and-drains.
+_PREEMPT_LOCK = threading.Lock()
+_PREEMPT_SIGNUM: Optional[int] = None
+
+
+def request_preempt(signum: int = signal.SIGTERM) -> None:
+    """Raise the process-wide preemption flag (thread-safe): every
+    in-flight ``train`` loop with a checkpoint manager saves a
+    ``reason=preempt`` snapshot at its next iteration boundary and
+    stops, exactly as if the process had received SIGTERM."""
+    global _PREEMPT_SIGNUM
+    with _PREEMPT_LOCK:
+        if _PREEMPT_SIGNUM is None:
+            _PREEMPT_SIGNUM = int(signum)
+
+
+def preempt_requested() -> Optional[int]:
+    """The pending preemption signal number, or None."""
+    with _PREEMPT_LOCK:
+        return _PREEMPT_SIGNUM
+
+
+def clear_preempt() -> None:
+    global _PREEMPT_SIGNUM
+    with _PREEMPT_LOCK:
+        _PREEMPT_SIGNUM = None
 
 
 class _PreemptGuard:
@@ -29,7 +66,11 @@ class _PreemptGuard:
     checkpoint (``reason=preempt``) and stops.  A second signal
     restores the original handlers and re-raises, so a stuck save can
     still be force-killed.  Signal handlers are process-global state:
-    the guard installs only on the main thread and always restores."""
+    the guard installs only on the main thread and always restores.
+    The flag itself is shared process-wide (``request_preempt``), so a
+    training loop running on a WORKER thread — the continual daemon's
+    normal mode — still drains when the main thread's guard catches
+    the signal."""
 
     def __init__(self):
         self.signum: Optional[int] = None
@@ -51,8 +92,14 @@ class _PreemptGuard:
             signal.raise_signal(signum)
             return
         self.signum = signum
+        request_preempt(signum)
         Log.warning("received signal %d: checkpointing at the next "
                     "iteration boundary, then stopping", signum)
+
+    def pending(self) -> Optional[int]:
+        """This guard's caught signal, or the process-wide flag."""
+        return self.signum if self.signum is not None \
+            else preempt_requested()
 
     def restore(self) -> None:
         for sig, handler in self._orig.items():
@@ -61,6 +108,21 @@ class _PreemptGuard:
             except (ValueError, OSError):  # pragma: no cover
                 pass
         self._orig = {}
+        if self.signum is not None:
+            # this guard's own catch raised the shared flag; clearing
+            # it on restore keeps a LATER train() in the same process
+            # (the signal was handled, work continued) from stopping
+            # on a stale preempt
+            clear_preempt()
+            self.signum = None
+
+
+def install_preempt_guard() -> _PreemptGuard:
+    """Install SIGTERM/SIGINT handlers feeding the shared preemption
+    flag (main thread only; a no-op guard elsewhere).  The continual
+    daemon's CLI entry point owns one for the whole loop; callers must
+    ``restore()`` it."""
+    return _PreemptGuard().install()
 
 
 def _replay_eval_history(eval_history, cbs_after, booster, params,
@@ -239,6 +301,15 @@ def train(params: Dict[str, Any], train_set: Dataset,
         if _replay_eval_history(eval_history, cbs_after, booster,
                                 params, num_boost_round):
             return booster
+    # tell the booster its TRUE iteration horizon: the fused
+    # super-step auto-sizes its tail block from config.num_iterations,
+    # and engine.train popped the round aliases from params above — a
+    # continue-training booster (init_model, the continual daemon's
+    # per-batch form) otherwise keeps the registry default and
+    # dispatches whole blocks past the boundary (wasted device work)
+    booster._gbdt.config.num_iterations = num_boost_round \
+        if (loaded_ckpt is not None or init_model is None) \
+        else booster._gbdt.iter + num_boost_round
     guard = _PreemptGuard()
     if ckpt_mgr is not None:
         guard.install()
@@ -295,7 +366,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
                 break
             if ckpt_mgr is not None:
-                if guard.signum is not None:
+                if guard.pending() is not None:
                     _save_ckpt("preempt")
                     break
                 if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0 \
@@ -305,7 +376,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 break
         if ckpt_mgr is not None and \
                 booster._gbdt.completed_iterations() != saved_at:
-            _save_ckpt("preempt" if guard.signum is not None
+            _save_ckpt("preempt" if guard.pending() is not None
                        else "final")
     finally:
         # handlers are process-global: restore them even when an

@@ -434,6 +434,14 @@ class GBDT:
         self._tele_counters_last: Dict[str, float] = {}
         if getattr(config, "telemetry_file", ""):
             self.attach_telemetry(config.telemetry_file)
+        else:
+            # a process-default recorder (set by the continual daemon /
+            # CLI via telemetry.set_recorder) adopts every booster it
+            # outlives: one JSONL stream for a whole ingest->train->
+            # publish loop instead of one file handle per batch
+            from ..utils import telemetry as _tele_mod
+            if _tele_mod.get_recorder() is not None:
+                self.attach_telemetry(_tele_mod.get_recorder())
 
     # ------------------------------------------------------------------
     def _constraint_tuples(self, config: Config, train_set: TpuDataset,
@@ -962,6 +970,17 @@ class GBDT:
                 new_sc = sc.at[0].add(upd)
                 host_rec = {k: v for k, v in rec.items()
                             if k not in drop}
+                # numerical-health flag: non-finite gradients, leaf
+                # values or scores ride the existing packed block
+                # fetch (zero extra device calls).  Gradients must be
+                # checked too — NaN gradients kill every split gain
+                # and masquerade as a legitimate "no splittable leaf"
+                # stop, which would end training silently instead of
+                # loudly (utils/health.py)
+                host_rec["nonfinite"] = jnp.logical_not(
+                    jnp.all(jnp.isfinite(grad[0])) &
+                    jnp.all(jnp.isfinite(vals)) &
+                    jnp.all(jnp.isfinite(new_sc)))
                 new_bag = bag if bag is not None else bag_prev
                 return (new_sc, new_bag), \
                     (host_rec, li.astype(li_dt), vals)
@@ -1062,6 +1081,25 @@ class GBDT:
             # the block's ONE device->host transfer (packed f32)
             _telemetry.counters.incr("superstep_fetches")
             host = self._fetch_records(recs)
+        bad = np.asarray(host.pop("nonfinite", np.zeros(K)), bool)
+        if np.any(bad):
+            # the per-iteration health flag tripped: rewind to the
+            # served boundary (nothing from this block was served or
+            # applied to the score — only the dispatch bookkeeping
+            # moved) and fail loudly instead of serving a NaN model.
+            # A finite stop tree BEFORE the first bad iteration wins:
+            # post-stop scan iterations are phantom state the replay
+            # discards anyway.
+            j = int(np.argmax(bad))
+            stops = np.nonzero(np.asarray(host["n_leaves"])[:K] <= 1)[0]
+            if stops.size == 0 or j <= int(stops[0]):
+                self._trees_dispatched = start_tid
+                self._rng_feature.set_state(rng_state)
+                from ..utils.health import abort_nonfinite
+                abort_nonfinite(getattr(self, "_telemetry", None),
+                                i0 + j, "superstep",
+                                f"fused block of {K} starting at "
+                                f"iteration {i0}")
         with timed("superstep/to_tree"):
             n_leaves_k = host["n_leaves"]
             trees, stop_idx = [], None
@@ -1296,6 +1334,25 @@ class GBDT:
             self.last_arm_passes = int(recs["n_arm_passes"])
         n_leaves = int(recs["n_leaves"])
         if n_leaves <= 1:
+            # non-finite gradients produce NaN gains everywhere and
+            # masquerade as this legitimate stop (the unsplit tree's
+            # returned record is all finite zeros, so the record
+            # cannot tell the two apart).  Probe the gradients the
+            # stop tree was dispatched with, plus the score — scalar
+            # fetches on the at-most-once stop path only
+            # (utils/health.py)
+            import jax.numpy as jnp
+            gh = pending.get("gh")
+            ok = bool(jnp.all(jnp.isfinite(self._score)))
+            if ok and gh is not None:
+                ok = bool(jnp.all(jnp.isfinite(gh[0])) &
+                          jnp.all(jnp.isfinite(gh[1])))
+            if not ok:
+                from ..utils.health import abort_nonfinite
+                abort_nonfinite(getattr(self, "_telemetry", None),
+                                max(self.iter - 1, 0), "pipelined",
+                                "non-finite gradients/score at the "
+                                "stop boundary")
             tree = Tree(2)
             tree.leaf_value[0] = pending["init_score"]
             if abs(pending["init_score"]) > _KEPS:
@@ -1304,6 +1361,7 @@ class GBDT:
             self._models.append(tree)
             return True
         tree = self._records_to_tree(recs)
+        self._check_tree_health(tree, max(self.iter - 1, 0), "pipelined")
         tree.apply_shrinkage(pending["lr"])
         if abs(pending["init_score"]) > _KEPS:
             tree.add_bias(pending["init_score"])
@@ -1314,6 +1372,37 @@ class GBDT:
         if self._pending is not None:
             if self._materialize_pending():
                 self._stop_flag = True
+
+    # ---- numerical health (utils/health.py) --------------------------
+    def _check_tree_health(self, tree, iteration: int,
+                           phase: str) -> None:
+        """Scan a just-materialized tree's leaf values (already
+        host-side — zero extra device calls) for non-finite outputs;
+        fail loudly instead of training on to a silent NaN model."""
+        vals = tree.leaf_value[:max(tree.num_leaves, 1)]
+        if not np.all(np.isfinite(vals)):
+            from ..utils.health import abort_nonfinite
+            n_bad = int((~np.isfinite(np.asarray(vals))).sum())
+            abort_nonfinite(getattr(self, "_telemetry", None),
+                            iteration, phase,
+                            f"{n_bad} non-finite leaf value(s)")
+
+    def _check_stop_health(self, grad, hess, iteration: int,
+                           phase: str) -> None:
+        """Non-finite gradients make every split gain NaN and
+        masquerade as a legitimate "no splittable leaf" stop.  A stop
+        happens at most once per training, so one scalar device fetch
+        here costs nothing at steady state."""
+        import jax.numpy as jnp
+        ok = bool(jnp.all(jnp.isfinite(grad)) &
+                  jnp.all(jnp.isfinite(hess)))
+        if not ok:
+            from ..utils.health import abort_nonfinite
+            abort_nonfinite(getattr(self, "_telemetry", None),
+                            iteration, phase,
+                            "non-finite gradients at the stop "
+                            "boundary (bad labels/scores, not an "
+                            "exhausted tree)")
 
     def _train_one_iter_pipelined(self) -> bool:
         """Pipelined iteration: device work for tree t is dispatched
@@ -1366,9 +1455,15 @@ class GBDT:
             with timed("tree/fetch"):
                 prev_stop = self._materialize_pending()
         self._pending = {"rec": rec, "init_score": init_score,
-                         "lr": self.shrinkage_rate}
+                         "lr": self.shrinkage_rate,
+                         # kept for the stop-path health probe: a
+                         # no-split stop must be distinguishable from
+                         # NaN gradients killing every gain
+                         "gh": (grad[0], hess[0])}
         self.iter += 1
         if prev_stop:
+            self._check_stop_health(grad, hess, max(self.iter - 2, 0),
+                                    "pipelined")
             self._stop_flag = True
             self._flush_pending()
             Log.warning("Stopped training because there are no more "
@@ -1552,6 +1647,7 @@ class GBDT:
                 should_stop = False
             self.models.append(tree)
         if should_stop:
+            self._check_stop_health(grad, hess, self.iter, "tree")
             Log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")
             return True
@@ -1597,6 +1693,7 @@ class GBDT:
 
         with timed("tree/to_tree"):
             tree = self._records_to_tree(recs)
+        self._check_tree_health(tree, self.iter, "tree")
         if self._track_train_leaf:
             # compact dtype ON DEVICE: leaf ids fit uint8/16 and the
             # device->host link is slow, so never ship int32

@@ -247,6 +247,15 @@ class CheckpointWatcher:
         self.recorder = recorder
         self.mgr = CheckpointManager(self.root)
         self._last_iter = -1
+        # fingerprint of the last same-boundary RE-SAVE examined: the
+        # continual daemon's refit batches recalibrate leaf values
+        # without advancing the iteration, re-saving the newest
+        # ckpt_* in place — the content change, not a new name, is
+        # the publish trigger (and a canary-failing re-save must not
+        # be retried every poll).  The stat gate keeps quiescent polls
+        # from re-reading the model text every tick.
+        self._resave_seen: Optional[str] = None
+        self._resave_stat: Optional[Tuple[str, int, int]] = None
         self._holddown: Dict[str, float] = {}  # model_id -> until (mono)
         self._baseline: Optional[Tuple[str, str]] = None
         self._watchdog: Optional[Dict[str, Any]] = None
@@ -299,6 +308,7 @@ class CheckpointWatcher:
                 self._baseline = self.target.active_model()
             except Exception:              # noqa: BLE001
                 pass
+        fresh = False
         for iter_, path in self.mgr.candidates():
             if iter_ <= self._last_iter:
                 continue
@@ -308,6 +318,48 @@ class CheckpointWatcher:
             if self._watchdog is not None:
                 break
             self._process(iter_, path, now)
+            fresh = True
+        if self._watchdog is None and not fresh:
+            self._check_resave(now)
+
+    def _check_resave(self, now: float) -> None:
+        """Re-examine the NEWEST already-seen checkpoint: a continual
+        refit re-saves the current boundary with new leaf values under
+        the same ``ckpt_*`` name, so the fingerprint change is what
+        must go through the manifest+canary gate.  Each distinct
+        re-save content is examined once (a canary-failing refit is
+        not retried every poll)."""
+        cands = self.mgr.candidates()
+        if not cands or cands[-1][0] != self._last_iter:
+            return
+        iter_, path = cands[-1]
+        mpath = os.path.join(path, "model.txt")
+        try:
+            st = os.stat(mpath)
+            stat_key = (path, st.st_mtime_ns, st.st_size)
+        except OSError:
+            return
+        if stat_key == self._resave_stat:
+            # unchanged since the last idle poll: don't re-read and
+            # re-hash a potentially large model text every watch tick
+            return
+        try:
+            with open(mpath) as f:
+                mid = model_fingerprint(f.read())
+        except OSError:
+            return     # racing a re-save swap: re-stat next poll
+        self._resave_stat = stat_key
+        if mid == self._resave_seen:
+            return
+        active = None
+        try:
+            active = self.target.active_model()
+        except Exception:                  # noqa: BLE001
+            pass
+        self._resave_seen = mid
+        if active is not None and active[0] == mid:
+            return
+        self._process(iter_, path, now)
 
     def _process(self, iter_: int, path: str, now: float) -> None:
         self._last_iter = iter_            # a bad snapshot is not retried
@@ -330,6 +382,7 @@ class CheckpointWatcher:
                        iter=iter_, error=f"model.txt unreadable: {exc}")
             return
         mid = model_fingerprint(model_text)
+        self._resave_seen = mid        # _check_resave examines once
         until = self._holddown.get(mid, 0.0)
         if until > now:
             Log.warning("watcher: SKIP %s — model %s is in rollback "

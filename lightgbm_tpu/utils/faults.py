@@ -32,7 +32,32 @@ point                  modes its call site interprets
                        response (client-visible transport failure)
 ``fleet.spawn``        ``fail`` — the replica spawn raises (exercises
                        restart backoff and the circuit breaker)
+``ingest.read``        continual daemon batch read
+                       (``cont/source.py``): ``error`` — the read
+                       raises a TRANSIENT OSError (bounded exponential
+                       backoff + retry); ``corrupt`` — the read raises
+                       a non-transient parse error (the batch is
+                       quarantined, reason ``read``)
+``ingest.validate``    ``reject`` — the batch validation gate
+                       (``cont/validate.py``) reports an injected
+                       failure; the batch is quarantined
+                       (reason ``validate``)
+``trainer.step``       fired once per boosting iteration inside a
+                       continual batch (``cont/trainer.py``):
+                       ``error`` — the step raises (retry from the
+                       last snapshot, then quarantine); ``hang`` — the
+                       step blocks until abandoned (drives the stall
+                       watchdog); ``sleep_<ms>`` — adds latency to the
+                       step
+``trainer.refit``      ``error`` — the continual refit pass raises
+                       (retry from the last snapshot, then quarantine)
 =====================  =================================================
+
+A spec naming a point outside this table arms nothing — a typo'd
+chaos spec would silently inject NOTHING — so the registry warns
+(``Log`` + the ``faults_unknown_point`` telemetry counter + a
+``continual`` record when a recorder is live) the first time each
+unknown point is configured, armed or read from ``LTPU_FAULTS``.
 
 Spec syntax (``LTPU_FAULTS`` env var or :func:`configure`), comma
 separated::
@@ -64,9 +89,17 @@ import os
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
-__all__ = ["InjectedFault", "FaultSpec", "configure", "arm", "clear",
-           "reset", "fire", "hits", "snapshot", "parse_specs",
-           "active_spec"]
+__all__ = ["InjectedFault", "FaultSpec", "KNOWN_POINTS", "configure",
+           "arm", "clear", "reset", "fire", "hits", "snapshot",
+           "parse_specs", "active_spec"]
+
+# the registered injection points (the table above).  The registry
+# itself stays point-agnostic — this set only powers the typo warning.
+KNOWN_POINTS = frozenset({
+    "ckpt.save", "watcher.validate", "watcher.canary", "serve.dispatch",
+    "http.request", "fleet.spawn", "ingest.read", "ingest.validate",
+    "trainer.step", "trainer.refit",
+})
 
 
 class InjectedFault(BaseException):
@@ -134,6 +167,31 @@ class FaultRegistry:
         self._env_cache: Tuple[str, List[FaultSpec]] = ("", [])
         self._legacy_cache: Tuple[Tuple[str, str], List[FaultSpec]] = \
             (("", ""), [])
+        self._warned_points: set = set()
+
+    def _warn_unknown(self, specs: List[FaultSpec],
+                      source: str) -> None:
+        """Log + telemetry for specs naming an unregistered point — a
+        typo'd point arms NOTHING, which a chaos job must not discover
+        by its scenario silently passing.  Once per point."""
+        for spec in specs:
+            if spec.point in KNOWN_POINTS:
+                continue
+            with self._lock:
+                if spec.point in self._warned_points:
+                    continue
+                self._warned_points.add(spec.point)
+            from .log import Log
+            from . import telemetry as _telemetry
+            Log.warning("faults: %s names unregistered point %r — no "
+                        "call site fires it, so this spec injects "
+                        "NOTHING (known points: %s)", source,
+                        spec.point, ", ".join(sorted(KNOWN_POINTS)))
+            _telemetry.counters.incr("faults_unknown_point")
+            rec = _telemetry.get_recorder()
+            if rec is not None:
+                rec.emit("continual", event="fault_unknown_point",
+                         point=spec.point, source=source)
 
     # -- configuration -------------------------------------------------
     def configure(self, spec: str) -> List[FaultSpec]:
@@ -143,6 +201,7 @@ class FaultRegistry:
         parsed = parse_specs(spec)
         with self._lock:
             self._specs = parsed
+        self._warn_unknown(parsed, "configure()")
         return parsed
 
     def arm(self, point: str, mode: str, at: str = "1") -> None:
@@ -151,6 +210,7 @@ class FaultRegistry:
         spec = parse_specs(f"{point}:{mode}@{at}")[0]
         with self._lock:
             self._specs.append(spec)
+        self._warn_unknown([spec], "arm()")
 
     def clear(self) -> None:
         with self._lock:
@@ -176,6 +236,7 @@ class FaultRegistry:
                             raw)
                 parsed = []
             self._env_cache = (raw, parsed)
+            self._warn_unknown(parsed, f"LTPU_FAULTS={raw!r}")
         return self._env_cache[1]
 
     def _legacy_specs(self) -> List[FaultSpec]:

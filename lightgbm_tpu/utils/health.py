@@ -1,0 +1,58 @@
+"""Numerical-health guard shared by every training path.
+
+A batch whose labels or gradients go non-finite used to train straight
+through to a silent NaN model that only the serve-time canary caught.
+The guard lives at the points where leaf values are ALREADY host-side
+(the per-tree record fetch, the fused block's packed fetch), so it
+costs zero extra device calls:
+
+- sequential / pipelined boosting: the materialized tree's leaf values
+  are scanned right after ``_records_to_tree`` (``models/gbdt.py``);
+- fused super-steps: a per-iteration finiteness flag is computed
+  INSIDE the ``lax.scan`` (leaf values + updated score) and rides the
+  existing stacked record fetch; on a bad iteration the block is
+  exactly rewound to the served boundary (PR 3 rewind) before raising.
+
+Detection raises :class:`NumericalHealthError` with iteration/phase
+context and emits a ``continual`` telemetry record
+(``event=nonfinite``).  One-shot ``engine.train`` fails loudly; the
+continual daemon (``lightgbm_tpu/cont/``) catches it, quarantines the
+offending batch, prunes its in-flight checkpoints and keeps training
+from the pre-batch state.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["NumericalHealthError", "abort_nonfinite"]
+
+
+class NumericalHealthError(RuntimeError):
+    """Training produced non-finite leaf values or scores."""
+
+    def __init__(self, iteration: int, phase: str, detail: str = ""):
+        self.iteration = int(iteration)
+        self.phase = str(phase)
+        self.detail = str(detail)
+        msg = (f"non-finite training state at iteration {iteration} "
+               f"({phase})")
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+def abort_nonfinite(recorder, iteration: int, phase: str,
+                    detail: str = "") -> None:
+    """Emit the telemetry record + counter, log, and raise."""
+    from . import telemetry as _telemetry
+    from .log import Log
+    _telemetry.counters.incr("nonfinite_aborts")
+    rec = recorder if recorder is not None else _telemetry.get_recorder()
+    if rec is not None:
+        rec.emit("continual", event="nonfinite", iter=int(iteration),
+                 phase=str(phase), detail=str(detail)[:200])
+    Log.warning("numerical health: non-finite training state at "
+                "iteration %d (%s)%s — aborting instead of training a "
+                "NaN model", iteration, phase,
+                f": {detail}" if detail else "")
+    raise NumericalHealthError(iteration, phase, detail)

@@ -73,7 +73,7 @@ def percentile(sorted_vals, q: float) -> float:
 SCHEMA_VERSION = 1
 
 RECORD_TYPES = ("run_start", "iteration", "superstep", "eval", "predict",
-                "serve", "checkpoint", "fleet", "run_end")
+                "serve", "checkpoint", "fleet", "continual", "run_end")
 
 # per-type required fields on top of the common envelope; values are
 # (field, type-or-types) pairs the lint enforces
@@ -121,6 +121,20 @@ _TYPE_FIELDS: Dict[str, Tuple[Tuple[str, Any], ...]] = {
     # from_id/to_id.  triage_run.py
     # summarizes them and flags skips, rollbacks and open circuits.
     "fleet": (("event", str),),
+    # one record per continual-training-loop event (lightgbm_tpu/cont/
+    # and the numerical-health guard, utils/health.py): ``event`` is
+    # batch (one consumed batch: batch/rows/iter/mode=extend|refit/
+    # duration_ms) | quarantine (reason=validate|nonfinite|read|stall|
+    # error + batch + error detail) | backoff (a transient ingest read
+    # retried: batch/attempt/sleep_s) | stall_restart (the watchdog
+    # abandoned a wedged train step: batch/attempt/stalled_s) |
+    # nonfinite (the numerical-health guard tripped: iter/phase —
+    # also emitted by one-shot engine.train) | batch_error (a train
+    # attempt raised: batch/attempt/error) | preempt | resume |
+    # idle_exit | fault_unknown_point (utils/faults.py typo warning).
+    # triage_run.py rolls up quarantine rate, stall restarts and
+    # non-finite rewinds as anomalies.
+    "continual": (("event", str),),
     "run_end": (("summary", dict),),
 }
 
@@ -387,6 +401,26 @@ class RunRecorder:
             }.get(rec.get("event"))
             if key:
                 self._agg[key] = self._agg.get(key, 0) + 1
+        elif t == "continual":
+            event = rec.get("event")
+            key = {
+                "batch": "continual_batches",
+                "quarantine": "continual_quarantines",
+                "backoff": "continual_backoffs",
+                "stall_restart": "continual_stall_restarts",
+                "nonfinite": "continual_nonfinite",
+                "batch_error": "continual_batch_errors",
+                "resume": "continual_resumes",
+            }.get(event)
+            if key:
+                self._agg[key] = self._agg.get(key, 0) + 1
+            if event == "batch":
+                self._agg["continual_rows"] = \
+                    self._agg.get("continual_rows", 0) + \
+                    int(rec.get("rows", 0))
+                self._agg["continual_batch_ms"] = round(
+                    self._agg.get("continual_batch_ms", 0.0) +
+                    float(rec.get("duration_ms", 0.0)), 3)
         elif t == "predict":
             self._agg["predicts"] = self._agg.get("predicts", 0) + 1
             self._agg["predict_rows"] = \
@@ -466,6 +500,15 @@ class RunRecorder:
                     f"publishes, {s.get('fleet_skips', 0):.0f} skips, "
                     f"{s.get('fleet_rollbacks', 0):.0f} rollbacks, "
                     f"{s.get('fleet_restarts', 0):.0f} restarts")
+            if s.get("continual_batches") or s.get("continual_quarantines"):
+                parts.append(
+                    f"continual: {s.get('continual_batches', 0):.0f} "
+                    f"batches ({s.get('continual_rows', 0):.0f} rows), "
+                    f"{s.get('continual_quarantines', 0):.0f} "
+                    f"quarantined, "
+                    f"{s.get('continual_stall_restarts', 0):.0f} stall "
+                    f"restarts, {s.get('continual_nonfinite', 0):.0f} "
+                    f"non-finite aborts")
             if s.get("serve_requests"):
                 parts.append(
                     f"{s['serve_requests']:.0f} serve requests "

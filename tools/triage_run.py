@@ -81,16 +81,41 @@ def _superstep_warmups(records):
     Sharded runs get TWO warmup blocks: block 1 consumes the
     single-device score the unfused bias iteration left behind,
     block 2 runs on the mesh-replicated carry — same trace, two XLA
-    executables by input sharding, both structural."""
+    executables by input sharding, both structural.  A ``run_start``
+    resets the tracking: it marks a new process segment (a continual
+    daemon restart appending to the same JSONL) or a new booster
+    adopting the recorder (one booster per continual batch) — either
+    way a fresh jitted scan whose first block per shape is warmup,
+    not a retrace storm.  The first checkpoint save and the first
+    load per segment also compile once (the mid-block alignment
+    replay and the restore path run eager jnp ops), and those
+    compiles land in the NEXT superstep's counter delta — that
+    superstep is exempt too."""
     seen = {}
+    ckpt_firsts = set()
+    ckpt_pending = False
     for r in records:
-        if r.get("type") != "superstep":
+        rtype = r.get("type")
+        if rtype == "run_start":
+            seen = {}
+            ckpt_firsts = set()
+            ckpt_pending = False
+            continue
+        if rtype == "checkpoint":
+            event = r.get("event")
+            if event in ("save", "load") and event not in ckpt_firsts:
+                ckpt_firsts.add(event)
+                ckpt_pending = True
+            continue
+        if rtype != "superstep":
             continue
         shards = int(r.get("num_shards", 1))
         key = (int(r.get("k", 1)), r.get("learner", ""), shards)
         n = seen.get(key, 0)
         seen[key] = n + 1
-        yield r, n < (2 if shards > 1 else 1)
+        warm = n < (2 if shards > 1 else 1) or ckpt_pending
+        ckpt_pending = False
+        yield r, warm
 
 
 def scan_anomalies(records):
@@ -269,6 +294,50 @@ def scan_anomalies(records):
             out.append(("MED", f"{len(errors)} watcher error(s); "
                                f"last: "
                                f"{str(errors[-1].get('error', '?'))[:140]}"))
+    cont = [r for r in records if r.get("type") == "continual"]
+    if cont:
+        batches = [r for r in cont if r.get("event") == "batch"]
+        quar = [r for r in cont if r.get("event") == "quarantine"]
+        consumed = len(batches) + len(quar)
+        if quar and consumed and len(quar) / consumed > 0.1:
+            by_reason = {}
+            for r in quar:
+                by_reason[r.get("reason", "?")] = \
+                    by_reason.get(r.get("reason", "?"), 0) + 1
+            out.append(("HIGH", f"continual quarantine rate "
+                                f"{len(quar)}/{consumed} batches "
+                                f"({', '.join(f'{k}:{v}' for k, v in sorted(by_reason.items()))})"
+                                f" — the ingest feed is degrading, "
+                                f"not the trainer"))
+        nonfin = [r for r in cont if r.get("event") == "nonfinite"]
+        if nonfin:
+            last = nonfin[-1]
+            out.append(("HIGH", f"numerical-health guard tripped "
+                                f"{len(nonfin)} time(s): non-finite "
+                                f"training state at iteration "
+                                f"{last.get('iter', '?')} "
+                                f"({last.get('phase', '?')}) — bad "
+                                f"input got past ingest validation"))
+        stalls = [r for r in cont if r.get("event") == "stall_restart"]
+        if stalls:
+            out.append(("MED", f"{len(stalls)} stalled train step(s) "
+                               f"abandoned by the watchdog and "
+                               f"restarted from the last snapshot "
+                               f"(worst {max(float(r.get('stalled_s', 0.0)) for r in stalls):.1f}s "
+                               f"silent)"))
+        errors = [r for r in cont if r.get("event") == "batch_error"]
+        if errors:
+            out.append(("MED", f"{len(errors)} continual train "
+                               f"attempt(s) raised and retried from "
+                               f"the last snapshot; last: "
+                               f"{str(errors[-1].get('error', '?'))[:120]}"))
+        unknown = [r for r in cont
+                   if r.get("event") == "fault_unknown_point"]
+        if unknown:
+            pts = sorted({r.get("point", "?") for r in unknown})
+            out.append(("MED", f"fault spec names unregistered "
+                               f"point(s) {pts} — the chaos scenario "
+                               f"armed NOTHING (typo?)"))
     ckpts = [r for r in records if r.get("type") == "checkpoint"]
     if ckpts:
         fallbacks = [r for r in ckpts if r.get("event") == "fallback"]
@@ -391,6 +460,20 @@ def triage(records, baseline=None):
                 f"({s.get('fleet_publish_verified', 0):.0f} verified), "
                 f"{s.get('fleet_skips', 0):.0f} skips, "
                 f"{s.get('fleet_rollbacks', 0):.0f} rollbacks")
+        if s.get("continual_batches") or s.get("continual_quarantines"):
+            mean_ms = (s.get("continual_batch_ms", 0.0) /
+                       max(s.get("continual_batches", 0), 1))
+            lines.append(
+                f"continual   : "
+                f"{s.get('continual_batches', 0):.0f} batches "
+                f"({s.get('continual_rows', 0):.0f} rows, mean "
+                f"{mean_ms:.0f} ms/batch), "
+                f"{s.get('continual_quarantines', 0):.0f} quarantined, "
+                f"{s.get('continual_backoffs', 0):.0f} read backoffs, "
+                f"{s.get('continual_stall_restarts', 0):.0f} stall "
+                f"restarts, "
+                f"{s.get('continual_nonfinite', 0):.0f} non-finite "
+                f"aborts, {s.get('continual_resumes', 0):.0f} resumes")
         if s.get("serve_requests"):
             lines.append(
                 f"serve       : {s['serve_requests']:.0f} requests "
