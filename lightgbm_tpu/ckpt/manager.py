@@ -173,6 +173,13 @@ class CheckpointManager:
             "best_iteration": int(booster.best_iteration),
             "best_score": booster.best_score,
             "eval_history": eval_history or [],
+            # mesh topology the snapshot was taken under: resume on a
+            # different device set validates against this and
+            # RE-SHARDS (the training state is host-side and mesh-
+            # agnostic) instead of failing inside shard_map
+            "mesh": (g.mesh_identity() if hasattr(g, "mesh_identity")
+                     else {"learner": "serial", "num_shards": 1,
+                           "mesh_shape": [1]}),
         })
         buf = io.BytesIO()
         np.savez(buf, **arrays)
@@ -204,7 +211,7 @@ class CheckpointManager:
                              os.path.join(staging, _MANIFEST))
         manifest = {"schema": SCHEMA_VERSION, "iteration": iteration,
                     "reason": str(reason), "created": meta["created"],
-                    "blobs": blobs}
+                    "mesh": meta["mesh"], "blobs": blobs}
         _fsync_write(os.path.join(staging, _MANIFEST),
                      json.dumps(manifest, sort_keys=True,
                                 indent=1).encode("utf-8"))
@@ -363,6 +370,38 @@ class CheckpointManager:
         if meta.get("objective") != g.config.objective:
             Log.warning("checkpoint objective %r differs from configured "
                         "%r", meta.get("objective"), g.config.objective)
+        ck_mesh = meta.get("mesh") or {}
+        if ck_mesh and hasattr(g, "mesh_identity"):
+            cur = g.mesh_identity()
+            ck_kind = str(ck_mesh.get("learner", cur["learner"]))
+            ck_shards = int(ck_mesh.get("num_shards",
+                                        cur["num_shards"]) or 1)
+            if (ck_kind, ck_shards) != (cur["learner"],
+                                        cur["num_shards"]):
+                # cross-mesh-width (or cross-learner) resume: the
+                # checkpointed state is host-side and mesh-agnostic —
+                # the freshly constructed booster already placed its
+                # tensors under ITS shardings, so restoring here IS
+                # the re-shard.  Continuation is bit-exact at the new
+                # width (docs/Distributed.md parity contract).
+                Log.warning(
+                    "checkpoint was taken under tree_learner=%s on a "
+                    "%d-shard mesh; this booster runs tree_learner=%s "
+                    "over %d shard(s) — re-sharding the restored "
+                    "training state (bit-exact continuation at the "
+                    "new width; see docs/Distributed.md)",
+                    ck_kind, ck_shards, cur["learner"],
+                    cur["num_shards"])
+                _telemetry.counters.incr("recovery_reshards")
+                rec = self.recorder or _telemetry.get_recorder() or \
+                    getattr(g, "_telemetry", None)
+                if rec is not None:
+                    rec.emit("recovery", event="reshard",
+                             from_shards=ck_shards,
+                             to_shards=int(cur["num_shards"]),
+                             from_learner=ck_kind,
+                             to_learner=cur["learner"],
+                             iter=int(meta.get("iter", -1)))
         raw = None
         if booster.train_set is not None:
             raw = booster.train_set.raw_mat

@@ -347,6 +347,29 @@ PARAMS: List[Param] = [
        "machine list file", group="network"),
     _p("machines", "", str, ("workers", "nodes"),
        "comma-separated machine list", group="network"),
+    # ---- elastic (shard-loss recovery for sharded training) ----
+    _p("elastic_training", False, bool, ("elastic",),
+       "supervise mesh-sharded fused training (tree_learner="
+       "data/feature/voting with fused_iters>1) for shard loss: each "
+       "fused-block dispatch runs under a collective-stall watchdog "
+       "and a per-block heartbeat; a failed or hung shard triggers "
+       "exact rewind to the served boundary, a re-mesh over the "
+       "surviving devices, and bit-exact continuation — see "
+       "docs/Distributed.md", group="elastic"),
+    _p("elastic_stall_timeout_s", 120.0, float, (),
+       "collective-stall watchdog: a fused-block dispatch silent this "
+       "long (no heartbeat) is abandoned as a hung collective and "
+       "recovery runs; a mesh identity's first block gets a 5x "
+       "compile grace; <=0 disables the watchdog (failures are still "
+       "detected as exceptions)", group="elastic", check=""),
+    _p("elastic_max_remesh", 2, int, (),
+       "shard-loss recoveries (re-meshes) one run may spend before "
+       "escalating with ElasticError (restart from checkpoint owns "
+       "anything past this)", group="elastic", check=">=0"),
+    _p("elastic_min_shards", 1, int, (),
+       "below this surviving mesh width recovery escalates instead "
+       "of degrading further (1 permits the serial-learner fallback)",
+       group="elastic", check=">=1"),
     # ---- device ----
     _p("gpu_platform_id", -1, int, (), "(compat) OpenCL platform id",
        group="device"),

@@ -172,7 +172,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
     ``num_machines``.  Sharded training runs as ONE compiled SPMD
     program — with ``fused_iters>1`` the whole K-iteration block rides
     a single ``shard_map``-wrapped ``lax.scan`` — see
-    ``docs/Distributed.md``.
+    ``docs/Distributed.md``.  With ``elastic_training=true`` that
+    program is supervised for shard loss: a failed or hung shard
+    triggers exact rewind to the served boundary, a re-mesh over the
+    surviving devices, and bit-exact continuation (``elastic_*``
+    params; ``parallel/elastic.py``).
 
     With ``checkpoint_dir`` set (params or config file) training is
     preemption-safe: atomic checkpoints every ``snapshot_freq``
@@ -310,6 +314,23 @@ def train(params: Dict[str, Any], train_set: Dataset,
     booster._gbdt.config.num_iterations = num_boost_round \
         if (loaded_ckpt is not None or init_model is None) \
         else booster._gbdt.iter + num_boost_round
+    # ---- elastic shard-loss recovery (parallel/elastic.py) -----------
+    # supervises the mesh-sharded fused path: each fused-block
+    # dispatch runs under the collective-stall watchdog; a failed or
+    # hung shard triggers exact rewind + re-mesh over the survivors +
+    # bit-exact continuation.  elastic_* params, docs/Distributed.md.
+    elastic_sup = None
+    if getattr(cfg, "elastic_training", False):
+        if (fobj is not None or
+                getattr(booster._gbdt, "_dist", None) is None or
+                int(getattr(cfg, "fused_iters", 1)) <= 1):
+            Log.warning(
+                "elastic_training requires a distributed tree_learner "
+                "(data/feature/voting) with fused_iters>1 and no "
+                "custom fobj; training runs unsupervised")
+        else:
+            from .parallel.elastic import ElasticSupervisor
+            elastic_sup = ElasticSupervisor(booster)
     guard = _PreemptGuard()
     if ckpt_mgr is not None:
         guard.install()
@@ -332,7 +353,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
         for i in range(start_iter, num_boost_round):
             for cb in cbs_before:
                 cb(CallbackEnv(booster, params, i, 0, num_boost_round, None))
-            should_stop = booster.update(fobj=fobj)
+            should_stop = elastic_sup.update(fobj=fobj) \
+                if elastic_sup is not None else booster.update(fobj=fobj)
             # per-iteration wall clock (GBDT::Train, gbdt.cpp:253-256)
             Log.debug("%.6f seconds elapsed, finished iteration %d",
                       _time.perf_counter() - t_train0, i + 1)

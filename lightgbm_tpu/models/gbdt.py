@@ -1047,6 +1047,21 @@ class GBDT:
             K = remaining
         i0 = self.iter
         rng_state = self._rng_feature.get_state()
+        # elastic dispatch fence: the ONLY host state a fused dispatch
+        # consumes before its fetch lands is the feature-fraction RNG
+        # stream and the quantization-stream position — when the
+        # dispatch is abandoned (hung collective) or dies (shard
+        # loss), abort_inflight_dispatch restores exactly these
+        # (parallel/elastic.py recovery path)
+        self._dispatch_fence = {"rng_state": rng_state,
+                                "tid": self._trees_dispatched}
+        # THIS attempt's generation token, captured before any device
+        # work: a later retry overwrites the attribute with its own
+        # token, and an abandoned zombie checking the shared attribute
+        # instead of its captured one would see the RETRY's (alive)
+        # token and commit phantom state
+        elastic_alive = getattr(self, "_elastic_alive", None)
+        self._elastic_beat()
         with timed("superstep/dispatch"):
             # host feature-fraction draws consumed in sequential order
             fmasks = jnp.stack([self._feature_fraction_mask()
@@ -1069,18 +1084,38 @@ class GBDT:
             qk = self._quant_key if self._quant_key is not None \
                 else jax.random.PRNGKey(0)
             _telemetry.counters.incr("superstep_dispatches")
-            (start_score, final_score, final_bag, recs, leaf_idx_k,
-             vals_k) = self._superstep_jit(
+            if self._dist is not None:
+                from ..utils import faults as _faults
+                # fired once per fused-block dispatch: the injected
+                # stand-in for a shard dying or wedging inside the
+                # block's collectives (tools/chaos_elastic.py)
+                fault_mode = _faults.fire("mesh.collective")
+                if fault_mode:
+                    self._mesh_collective_fault(fault_mode,
+                                                elastic_alive)
+            outs = self._superstep_jit(
                 self._score, bag0, jnp.float32(self.shrinkage_rate), qk,
                 self._xt, self._base_mask, self._num_bins,
                 self._missing_type, self._is_cat, iters, fmasks,
                 tree_ids)
-        start_tid = self._trees_dispatched
-        self._trees_dispatched += K
+        # an abandoned attempt (elastic stall watchdog moved on and a
+        # re-mesh owns ``self`` now) must not commit ANY state — the
+        # checks bracket the only other device interaction, the fetch
+        self._abandoned_check(elastic_alive)
+        (start_score, final_score, final_bag, recs, leaf_idx_k,
+         vals_k) = outs
         with timed("superstep/fetch"):
             # the block's ONE device->host transfer (packed f32)
             _telemetry.counters.incr("superstep_fetches")
             host = self._fetch_records(recs)
+        self._abandoned_check(elastic_alive)
+        self.__dict__.pop("_dispatch_fence", None)
+        # per-block heartbeat: rides the block bookkeeping the
+        # superstep telemetry record is assembled from — zero extra
+        # device calls (parallel/elastic.py)
+        self._elastic_beat(block=True)
+        start_tid = self._trees_dispatched
+        self._trees_dispatched += K
         bad = np.asarray(host.pop("nonfinite", np.zeros(K)), bool)
         if np.any(bad):
             # the per-iteration health flag tripped: rewind to the
@@ -1265,6 +1300,161 @@ class GBDT:
             self._fused_restore(served - 1)
         self._fused_block = None
 
+    # ---- elastic mesh recovery (parallel/elastic.py) -----------------
+    def _elastic_beat(self, block: bool = False) -> None:
+        """Beat the elastic heartbeat (dispatch start / block landed).
+        The ``mesh.heartbeat:suppress`` fault drops beats — the
+        injected stand-in for a shard that stops reporting progress
+        without dying, driving the stall watchdog distinctly from a
+        hung collective."""
+        hb = getattr(self, "_elastic_heartbeat", None)
+        if hb is None:
+            return
+        from ..utils import faults as _faults
+        if _faults.fire("mesh.heartbeat") == "suppress":
+            return
+        hb.beat(block=block)
+
+    def _abandoned_check(self, alive) -> None:
+        """Raise out of an abandoned dispatch attempt BEFORE it
+        commits state: once the elastic stall watchdog moved on, a
+        re-mesh owns ``self`` and a late-returning zombie thread must
+        not race its restored bookkeeping.  ``alive`` is THIS
+        attempt's captured generation token — never the live
+        attribute, which a retry overwrites with its own."""
+        if alive is not None and not alive():
+            from ..parallel.elastic import ElasticAbandoned
+            raise ElasticAbandoned("fused dispatch abandoned by the "
+                                   "elastic supervisor")
+
+    def _mesh_collective_fault(self, mode: str, alive) -> None:
+        """Consume one armed ``mesh.collective`` fault: ``error``
+        raises the way XLA surfaces a dead peer, ``hang`` blocks the
+        way a lost shard stalls the collective rendezvous (forever
+        when unsupervised — faithful to the real failure), and
+        ``sleep_<ms>`` delays the dispatch (drives the watchdog when
+        heartbeats are suppressed)."""
+        import time as _time
+        from ..utils.faults import InjectedFault
+        if mode == "error":
+            raise InjectedFault(
+                "injected collective failure (mesh.collective:error): "
+                "simulated shard loss inside the fused block")
+        if mode == "hang":
+            while alive is None or alive():
+                _time.sleep(0.02)
+            from ..parallel.elastic import ElasticAbandoned
+            raise ElasticAbandoned("hung collective abandoned by the "
+                                   "elastic supervisor")
+        if mode.startswith("sleep_"):
+            _time.sleep(float(mode[len("sleep_"):]) / 1e3)
+
+    def abort_inflight_dispatch(self) -> bool:
+        """Restore the pre-block host state an in-flight fused
+        dispatch consumed when that dispatch will never land (hung or
+        failed collective): the feature-fraction RNG stream and the
+        quantization-stream position are the only mutations between
+        dispatch and fetch.  Returns True when a fence was armed."""
+        fence = self.__dict__.pop("_dispatch_fence", None)
+        if fence is None:
+            return False
+        self._rng_feature.set_state(fence["rng_state"])
+        self._trees_dispatched = int(fence["tid"])
+        return True
+
+    def next_update_is_local(self) -> bool:
+        """True when the next ``train_one_iter`` only serves an
+        already-materialized tree from the in-flight fused block —
+        pure host work, no device dispatch — so the elastic
+        supervisor runs it inline instead of on a watched thread."""
+        blk = self._fused_block
+        return (blk is not None and blk["served"] < len(blk["trees"])
+                and blk.get("lr") == self.shrinkage_rate and
+                self._fused_ok())
+
+    def mesh_identity(self) -> Dict:
+        """The live mesh topology — recorded in checkpoint manifests
+        (``ckpt/manager.py``) so resume can validate it against the
+        restoring booster and re-shard across widths."""
+        if self._dist is None:
+            return {"learner": "serial", "num_shards": 1,
+                    "mesh_shape": [1]}
+        return {"learner": self._dist.kind,
+                "num_shards": int(self._dist.num_shards),
+                "mesh_shape": [int(s) for s in
+                               self._dist.mesh.devices.shape]}
+
+    def remesh(self, num_shards: Optional[int] = None, mesh=None,
+               raw=None, snapshot: Optional[Dict] = None) -> int:
+        """Re-mesh entry point: rebuild the device mesh (narrower
+        after shard loss, or any explicit 1-D mesh) and continue
+        BIT-exactly from the last served boundary.
+
+        Lands on the served boundary first (dispatch-fence restore +
+        the PR 3 exact rewind), captures the PR 5 bit-exact training
+        snapshot, re-runs construction against the new mesh — every
+        mesh-dependent decision (row/feature paddings, NamedShardings,
+        tier gates, EFB when the survivor set collapses to serial) is
+        re-derived exactly as a fresh booster would derive it — and
+        restores the snapshot; the mesh-resident tensors land under
+        the new ``DistributedBuilder.shardings()`` and the fused scan
+        rebuilds lazily, keyed by the new mesh shape.
+        ``num_shards=1`` falls back to the serial learner.  Returns
+        the new shard count.
+
+        ``snapshot``: a pre-captured :meth:`training_snapshot` to
+        restore instead of capturing one here — the elastic
+        supervisor's degrade-retry loop passes the snapshot it took
+        BEFORE the first attempt, so a remesh that failed after its
+        internal re-construction (leaving this booster blank) cannot
+        make the retry restore blank state."""
+        import jax
+        self.abort_inflight_dispatch()
+        if snapshot is None:
+            self._fused_rewind()
+            self._flush_pending()
+            snapshot = self.training_snapshot()
+        rec = getattr(self, "_telemetry", None)
+        valid_sets = self.valid_sets
+        cfg = self.config
+        if mesh is None:
+            if num_shards is None:
+                raise ValueError("remesh needs num_shards or an "
+                                 "explicit mesh")
+            from ..parallel.learners import AXIS_NAME, make_mesh_for
+            if int(num_shards) > 1:
+                mesh = make_mesh_for(int(num_shards))
+            else:
+                # 1-device mesh: resolve_num_shards reads 1 and the
+                # construction falls back to the serial learner
+                mesh = jax.sharding.Mesh(
+                    np.asarray(jax.devices()[:1]), (AXIS_NAME,))
+        # the SAME recorder must survive the re-construction: blank
+        # the file param so __init__ cannot open a second handle on
+        # the same JSONL
+        tf = cfg.telemetry_file
+        cfg.telemetry_file = ""
+        try:
+            self.__init__(cfg, self.train_set, self.objective,
+                          self.metrics, mesh=mesh)
+        finally:
+            cfg.telemetry_file = tf
+        self.valid_sets = valid_sets
+        if rec is not None and getattr(self, "_telemetry", None) is not rec:
+            # re-adopt THIS booster's recorder even when __init__
+            # already adopted the process-default one (telemetry_file
+            # was blanked, so a live global recorder wins that race)
+            # — the run's own stream must keep receiving records.
+            # Re-adoption emits a fresh run_start, which resets
+            # triage_run's superstep-warmup tracking: the post-re-mesh
+            # recompile is per-shape warmup, not a storm
+            self._telemetry = None
+            self.attach_telemetry(rec)
+        self.restore_training_snapshot(snapshot, raw=raw)
+        return int(self._dist.num_shards) if self._dist is not None \
+            else 1
+
+    # ------------------------------------------------------------------
     def _dispatch_build(self, grad_k, hess_k, bag):
         """Pad + bag-weight one class's gradients, draw the feature
         mask and dispatch the jitted tree build.  Returns (device
@@ -1944,6 +2134,14 @@ class GBDT:
         self._trees_dispatched = int(snap["trees_dispatched"])
         self.shrinkage_rate = float(snap["shrinkage_rate"])
         self._score = jnp.asarray(np.asarray(snap["score"], np.float32))
+        if self._dist is not None:
+            # mesh-resident contract: the restored carry goes back on
+            # the mesh replicated, exactly as construction placed the
+            # fresh one — a host-placed carry would compile a second
+            # executable for its input sharding on the first block
+            import jax
+            self._score = jax.device_put(self._score,
+                                         self._dist.shardings()["rep"])
         self._prev_score = None
         self._prev_valid_scores = []
         self._rng_feature.set_state(snap["rng_feature"])

@@ -14,8 +14,9 @@ from the reference is the *interface shape* — which learner shards what,
 and which reductions run where — as documented on
 :class:`~lightgbm_tpu.ops.grow.DistConfig`.
 """
+from .elastic import ElasticError, ElasticSupervisor
 from .learners import (AXIS_NAME, DistributedBuilder, make_mesh_for,
                        resolve_num_shards)
 
-__all__ = ["AXIS_NAME", "DistributedBuilder", "make_mesh_for",
-           "resolve_num_shards"]
+__all__ = ["AXIS_NAME", "DistributedBuilder", "ElasticError",
+           "ElasticSupervisor", "make_mesh_for", "resolve_num_shards"]

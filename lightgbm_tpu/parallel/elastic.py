@@ -1,0 +1,341 @@
+"""Elastic recovery for mesh-sharded training: shard-loss detection,
+re-mesh over the survivors, and bit-exact continuation.
+
+PR 7 put the distributed learners inside ONE compiled ``shard_map``
+super-step — which made the compiled program a single point of
+failure: on preemptible slices a lost or hung shard stalls the
+collective rendezvous and takes the whole training job with it, and
+shard loss is the NORMAL failure mode there, not an edge case.  This
+module is the mesh path's failure story, completing the set PR 5
+(checkpoint), PR 6 (serving fleet) and PR 8 (continual daemon) gave
+the other subsystems:
+
+- **detection** — a per-block heartbeat rides the fused super-step's
+  existing host-side block bookkeeping (the same place the
+  ``superstep`` telemetry record is assembled), so it costs ZERO extra
+  device calls; a collective-stall watchdog (the PR 8 heartbeat
+  pattern generalized to the mesh path) runs each fused dispatch on a
+  worker thread and abandons it when the heartbeat goes silent past
+  ``elastic_stall_timeout_s`` (a hung collective never returns — on a
+  real slice that is what losing a peer looks like).  Dispatch
+  EXCEPTIONS are classified: collective/device-loss signatures (and
+  the ``mesh.collective`` injection point) mean a shard died mid-
+  block; anything else — ``NumericalHealthError``, a checkpoint
+  fault, a plain bug — propagates untouched.
+- **rewind** — nothing from a failed block was served or applied to
+  the model: the dispatch fence (``GBDT._dispatch_fence``) restores
+  the pre-block host-RNG/quantization-stream state the aborted
+  dispatch consumed, and the PR 3 served-boundary replay discards any
+  partially-served block, exactly as the checkpoint capture does.
+- **re-mesh** — :meth:`GBDT.remesh` rebuilds the mesh over the
+  surviving device set, re-places every mesh-resident tensor under
+  the new ``DistributedBuilder.shardings()`` and rebuilds the fused
+  scan (the superstep program is keyed by mesh shape), continuing
+  from the served boundary.
+- **parity contract** — the recovered model is BIT-IDENTICAL to an
+  uninterrupted run over the surviving mesh from the rewind boundary:
+  gradients, mask draws and the score update are replicated (the PR 7
+  bit-exactness anchor), the host PRNG streams are rewound exactly,
+  and the score carry is replayed to the boundary.  Cross-width
+  caveat: the data/voting learners' float histogram ``psum`` groups
+  rows per shard, so tree prefixes TRAINED at different widths differ
+  in float low bits — the oracle for byte-equality therefore shares
+  the prefix (a clean continuation at the surviving width), while
+  feature-parallel — which reduces no float histograms — is byte-
+  identical to serial at EVERY width, prefix included
+  (``docs/Distributed.md``).
+
+Surviving-set policy: when the failure names a device (real runtimes
+usually do; the classifier keeps the message) the mesh is rebuilt
+without it; otherwise the HIGHEST-index device is dropped — a
+deterministic stand-in that keeps the chaos harness and the parity
+oracles reproducible.  Repeated failures degrade further, bounded by
+``elastic_max_remesh`` and ``elastic_min_shards``; past either bound
+the supervisor raises :class:`ElasticError` (fail loudly: the PR 5
+checkpoint story owns process-level restart, including resuming an
+8-shard snapshot on a narrower host — ``ckpt/manager.py`` re-shards
+from the manifest's recorded mesh topology).
+
+Fault-injection points (``utils/faults.py``): ``mesh.collective``
+(``error`` | ``hang`` | ``sleep_<ms>``, fired once per fused-block
+dispatch), ``mesh.heartbeat`` (``suppress``), ``elastic.remesh``
+(``error``).  Chaos harness: ``tools/chaos_elastic.py`` (CI).
+"""
+from __future__ import annotations
+
+import re
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ..utils import faults as _faults
+from ..utils import telemetry as _telemetry
+from ..utils.log import Log
+
+__all__ = ["ElasticError", "ElasticAbandoned", "ElasticSupervisor",
+           "classify_shard_failure"]
+
+# message signatures of a shard/collective failure, matched against
+# real XLA/PJRT device-loss errors and the injected stand-in.  Kept
+# deliberately narrow: an unrecognized exception must PROPAGATE (a
+# NumericalHealthError rewound-and-remeshed would hide bad data).
+_SHARD_FAILURE_RE = re.compile(
+    r"(?i)(injected collective|collective.+(?:fail|abort|timeout|"
+    r"stall)|all[-_ ]?(?:gather|reduce).+(?:fail|abort|timeout)|"
+    r"rendezvous|DEADLINE_EXCEEDED|device.+(?:lost|failed|halted|"
+    r"unhealthy|removed)|slice.+(?:lost|unhealthy)|"
+    r"peer.+(?:down|unreachable)|NCCL|preempt.+(?:worker|host))")
+
+
+class ElasticError(RuntimeError):
+    """Shard-loss recovery exhausted (re-mesh budget or minimum mesh
+    width) — the job must fail loudly and restart from checkpoint."""
+
+
+class ElasticAbandoned(BaseException):
+    """Raised INSIDE an abandoned dispatch attempt when its supervisor
+    has already moved on (stall watchdog fired): the zombie thread
+    must not commit any state.  BaseException so cleanup code guarded
+    by ``except Exception`` cannot swallow it."""
+
+
+def classify_shard_failure(exc: BaseException) -> Optional[str]:
+    """Shard-failure detail string when ``exc`` looks like a lost or
+    hung shard (collective abort, device loss, the ``mesh.collective``
+    injection), else None — the caller re-raises unclassified
+    failures untouched."""
+    if isinstance(exc, ElasticAbandoned):
+        return None
+    msg = f"{type(exc).__name__}: {exc}"
+    if isinstance(exc, _faults.InjectedFault) and \
+            "mesh.collective" in str(exc):
+        return msg
+    if _SHARD_FAILURE_RE.search(msg):
+        return msg
+    return None
+
+
+class _Heartbeat:
+    """Monotonic last-sign-of-life timestamp beaten from the fused
+    block's host-side bookkeeping (GIL-atomic float — same shape as
+    the continual daemon's)."""
+
+    def __init__(self):
+        self.t = time.monotonic()
+        self.blocks = 0
+
+    def beat(self, block: bool = False) -> None:
+        self.t = time.monotonic()
+        if block:
+            self.blocks += 1
+
+    def age(self) -> float:
+        return time.monotonic() - self.t
+
+
+class ElasticSupervisor:
+    """Supervise a sharded booster's update loop: run each fused
+    dispatch on a worker thread under the stall watchdog, classify
+    failures, and recover by rewind + re-mesh.
+
+    Pure-host serve iterations (``GBDT.next_update_is_local``) run
+    inline — supervision adds no device calls and no thread hops to
+    them, so the healthy-path budget stays 2 device calls per K-block
+    (pinned by ``tools/prof_superstep.py``).
+    """
+
+    #: stall-timeout multiple while a mesh identity's first block is
+    #: still compiling (same rationale as the continual watchdog's
+    #: first-iteration grace)
+    COMPILE_GRACE = 5.0
+
+    def __init__(self, booster, stall_timeout_s: Optional[float] = None,
+                 max_remesh: Optional[int] = None,
+                 min_shards: Optional[int] = None, recorder=None):
+        self.booster = booster
+        cfg = booster._gbdt.config
+        self.stall_timeout_s = float(
+            cfg.elastic_stall_timeout_s if stall_timeout_s is None
+            else stall_timeout_s)
+        self.max_remesh = int(cfg.elastic_max_remesh if max_remesh is None
+                              else max_remesh)
+        self.min_shards = max(int(cfg.elastic_min_shards
+                                  if min_shards is None else min_shards),
+                              1)
+        self.recorder = recorder
+        self.remeshes = 0
+        self._gen_lock = threading.Lock()
+        self._generation = 0
+        self._warm_meshes: set = set()
+
+    # one event -> counter-key map shared with RunRecorder._aggregate
+    # (telemetry.py) so counters_snapshot readers and run_end
+    # summaries agree on names
+    COUNTER_KEYS = {
+        "detect": "recovery_detects",
+        "remesh": "recovery_remeshes",
+        "remesh_failed": "recovery_remesh_failures",
+        "reshard": "recovery_reshards",
+        "escalate": "recovery_escalations",
+    }
+
+    # ------------------------------------------------------------------
+    def _emit(self, event: str, **fields) -> None:
+        _telemetry.counters.incr(
+            self.COUNTER_KEYS.get(event, f"recovery_{event}s"))
+        rec = self.recorder or \
+            getattr(self.booster._gbdt, "_telemetry", None) or \
+            _telemetry.get_recorder()
+        if rec is not None:
+            rec.emit("recovery", event=event, **fields)
+
+    def _mesh_key(self):
+        g = self.booster._gbdt
+        return (g._dist.kind if g._dist is not None else "serial",
+                int(g._dist.num_shards) if g._dist is not None else 1)
+
+    # ------------------------------------------------------------------
+    def update(self, fobj=None) -> bool:
+        """One supervised boosting iteration (the engine loop's
+        ``booster.update`` under elastic training)."""
+        g = self.booster._gbdt
+        if fobj is not None or g._dist is None:
+            # custom gradients / serial fallback: nothing to supervise
+            return self.booster.update(fobj=fobj)
+        if g.next_update_is_local():
+            # serving an already-materialized tree: pure host work
+            return self.booster.update()
+        while True:
+            done, result = self._attempt()
+            if done:
+                return result
+
+    def _attempt(self):
+        """One watched dispatch attempt.  Returns ``(True, stop)`` on
+        success; on a classified shard failure recovers (re-mesh) and
+        returns ``(False, None)`` so the caller retries the iteration
+        on the new mesh.  Unclassified failures propagate."""
+        g = self.booster._gbdt
+        with self._gen_lock:
+            self._generation += 1
+            gen = self._generation
+
+        def alive(expect=gen):
+            with self._gen_lock:
+                return self._generation == expect
+
+        hb = _Heartbeat()
+        g._elastic_heartbeat = hb
+        g._elastic_alive = alive
+        box: Dict[str, Any] = {}
+
+        def run():
+            try:
+                box["stop"] = self.booster.update()
+            except BaseException as exc:  # noqa: BLE001 - classified below
+                box["error"] = exc
+
+        th = threading.Thread(target=run, name="ltpu-elastic-dispatch",
+                              daemon=True)
+        mesh_key = self._mesh_key()
+        limit = self.stall_timeout_s
+        if limit > 0 and mesh_key not in self._warm_meshes:
+            limit *= self.COMPILE_GRACE   # first block compiles here
+        th.start()
+        stalled = False
+        while th.is_alive():
+            th.join(0.05)
+            if limit > 0 and hb.age() > limit:
+                stalled = True
+                break
+        if stalled:
+            with self._gen_lock:
+                self._generation += 1   # zombie sees !alive(): it must
+            cause, detail = "hang", (   # not commit any state
+                f"no heartbeat for {hb.age():.1f}s inside a fused "
+                f"block dispatch (collective stall)")
+        else:
+            err = box.get("error")
+            if err is None:
+                self._warm_meshes.add(mesh_key)
+                return True, box.get("stop", False)
+            if isinstance(err, ElasticAbandoned):  # pragma: no cover
+                return False, None      # raced a concurrent abandon
+            detail = classify_shard_failure(err)
+            if detail is None:
+                raise err               # not a shard failure
+            cause = "error"
+        self._recover(cause, detail)
+        return False, None
+
+    # ------------------------------------------------------------------
+    def _recover(self, cause: str, detail: str) -> None:
+        """Rewind to the served boundary and re-mesh over the
+        survivors; bounded by ``elastic_max_remesh`` /
+        ``elastic_min_shards``, past which :class:`ElasticError`
+        escalates to the process-level (checkpoint) recovery story."""
+        g = self.booster._gbdt
+        # land on a consistent host state FIRST — before any
+        # escalation can raise: the dead block's fence (RNG +
+        # quantization-stream draws) must be restored even when no
+        # re-mesh follows, or a checkpoint taken from the live
+        # booster after ElasticError resumes with a drifted RNG
+        g.abort_inflight_dispatch()
+        width = int(g._dist.num_shards) if g._dist is not None else 1
+        boundary = int(g.completed_iterations())
+        self._emit("detect", cause=cause, detail=str(detail)[:300],
+                   iter=boundary, num_shards=width)
+        Log.warning("elastic: shard failure detected (%s) at iteration "
+                    "%d on the %d-shard mesh: %s", cause, boundary,
+                    width, str(detail)[:200])
+        self.remeshes += 1
+        if self.remeshes > self.max_remesh:
+            self._emit("escalate", reason="max_remesh",
+                       num_shards=width, iter=boundary)
+            raise ElasticError(
+                f"shard-loss recovery exhausted: {self.remeshes - 1} "
+                f"re-mesh(es) already spent (elastic_max_remesh="
+                f"{self.max_remesh}) — restart from checkpoint "
+                f"({cause}: {str(detail)[:200]})")
+        # capture the served-boundary snapshot ONCE, before the first
+        # remesh attempt can mutate the booster: a remesh that fails
+        # AFTER its internal re-construction leaves a blank booster,
+        # and a retry snapshotting THAT would silently restart
+        # training from iteration 0
+        g._fused_rewind()
+        g._flush_pending()
+        snapshot = g.training_snapshot()
+        survivors = width - 1
+        while True:
+            if survivors < self.min_shards:
+                self._emit("escalate", reason="min_shards",
+                           num_shards=width, iter=boundary)
+                raise ElasticError(
+                    f"only {survivors} shard(s) would survive, below "
+                    f"elastic_min_shards={self.min_shards} — restart "
+                    f"from checkpoint ({cause}: {str(detail)[:200]})")
+            t0 = time.perf_counter()
+            try:
+                mode = _faults.fire("elastic.remesh")
+                if mode == "error":
+                    raise RuntimeError("injected fault "
+                                       "(elastic.remesh:error)")
+                new_width = g.remesh(num_shards=survivors,
+                                     snapshot=snapshot)
+            except (Exception, _faults.InjectedFault) as exc:
+                self._emit("remesh_failed", to_shards=survivors,
+                           error=str(exc)[:300])
+                Log.warning("elastic: re-mesh to %d shard(s) failed "
+                            "(%s); degrading further", survivors, exc)
+                survivors -= 1
+                continue
+            self._emit("remesh", from_shards=width,
+                       to_shards=int(new_width), iter=boundary,
+                       cause=cause,
+                       duration_ms=round(
+                           (time.perf_counter() - t0) * 1e3, 3))
+            Log.warning("elastic: re-meshed %d -> %d shard(s) at "
+                        "iteration %d; continuing bit-exactly from "
+                        "the served boundary", width, new_width,
+                        boundary)
+            return

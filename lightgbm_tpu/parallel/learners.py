@@ -64,10 +64,22 @@ def resolve_num_shards(config, mesh=None) -> int:
 
 
 def make_mesh_for(num_shards: int):
-    """A 1-D mesh over the first ``num_shards`` local devices."""
+    """A 1-D mesh over the first ``num_shards`` local devices.
+    Raises when fewer devices are visible — silently returning a
+    narrower mesh than requested is exactly the opaque-placement
+    failure mode cross-width resume used to die with (a snapshot
+    taken on a wider mesh restores fine on a narrower host; the mesh
+    just has to SAY it is narrower — ``docs/Distributed.md``)."""
     import jax
-    devices = jax.devices()[:num_shards]
-    return jax.sharding.Mesh(np.asarray(devices), (AXIS_NAME,))
+    devices = jax.devices()
+    if len(devices) < num_shards:
+        raise ValueError(
+            f"requested a {num_shards}-shard mesh but only "
+            f"{len(devices)} device(s) are visible — pass the real "
+            f"device count (resume re-shards checkpointed state to "
+            f"any width automatically; see docs/Distributed.md)")
+    return jax.sharding.Mesh(np.asarray(devices[:num_shards]),
+                             (AXIS_NAME,))
 
 
 def pad_rows_for(kind: str, num_shards: int, n: int, base: int = 1) -> int:

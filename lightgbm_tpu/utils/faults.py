@@ -51,6 +51,24 @@ point                  modes its call site interprets
                        step
 ``trainer.refit``      ``error`` — the continual refit pass raises
                        (retry from the last snapshot, then quarantine)
+``mesh.collective``    fired once per fused-block dispatch of a
+                       SHARDED super-step (``models/gbdt.py``):
+                       ``error`` — the dispatch raises the way XLA
+                       surfaces a dead peer (the elastic supervisor
+                       classifies it as shard loss and re-meshes);
+                       ``hang`` — the dispatch blocks the way a lost
+                       shard stalls the collective rendezvous (drives
+                       the collective-stall watchdog; blocks FOREVER
+                       when unsupervised — faithful to the real
+                       failure); ``sleep_<ms>`` — delays the dispatch
+``mesh.heartbeat``     ``suppress`` — elastic per-block heartbeats are
+                       dropped (a shard that stops reporting progress
+                       without dying; combined with a dispatch delay
+                       this trips the watchdog on a block that would
+                       have landed)
+``elastic.remesh``     ``error`` — one re-mesh attempt raises
+                       (recovery degrades to a narrower survivor set,
+                       bounded by ``elastic_min_shards``)
 =====================  =================================================
 
 A spec naming a point outside this table arms nothing — a typo'd
@@ -98,7 +116,8 @@ __all__ = ["InjectedFault", "FaultSpec", "KNOWN_POINTS", "configure",
 KNOWN_POINTS = frozenset({
     "ckpt.save", "watcher.validate", "watcher.canary", "serve.dispatch",
     "http.request", "fleet.spawn", "ingest.read", "ingest.validate",
-    "trainer.step", "trainer.refit",
+    "trainer.step", "trainer.refit", "mesh.collective",
+    "mesh.heartbeat", "elastic.remesh",
 })
 
 

@@ -73,7 +73,8 @@ def percentile(sorted_vals, q: float) -> float:
 SCHEMA_VERSION = 1
 
 RECORD_TYPES = ("run_start", "iteration", "superstep", "eval", "predict",
-                "serve", "checkpoint", "fleet", "continual", "run_end")
+                "serve", "checkpoint", "fleet", "continual", "recovery",
+                "run_end")
 
 # per-type required fields on top of the common envelope; values are
 # (field, type-or-types) pairs the lint enforces
@@ -135,6 +136,18 @@ _TYPE_FIELDS: Dict[str, Tuple[Tuple[str, Any], ...]] = {
     # triage_run.py rolls up quarantine rate, stall restarts and
     # non-finite rewinds as anomalies.
     "continual": (("event", str),),
+    # one record per elastic-recovery event (parallel/elastic.py and
+    # the cross-width resume path, ckpt/manager.py): ``event`` is
+    # detect (a shard failure was classified: cause=hang|error +
+    # detail/iter/num_shards) | remesh (recovery rebuilt the mesh:
+    # from_shards/to_shards/iter/cause/duration_ms) | remesh_failed
+    # (one re-mesh attempt raised; recovery degrades further) |
+    # reshard (a checkpoint taken on one mesh topology restored onto
+    # another: from_shards/to_shards + learners) | escalate (recovery
+    # budget exhausted: reason=max_remesh|min_shards — the run fails
+    # loudly into the checkpoint restart story).  triage_run.py rolls
+    # these up and flags repeated re-meshes of one run as HIGH.
+    "recovery": (("event", str),),
     "run_end": (("summary", dict),),
 }
 
@@ -421,6 +434,16 @@ class RunRecorder:
                 self._agg["continual_batch_ms"] = round(
                     self._agg.get("continual_batch_ms", 0.0) +
                     float(rec.get("duration_ms", 0.0)), 3)
+        elif t == "recovery":
+            key = {
+                "detect": "recovery_detects",
+                "remesh": "recovery_remeshes",
+                "remesh_failed": "recovery_remesh_failures",
+                "reshard": "recovery_reshards",
+                "escalate": "recovery_escalations",
+            }.get(rec.get("event"))
+            if key:
+                self._agg[key] = self._agg.get(key, 0) + 1
         elif t == "predict":
             self._agg["predicts"] = self._agg.get("predicts", 0) + 1
             self._agg["predict_rows"] = \
@@ -500,6 +523,16 @@ class RunRecorder:
                     f"publishes, {s.get('fleet_skips', 0):.0f} skips, "
                     f"{s.get('fleet_rollbacks', 0):.0f} rollbacks, "
                     f"{s.get('fleet_restarts', 0):.0f} restarts")
+            if s.get("recovery_detects") or s.get("recovery_remeshes") \
+                    or s.get("recovery_reshards"):
+                parts.append(
+                    f"elastic: {s.get('recovery_detects', 0):.0f} "
+                    f"shard-failure detections, "
+                    f"{s.get('recovery_remeshes', 0):.0f} re-meshes, "
+                    f"{s.get('recovery_reshards', 0):.0f} resume "
+                    f"re-shards, "
+                    f"{s.get('recovery_escalations', 0):.0f} "
+                    f"escalations")
             if s.get("continual_batches") or s.get("continual_quarantines"):
                 parts.append(
                     f"continual: {s.get('continual_batches', 0):.0f} "

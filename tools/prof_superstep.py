@@ -16,11 +16,13 @@ issues ~5 device calls per iteration (gradients, bagging draw, build
 dispatch, score update, record fetch/pack).
 
 A SHARDED cell (``--shards``, default 8 virtual host devices on CPU)
-runs the data-parallel learner through the same fused scan and pins
-that its per-block device-call budget MATCHES the serial fused path —
-the single-program property `docs/Distributed.md` documents (the
-pre-refactor per-call path issued ~5 dispatches per shard per
-iteration, the WEAKSCALE.json degradation).
+runs the data-parallel learner through the same fused scan — UNDER
+the elastic shard-loss supervisor (``parallel/elastic.py``) — and
+pins that its per-block device-call budget MATCHES the serial fused
+path: the single-program property `docs/Distributed.md` documents
+(the pre-refactor per-call path issued ~5 dispatches per shard per
+iteration, the WEAKSCALE.json degradation), and the elastic
+heartbeat/watchdog detection riding it at zero extra device calls.
 
     JAX_PLATFORMS=cpu python tools/prof_superstep.py            # write
     JAX_PLATFORMS=cpu python tools/prof_superstep.py --stdout
@@ -39,7 +41,7 @@ OUT = os.path.join(ROOT, "BENCH_superstep_cpu.json")
 
 
 def measure(variants=(1, 4, 8), n_rows=5_000, n_feat=28, reps=6,
-            block=8, learner="serial", num_shards=0):
+            block=8, learner="serial", num_shards=0, elastic=False):
     """Interleaved A/B: one booster per ``fused_iters`` variant, then
     round-robin 8-iteration blocks across them — the same-process
     interleaving discipline docs/Benchmarks.md's protocol notes
@@ -70,19 +72,27 @@ def measure(variants=(1, 4, 8), n_rows=5_000, n_feat=28, reps=6,
         d = lgb.Dataset(X, label=y, params=params)
         d.construct()
         bst = lgb.Booster(params=params, train_set=d, mesh=mesh)
+        step = bst.update
+        if elastic and mesh is not None:
+            # the sharded cell runs under the elastic supervisor
+            # (parallel/elastic.py): the healthy-path budget pin below
+            # covers the SUPERVISED path — detection must cost zero
+            # device calls
+            from lightgbm_tpu.parallel import ElasticSupervisor
+            step = ElasticSupervisor(bst).update
         # warmup covers the XLA compiles: iteration 0 (unfused bias
         # iteration) plus the first whole fused block
         for _ in range(1 + max(k, 1)):
-            bst.update()
-        boosters[k] = bst
+            step()
+        boosters[k] = (bst, step)
     mins = {k: [] for k in variants}
     base_c = telemetry.counters_snapshot()
     for _ in range(reps):
         for k in variants:
-            bst = boosters[k]
+            _, step = boosters[k]
             t0 = time.time()
             for _ in range(block):
-                bst.update()
+                step()
             mins[k].append((time.time() - t0) / block)
     end_c = telemetry.counters_snapshot()
 
@@ -160,11 +170,12 @@ def main(argv=None):
     if D >= 2:
         sharded_cells, sharded_budget = measure(
             variants=(8,), n_rows=2_048 * D, n_feat=10, reps=args.reps,
-            learner="data", num_shards=D)
+            learner="data", num_shards=D, elastic=True)
         for c in sharded_cells:
             c["shape"] = (f"{2048 * D} x 10, data-parallel over "
-                          f"{D} shards")
+                          f"{D} shards, elastic-supervised")
         sharded_budget["num_shards"] = D
+        sharded_budget["supervised_elastic"] = True
         sharded_budget["matches_serial_fused"] = (
             sharded_budget["observed_fused_device_calls"] ==
             sharded_budget["expected_fused_device_calls"])

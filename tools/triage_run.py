@@ -90,16 +90,27 @@ def _superstep_warmups(records):
     load per segment also compile once (the mid-block alignment
     replay and the restore path run eager jnp ops), and those
     compiles land in the NEXT superstep's counter delta — that
-    superstep is exempt too."""
+    superstep is exempt too.  An elastic re-mesh (``recovery`` record,
+    event remesh/reshard — parallel/elastic.py) rebuilds the fused
+    scan for the survivor mesh: the next TWO superstep records are
+    exempt whatever their (k, learner, shards) key says — a recovery
+    back onto a width this run already trained at (transient loss, a
+    weak-scale grid that visited it) re-COMPILES even though the key
+    counter is past its allowance."""
     seen = {}
     ckpt_firsts = set()
     ckpt_pending = False
+    remesh_grace = 0
     for r in records:
         rtype = r.get("type")
         if rtype == "run_start":
             seen = {}
             ckpt_firsts = set()
             ckpt_pending = False
+            continue
+        if rtype == "recovery":
+            if r.get("event") in ("remesh", "reshard"):
+                remesh_grace = 2
             continue
         if rtype == "checkpoint":
             event = r.get("event")
@@ -113,8 +124,11 @@ def _superstep_warmups(records):
         key = (int(r.get("k", 1)), r.get("learner", ""), shards)
         n = seen.get(key, 0)
         seen[key] = n + 1
-        warm = n < (2 if shards > 1 else 1) or ckpt_pending
+        warm = (n < (2 if shards > 1 else 1) or ckpt_pending or
+                remesh_grace > 0)
         ckpt_pending = False
+        if remesh_grace > 0:
+            remesh_grace -= 1
         yield r, warm
 
 
@@ -294,6 +308,41 @@ def scan_anomalies(records):
             out.append(("MED", f"{len(errors)} watcher error(s); "
                                f"last: "
                                f"{str(errors[-1].get('error', '?'))[:140]}"))
+    recov = [r for r in records if r.get("type") == "recovery"]
+    if recov:
+        remeshes = [r for r in recov if r.get("event") == "remesh"]
+        if len(remeshes) >= 2:
+            path = " -> ".join(
+                [str(remeshes[0].get("from_shards", "?"))] +
+                [str(r.get("to_shards", "?")) for r in remeshes])
+            out.append(("HIGH", f"repeated re-mesh: {len(remeshes)} "
+                                f"shard-loss recoveries in ONE run "
+                                f"({path} shards) — the fleet is "
+                                f"shedding shards faster than one "
+                                f"preemption; check the slice health "
+                                f"before trusting the wall clock"))
+        elif remeshes:
+            r = remeshes[-1]
+            out.append(("MED", f"elastic re-mesh: "
+                               f"{r.get('from_shards', '?')} -> "
+                               f"{r.get('to_shards', '?')} shards at "
+                               f"iteration {r.get('iter', '?')} "
+                               f"({r.get('cause', '?')}) — training "
+                               f"continued bit-exactly on the "
+                               f"survivors"))
+        escal = [r for r in recov if r.get("event") == "escalate"]
+        if escal:
+            out.append(("HIGH", f"elastic recovery ESCALATED "
+                                f"({escal[-1].get('reason', '?')}) — "
+                                f"the run failed loudly into the "
+                                f"checkpoint restart story"))
+        failed = [r for r in recov
+                  if r.get("event") == "remesh_failed"]
+        if failed:
+            out.append(("MED", f"{len(failed)} re-mesh attempt(s) "
+                               f"failed and recovery degraded to a "
+                               f"narrower mesh; last: "
+                               f"{str(failed[-1].get('error', '?'))[:120]}"))
     cont = [r for r in records if r.get("type") == "continual"]
     if cont:
         batches = [r for r in cont if r.get("event") == "batch"]
@@ -447,6 +496,27 @@ def triage(records, baseline=None):
                 f"{s.get('ckpt_loads', 0):.0f} loads "
                 f"({s.get('ckpt_load_ms', 0.0):.0f} ms), "
                 f"{s.get('ckpt_fallbacks', 0):.0f} fallbacks")
+        if any(s.get(k) for k in ("recovery_detects",
+                                  "recovery_remeshes",
+                                  "recovery_reshards",
+                                  "recovery_escalations")):
+            remesh_recs = [r for r in records
+                           if r.get("type") == "recovery" and
+                           r.get("event") == "remesh"]
+            path = ""
+            if remesh_recs:
+                path = (" (" + " -> ".join(
+                    [str(remesh_recs[0].get("from_shards", "?"))] +
+                    [str(r.get("to_shards", "?"))
+                     for r in remesh_recs]) + " shards)")
+            lines.append(
+                f"elastic     : "
+                f"{s.get('recovery_detects', 0):.0f} shard-failure "
+                f"detections, {s.get('recovery_remeshes', 0):.0f} "
+                f"re-meshes{path}, "
+                f"{s.get('recovery_reshards', 0):.0f} resume "
+                f"re-shards, {s.get('recovery_escalations', 0):.0f} "
+                f"escalations")
         if any(s.get(k) for k in ("fleet_publishes", "fleet_skips",
                                   "fleet_rollbacks", "fleet_restarts",
                                   "fleet_replica_starts",
