@@ -153,7 +153,10 @@ class CheckpointManager:
              eval_history: Optional[List] = None) -> str:
         """Write one checkpoint aligned to the booster's last COMPLETED
         iteration (mid-fused-block state is aligned by the snapshot
-        capture).  Returns the finalized checkpoint path."""
+        capture, WITHOUT disturbing the block being served or any
+        async-pipelined blocks still in flight — training keeps
+        serving from them after the save; restore is what discards
+        the queue).  Returns the finalized checkpoint path."""
         from . import state as state_mod
         t0 = time.perf_counter()
         fault = atomic.fault_armed()

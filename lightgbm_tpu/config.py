@@ -463,8 +463,44 @@ PARAMS: List[Param] = [
        "the shrinkage mid-block triggers an exact rewind + "
        "redispatch — correct, but it rebuilds the block every "
        "iteration and negates the fusion win; prefer a constant "
-       "learning_rate with fused_iters",
+       "learning_rate with fused_iters.  Combine with "
+       "superstep_pipeline_depth to also hide the one per-block "
+       "device->host record fetch behind the next block's dispatch",
        group="device", check=">=1"),
+    _p("superstep_pipeline_depth", 1, int, ("pipeline_depth",),
+       "fused super-step blocks kept IN FLIGHT beyond the one being "
+       "served (fused_iters > 1 only): block K+1 is dispatched BEFORE "
+       "block K's stacked split records are fetched, so the one "
+       "device->host round-trip per block hides behind the next "
+       "block's device compute instead of stalling the loop (the r04 "
+       "phase profile showed that fetch at 734.5 ms/iter vs ~4 ms for "
+       "everything else).  The healthy-path device-call budget stays "
+       "2 per K-block at any depth (pinned by tools/prof_superstep.py"
+       "'s pipelined cell) and training remains BIT-exact with depth "
+       "0: the in-flight queue drains exactly at the boundaries that "
+       "already force one (the no-split stop probe, a mid-block "
+       "checkpoint alignment, a learning-rate change, the preempt "
+       "flag, a numerical-health trip, elastic rewind/re-mesh), with "
+       "each queued block's dispatch fence restoring the host-RNG and "
+       "quantization-stream draws it consumed.  0 disables (dispatch "
+       "then fetch, the pre-pipelining behavior); engine.train "
+       "auto-disables it under a learning_rates schedule (every "
+       "pre-dispatched block would be rebuilt).  Per-block telemetry: "
+       "fetch_overlap_s / pipeline_depth on superstep records; "
+       "triage_run.py flags overlap ~ 0 at depth > 0 as pipelining "
+       "silently disabled", group="device", check=">=0"),
+    _p("predict_device_handoff", True, bool, ("device_handoff",),
+       "serve same-process predict/serve/publish straight from the "
+       "training-side packed per-tree tables: each tree's flat "
+       "predictor row (ops/predict.py) is extracted ONCE when the "
+       "tree materializes from the training fetch, and "
+       "flatten_forest_device assembles the engine's SoA tables from "
+       "those cached rows — zero full-forest host repacks at the "
+       "train->predict seam (counter flatten_full_repacks stays 0 "
+       "in-process; flatten_device_handoffs counts the fast path), "
+       "byte-identical to the cold-load flatten_forest path (pinned "
+       "by tests/test_pipeline.py).  false = always rebuild via "
+       "flatten_forest (the model-file/cold-load path)", group="io"),
     # ---- serve (online serving subsystem, lightgbm_tpu/serve/) ----
     _p("serve_host", "127.0.0.1", str, (),
        "bind address of the task=serve HTTP endpoint", group="serve"),

@@ -314,6 +314,16 @@ def train(params: Dict[str, Any], train_set: Dataset,
     booster._gbdt.config.num_iterations = num_boost_round \
         if (loaded_ckpt is not None or init_model is None) \
         else booster._gbdt.iter + num_boost_round
+    if learning_rates is not None and \
+            int(getattr(cfg, "superstep_pipeline_depth", 0) or 0) > 0:
+        # a per-iteration learning_rates schedule changes the
+        # shrinkage between serves: every pre-dispatched in-flight
+        # block would be built at a stale rate and drained on arrival
+        # (correct, but pure wasted device work every block) — run
+        # the fused path unpipelined instead.  The booster-level
+        # drain stays as the correctness backstop for schedules
+        # applied through raw callbacks.
+        booster._gbdt.config.superstep_pipeline_depth = 0
     # ---- elastic shard-loss recovery (parallel/elastic.py) -----------
     # supervises the mesh-sharded fused path: each fused-block
     # dispatch runs under the collective-stall watchdog; a failed or

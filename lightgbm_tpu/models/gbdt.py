@@ -80,9 +80,13 @@ class GBDT:
         """Drop the flattened-forest cache (ops/predict.py).  Appends
         and pops are covered by the tree-count in the cache key; this
         hook is for IN-PLACE mutations of existing trees — DART
-        renormalization, refit, merge splices, model-list swaps."""
+        renormalization, refit, merge splices, model-list swaps.  The
+        per-tree handoff rows (``_tree_flats``) are cleared too: an
+        in-place mutation invalidates the extracted row, and the
+        device-handoff path re-extracts lazily."""
         self._model_version = getattr(self, "_model_version", 0) + 1
         self._flat_cache = None
+        self._tree_flats = []
 
     def __init__(self, config: Config, train_set: TpuDataset,
                  objective: Optional[Objective],
@@ -100,6 +104,7 @@ class GBDT:
         self._models: List[Tree] = []
         self._model_version = 0
         self._flat_cache = None     # (key, FlatForest) — ops/predict.py
+        self._tree_flats = []       # per-tree handoff rows (TreeFlat)
         self._pending = None        # in-flight tree (pipelined boosting)
         self._stop_flag = False
         self._pipeline_enabled = True  # DART/RF opt out
@@ -107,7 +112,8 @@ class GBDT:
         # jitted lax.scan runs K iterations on device; the block state
         # below serves its trees one per train_one_iter call
         self._superstep_enabled = True  # DART/RF opt out
-        self._fused_block = None        # in-flight super-step block
+        self._fused_block = None        # fetched block being served
+        self._sq = []                   # dispatched-but-unfetched blocks
         self._superstep_jit = None      # lazily-built jitted scan
         self._fused_has_bagging = False
         self._trees_dispatched = 0  # quantization PRNG stream position
@@ -988,10 +994,14 @@ class GBDT:
             (final_sc, final_bag), (recs, leaf_idx_k, vals_k) = \
                 jax.lax.scan(step, (score, bag0),
                              (iters, fmasks, tree_ids))
-            # returning the donated input forces XLA to copy the
-            # block-start score out — the rewind/rollback anchor at no
-            # extra dispatch
-            return score, final_sc, final_bag, recs, leaf_idx_k, vals_k
+            # returning the donated inputs forces XLA to copy the
+            # block-start score AND bagging mask out — the
+            # rewind/rollback anchor at no extra dispatch, and (under
+            # async pipelining) the un-donated value the PREVIOUS
+            # block's commit reads after ITS outputs were donated to
+            # this dispatch
+            return (score, bag0, final_sc, final_bag, recs, leaf_idx_k,
+                    vals_k)
 
         if dist is not None:
             from jax.sharding import PartitionSpec as P
@@ -1018,7 +1028,7 @@ class GBDT:
             li_spec = P(None, ax_name) if rows_sharded else R
             superstep = shard_map_compat(superstep, dist.mesh,
                                          in_specs=in_specs,
-                                         out_specs=(R, R, R, R,
+                                         out_specs=(R, R, R, R, R,
                                                     li_spec, R))
 
         # carry donation frees both N-sized buffers for in-place reuse
@@ -1026,42 +1036,61 @@ class GBDT:
         donate = (0, 1) if jax.default_backend() not in ("cpu",) else ()
         return jax.jit(superstep, donate_argnums=donate)
 
-    def _train_superstep(self) -> bool:
-        """Dispatch one fused super-step (the K' trees materialize
-        from a single stacked fetch) and serve its first tree."""
+    def _pipeline_depth(self) -> int:
+        """Extra fused blocks kept in flight beyond the one being
+        landed (``superstep_pipeline_depth``); 0 = dispatch-then-fetch
+        (the pre-pipelining behavior)."""
+        return max(int(getattr(self.config, "superstep_pipeline_depth",
+                               0) or 0), 0)
+
+    def _next_dispatch_iter(self) -> int:
+        """First iteration of the next block to dispatch: the frontier
+        of the in-flight queue, or the served boundary when nothing is
+        outstanding."""
+        if self._sq:
+            last = self._sq[-1]
+            return last["i0"] + last["k"]
+        return self.iter
+
+    def _dispatch_superstep_block(self, elastic_alive,
+                                  required: bool) -> bool:
+        """Dispatch ONE fused block at the queue frontier and append
+        it to the in-flight queue (dispatched, unfetched).  Returns
+        False without dispatching when the frontier is at/past the
+        ``num_iterations`` horizon and the block is speculative
+        (``required=False``) — the pipeline never wastes device work
+        past the end of training."""
+        import time as _time
+
         import jax
         import jax.numpy as jnp
         from ..utils import telemetry as _telemetry
         from ..utils.profiling import timed
 
-        self._flush_pending()
-        if self._stop_flag:
-            return True
         cfg = self.config
+        i0 = self._next_dispatch_iter()
         K = int(cfg.fused_iters)
-        remaining = cfg.num_iterations - self.iter
+        remaining = cfg.num_iterations - i0
+        if remaining <= 0 and not required:
+            return False
         if 0 < remaining < K:
             # auto-size the tail block down to the num_iterations
             # boundary (shorter scan -> one extra XLA compile there,
             # which triage_run treats as per-shape warmup)
             K = remaining
-        i0 = self.iter
-        rng_state = self._rng_feature.get_state()
         # elastic dispatch fence: the ONLY host state a fused dispatch
         # consumes before its fetch lands is the feature-fraction RNG
         # stream and the quantization-stream position — when the
         # dispatch is abandoned (hung collective) or dies (shard
         # loss), abort_inflight_dispatch restores exactly these
-        # (parallel/elastic.py recovery path)
-        self._dispatch_fence = {"rng_state": rng_state,
-                                "tid": self._trees_dispatched}
-        # THIS attempt's generation token, captured before any device
-        # work: a later retry overwrites the attribute with its own
-        # token, and an abandoned zombie checking the shared attribute
-        # instead of its captured one would see the RETRY's (alive)
-        # token and commit phantom state
-        elastic_alive = getattr(self, "_elastic_alive", None)
-        self._elastic_beat()
+        # (parallel/elastic.py recovery path).  With blocks in flight
+        # the LIVE fence is always the OLDEST outstanding dispatch's
+        # pre-state: restoring it rewinds across EVERY queued block's
+        # RNG/quantization-stream consumption in one step.
+        fence = {"rng_state": self._rng_feature.get_state(),
+                 "tid": self._trees_dispatched}
+        if self.__dict__.get("_dispatch_fence") is None:
+            self._dispatch_fence = fence
         with timed("superstep/dispatch"):
             # host feature-fraction draws consumed in sequential order
             fmasks = jnp.stack([self._feature_fraction_mask()
@@ -1070,17 +1099,28 @@ class GBDT:
             tree_ids = jnp.arange(self._trees_dispatched,
                                   self._trees_dispatched + K,
                                   dtype=jnp.int32)
+            self._trees_dispatched += K
             if self._superstep_jit is None:
                 self._superstep_jit = self._build_superstep_fn()
-            bag0 = getattr(self, "_cached_bag", None)
-            if bag0 is None:
-                # ALL-ONES sentinel: with no cached mask the sequential
-                # path trains UNBAGGED until the next bagging_freq
-                # boundary (continue-training starts mid-cycle), and a
-                # unit weight vector is bit-identical to "no mask"
-                # (x*1.0 == x); a zeros sentinel would silently zero
-                # every gradient until the first in-block draw
-                bag0 = jnp.ones(self.num_data, jnp.float32)
+            if self._sq:
+                # chain on the in-flight predecessor's device futures:
+                # the score/bag carries never touch the host between
+                # blocks, and this dispatch goes out BEFORE the
+                # predecessor's fetch
+                prev = self._sq[-1]["outs"]
+                score0, bag0 = prev[2], prev[3]
+            else:
+                score0 = self._score
+                bag0 = getattr(self, "_cached_bag", None)
+                if bag0 is None:
+                    # ALL-ONES sentinel: with no cached mask the
+                    # sequential path trains UNBAGGED until the next
+                    # bagging_freq boundary (continue-training starts
+                    # mid-cycle), and a unit weight vector is
+                    # bit-identical to "no mask" (x*1.0 == x); a zeros
+                    # sentinel would silently zero every gradient
+                    # until the first in-block draw
+                    bag0 = jnp.ones(self.num_data, jnp.float32)
             qk = self._quant_key if self._quant_key is not None \
                 else jax.random.PRNGKey(0)
             _telemetry.counters.incr("superstep_dispatches")
@@ -1094,40 +1134,131 @@ class GBDT:
                     self._mesh_collective_fault(fault_mode,
                                                 elastic_alive)
             outs = self._superstep_jit(
-                self._score, bag0, jnp.float32(self.shrinkage_rate), qk,
+                score0, bag0, jnp.float32(self.shrinkage_rate), qk,
                 self._xt, self._base_mask, self._num_bins,
                 self._missing_type, self._is_cat, iters, fmasks,
                 tree_ids)
         # an abandoned attempt (elastic stall watchdog moved on and a
         # re-mesh owns ``self`` now) must not commit ANY state — the
-        # checks bracket the only other device interaction, the fetch
+        # checks bracket every device interaction
         self._abandoned_check(elastic_alive)
-        (start_score, final_score, final_bag, recs, leaf_idx_k,
-         vals_k) = outs
+        self._sq.append({"outs": outs, "i0": i0, "k": K,
+                         "fence": fence, "lr": self.shrinkage_rate,
+                         "t_dispatch": _time.perf_counter()})
+        return True
+
+    def _discard_queue(self) -> None:
+        """Drop every dispatched-but-unfetched block and restore the
+        host state their dispatches consumed (feature-fraction RNG
+        draws, quantization-stream positions) — the pipelined half of
+        the dispatch-fence contract.  The drain points are exactly
+        the boundaries that already force one: the no-split stop, a
+        learning-rate change, eligibility drift, rollback/rewind,
+        a numerical-health trip, elastic abort/re-mesh."""
+        if not self._sq:
+            return
+        first = self._sq[0]
+        self._sq = []
+        self._rng_feature.set_state(first["fence"]["rng_state"])
+        self._trees_dispatched = int(first["fence"]["tid"])
+        self.__dict__.pop("_dispatch_fence", None)
+
+    def _recompute_bag_cache(self) -> None:
+        """Rebuild the bernoulli/stratified bagging-mask cache from
+        its defining PRNG fold at the CURRENT iteration — the one
+        recipe shared by the fused-rewind restore and the pipeline
+        drain (a drained queue may have donated the cached device
+        buffer to an abandoned dispatch)."""
+        cfg = self.config
+        if not (self._fused_has_bagging and
+                type(self)._bagging_mask is GBDT._bagging_mask):
+            return
+        it = self.iter
+        if it > 0:
+            last_draw = (it - 1) // cfg.bagging_freq * cfg.bagging_freq
+            self._cached_bag = self._draw_bag_mask(last_draw)
+        else:
+            self.__dict__.pop("_cached_bag", None)
+
+    def _train_superstep(self) -> bool:
+        """One fused-super-step update: top up the in-flight dispatch
+        queue (block K+1 goes out BEFORE block K's stacked records are
+        fetched, so the one device->host round-trip per block hides
+        behind the next block's device compute), then land the oldest
+        block and serve its first tree.  The healthy-path device-call
+        budget stays 2 per K-block at any pipeline depth — pipelining
+        reorders the same dispatch+fetch pair, it never adds calls."""
+        self._flush_pending()
+        if self._stop_flag:
+            return True
+        # THIS attempt's generation token, captured before any device
+        # work: a later retry overwrites the attribute with its own
+        # token, and an abandoned zombie checking the shared attribute
+        # instead of its captured one would see the RETRY's (alive)
+        # token and commit phantom state
+        elastic_alive = getattr(self, "_elastic_alive", None)
+        self._elastic_beat()
+        if self._sq and self._sq[0]["lr"] != self.shrinkage_rate:
+            # a learning_rates schedule changed the shrinkage since
+            # the queued blocks were dispatched: they were built at
+            # the old rate — drain and redispatch at the new one
+            # (BEFORE topping up, so no fresh block chains onto a
+            # stale carry)
+            self._discard_queue()
+        target = 1 + self._pipeline_depth()
+        while len(self._sq) < target:
+            if not self._dispatch_superstep_block(
+                    elastic_alive, required=not self._sq):
+                break
+        return self._land_superstep_block(elastic_alive)
+
+    def _land_superstep_block(self, elastic_alive) -> bool:
+        """Fetch + materialize the OLDEST in-flight block (the K'
+        trees materialize from a single stacked fetch) and serve its
+        first tree."""
+        import time as _time
+
+        from ..utils import telemetry as _telemetry
+        from ..utils.profiling import timed
+
+        entry = self._sq.pop(0)
+        K = entry["k"]
+        i0 = entry["i0"]
+        rng_state = entry["fence"]["rng_state"]
+        start_tid = int(entry["fence"]["tid"])
+        t_fetch0 = _time.perf_counter()
         with timed("superstep/fetch"):
             # the block's ONE device->host transfer (packed f32)
             _telemetry.counters.incr("superstep_fetches")
-            host = self._fetch_records(recs)
+            host = self._fetch_records(entry["outs"][4])
         self._abandoned_check(elastic_alive)
-        self.__dict__.pop("_dispatch_fence", None)
+        # the live fence moves to the next outstanding dispatch (or
+        # clears): this block is fetched, its state commits below
+        if self._sq:
+            self._dispatch_fence = self._sq[0]["fence"]
+        else:
+            self.__dict__.pop("_dispatch_fence", None)
         # per-block heartbeat: rides the block bookkeeping the
         # superstep telemetry record is assembled from — zero extra
         # device calls (parallel/elastic.py)
         self._elastic_beat(block=True)
-        start_tid = self._trees_dispatched
-        self._trees_dispatched += K
+        (start_score, _start_bag, final_score, final_bag, _recs,
+         leaf_idx_k, vals_k) = entry["outs"]
         bad = np.asarray(host.pop("nonfinite", np.zeros(K)), bool)
         if np.any(bad):
             # the per-iteration health flag tripped: rewind to the
-            # served boundary (nothing from this block was served or
-            # applied to the score — only the dispatch bookkeeping
-            # moved) and fail loudly instead of serving a NaN model.
-            # A finite stop tree BEFORE the first bad iteration wins:
-            # post-stop scan iterations are phantom state the replay
-            # discards anyway.
+            # served boundary (nothing from this block — or the
+            # queued blocks chained on it — was served or applied to
+            # the score; only dispatch bookkeeping moved) and fail
+            # loudly instead of serving a NaN model.  A finite stop
+            # tree BEFORE the first bad iteration wins: post-stop
+            # scan iterations are phantom state the replay discards
+            # anyway.
             j = int(np.argmax(bad))
             stops = np.nonzero(np.asarray(host["n_leaves"])[:K] <= 1)[0]
             if stops.size == 0 or j <= int(stops[0]):
+                self._sq = []
+                self.__dict__.pop("_dispatch_fence", None)
                 self._trees_dispatched = start_tid
                 self._rng_feature.set_state(rng_state)
                 from ..utils.health import abort_nonfinite
@@ -1148,7 +1279,7 @@ class GBDT:
                     break
                 rec_t = {k: v[t] for k, v in host.items()}
                 tree = self._records_to_tree(rec_t)
-                tree.apply_shrinkage(self.shrinkage_rate)
+                tree.apply_shrinkage(entry["lr"])
                 trees.append(tree)
         if "n_arm_passes" in host:
             passes = host["n_arm_passes"][:len(trees)]
@@ -1164,22 +1295,43 @@ class GBDT:
             # the shrinkage the block's trees were built with: a
             # learning_rates schedule (reset_parameter callback)
             # changing it mid-block invalidates the unserved trees
-            "lr": self.shrinkage_rate,
+            "lr": entry["lr"],
         }
         if stop_idx is None:
-            self._score = final_score
-            if self._fused_has_bagging:
-                self._cached_bag = final_bag
+            if self._sq:
+                # this block's own final score/bag buffers were
+                # DONATED to the next queued dispatch; commit the
+                # bit-identical copies that dispatch returned of its
+                # inputs instead
+                self._score = self._sq[0]["outs"][0]
+                if self._fused_has_bagging:
+                    self._cached_bag = self._sq[0]["outs"][1]
+            else:
+                self._score = final_score
+                if self._fused_has_bagging:
+                    self._cached_bag = final_bag
         else:
             # the scan has no early exit: iterations AFTER the stop
             # tree still ran, and under bagging their fresh draws can
             # even split — those phantom contributions (and the
             # post-stop bagging mask) must not leak into the
-            # model-consistent state.  Replay the pre-stop prefix
+            # model-consistent state.  Queued successor blocks are
+            # phantom state wholesale: discard them (restoring their
+            # consumed RNG draws), then replay the pre-stop prefix
             # (the stop tree itself contributes 0).
+            self._discard_queue()
             self._score, _ = self._fused_replay_score(stop_idx)
-        # superstep telemetry marker (consumed by train_one_iter)
-        self._tele_superstep = {"k": K, "hist_passes": hist_passes}
+        # superstep telemetry marker (consumed by train_one_iter).
+        # fetch_overlap_s: wall between this block's dispatch and its
+        # fetch — the window its device compute overlapped host work
+        # (serving the previous block, materializing its trees,
+        # dispatching the successor).  ~0 at depth 0 by construction.
+        self._tele_superstep = {
+            "k": K, "hist_passes": hist_passes,
+            "pipeline_depth": self._pipeline_depth(),
+            "fetch_overlap_s": round(
+                max(t_fetch0 - entry["t_dispatch"], 0.0), 6),
+        }
         if self._dist is not None:
             # per-block collective accounting for the sharded scan:
             # static per-pass estimate x passes in the block, plus the
@@ -1260,21 +1412,14 @@ class GBDT:
         self._rng_feature.set_state(blk["rng_state"])
         for _ in range(pos):
             self._feature_fraction_mask()
-        cfg = self.config
-        if self._fused_has_bagging and \
-                type(self)._bagging_mask is GBDT._bagging_mask:
-            it = self.iter
-            if it > 0:
-                last_draw = (it - 1) // cfg.bagging_freq * \
-                    cfg.bagging_freq
-                self._cached_bag = self._draw_bag_mask(last_draw)
-            else:
-                self.__dict__.pop("_cached_bag", None)
+        self._recompute_bag_cache()
 
     def _fused_rewind(self) -> None:
-        """Discard the block's unserved trees and land on the served
-        boundary — the escape hatch when eligibility drifts mid-block
-        (a validation set attached, a custom-gradient call)."""
+        """Discard the block's unserved trees (and every queued
+        in-flight successor) and land on the served boundary — the
+        escape hatch when eligibility drifts mid-block (a validation
+        set attached, a custom-gradient call)."""
+        self._discard_queue()
         blk = self._fused_block
         if blk is None:
             return
@@ -1283,6 +1428,7 @@ class GBDT:
 
     def _fused_rollback(self) -> None:
         """Undo the last served iteration of the in-flight block."""
+        self._discard_queue()
         blk = self._fused_block
         self._stop_flag = False
         self._invalidate_predictor()
@@ -1350,12 +1496,17 @@ class GBDT:
             _time.sleep(float(mode[len("sleep_"):]) / 1e3)
 
     def abort_inflight_dispatch(self) -> bool:
-        """Restore the pre-block host state an in-flight fused
-        dispatch consumed when that dispatch will never land (hung or
-        failed collective): the feature-fraction RNG stream and the
+        """Restore the pre-block host state the in-flight fused
+        dispatches consumed when they will never land (hung or failed
+        collective): the feature-fraction RNG stream and the
         quantization-stream position are the only mutations between
-        dispatch and fetch.  Returns True when a fence was armed."""
+        dispatch and fetch.  Under async pipelining MORE THAN ONE
+        block can be outstanding; the live fence is the OLDEST
+        dispatch's pre-state, so one restore rewinds across BOTH (all)
+        blocks' RNG/quantization-stream consumption, and every queued
+        block dies with it.  Returns True when a fence was armed."""
         fence = self.__dict__.pop("_dispatch_fence", None)
+        self._sq = []
         if fence is None:
             return False
         self._rng_feature.set_state(fence["rng_state"])
@@ -1514,11 +1665,13 @@ class GBDT:
         pending, self._pending = self._pending, None
         rec = pending["rec"]
         if os.environ.get("LTPU_SPLIT_FETCH_TIMER"):
+            from ..utils.device import build_barrier
             from ..utils.profiling import timed
             with timed("tree/device_wait"):
-                # 1-element fetch: blocks until the build completed
-                # (block_until_ready is unreliable on the axon tunnel)
-                np.asarray(rec["n_leaves"])
+                # build barrier: jax.block_until_ready where the
+                # backend honors it; LTPU_SYNC_FETCH=1 falls back to
+                # the 1-element fetch (remote-tunnel runtimes)
+                build_barrier(rec["n_leaves"])
         recs = self._fetch_records(rec)
         if "n_arm_passes" in recs:
             self.last_arm_passes = int(recs["n_arm_passes"])
@@ -1704,6 +1857,14 @@ class GBDT:
             }
             if ss.get("hist_passes") is not None:
                 fields["hist_passes"] = int(ss["hist_passes"])
+            # async pipelining observability: the configured in-flight
+            # depth and the wall this block's device compute ran
+            # overlapped with host work (dispatch -> fetch window).
+            # triage_run.py flags depth > 0 with ~zero overlap as
+            # "pipelining silently disabled"
+            fields["pipeline_depth"] = int(ss.get("pipeline_depth", 0))
+            fields["fetch_overlap_s"] = float(
+                ss.get("fetch_overlap_s", 0.0))
             # sharded super-step: per-block collective accounting +
             # mesh identity (the weak-scaling triage reads these —
             # per-iteration time growing with num_shards at constant
@@ -1786,6 +1947,13 @@ class GBDT:
                 self._fused_rewind()
             elif not fused:
                 self._fused_block = None  # rollback window closed
+                if self._sq:
+                    # fused mode just disengaged with blocks still in
+                    # flight: drain them (restoring their consumed RNG
+                    # draws) and rebuild the bagging cache the drained
+                    # chain may have donated away
+                    self._discard_queue()
+                    self._recompute_bag_cache()
         if fused and not self._fused_bias_pending():
             return self._train_superstep()
         if grad is None and self._pipeline_ok():
@@ -2093,8 +2261,19 @@ class GBDT:
             _ = self.models            # flush any pipelined tree
             score = self._score
             it = self.iter
-            tid = self._trees_dispatched
-            rng_state = self._rng_feature.get_state()
+            if self._sq:
+                # block boundary with successor blocks dispatched but
+                # unfetched: the LIVE stream positions include their
+                # consumed feature-fraction draws and quantization
+                # tids — model-consistent state is the OLDEST queued
+                # dispatch's pre-state (exactly the fence an abort
+                # would restore; the resumed run redispatches those
+                # blocks itself)
+                tid = int(self._sq[0]["fence"]["tid"])
+                rng_state = self._sq[0]["fence"]["rng_state"]
+            else:
+                tid = self._trees_dispatched
+                rng_state = self._rng_feature.get_state()
         return {
             "iter": int(it),
             "trees_dispatched": int(tid),
@@ -2127,6 +2306,8 @@ class GBDT:
         DART renormalization) are overwritten from the snapshot."""
         import jax.numpy as jnp
         self._fused_block = None
+        self._sq = []
+        self.__dict__.pop("_dispatch_fence", None)
         self._pending = None
         self._stop_flag = bool(snap.get("stopped", False))
         self.models = list(snap["models"])   # setter bumps the predictor
@@ -2274,13 +2455,30 @@ class GBDT:
         """Flattened SoA forest tables (ops/predict.py), cached until
         the model mutates — appends/pops change the tree count in the
         key, in-place tree mutations bump ``_model_version`` via
-        :meth:`_invalidate_predictor`."""
-        from ..ops.predict import flatten_forest
+        :meth:`_invalidate_predictor`.
+
+        Same-process train->predict takes the DEVICE-HANDOFF path
+        (``predict_device_handoff``, default on): per-tree flat rows
+        are extracted once as trees materialize from the training
+        fetch and only the delta since the last handoff is walked —
+        zero full-forest host repacks at the train->serve seam
+        (``flatten_full_repacks`` telemetry counter stays 0;
+        byte-identical to :func:`~..ops.predict.flatten_forest`,
+        pinned by tests/test_pipeline.py).  Cold loads (model file,
+        handoff disabled) keep the numpy full-repack path."""
+        from ..ops.predict import flatten_forest, flatten_forest_device
         models = self.models            # flushes any pending tree
         key = (self._model_version, len(models))
         if self._flat_cache is None or self._flat_cache[0] != key:
-            self._flat_cache = (key, flatten_forest(
-                models, self.num_tree_per_iteration))
+            if (bool(getattr(self.config, "predict_device_handoff",
+                             True)) and self.train_set is not None):
+                flat = flatten_forest_device(
+                    models, self.num_tree_per_iteration,
+                    self._tree_flats)
+            else:
+                flat = flatten_forest(models,
+                                      self.num_tree_per_iteration)
+            self._flat_cache = (key, flat)
         return self._flat_cache[1]
 
     def predict_raw(self, X: np.ndarray, num_iteration: int = -1,

@@ -218,33 +218,105 @@ def _range_masks(lo, hi, W: int, wbits: int) -> np.ndarray:
     return inv.view(np.int64).reshape(-1, W)
 
 
-def flatten_forest(models: List, num_tree_per_iteration: int = 1
-                   ) -> FlatForest:
-    """Pack ``models`` (a list of :class:`~..models.tree.Tree`) into
-    SoA device-ready tables."""
+@dataclasses.dataclass
+class TreeFlat:
+    """ONE tree's flattened predictor row, unpadded — the per-tree
+    half of :func:`flatten_forest`, split out so the train->predict
+    handoff (:func:`flatten_forest_device`) can extract it once per
+    tree as trees materialize from the training fetch and never pay a
+    full-forest repack.  Forest-level padding, the QuickScorer range
+    masks (which need the forest-wide word width) and the compacted
+    x-matrix row remap happen at assembly (:func:`assemble_forest`)."""
+    num_leaves: int
+    vals: np.ndarray          # (L,) f64 leaf values in DFS order
+    leaf_orig: np.ndarray     # (L,) i32 DFS position -> model leaf id
+    ni: int                   # internal nodes with real slots (0: stump)
+    var: np.ndarray           # (ni,) i64 x-matrix variant per node
+    feats: np.ndarray         # (ni,) i64 split feature per node
+    thrs: np.ndarray          # (ni,) f64 numeric thresholds
+    is_cat: np.ndarray        # (ni,) bool categorical-node flags
+    lo: np.ndarray            # (ni,) i64 DFS left-subtree ranges
+    hi: np.ndarray
+    cat_nodes: np.ndarray     # (nc,) i64 node index of each cat node
+    cat_words: List[np.ndarray]   # per cat node: packed u64 bitset
+    max_feature: int          # 1 + max feature id referenced (min 1)
+    # per-(W, wbits) memo of the materialized QuickScorer range masks:
+    # repeated handoffs (a serve loop publishing after every block)
+    # re-assemble the forest with unchanged layout statics, and the
+    # mask build is the per-tree assembly cost worth skipping
+    _masks: Dict = dataclasses.field(default_factory=dict, repr=False)
+
+    def node_masks(self, W: int, wbits: int) -> np.ndarray:
+        hit = self._masks.get((W, wbits))
+        if hit is None:
+            hit = _range_masks(self.lo, self.hi, W, wbits)
+            self._masks.clear()     # layouts change monotonically
+            self._masks[(W, wbits)] = hit
+        return hit
+
+
+def flatten_one_tree(t) -> TreeFlat:
+    """Extract one tree's :class:`TreeFlat` (the host-side per-tree
+    walk: DFS layout + staged node columns).  Pure function of the
+    tree — safe to run at materialization time, concurrent with the
+    next block's device compute."""
     from ..models.tree import _CAT_MASK, _DEFAULT_LEFT_MASK
 
-    T = len(models)
+    order, lo, hi = _dfs_layout(t)
+    vals = np.asarray(t.leaf_value[order], np.float64)
+    leaf_orig = np.asarray(order, np.int32)
+    empty64 = np.zeros(0, np.int64)
+    if t.num_leaves <= 1:
+        return TreeFlat(max(t.num_leaves, 1), vals, leaf_orig, 0,
+                        empty64, empty64, np.zeros(0, np.float64),
+                        np.zeros(0, bool), empty64, empty64, empty64,
+                        [], 1)
+    ni = t.num_leaves - 1
+    dtv = np.asarray(t.decision_type[:ni], np.int64)
+    is_cat = (dtv & _CAT_MASK) != 0
+    mt = (dtv >> 2) & 3
+    dl = (dtv & _DEFAULT_LEFT_MASK) != 0
+    var = np.zeros(ni, np.int64)
+    var[(mt == 2) & dl] = 1
+    var[(mt == 2) & ~dl] = 2
+    var[(mt == 1) & dl] = 3
+    var[(mt == 1) & ~dl] = 4
+    feats = np.asarray(t.split_feature[:ni], np.int64)
+    cat_nodes = np.nonzero(is_cat)[0].astype(np.int64)
+    cat_words = []
+    for nd in cat_nodes:
+        kk = int(t.threshold_bin[nd])
+        b0, b1 = t.cat_boundaries[kk], t.cat_boundaries[kk + 1]
+        w32 = np.asarray(t.cat_threshold[b0:b1], np.uint64)
+        w64 = np.zeros(max((len(w32) + 1) // 2, 1), np.uint64)
+        for wi in range(len(w32)):
+            w64[wi // 2] |= w32[wi] << np.uint64(32 * (wi % 2))
+        cat_words.append(w64)
+    return TreeFlat(t.num_leaves, vals, leaf_orig, ni, var, feats,
+                    np.asarray(t.threshold[:ni], np.float64), is_cat,
+                    lo[:ni].astype(np.int64), hi[:ni].astype(np.int64),
+                    cat_nodes, cat_words,
+                    int(feats.max()) + 1 if ni else 1)
+
+
+def assemble_forest(flats: List[TreeFlat],
+                    num_tree_per_iteration: int = 1) -> FlatForest:
+    """Pad + stack per-tree :class:`TreeFlat` rows into the engine's
+    forest tables.  Byte-identical to :func:`flatten_forest` on the
+    same trees (same numbers flow in, in the same order) — pinned by
+    ``tests/test_pipeline.py``."""
+    T = len(flats)
     k = max(num_tree_per_iteration, 1)
-    M = max([max(t.num_leaves - 1, 1) for t in models] or [1])
-    Lm = max([t.num_leaves for t in models] or [1])
+    M = max([max(f.num_leaves - 1, 1) for f in flats] or [1])
+    Lm = max([f.num_leaves for f in flats] or [1])
     if Lm <= 32:
         wbits, wdt = 32, np.int32
     else:
         wbits, wdt = 64, np.int64
     W = (Lm + wbits - 1) // wbits
 
-    Mc = 0
-    nw64 = 1
-    for t in models:
-        if t.num_cat > 0:
-            n_cat = int(np.count_nonzero(
-                (t.decision_type[:max(t.num_leaves - 1, 1)] & _CAT_MASK)
-                != 0))
-            Mc = max(Mc, n_cat)
-            w32 = max((t.cat_boundaries[j + 1] - t.cat_boundaries[j])
-                      for j in range(len(t.cat_boundaries) - 1))
-            nw64 = max(nw64, (w32 + 1) // 2)
+    Mc = max([len(f.cat_nodes) for f in flats] or [0])
+    nw64 = max([len(w) for f in flats for w in f.cat_words] or [1])
 
     # variant ids and features are staged in int64 (variant, feature)
     # pairs, then remapped to compacted x-matrix row ids once the used
@@ -263,46 +335,31 @@ def flatten_forest(models: List, num_tree_per_iteration: int = 1
     used = set()
     num_features = 1
     requires_features = 0
-    for i, t in enumerate(models):
-        order, lo, hi = _dfs_layout(t)
-        vals[i, :len(order)] = t.leaf_value[order]
-        leaf_orig[i, :len(order)] = order
-        if t.num_leaves <= 1:
+    for i, f in enumerate(flats):
+        L = len(f.vals)
+        vals[i, :L] = f.vals
+        leaf_orig[i, :L] = f.leaf_orig
+        if f.ni <= 0:
             continue
-        ni = t.num_leaves - 1
-        dtv = np.asarray(t.decision_type[:ni], np.int64)
-        is_cat = (dtv & _CAT_MASK) != 0
-        mt = (dtv >> 2) & 3
-        dl = (dtv & _DEFAULT_LEFT_MASK) != 0
-        var = np.zeros(ni, np.int64)
-        var[(mt == 2) & dl] = 1
-        var[(mt == 2) & ~dl] = 2
-        var[(mt == 1) & dl] = 3
-        var[(mt == 1) & ~dl] = 4
-        feats = np.asarray(t.split_feature[:ni], np.int64)
-        num_features = max(num_features, int(feats.max()) + 1)
+        ni = f.ni
+        num_features = max(num_features, f.max_feature)
         requires_features = num_features
-        used.update(int(v) for v in np.unique(var[~is_cat]))
-        node_masks = _range_masks(lo, hi, W, wbits)
-        num = ~is_cat
+        used.update(int(v) for v in np.unique(f.var[~f.is_cat]))
+        node_masks = f.node_masks(W, wbits)
+        num = ~f.is_cat
         # numerical nodes occupy their slots; categorical nodes are
         # no-ops in the numeric pass (thr stays +inf -> condition
         # true -> mask untouched) and get real slots in the cat pass
-        vcols[i, :ni] = np.where(num, var, 0)
-        fcols[i, :ni] = np.where(num, feats, 0)
-        thrs[i, :ni][num] = t.threshold[:ni][num]
+        vcols[i, :ni] = np.where(num, f.var, 0)
+        fcols[i, :ni] = np.where(num, f.feats, 0)
+        thrs[i, :ni][num] = f.thrs[num]
         masks[i, :ni][num] = node_masks[num]
-        if np.any(is_cat):
-            for j, nd in enumerate(np.nonzero(is_cat)[0]):
-                fcat[i, j] = feats[nd]
-                cat_masks[i, j] = node_masks[nd]
-                kk = int(t.threshold_bin[nd])
-                b0, b1 = t.cat_boundaries[kk], t.cat_boundaries[kk + 1]
-                w32 = np.asarray(t.cat_threshold[b0:b1], np.uint64)
-                w64 = np.zeros(nw64, np.uint64)
-                for wi in range(len(w32)):
-                    w64[wi // 2] |= w32[wi] << np.uint64(32 * (wi % 2))
-                cat_words[i, j] = w64.view(np.int64)
+        for j, nd in enumerate(f.cat_nodes):
+            fcat[i, j] = f.feats[nd]
+            cat_masks[i, j] = node_masks[nd]
+            w64 = np.zeros(nw64, np.uint64)
+            w64[:len(f.cat_words[j])] = f.cat_words[j]
+            cat_words[i, j] = w64.view(np.int64)
     if Mc > 0:
         used.add(_CAT_VARIANT)
     if not used:
@@ -324,6 +381,49 @@ def flatten_forest(models: List, num_tree_per_iteration: int = 1
         vals=vals, leaf_orig=leaf_orig, cat_cols=cat_cols,
         cat_masks=cat_masks, cat_words=cat_words,
         requires_features=requires_features)
+
+
+def flatten_forest(models: List, num_tree_per_iteration: int = 1
+                   ) -> FlatForest:
+    """Pack ``models`` (a list of :class:`~..models.tree.Tree`) into
+    SoA device-ready tables — the COLD path (model-file load, handoff
+    disabled): every tree is walked here, a full-forest host repack.
+    Same-process train->predict uses :func:`flatten_forest_device`
+    instead; the ``flatten_full_repacks`` counter pins which path a
+    run took."""
+    _tele_counters.incr("flatten_full_repacks")
+    return assemble_forest([flatten_one_tree(t) for t in models],
+                           num_tree_per_iteration)
+
+
+def flatten_forest_device(models: List, num_tree_per_iteration: int,
+                          flats: List[TreeFlat]) -> FlatForest:
+    """The train->predict HANDOFF path: build the engine's SoA tables
+    from the per-tree :class:`TreeFlat` cache a live booster maintains
+    alongside its model list, extracting rows ONLY for trees not yet
+    cached (the delta since the last handoff) — so a booster that
+    trains and then predicts/serves/publishes in the same process
+    never re-walks its whole forest the way the cold
+    :func:`flatten_forest` path must (its per-tree DFS walk is Python-
+    bound and grows with trees x nodes, exactly the repack the r04
+    profile showed riding the train->serve seam).
+
+    ``flats`` is extended IN PLACE (the booster owns it and clears it
+    when trees mutate in place — DART renormalization, refit, merge).
+    Counters: ``flatten_device_handoffs`` (this path ran) and
+    ``flatten_tree_extracts`` (per-tree rows extracted — the delta,
+    not the forest).  Output is byte-identical to
+    :func:`flatten_forest` on the same models (one shared
+    :func:`assemble_forest`), pinned by ``tests/test_pipeline.py``."""
+    if len(flats) > len(models):
+        # the model list shrank without an invalidation sweep
+        # (defensive: rollback paths clear the cache explicitly)
+        del flats[len(models):]
+    for t in models[len(flats):]:
+        flats.append(flatten_one_tree(t))
+        _tele_counters.incr("flatten_tree_extracts")
+    _tele_counters.incr("flatten_device_handoffs")
+    return assemble_forest(flats, num_tree_per_iteration)
 
 
 # ----------------------------------------------------------------------

@@ -27,6 +27,12 @@ the other subsystems:
   the pre-block host-RNG/quantization-stream state the aborted
   dispatch consumed, and the PR 3 served-boundary replay discards any
   partially-served block, exactly as the checkpoint capture does.
+  Under async pipelining (``superstep_pipeline_depth`` > 0) MORE
+  THAN ONE block can be outstanding — the live fence always points
+  at the OLDEST unfetched dispatch, so one abort restores the draws
+  every in-flight block consumed and the whole queue dies with it
+  (an abandoned zombie dies on its captured generation token before
+  it can append a queue entry or commit a fetched block).
 - **re-mesh** — :meth:`GBDT.remesh` rebuilds the mesh over the
   surviving device set, re-places every mesh-resident tensor under
   the new ``DistributedBuilder.shardings()`` and rebuilds the fused

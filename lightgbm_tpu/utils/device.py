@@ -1,0 +1,67 @@
+"""Device synchronization helper.
+
+The training loop occasionally needs a BUILD BARRIER — "wait until
+this device computation finished" — without paying for its payload:
+the split-fetch diagnostic timer, the standalone kernel timers, and
+the profilers all want device wall time, not transfer time.
+
+``jax.block_until_ready`` is the native barrier, but on the remote
+accelerator tunnel this repo historically trained over (the ``axon``
+PJRT plugin) it returns before the computation has landed — the
+round-4 profiling sessions measured dispatch time, not compute.  The
+workaround was a 1-element ``np.asarray`` fetch — reliable
+everywhere, but it costs one extra tunnel round-trip (~120 ms there)
+and was copy-pasted inline at three call sites.  This module is the
+ONE implementation of that choice:
+
+- local backends (cpu/gpu/tpu — every runtime whose
+  ``block_until_ready`` is honest): ``jax.block_until_ready``, free;
+- the tunnel backend (platform name matches ``axon``), or
+  ``LTPU_SYNC_FETCH=1``: the 1-element fetch fallback
+  (``LTPU_SYNC_FETCH=0`` forces the native barrier even there).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["build_barrier", "sync_fetch_needed"]
+
+_TUNNEL_PLATFORMS = ("axon",)
+
+
+def sync_fetch_needed() -> bool:
+    """True when the barrier must be a 1-element fetch: the operator
+    forced it (``LTPU_SYNC_FETCH=1``), or the default backend is a
+    remote-tunnel platform whose ``block_until_ready`` returns before
+    compute lands.  ``LTPU_SYNC_FETCH=0`` forces the native barrier
+    unconditionally."""
+    forced = os.environ.get("LTPU_SYNC_FETCH", "")
+    if forced == "1":
+        return True
+    if forced == "0":
+        return False
+    try:
+        import jax
+
+        return jax.default_backend() in _TUNNEL_PLATFORMS
+    except Exception:  # pragma: no cover - backend probe must not raise
+        return False
+
+
+def build_barrier(x):
+    """Block until the device computation behind ``x`` (an array or a
+    pytree of arrays) has completed.  Returns ``x`` so call sites can
+    barrier inline.  Transfers at most ONE element (and usually
+    nothing): this is a wait, not a fetch."""
+    if sync_fetch_needed():
+        import numpy as np
+        import jax
+
+        leaf = next((l for l in jax.tree_util.tree_leaves(x)
+                     if hasattr(l, "reshape")), None)
+        if leaf is not None:
+            np.asarray(leaf.reshape(-1)[:1])
+        return x
+    import jax
+
+    return jax.block_until_ready(x)

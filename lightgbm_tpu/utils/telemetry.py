@@ -91,7 +91,12 @@ _TYPE_FIELDS: Dict[str, Tuple[Tuple[str, Any], ...]] = {
     # docs/Distributed.md) additionally carry ``learner``,
     # ``num_shards``, ``mesh_shape`` and the per-block per-shard
     # ``collective_bytes``/``collective_ops`` estimates — the series
-    # triage_run.py's weak-scaling anomaly reads
+    # triage_run.py's weak-scaling anomaly reads.  Async-pipelined
+    # runs (superstep_pipeline_depth > 0) add ``pipeline_depth`` (the
+    # configured in-flight depth) and ``fetch_overlap_s`` (wall
+    # between the block's dispatch and its fetch — the window its
+    # device compute overlapped host work); triage_run.py flags
+    # depth > 0 with ~zero overlap as pipelining silently disabled
     "superstep": (("iter", int), ("k", int),
                   ("duration_ms", (int, float))),
     "eval": (("iter", int), ("results", list)),

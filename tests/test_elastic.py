@@ -173,6 +173,42 @@ def test_supervisor_error_recovery_bit_exact(data601, tmp_path):
     assert bst.model_to_string() == _oracle_remesh_at(X, y, boundary, 7)
 
 
+def test_supervisor_recovery_with_outstanding_block(data601, tmp_path):
+    """A shard failure on block K+2's dispatch while block K+1 is
+    still IN FLIGHT (superstep_pipeline_depth=1: dispatched, records
+    unfetched) and block K is fully served: the abort must restore
+    the dispatch fence across BOTH outstanding dispatches'
+    RNG/quantization-stream consumption, die on the captured
+    generation token, and recover bit-exactly from the served
+    boundary — the pipeline x elastic contract (docs/Distributed.md).
+    """
+    X, y = data601
+    tele = str(tmp_path / "tele.jsonl")
+    # ordinals with depth 1: dispatch b1 (@1) + pre-seed b2 (@2)
+    # inside update 2, then b3's dispatch (@3) fires while b2 is the
+    # queued outstanding block and b1 is fully served
+    faults.configure("mesh.collective:error@3")
+    p = _params(elastic_training=True, superstep_pipeline_depth=1,
+                telemetry_file=tele)
+    d = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.train(p, d, verbose_eval=False, mesh=_mesh(8))
+    bst._gbdt._telemetry.close(log=False)
+    faults.clear()
+    g = bst._gbdt
+    assert g._dist is not None and g._dist.num_shards == 7
+    assert g.iter == ROUNDS and g._sq == []
+
+    recov = [json.loads(l) for l in open(tele)
+             if '"type": "recovery"' in l]
+    assert [r["event"] for r in recov] == ["detect", "remesh"], recov
+    boundary = recov[1]["iter"]
+    # block 1 ([1, 5)) was fully served when the fault hit: recovery
+    # lands on its end, discarding the queued block 2 wholesale
+    assert boundary == 5, recov
+    assert bst.model_to_string() == _oracle_remesh_at(
+        X, y, boundary, 7, superstep_pipeline_depth=1)
+
+
 def test_supervisor_healthy_path_noop_and_budget(data601):
     """On a healthy run supervision is invisible: the model is
     byte-identical to the unsupervised run, no recovery records are

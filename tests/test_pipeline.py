@@ -1,0 +1,368 @@
+"""Async super-step block pipelining + device-resident train->predict
+handoff (ISSUE 11).
+
+The contracts under test:
+
+- **bit-exact parity** — ``superstep_pipeline_depth`` in {1, 2}
+  produces BYTE-identical trees, training scores and predictions to
+  the unpipelined (depth 0) path across sampling modes and
+  ``fused_iters`` {1, 4}: pipelining reorders the dispatch/fetch pair
+  (block K+1's scan goes out before block K's stacked-record fetch),
+  it never changes the math, the PRNG folds, or the host-RNG draw
+  order.
+- **drain points** — the in-flight queue drains exactly at the
+  boundaries that already force one: the no-split stop, a mid-block
+  checkpoint (capture does NOT disturb the queue; restore discards
+  it), a learning-rate change, eligibility drift, rollback, elastic
+  rewind/re-mesh — each with the queued blocks' consumed host-RNG /
+  quantization-stream draws restored through the dispatch fence.
+- **device-resident handoff** — ``flatten_forest_device`` (the
+  same-process train->predict seam) is byte-identical to the numpy
+  ``flatten_forest`` cold path, and a train-then-predict process does
+  ZERO full-forest host repacks (``flatten_full_repacks`` counter).
+- **telemetry** — superstep records carry ``fetch_overlap_s`` /
+  ``pipeline_depth``; ``triage_run.py`` raises MED when overlap ~ 0
+  at depth > 0 (with the warmup-block exemptions applied).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils import telemetry
+
+
+def _data(objective="binary", n=400, f=6, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    if objective == "binary":
+        y = (X[:, 0] + 0.5 * rng.randn(n) > 0).astype(np.float64)
+    else:
+        y = X[:, 0] * 2 + 0.3 * rng.randn(n)
+    return X, y
+
+
+def _train(depth, fused=4, objective="binary", extra=None, rounds=10,
+           data=None, **kw):
+    X, y = data if data is not None else _data(objective)
+    p = {"objective": objective, "num_leaves": 7, "max_bin": 31,
+         "verbose": -1, "metric": "None", "num_iterations": rounds,
+         "fused_iters": fused, "superstep_pipeline_depth": depth}
+    if extra:
+        p.update(extra)
+    d = lgb.Dataset(X, label=y, params=p)
+    return lgb.train(p, d, num_boost_round=rounds, verbose_eval=False,
+                     **kw)
+
+
+def _assert_identical(a, b):
+    ga, gb = a._gbdt, b._gbdt
+    assert len(ga.models) == len(gb.models)
+    for ta, tb in zip(ga.models, gb.models):
+        assert ta.num_leaves == tb.num_leaves
+        np.testing.assert_array_equal(ta.leaf_value, tb.leaf_value)
+        np.testing.assert_array_equal(ta.split_feature,
+                                      tb.split_feature)
+        np.testing.assert_array_equal(ta.threshold_bin,
+                                      tb.threshold_bin)
+        np.testing.assert_array_equal(ta.decision_type,
+                                      tb.decision_type)
+        np.testing.assert_array_equal(ta.leaf_count, tb.leaf_count)
+    np.testing.assert_array_equal(ga.train_score, gb.train_score)
+
+
+# ---------------------------------------------------------------------
+# parity — fast representatives (full matrix below is @slow)
+# ---------------------------------------------------------------------
+def test_parity_depth1_bagging():
+    extra = {"bagging_fraction": 0.7, "bagging_freq": 2,
+             "feature_fraction": 0.6}
+    data = _data()
+    a = _train(0, extra=extra, data=data)
+    b = _train(1, extra=extra, data=data)
+    _assert_identical(a, b)
+    X = data[0]
+    np.testing.assert_array_equal(a.predict(X), b.predict(X))
+
+
+def test_parity_depth2_goss():
+    data = _data()
+    a = _train(0, extra={"boosting": "goss"}, data=data)
+    b = _train(2, extra={"boosting": "goss"}, data=data)
+    _assert_identical(a, b)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("extra", [
+    {},
+    {"bagging_fraction": 0.7, "bagging_freq": 2},
+    {"boosting": "goss"},
+    {"boosting": "mvs", "bagging_fraction": 0.6},
+], ids=["none", "bernoulli", "goss", "mvs"])
+@pytest.mark.parametrize("fused", [1, 4])
+@pytest.mark.parametrize("depth", [1, 2])
+def test_parity_matrix(extra, fused, depth):
+    """The acceptance matrix: {none, bagging, GOSS, MVS} x
+    fused_iters {1, 4} x pipeline depth {1, 2} against depth 0.
+    fused_iters=1 never fuses — depth must be inert there."""
+    data = _data()
+    a = _train(0, fused=fused, extra=extra, data=data)
+    b = _train(depth, fused=fused, extra=extra, data=data)
+    _assert_identical(a, b)
+    X = data[0]
+    np.testing.assert_array_equal(a.predict(X), b.predict(X))
+
+
+def test_stop_discards_inflight_blocks():
+    """Unsplittable data stops mid-pipeline: the queued successor
+    blocks (phantom state chained on the stopped carry) are
+    discarded, their consumed RNG draws restored, and the score stays
+    model-consistent — identical to the unpipelined stop."""
+    X, _ = _data()
+    y = np.ones(X.shape[0])
+    data = (X, y)
+    a = _train(0, objective="regression", rounds=12, data=data,
+               extra={"bagging_freq": 1, "bagging_fraction": 0.5})
+    b = _train(2, objective="regression", rounds=12, data=data,
+               extra={"bagging_freq": 1, "bagging_fraction": 0.5})
+    assert a._gbdt._stop_flag and b._gbdt._stop_flag
+    assert b._gbdt._sq == []          # queue drained at the stop
+    np.testing.assert_array_equal(a._gbdt.train_score,
+                                  b._gbdt.train_score)
+    np.testing.assert_array_equal(a.predict(X), b.predict(X))
+
+
+def test_learning_rate_schedule_drains_pipeline():
+    """A learning_rates schedule changes the shrinkage between
+    blocks: queued blocks built at the old rate must be drained and
+    redispatched, never served stale (engine.train also clamps the
+    depth to 0 under a schedule — exercise the booster-level drain
+    directly with the callback)."""
+    X, y = _data()
+    lrs = [0.3 * 0.7 ** i for i in range(12)]
+
+    def sched(depth):
+        p = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+             "verbose": -1, "metric": "None", "num_iterations": 12,
+             "fused_iters": 4, "superstep_pipeline_depth": depth}
+        d = lgb.Dataset(X, label=y, params=p)
+        import lightgbm_tpu.callback as cb
+        return lgb.train(p, d, num_boost_round=12, verbose_eval=False,
+                         callbacks=[cb.reset_parameter(
+                             learning_rate=list(lrs))])
+
+    a, b = sched(0), sched(2)
+    _assert_identical(a, b)
+
+
+def test_rollback_with_inflight_queue():
+    """rollback_one_iter drains the queue and restores the exact
+    sequential state; training continues bit-identically."""
+    X, y = _data()
+
+    def boosters(depth):
+        p = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+             "verbose": -1, "metric": "None", "num_iterations": 20,
+             "fused_iters": 4, "superstep_pipeline_depth": depth}
+        d = lgb.Dataset(X, label=y, params=p)
+        d.construct()
+        return lgb.Booster(params=p, train_set=d)
+
+    ba, bb = boosters(0), boosters(2)
+    for _ in range(6):
+        ba.update()
+        bb.update()
+    ba.rollback_one_iter()
+    bb.rollback_one_iter()
+    assert bb._gbdt._sq == []
+    assert len(ba._gbdt.models) == len(bb._gbdt.models) == 5
+    for _ in range(4):
+        ba.update()
+        bb.update()
+    np.testing.assert_array_equal(ba._gbdt.train_score,
+                                  bb._gbdt.train_score)
+
+
+# ---------------------------------------------------------------------
+# checkpoint alignment with blocks in flight
+# ---------------------------------------------------------------------
+def test_mid_inflight_block_checkpoint_resume(tmp_path):
+    """A periodic save landing mid-fused-block WITH a successor block
+    already dispatched (snapshot_freq=3, fused_iters=4, depth=2)
+    captures the served boundary without disturbing the in-flight
+    queue — the interrupted run still finishes bit-identically — and
+    the resumed run realigns the block schedule bit-identically."""
+    data = _data()
+    a = _train(0, data=data, rounds=10)
+    ck = str(tmp_path / "ck")
+    part = _train(2, data=data, rounds=10,
+                  extra={"checkpoint_dir": ck, "snapshot_freq": 3,
+                         "keep_last_n": 8})
+    # the checkpointing run itself must not be perturbed by the saves
+    _assert_identical(a, part)
+    assert os.path.isdir(os.path.join(ck, "ckpt_00000003"))
+    b = _train(2, data=data, rounds=10,
+               resume_from=os.path.join(ck, "ckpt_00000003"))
+    _assert_identical(a, b)
+
+
+def test_block_boundary_checkpoint_resume_with_inflight(tmp_path):
+    """A periodic save landing EXACTLY on a served-block boundary
+    while the successor block is dispatched-but-unfetched must
+    capture the pre-dispatch RNG/quantization-stream positions (the
+    oldest fence), not the queue-advanced ones — the resumed run
+    redispatches those blocks itself and must draw the same feature
+    fractions."""
+    data = _data()
+    extra = {"feature_fraction": 0.6}
+    a = _train(0, data=data, rounds=12, extra=extra)
+    ck = str(tmp_path / "ck")
+    # depth 1, fused 4: the save at iteration 5 (snapshot_freq=5)
+    # lands on block [1,5)'s served boundary with block [5,9) queued
+    part = _train(1, data=data, rounds=12,
+                  extra=dict(extra, checkpoint_dir=ck,
+                             snapshot_freq=5, keep_last_n=8))
+    _assert_identical(a, part)
+    assert os.path.isdir(os.path.join(ck, "ckpt_00000005"))
+    b = _train(1, data=data, rounds=12, extra=extra,
+               resume_from=os.path.join(ck, "ckpt_00000005"))
+    _assert_identical(a, b)
+
+
+# ---------------------------------------------------------------------
+# device-resident train->predict handoff
+# ---------------------------------------------------------------------
+def test_flatten_forest_device_byte_identity():
+    """flatten_forest_device (the handoff path) is byte-identical to
+    the numpy flatten_forest cold path on the same trained forest —
+    every SoA table, the variant set, and the layout statics."""
+    from lightgbm_tpu.ops import predict as pr
+    b = _train(1, extra={"feature_fraction": 0.6})
+    trees = b._gbdt.models
+    cold = pr.flatten_forest(trees, 1)
+    flats = []
+    hand = pr.flatten_forest_device(trees, 1, flats)
+    assert len(flats) == len(trees)
+    for name in ("cols", "thrs", "masks", "vals", "leaf_orig",
+                 "cat_cols", "cat_masks", "cat_words"):
+        np.testing.assert_array_equal(getattr(cold, name),
+                                      getattr(hand, name), err_msg=name)
+        assert getattr(cold, name).dtype == getattr(hand, name).dtype
+    for name in ("n_trees", "k", "num_features", "max_leaves",
+                 "max_nodes", "wbits", "n_words", "n_cat_nodes",
+                 "n_cat_words", "used_variants", "var_base",
+                 "requires_features"):
+        assert getattr(cold, name) == getattr(hand, name), name
+
+
+def test_same_process_train_predict_zero_repacks():
+    """The acceptance pin: train -> predict in one process performs
+    ZERO full-forest host repacks (the flatten_full_repacks counter
+    stays flat; flatten_device_handoffs counts the fast path), the
+    incremental extraction only walks the delta after more training,
+    and the engine output equals the per-tree oracle."""
+    X, y = _data()
+    p = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+         "verbose": -1, "metric": "None", "num_iterations": 20,
+         "fused_iters": 4}
+    d = lgb.Dataset(X, label=y, params=p)
+    d.construct()
+    bst = lgb.Booster(params=p, train_set=d)
+    c0 = telemetry.counters_snapshot()
+    for _ in range(10):
+        bst.update()
+    out1 = bst.predict(X)
+    c1 = telemetry.counters_snapshot()
+
+    def delta(a, b, key):
+        return b.get(key, 0.0) - a.get(key, 0.0)
+
+    assert delta(c0, c1, "flatten_full_repacks") == 0
+    assert delta(c0, c1, "flatten_device_handoffs") == 1
+    n1 = delta(c0, c1, "flatten_tree_extracts")
+    assert n1 == len(bst._gbdt.models)
+    # more training -> the next handoff extracts ONLY the new trees
+    for _ in range(10):
+        bst.update()
+    bst.predict(X)
+    c2 = telemetry.counters_snapshot()
+    assert delta(c1, c2, "flatten_full_repacks") == 0
+    assert delta(c1, c2, "flatten_tree_extracts") == \
+        len(bst._gbdt.models) - n1
+    # byte-identical to the oracle host loop
+    hand = bst.predict(X)
+    oracle = bst.predict(X, predict_engine=False)
+    np.testing.assert_allclose(hand, oracle, rtol=1e-12, atol=1e-12)
+    # and BYTE-identical to the cold path (handoff disabled forces a
+    # full flatten_forest repack of the same trees)
+    del out1
+    bst._gbdt.config.predict_device_handoff = False
+    bst._gbdt._flat_cache = None
+    cold = bst.predict(X)
+    c3 = telemetry.counters_snapshot()
+    assert delta(c2, c3, "flatten_full_repacks") == 1
+    np.testing.assert_array_equal(hand, cold)
+
+
+def test_inplace_mutation_invalidates_handoff_rows():
+    """Refit mutates leaf values in place: the cached per-tree rows
+    must be dropped (stale rows would serve the pre-refit values)."""
+    X, y = _data()
+    p = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+         "verbose": -1, "metric": "None", "num_iterations": 8}
+    d = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.train(p, d, num_boost_round=8, verbose_eval=False)
+    bst.predict(X)                      # populate the handoff rows
+    g = bst._gbdt
+    assert len(g._tree_flats) == len(g.models)
+    g.refit(X, y, decay_rate=0.5)
+    assert g._tree_flats == []          # invalidated
+    after = bst.predict(X)
+    oracle = bst.predict(X, predict_engine=False)
+    np.testing.assert_allclose(after, oracle, rtol=1e-12, atol=1e-12)
+
+
+# ---------------------------------------------------------------------
+# telemetry + triage
+# ---------------------------------------------------------------------
+def test_superstep_records_carry_pipeline_fields(tmp_path):
+    path = str(tmp_path / "pipe.jsonl")
+    _train(1, rounds=13, extra={"telemetry_file": path})._gbdt \
+        ._telemetry.close()
+    recs = [json.loads(l) for l in open(path) if l.strip()]
+    ss = [r for r in recs if r["type"] == "superstep"]
+    assert len(ss) == 3
+    assert all(r["pipeline_depth"] == 1 for r in ss)
+    assert all("fetch_overlap_s" in r for r in ss)
+    # steady-state blocks were dispatched a full serve-cycle before
+    # their fetch; the first block has no predecessor (warmup-exempt)
+    assert all(r["fetch_overlap_s"] > 0 for r in ss[1:])
+    n, errs = telemetry.lint_file(path)
+    assert errs == [] and n == len(recs)
+
+
+def test_triage_flags_zero_overlap_at_depth():
+    """Synthesized stream: depth > 0 with ~zero overlap on repeated
+    blocks raises the MED anomaly; healthy overlap does not, and the
+    warmup (first) block is exempt either way."""
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    from triage_run import scan_anomalies
+
+    def stream(overlap):
+        recs = [{"type": "run_start", "backend": "cpu"}]
+        for i in range(4):
+            recs.append({"type": "superstep", "iter": 1 + 4 * i,
+                         "k": 4, "duration_ms": 10.0,
+                         "pipeline_depth": 1,
+                         # block 0 is warmup-exempt whatever it says
+                         "fetch_overlap_s": 0.0 if i == 0 else overlap})
+        return recs
+
+    bad = [m for s, m in scan_anomalies(stream(0.0)) if s == "MED"]
+    assert any("pipelining silently disabled" in m for m in bad), bad
+    good = scan_anomalies(stream(0.004))
+    assert not any("pipelining" in m for _, m in good), good

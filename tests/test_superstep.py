@@ -143,21 +143,23 @@ def test_parity_stratified_and_quantized():
 # ---------------------------------------------------------------------
 # device-call budget + compile stability
 # ---------------------------------------------------------------------
-def test_dispatch_and_fetch_budget():
-    """fused_iters=8 issues <= 2 device dispatches (one jitted scan +
-    one packed-record pack) and exactly 1 device->host fetch per 8
-    iterations, and the scan compiles ONCE — the second same-K block
-    re-runs the cached program."""
+@pytest.mark.parametrize("depth", [0, 1])
+def test_dispatch_and_fetch_budget(depth):
+    """fused_iters=8 issues 2 device dispatches (one jitted scan + one
+    packed-record fetch) per 8 iterations AT ANY PIPELINE DEPTH —
+    async pipelining reorders the pair (block K+1's scan goes out
+    before block K's fetch), it never adds calls — and the scan
+    compiles ONCE: later same-K blocks re-run the cached program."""
     X, y = _data("regression")
     p = {"objective": "regression", "num_leaves": 7, "max_bin": 31,
          "verbose": -1, "metric": "None", "num_iterations": 100,
-         "fused_iters": 8}
+         "fused_iters": 8, "superstep_pipeline_depth": depth}
     d = lgb.Dataset(X, label=y, params=p)
     d.construct()
     bst = lgb.Booster(params=p, train_set=d)
     bst.update()                      # iteration 0: unfused (bias)
     c0 = telemetry.counters_snapshot()
-    for _ in range(8):                # block 1: dispatch + 7 serves
+    for _ in range(8):                # block 1 (+ the depth pre-seed)
         bst.update()
     c1 = telemetry.counters_snapshot()
     for _ in range(8):                # block 2: same-K, cached scan
@@ -167,15 +169,19 @@ def test_dispatch_and_fetch_budget():
     def delta(a, b, key):
         return b.get(key, 0.0) - a.get(key, 0.0)
 
-    # block 1: one scan dispatch, one packed fetch
-    assert delta(c0, c1, "superstep_dispatches") == 1
+    # block 1's window: one scan dispatch for the block itself plus
+    # the pipeline pre-seeding its in-flight successors; one fetch
+    assert delta(c0, c1, "superstep_dispatches") == 1 + depth
     assert delta(c0, c1, "superstep_fetches") == 1
-    # block 2: same budget, and ZERO fresh XLA compiles — the fused
-    # program is cached for repeated same-K blocks
+    # steady state: exactly 2 device calls per K-block at any depth,
+    # and ZERO fresh XLA compiles — the fused program is cached for
+    # repeated same-K blocks (the pre-seeded dispatch reused it too)
     assert delta(c1, c2, "superstep_dispatches") == 1
     assert delta(c1, c2, "superstep_fetches") == 1
     assert delta(c1, c2, "xla_compiles") == 0
     assert len(bst._gbdt.models) == 17
+    # the in-flight queue holds exactly `depth` un-fetched blocks
+    assert len(bst._gbdt._sq) == depth
 
 
 # ---------------------------------------------------------------------

@@ -18,7 +18,12 @@ on the forced 8-device CPU mesh:
   slice), reads the mesh topology the checkpoint manifest recorded,
   RE-SHARDS and resumes bit-exactly at the new width;
 - **healthy path**: supervision is invisible — byte-identical model,
-  2 device calls per K-block.
+  2 device calls per K-block;
+- **shard death with a whole block IN FLIGHT**
+  (``superstep_pipeline_depth=2``): the fault fires on a dispatch
+  while earlier blocks are dispatched-but-unfetched — the abort
+  restores the fence across every outstanding block's
+  RNG/quantization-stream draws and recovery is still bit-exact.
 
 Hard asserts (exit nonzero on any failure):
 
@@ -342,6 +347,38 @@ def main(argv=None):
     ok &= check("phase4: supervised healthy run byte-identical to "
                 "unsupervised", sup.model_to_string() ==
                 plain.model_to_string())
+
+    # ---- phase 5: shard death with a whole block IN FLIGHT ----------
+    # (async pipelining, superstep_pipeline_depth=2): the fault fires
+    # on a dispatch while earlier blocks are dispatched-but-unfetched
+    # — the abort must restore the fence across EVERY outstanding
+    # block's RNG/quantization-stream consumption and recover
+    # bit-exactly from the served boundary
+    print("== phase 5: collective error with in-flight pipelined "
+          "blocks ==", flush=True)
+    seen = len(recovery_records(telemetry))
+    faults.reset()
+    # ordinal 3 = the third block's dispatch, which (at depth 2) goes
+    # out while blocks 1 and 2 are still unfetched in the queue
+    faults.configure("mesh.collective:error@3")
+    bst5 = train(X, y, elastic_training=True, telemetry_file=telemetry,
+                 superstep_pipeline_depth=2)
+    bst5._gbdt._telemetry.close(log=False)
+    faults.clear()
+    faults.reset()
+    recov5 = recovery_records(telemetry)[seen:]
+    ok &= check("phase5: in-flight-block failure detected + re-meshed",
+                [r["event"] for r in recov5] == ["detect", "remesh"]
+                and recov5[0]["cause"] == "error" and
+                recov5[1]["to_shards"] == 7, str(recov5))
+    ok &= check("phase5: training completed with the queue drained",
+                bst5._gbdt.iter == ROUNDS and bst5._gbdt._sq == [])
+    boundary5 = recov5[1]["iter"] if len(recov5) > 1 else 0
+    ok &= check("phase5: model BYTE-identical to the uninterrupted "
+                "run over the surviving mesh (queued blocks discarded "
+                "losslessly)",
+                bst5.model_to_string() ==
+                oracle_remesh_at(X, y, boundary5, 7))
 
     # ---- telemetry: lint + triage anomalies -------------------------
     n, errs = lint_file(telemetry)

@@ -15,6 +15,14 @@ per block) plus the packed-record dispatch; the per-iteration path
 issues ~5 device calls per iteration (gradients, bagging draw, build
 dispatch, score update, record fetch/pack).
 
+A PIPELINED cell (``superstep_pipeline_depth`` 0/1/2 at K=8 on the
+dispatch-bound shape) measures the fetch overlap — the
+``superstep/fetch`` phase wall that disappears when block K+1's
+dispatch goes out before block K's stacked-record fetch — and
+HARD-asserts the healthy-path device-call budget stays 2 per K-block
+at every depth (pipelining reorders the dispatch/fetch pair, it never
+adds calls).
+
 A SHARDED cell (``--shards``, default 8 virtual host devices on CPU)
 runs the data-parallel learner through the same fused scan — UNDER
 the elastic shard-loss supervisor (``parallel/elastic.py``) — and
@@ -124,6 +132,95 @@ def measure(variants=(1, 4, 8), n_rows=5_000, n_feat=28, reps=6,
                    "n_fused_variants": n_fused}
 
 
+def measure_pipelined(depths=(0, 1, 2), K=8, n_rows=2_000, n_feat=10,
+                      reps=6, block=8):
+    """Async block pipelining A/B on the dispatch-bound shape: one
+    booster per ``superstep_pipeline_depth``, interleaved 8-update
+    windows (window == one whole K=8 block, so every window is one
+    dispatch + one fetch at steady state).  Reports per-depth steady
+    wall, the ``superstep/fetch`` phase wall (the stall the pipeline
+    exists to hide — at depth > 0 the block has been computing since
+    its dispatch one serve-cycle earlier, so the fetch waits only for
+    the residual), and HARD-asserts the healthy-path device-call
+    budget stays 2 per K-block at any depth."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils import profiling, telemetry
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(n_rows, n_feat).astype(np.float32)
+    y = (X[:, 0] + 0.4 * rng.randn(n_rows) > 0).astype(np.float32)
+    boosters = {}
+    for depth in depths:
+        params = {"objective": "binary", "num_leaves": 7,
+                  "max_bin": 63, "verbose": -1, "metric": "None",
+                  "num_iterations": 10_000, "fused_iters": K,
+                  "superstep_pipeline_depth": depth}
+        d = lgb.Dataset(X, label=y, params=params)
+        d.construct()
+        bst = lgb.Booster(params=params, train_set=d)
+        # warmup ends exactly on a block boundary (1 bias iteration +
+        # one whole block), pre-seeding the in-flight queue — every
+        # measured window is then exactly one steady-state block
+        for _ in range(1 + K):
+            bst.update()
+        boosters[depth] = bst
+    mins = {d: [] for d in depths}
+    fetch_ms = {d: [] for d in depths}
+    calls = {d: [0, 0] for d in depths}
+    for _ in range(reps):
+        for depth in depths:
+            bst = boosters[depth]
+            ph0 = profiling.snapshot()
+            c0 = telemetry.counters_snapshot()
+            t0 = time.time()
+            for _ in range(block):
+                bst.update()
+            mins[depth].append((time.time() - t0) / block)
+            c1 = telemetry.counters_snapshot()
+            fetch_ms[depth].append(
+                profiling.delta_ms(ph0).get("superstep/fetch", 0.0) /
+                block)
+            calls[depth][0] += int(c1.get("superstep_dispatches", 0) -
+                                   c0.get("superstep_dispatches", 0))
+            calls[depth][1] += int(c1.get("superstep_fetches", 0) -
+                                   c0.get("superstep_fetches", 0))
+    cells = []
+    blocks = reps * block // K
+    for depth in depths:
+        disp, fet = calls[depth]
+        # the pin this cell exists for: pipelining reorders the
+        # dispatch/fetch pair, it NEVER adds device calls — 2 per
+        # K-block at any depth
+        assert disp == blocks and fet == blocks, (
+            f"device-call budget broken at pipeline_depth={depth}: "
+            f"{disp} dispatches / {fet} fetches over {blocks} blocks "
+            f"(expected {blocks}/{blocks})")
+        cells.append({
+            "pipeline_depth": depth,
+            "fused_iters": K,
+            "iter_s": round(min(mins[depth]), 6),
+            "iter_s_mean": round(sum(mins[depth]) / reps, 6),
+            "fetch_ms_per_iter": round(min(fetch_ms[depth]), 4),
+            "dispatches_per_block": round(disp / blocks, 3),
+            "fetches_per_block": round(fet / blocks, 3),
+        })
+    base = cells[0]
+    for c in cells:
+        c["speedup_vs_unpipelined"] = round(
+            base["iter_s"] / max(c["iter_s"], 1e-9), 2)
+        c["fetch_wall_hidden_ms"] = round(
+            max(base["fetch_ms_per_iter"] - c["fetch_ms_per_iter"],
+                0.0), 4)
+    return {
+        "shape": f"{n_rows} x {n_feat} binary, 7 leaves, K={K}, "
+                 f"interleaved min-of-{reps} {block}-update windows",
+        "device_call_budget_per_block": 2,
+        "budget_ok_at_all_depths": True,
+        "cells": cells,
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--stdout", action="store_true")
@@ -179,6 +276,10 @@ def main(argv=None):
         sharded_budget["matches_serial_fused"] = (
             sharded_budget["observed_fused_device_calls"] ==
             sharded_budget["expected_fused_device_calls"])
+    # ASYNC BLOCK PIPELINING cell (superstep_pipeline_depth): the
+    # per-block fetch overlapped behind the next block's dispatch,
+    # with the 2-calls-per-K-block budget hard-asserted at every depth
+    pipelined = measure_pipelined(reps=args.reps)
     out = {
         "metric": "fused_superstep_vs_periter_cpu",
         "unit": "s/iter",
@@ -192,6 +293,7 @@ def main(argv=None):
         "device_call_budget": budget,
         "cells": cells,
         "dispatch_bound_cells": tiny,
+        "pipelined": pipelined,
     }
     if sharded_cells:
         out["sharded_cells"] = sharded_cells

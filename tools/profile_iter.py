@@ -30,8 +30,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def sync(x):
-    # 1-element fetch: the only reliable device sync through the tunnel
-    return np.asarray(x.reshape(-1)[:1])
+    # shared build barrier (utils/device.py): block_until_ready by
+    # default, LTPU_SYNC_FETCH=1 for the tunnel's 1-element fetch
+    from lightgbm_tpu.utils.device import build_barrier
+    return build_barrier(x)
 
 
 def main():

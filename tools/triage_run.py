@@ -161,6 +161,30 @@ def scan_anomalies(records):
                             f"compiles ({secs:.1f}s) AFTER iteration "
                             f"{WARMUP_ITERS} — steady state should "
                             f"re-run cached programs"))
+    # pipelining silently disabled: superstep records claim a pipeline
+    # depth > 0 yet their fetch-overlap window is ~zero — the block
+    # was dispatched and fetched back-to-back, so the one device->host
+    # round-trip per block is stalling the loop again (a drain point
+    # firing every block: a learning_rates schedule, eligibility
+    # flapping, or a bug).  Warmup-exempt blocks are skipped with the
+    # shared _superstep_warmups rule: the FIRST block of a run (and of
+    # each shape/mesh/checkpoint/remesh segment) legitimately has no
+    # predecessor to overlap.
+    overlaps = [float(r.get("fetch_overlap_s", 0.0))
+                for r, warm in _superstep_warmups(records)
+                if not warm and int(r.get("pipeline_depth", 0)) > 0]
+    if overlaps:
+        stalled = sum(1 for v in overlaps if v < 1e-5)
+        if stalled > len(overlaps) / 2:
+            out.append(("MED", f"superstep pipelining silently "
+                               f"disabled: {stalled}/{len(overlaps)} "
+                               f"fused blocks show ~zero fetch "
+                               f"overlap at pipeline_depth > 0 — "
+                               f"every block is draining the "
+                               f"in-flight queue (learning_rates "
+                               f"schedule? eligibility flapping?), "
+                               f"so the per-block fetch RTT is "
+                               f"un-hidden again"))
     # weak-scaling regression: sharded super-steps at DIFFERENT mesh
     # sizes in one run (the weak-scale bench grid, or a resumed run on
     # a wider mesh) whose per-iteration time grows with the shard

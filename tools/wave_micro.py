@@ -27,7 +27,10 @@ L = 255
 
 
 def sync(x):
-    return np.asarray(x.reshape(-1)[:1])
+    # shared build barrier (utils/device.py): block_until_ready by
+    # default, LTPU_SYNC_FETCH=1 for the tunnel's 1-element fetch
+    from lightgbm_tpu.utils.device import build_barrier
+    return build_barrier(x)
 
 
 def timeit(fn, *args, reps=6):
