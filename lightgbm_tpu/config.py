@@ -433,6 +433,28 @@ PARAMS: List[Param] = [
        "shapes where the per-pass fixed cost outweighs the stream "
        "saving (features x padded bins < ~7000)",
        group="device"),
+    _p("split_kernel", "auto", str, ("best_split_kernel",),
+       "best-split search engine: auto, pallas, xla.  pallas runs the "
+       "split scan as a Pallas kernel family fused with the histogram "
+       "pass — the batched histogram kernels scan their own "
+       "accumulated (leaf, feature-tile) histogram while it is still "
+       "VMEM-resident (fused epilogue) and the subtraction-trick "
+       "children go through a standalone per-(leaf, feature-tile) "
+       "scan kernel with a two-stage tile-then-global argmax — so the "
+       "full (leaves x features x bins) histogram is never round-"
+       "tripped through HBM between the build and the split search.  "
+       "auto = pallas on an accelerator backend, xla elsewhere.  "
+       "Numerical features with the serial tree learner only; "
+       "categorical features, EFB bundles, forced splits, c2f "
+       "refinement (hist_refinement) and parallel learners fall back "
+       "to the XLA scans and record the gate in tier telemetry "
+       "(superstep records carry split_kernel + split_fallback; "
+       "triage_run.py flags an XLA fallback on a TPU backend).  Split "
+       "choice is identical to the XLA scan (bit-exact choice, gains "
+       "within ~1e-6 relative under monotone clipping); on a CPU "
+       "backend split_kernel=pallas runs under the Pallas interpreter "
+       "(correctness lane, not a fast path)",
+       group="device", check="auto, pallas, xla"),
     _p("fused_iters", 1, int, ("fused_iterations", "superstep_iters"),
        "boosting iterations fused into ONE on-device super-step: a "
        "single jitted lax.scan runs K iterations of gradients + "

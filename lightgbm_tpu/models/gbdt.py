@@ -149,6 +149,14 @@ class GBDT:
 
         use_pallas = (config.device_type != "cpu" and
                       jax.default_backend() not in ("cpu",))
+        from ..utils.env import pallas_interpret_forced
+        if not use_pallas and pallas_interpret_forced():
+            # LTPU_PALLAS_INTERPRET: the interpret-mode CPU parity
+            # lane — every Pallas kernel (histogram tiers, routed
+            # passes, the best-split scan) runs interpreted so tier-1
+            # exercises the kernel paths without a TPU.  Correctness
+            # only; interpreter wall time is meaningless.
+            use_pallas = True
         rpb = int(config.tpu_rows_per_block)
         n = train_set.num_data
 
@@ -287,6 +295,44 @@ class GBDT:
             # missing values ride a RESERVED last coarse slot (grow.py
             # Bc_c2f) and a default-left row in the routed lane tables
             refine_shift = 4 if self.max_bin > 64 else 3
+        # best-split engine (split_kernel=auto|pallas|xla): the Pallas
+        # kernel family scans histograms on-chip (fused epilogue in
+        # the batched passes + the standalone per-(leaf, feature-tile)
+        # kernel), eliminating the histogram→split HBM round-trip.
+        # Numerical serial configs only; every rejection records the
+        # gate (tier telemetry) so a TPU run silently landing on the
+        # XLA scan is triageable (tools/triage_run.py MED anomaly).
+        split_req = str(config.split_kernel).lower() or "auto"
+        if split_req not in ("auto", "pallas", "xla"):
+            # an unrecognized value must NOT silently land on the
+            # interpreter lane (pallas-on-cpu is orders of magnitude
+            # slower than the XLA scan it would replace)
+            Log.warning("unknown split_kernel=%r; using auto",
+                        config.split_kernel)
+            split_req = "auto"
+        split_kernel, split_gate = "xla", None
+        if split_req == "xla":
+            split_gate = "split_kernel=xla"
+        elif any_cat:
+            split_gate = ("categorical scans (one-vs-other / sorted "
+                          "many-vs-many) read the XLA path")
+        elif self._bundles is not None:
+            split_gate = "EFB bundles active (histogram expansion)"
+        elif dist_active:
+            split_gate = f"tree_learner={learner}"
+        elif forced:
+            split_gate = "forced splits"
+        elif refine_shift:
+            split_gate = ("c2f refinement scans coarse+window "
+                          "(hist_refinement)")
+        elif split_req == "auto" and not use_pallas:
+            split_gate = ("cpu backend (split_kernel=pallas or "
+                          "LTPU_PALLAS_INTERPRET=1 runs the "
+                          "interpret lane)")
+        else:
+            # split_req "pallas" on a CPU backend is honored via the
+            # interpret lane (ops/split.py pallas_interpret)
+            split_kernel = "pallas"
         self.grow_params = GrowParams(
             split=SplitParams(
                 max_bin=self.max_bin,
@@ -331,6 +377,7 @@ class GBDT:
             wave=wave_on,
             two_col=two_col,
             refine_shift=refine_shift,
+            split_kernel=split_kernel,
             # speculative child arming fills the MXU lanes (21 leaves x
             # 6 value columns, 42 x 3 quantized, 64 x 2 two-column);
             # enabled on the accelerator path where the batched pallas
@@ -425,7 +472,8 @@ class GBDT:
             learner=learner, num_shards=num_shards, wave_on=wave_on,
             two_col=two_col, refine_shift=refine_shift, any_cat=any_cat,
             any_missing=any_missing, use_pool=use_pool,
-            forced=bool(forced), G_cols=G_cols)
+            forced=bool(forced), G_cols=G_cols,
+            split_kernel=split_kernel, split_gate=split_gate)
         self._collective_per_pass = 0
         self._collective_ops_per_pass = 0
         if dist_active and self._dist is not None:
@@ -516,7 +564,8 @@ class GBDT:
     # ------------------------------------------------------------------
     def _tier_gates(self, config, use_pallas, dist_active, learner,
                     num_shards, wave_on, two_col, refine_shift, any_cat,
-                    any_missing, use_pool, forced, G_cols):
+                    any_missing, use_pool, forced, G_cols,
+                    split_kernel="xla", split_gate=None):
         """The histogram-tier decision for this booster, with the gate
         that rejected each higher tier.  Mirrors the driver gates above
         and the routed-kernel feasibility in ``ops/grow.py`` — the
@@ -581,6 +630,11 @@ class GBDT:
             int(config.tpu_rows_per_block))
         if "routed" not in gates and not routed:
             gates["routed"] = "feature block exceeds one kernel chunk"
+        # best-split engine gate (split_kernel): why a run scans splits
+        # in XLA instead of the fused/standalone Pallas kernels —
+        # triage_run.py flags the silent-fallback-on-TPU case
+        if split_kernel != "pallas" and split_gate:
+            gates["split"] = split_gate
         if two_col:
             tier = "two_col"
         elif wave_on:
@@ -592,6 +646,7 @@ class GBDT:
         return {
             "tier": tier,
             "gates": gates,
+            "split_kernel": split_kernel,
             "routed": bool(routed),
             "c2f": bool(refine_shift),
             "refine_shift": int(refine_shift),
@@ -1865,6 +1920,14 @@ class GBDT:
             fields["pipeline_depth"] = int(ss.get("pipeline_depth", 0))
             fields["fetch_overlap_s"] = float(
                 ss.get("fetch_overlap_s", 0.0))
+            # best-split engine per block: which scan ran and, when it
+            # fell back to XLA, the gate that rejected the Pallas tier
+            # (triage_run.py flags xla-on-a-TPU-backend as MED)
+            fields["split_kernel"] = self.tier_decision.get(
+                "split_kernel", "xla")
+            sf = self.tier_decision.get("gates", {}).get("split")
+            if sf:
+                fields["split_fallback"] = sf
             # sharded super-step: per-block collective accounting +
             # mesh identity (the weak-scaling triage reads these —
             # per-iteration time growing with num_shards at constant

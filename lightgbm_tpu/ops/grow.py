@@ -52,7 +52,8 @@ from .histogram import (histogram_pallas, histogram_pallas_multi,
                         routed_chunk_ok)
 from .split import (NEG_INF, SplitParams, choose_window,
                     eval_forced_split, find_best_split,
-                    find_best_split_c2f, leaf_output)
+                    find_best_split_c2f, find_best_split_pallas,
+                    leaf_output, split_lane_scalars)
 
 __all__ = ["DistConfig", "GrowParams", "build_tree", "build_tree_impl",
            "collective_bytes_per_pass"]
@@ -150,6 +151,14 @@ class GrowParams:
     # pass: 1 byte/entry instead of 4 (pallas + quantize only; the
     # float hi/lo path needs f32)
     vals_i8: bool = True
+    # best-split engine: "xla" = the vectorized jnp scans in
+    # ops/split.py (every tier); "pallas" = the on-chip kernel family
+    # (find_best_split_pallas + the fused histogram→split epilogue in
+    # the batched passes) — numerical features, serial learner, no
+    # EFB/forced/c2f; the DRIVER gates this (models/gbdt.py records
+    # the gate that rejected it), build_tree only falls back silently
+    # for the sub-paths the kernel cannot serve
+    split_kernel: str = "xla"
     # >0: relative gain tolerance for preferring an already-ARMED leaf
     # over a fresh unarmed one when their best gains are within
     # tol*|best|.  Late boosting iterations have near-flat gains and
@@ -490,6 +499,23 @@ def build_tree_impl(xt: jax.Array, grad: jax.Array, hess: jax.Array,
             "and no bundling"
         assert kind in ("serial", "data"), \
             "coarse-to-fine runs under the serial/data learners only"
+    # Pallas best-split tier (GrowParams.split_kernel): the numerical
+    # scan runs as the on-chip kernel family instead of the XLA scan.
+    # The driver (models/gbdt.py) gates eligibility and records why a
+    # config fell back; the asserts here are the backstop for direct
+    # build_tree users.
+    use_split_pallas = p.split_kernel == "pallas"
+    if use_split_pallas:
+        assert kind == "serial" and not sp.any_cat and not p.bundled \
+            and not p.forced and not use_c2f, \
+            "split_kernel=pallas: serial learner, numerical features, " \
+            "no EFB/forced splits/c2f refinement (driver-gated)"
+    # fused histogram→split epilogue: the batched pass scans its own
+    # accumulated tile in VMEM for the smaller children (the larger,
+    # subtraction-trick children go through the standalone kernel on
+    # the pool histogram)
+    use_split_fused = (use_split_pallas and use_wave and
+                       p.hist_impl == "pallas")
     if do_spec:
         base_vals = jnp.stack([grad * sample_mask, hess * sample_mask,
                                sample_mask], axis=-1)
@@ -513,13 +539,23 @@ def build_tree_impl(xt: jax.Array, grad: jax.Array, hess: jax.Array,
                 return h
             return h if hist_scale is None else h * hist_scale
 
-        def multi_hist(sel):
+        def multi_hist(sel, split_args=None):
             if p.hist_impl == "pallas":
+                if split_args is not None:
+                    # fused histogram→split epilogue: the pass scans
+                    # its own accumulated tile in VMEM (serial only —
+                    # gated with use_split_fused)
+                    h, srec = histogram_pallas_multi(
+                        xt, kvals, sel, B, W_spec, p.rows_per_block,
+                        exact=p.quantize > 0, two_col=p.two_col,
+                        split_params=sp, split_args=split_args)
+                    return _wave_hist_finish(h), srec
                 h = histogram_pallas_multi(xt, kvals, sel, B, W_spec,
                                            p.rows_per_block,
                                            exact=p.quantize > 0,
                                            two_col=p.two_col)
             else:
+                assert split_args is None
                 h = histogram_segsum_multi(xt, base_vals, sel, B, W_spec,
                                            two_col=p.two_col)
             return _wave_hist_finish(h)
@@ -543,7 +579,16 @@ def build_tree_impl(xt: jax.Array, grad: jax.Array, hess: jax.Array,
     # and per score-update, 4x less HBM than int32
     li_narrow = L <= 255
 
-    def routed_call(li, tbl, max_bin_r, shift_r, mode):
+    def routed_call(li, tbl, max_bin_r, shift_r, mode,
+                    split_args=None):
+        if split_args is not None:
+            # route + histogram + best-split scan in ONE kernel
+            hist, li_new, sel, srec = histogram_pallas_multi_routed(
+                xt, kvals, li, tbl, max_bin_r, W_spec,
+                p.rows_per_block, exact=p.quantize > 0,
+                two_col=p.two_col, shift=shift_r, mode=mode,
+                miss_bin=mb_l, split_params=sp, split_args=split_args)
+            return _wave_hist_finish(hist), li_new, sel, srec
         hist, li_new, sel = histogram_pallas_multi_routed(
             xt, kvals, li, tbl, max_bin_r, W_spec,
             p.rows_per_block, exact=p.quantize > 0, two_col=p.two_col,
@@ -638,10 +683,19 @@ def build_tree_impl(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         if kind == "voting":
             b = _best_voting(hist_leaf, stats, mn, mx)
         else:
-            b = find_best_split(expand(hist_leaf, stats), stats, nb_l,
-                                mt_l, cat_l, fmask_l, sp, monotone=mono_l,
-                                penalty=pen_l, min_output=mn,
-                                max_output=mx)
+            if use_split_pallas:
+                # on-chip numerical scan (EFB gated off: expand is the
+                # identity here)
+                b = find_best_split_pallas(hist_leaf, stats, nb_l,
+                                           mt_l, fmask_l, sp,
+                                           monotone=mono_l,
+                                           penalty=pen_l, min_output=mn,
+                                           max_output=mx)
+            else:
+                b = find_best_split(expand(hist_leaf, stats), stats,
+                                    nb_l, mt_l, cat_l, fmask_l, sp,
+                                    monotone=mono_l, penalty=pen_l,
+                                    min_output=mn, max_output=mx)
             b["feature"] = b["feature"] + f_offset
             if kind in ("data", "feature") and not wave_dist:
                 # wave_dist scans replicated histograms — every shard
@@ -1236,6 +1290,15 @@ def build_tree_impl(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         """Per-strategy children best-split stage of a wave."""
         if wave_vote:
             return _wave_best_voting(ch_hist, ch_stats, ch_mn, ch_mx)
+        if use_split_pallas:
+            # lane-batched on-chip scan: the kernel grid runs all 2W
+            # children natively — no vmap over pallas_call
+            return find_best_split_pallas(ch_hist, ch_stats, nb_l,
+                                          mt_l, fmask_l, sp,
+                                          monotone=mono_l,
+                                          penalty=pen_l,
+                                          min_output=ch_mn,
+                                          max_output=ch_mx)
         if has_mono:
             bests = jax.vmap(child_best)(ch_hist, ch_stats, ch_mn,
                                          ch_mx)
@@ -1284,14 +1347,46 @@ def build_tree_impl(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         rstat_w = pstat_w - lstat_w
         small_left_w = lstat_w[:, 2] <= rstat_w[:, 2]
 
+        # depth/bounds hoisted above the pass: the fused epilogue's
+        # per-lane scalars (child stats + monotone bounds) must exist
+        # BEFORE the histogram kernel is launched
+        depth_w = st["leaf_depth"][ids] + 1
+        if has_mono:
+            l_min, l_max, r_min, r_max = child_bounds(
+                lstat_w, rstat_w, st["leaf_min"][ids],
+                st["leaf_max"][ids], feat_w, cat_w)
+            ch_mn = jnp.concatenate([l_min, r_min])
+            ch_mx = jnp.concatenate([l_max, r_max])
+        sargs = None
+        if use_split_fused:
+            small_stats = jnp.where(small_left_w[:, None], lstat_w,
+                                    rstat_w)
+            if has_mono:
+                small_mn = jnp.where(small_left_w, l_min, r_min)
+                small_mx = jnp.where(small_left_w, l_max, r_max)
+            else:
+                small_mn = small_mx = None
+            lane_scal = split_lane_scalars(small_stats, sp, small_mn,
+                                           small_mx)
+            scale3 = hist_scale if hist_scale is not None \
+                else jnp.ones(3, jnp.float32)
+            sargs = (lane_scal, scale3, nb_l, mt_l, fmask_l, mono_l,
+                     pen_l)
+
         li = st["leaf_idx"]
+        bests_small = None
         if routed_full_ok:
             # routing resolved inside the pass itself; the kernel
-            # also emits the updated leaf vector
+            # also emits the updated leaf vector (and, fused, the
+            # smaller children's best splits)
             tbl = lane_tables(ids_leaf, feat_w, thr_w, new_ids,
                               small_left_w, dl_w)
-            hist_small, leaf_idx, _ = routed_call(li, tbl, B, 0,
-                                                  "small")
+            if sargs is not None:
+                hist_small, leaf_idx, _, bests_small = routed_call(
+                    li, tbl, B, 0, "small", split_args=sargs)
+            else:
+                hist_small, leaf_idx, _ = routed_call(li, tbl, B, 0,
+                                                      "small")
         else:
             # route every in-wave row through ITS leaf's split
             if p.bundled:
@@ -1306,7 +1401,10 @@ def build_tree_impl(xt: jax.Array, grad: jax.Array, hess: jax.Array,
                            extras=(small_left_w, new_ids))
             to_small = goes_left == small_left_row
             sel = jnp.where(in_wave & to_small, w_row, jnp.int32(-1))
-            hist_small = multi_hist(sel)            # (W, F_hist, B, 3)
+            if sargs is not None:
+                hist_small, bests_small = multi_hist(sel, sargs)
+            else:
+                hist_small = multi_hist(sel)        # (W, F_hist, B, 3)
             leaf_idx = jnp.where(in_wave & ~goes_left, new_id_row, li)
 
         hist_parent = st["hist"][ids]
@@ -1315,21 +1413,40 @@ def build_tree_impl(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         hist_l = jnp.where(sl4, hist_small, hist_large)
         hist_r = jnp.where(sl4, hist_large, hist_small)
 
-        depth_w = st["leaf_depth"][ids] + 1
-        if has_mono:
-            l_min, l_max, r_min, r_max = child_bounds(
-                lstat_w, rstat_w, st["leaf_min"][ids],
-                st["leaf_max"][ids], feat_w, cat_w)
-            ch_mn = jnp.concatenate([l_min, r_min])
-            ch_mx = jnp.concatenate([l_max, r_max])
-
-        # children best splits: ONE vmapped scan over all 2W children
-        ch_hist = jnp.concatenate([hist_l, hist_r], axis=0)
         ch_stats = jnp.concatenate([lstat_w, rstat_w], axis=0)
         ch_depth = jnp.concatenate([depth_w, depth_w])
-        bests = children_bests(ch_hist, ch_stats,
-                               ch_mn if has_mono else None,
-                               ch_mx if has_mono else None)
+        if bests_small is not None:
+            # fused path: the smaller children's scans already ran in
+            # the histogram kernel; only the subtraction-trick larger
+            # children go through the standalone kernel, then the two
+            # halves stitch back into [left(W), right(W)] lane order
+            large_stats = jnp.where(small_left_w[:, None], rstat_w,
+                                    lstat_w)
+            if has_mono:
+                large_mn = jnp.where(small_left_w, r_min, l_min)
+                large_mx = jnp.where(small_left_w, r_max, l_max)
+            else:
+                large_mn = large_mx = None
+            bests_large = find_best_split_pallas(
+                hist_large, large_stats, nb_l, mt_l, fmask_l, sp,
+                monotone=mono_l, penalty=pen_l, min_output=large_mn,
+                max_output=large_mx)
+            bests = {}
+            for k in ("gain", "feature", "threshold", "default_left",
+                      "is_cat", "left_mask", "left_stats"):
+                sm, lg = bests_small[k], bests_large[k]
+                cnd = small_left_w.reshape((W,) + (1,) * (sm.ndim - 1))
+                bests[k] = jnp.concatenate(
+                    [jnp.where(cnd, sm, lg), jnp.where(cnd, lg, sm)],
+                    axis=0)
+            ch_hist = jnp.concatenate([hist_l, hist_r], axis=0)
+        else:
+            # children best splits: ONE batched scan over all 2W
+            # children
+            ch_hist = jnp.concatenate([hist_l, hist_r], axis=0)
+            bests = children_bests(ch_hist, ch_stats,
+                                   ch_mn if has_mono else None,
+                                   ch_mx if has_mono else None)
         allowed = (p.max_depth <= 0) | (ch_depth < p.max_depth)
         bests["gain"] = jnp.where(allowed, bests["gain"], NEG_INF)
         # materialization fence: without it XLA fuses the vmapped scan's

@@ -550,6 +550,441 @@ def find_best_split_c2f(coarse: jax.Array, win: jax.Array,
     }
 
 
+# ---- Pallas best-split kernel family --------------------------------
+#
+# The XLA split scan above reads the full (leaves x F x B x 3)
+# histogram back from HBM after the histogram pass wrote it — a pure
+# producer/consumer round-trip (the same memory-bound pairing the GPU
+# boosting systems fuse, arXiv:1706.08359 §4, arXiv:1806.11248 §3).
+# This kernel family runs the NUMERICAL threshold scan on-chip:
+#
+# - ``find_best_split_pallas``: a standalone per-(leaf, feature-tile)
+#   kernel over an already-materialized histogram (the subtraction-
+#   trick children, the root, the exact/speculative tiers): grid
+#   (leaf-lane, feature-tile), each step cumsums its (FC, B) tile in
+#   VMEM, evaluates both default directions + constraints, and
+#   reduces to ONE 16-lane partial row; a tiny second-stage argmax
+#   over tiles (XLA, O(tiles) work) picks the global winner.
+# - ``split_epilogue_rows``: the FUSED form — called by
+#   ``histogram_pallas_multi``/``_routed`` on their LAST row-tile grid
+#   step, consuming the accumulated histogram tile while it is still
+#   VMEM-resident (dequantization + hi/lo fold + two_col count proxy
+#   applied in-kernel), so the smaller-child scan never re-reads the
+#   histogram from HBM at all.
+#
+# Parity contract: numerical features only (the driver gates
+# categorical/EFB/c2f/forced to the XLA scan and records why —
+# models/gbdt.py tier gates); identical (feature, bin, default_left)
+# choice to :func:`find_best_split` with first-max tie order (lowest
+# bin within a feature, lowest feature globally), gains bit-equal in
+# the interpret-mode lane (the kernel evaluates the same jnp
+# expression tree) and within float tolerance across backends.  On a
+# CPU backend the kernels run under ``pl.pallas_call(...,
+# interpret=True)`` (utils/env.pallas_interpret) so tier-1 exercises
+# this path without a TPU.
+
+_PART_LANES = 16  # partial-row width: [gain, f_loc, j, dir, Lg, Lh, Lc, pad]
+
+
+def _split_compiler_params():
+    """Same scoped-VMEM raise as ops/histogram.py (the two modules
+    cannot share it without an import cycle)."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+        return pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+    except Exception:  # pragma: no cover - older pallas versions
+        return None
+
+
+def _scan_tile(g, h, c, nb, mt, fm, mono, pen, pg, ph, pc, gshift,
+               mn, mx, p: SplitParams):
+    """Shared numerical scan over one feature tile — the exact jnp
+    expression tree of :func:`find_best_split`'s numeric section, so
+    the kernel and the XLA scan agree bit-for-bit wherever the
+    backend evaluates both identically (always, in interpret mode).
+
+    g/h/c: (..., FC, B) per-channel histograms (dequantized);
+    nb/mt: (..., FC, 1) int32; fm: (..., FC, 1) bool; mono: (..., FC,
+    1) int32 or None; pen: (..., FC, 1) f32 or None;
+    pg/ph/pc/gshift/mn/mx: (..., 1, 1) per-lane scalars (mn/mx None =
+    unconstrained).  mono/pen/mn/mx None-ness must mirror the XLA
+    call exactly: a neutral-VALUE operand (zeros / ones / ±inf) is
+    value-identical but compiles a different expression tree, and the
+    extra clip/select ops fuse differently — gains then drift in the
+    last ulp vs :func:`find_best_split` (observed on the CPU
+    backend), which is exactly the bit-drift the static gating kills.
+    Returns (masked gain, dir_left, winner-side Lg/Lh/Lc), all
+    (..., FC, B).
+    """
+    l1, l2, mds = p.lambda_l1, p.lambda_l2, p.max_delta_step
+    jidx = jax.lax.broadcasted_iota(jnp.int32, g.shape, g.ndim - 1)
+    if p.any_missing:
+        has_missing = mt != 0
+        nv = nb - has_missing.astype(jnp.int32)
+    else:
+        nv = nb
+    in_value = jidx < nv
+    gv, hv, cv = g * in_value, h * in_value, c * in_value
+    if p.any_missing:
+        # miss stats via one-hot contraction (single nonzero term —
+        # exact), not a per-feature gather
+        moh = ((jidx == nb - 1) & has_missing).astype(g.dtype)
+        mg = jnp.sum(g * moh, axis=-1, keepdims=True)
+        mh = jnp.sum(h * moh, axis=-1, keepdims=True)
+        mc = jnp.sum(c * moh, axis=-1, keepdims=True)
+    cum_g = jnp.cumsum(gv, axis=-1)
+    cum_h = jnp.cumsum(hv, axis=-1)
+    cum_c = jnp.cumsum(cv, axis=-1)
+    cand_ok = jidx <= nv - 2
+
+    def scan_dir(default_left: bool):
+        Lg = cum_g + mg if default_left else cum_g
+        Lh = cum_h + mh if default_left else cum_h
+        Lc = cum_c + mc if default_left else cum_c
+        Rg, Rh, Rc = pg - Lg, ph - Lh, pc - Lc
+        gg = _split_gain(Lg, Lh + EPS, Rg, Rh + EPS, l1, l2, mds,
+                         mn, mx, mono) - gshift
+        if p.counts_proxy:
+            msh = max(p.min_sum_hessian_in_leaf, EPS)
+            ok = (Lh >= msh) & (Rh >= msh)
+        else:
+            md = max(p.min_data_in_leaf, 1)
+            ok = ((Lc >= md) & (Rc >= md) &
+                  (Lh >= p.min_sum_hessian_in_leaf) &
+                  (Rh >= p.min_sum_hessian_in_leaf))
+        return jnp.where(cand_ok & ok, gg, NEG_INF), Lg, Lh, Lc
+
+    g_r, Lg_r, Lh_r, Lc_r = scan_dir(False)
+    if p.any_missing:
+        g_l, Lg_l, Lh_l, Lc_l = scan_dir(True)
+        no_miss = mc <= 0
+        g_l = jnp.where(no_miss, NEG_INF, g_l)
+        gain = jnp.maximum(g_r, g_l)
+        dirl = g_l > g_r
+        Lg_s = jnp.where(dirl, Lg_l, Lg_r)
+        Lh_s = jnp.where(dirl, Lh_l, Lh_r)
+        Lc_s = jnp.where(dirl, Lc_l, Lc_r)
+    else:
+        gain, dirl = g_r, jnp.zeros(g_r.shape, bool)
+        Lg_s, Lh_s, Lc_s = Lg_r, Lh_r, Lc_r
+    if pen is not None:
+        gain = jnp.where(gain > 0.5 * NEG_INF, gain * pen, gain)
+    gain = jnp.where(fm, gain, NEG_INF)
+    return gain, dirl, Lg_s, Lh_s, Lc_s
+
+
+def _tile_best(gain, dirl, Lg, Lh, Lc):
+    """Tile-stage reduction: (..., FC, B) masked gains -> ((..., 16)
+    partial row, (..., FC, 1) per-feature bests).  Ties resolve to
+    the lowest bin within a feature and the lowest feature in the
+    tile — the first-max order of ``jnp.argmax`` in
+    :func:`find_best_split` — expressed as where/min reductions
+    (Mosaic-friendly; no argmax primitive needed in-kernel)."""
+    FC, B = gain.shape[-2:]
+    f32 = jnp.float32
+    jl = jax.lax.broadcasted_iota(jnp.int32, gain.shape, gain.ndim - 1)
+    fio = jax.lax.broadcasted_iota(jnp.int32, gain.shape[:-1] + (1,),
+                                   gain.ndim - 2)
+    best_pf = jnp.max(gain, axis=-1, keepdims=True)        # (...,FC,1)
+    best_j = jnp.min(jnp.where(gain == best_pf, jl, B), axis=-1,
+                     keepdims=True)                        # (...,FC,1)
+    gmax = jnp.max(best_pf, axis=-2, keepdims=True)        # (...,1,1)
+    f_loc = jnp.min(jnp.where(best_pf == gmax, fio, FC), axis=-2,
+                    keepdims=True)                         # (...,1,1)
+    f_oh = (fio == f_loc).astype(f32)                      # (...,FC,1)
+    j_star = jnp.sum(best_j.astype(f32) * f_oh, axis=-2,
+                     keepdims=True)                        # (...,1,1)
+    win = f_oh * (jl.astype(f32) == j_star)                # (...,FC,B)
+
+    def pick(x):
+        # winner extraction by one-hot sum: a single nonzero term, so
+        # the reduction is exact for any float value
+        s = jnp.sum(x.astype(f32) * win, axis=-1, keepdims=True)
+        return jnp.sum(s, axis=-2, keepdims=True)[..., 0]  # (...,1)
+
+    lead = gain.shape[:-2]
+    row = jnp.concatenate([
+        gmax[..., 0], f_loc.astype(f32)[..., 0], j_star[..., 0],
+        pick(dirl), pick(Lg), pick(Lh), pick(Lc),
+        jnp.zeros(lead + (_PART_LANES - 7,), f32)], axis=-1)
+    return row, best_pf
+
+
+def split_lane_scalars(parent, params: SplitParams, min_output=None,
+                       max_output=None) -> jax.Array:
+    """(W, 8) f32 per-lane scalar operand for the split-scan kernels:
+    [parent_g, parent_h, parent_c, gain_shift, min_out, max_out, 0, 0].
+    Neutral ±inf bounds reproduce the unconstrained XLA scan exactly
+    (clip against ±inf is the identity on the finite leaf outputs)."""
+    p = params
+    parent = jnp.asarray(parent, jnp.float32)
+    if parent.ndim == 1:
+        parent = parent[None]
+    W = parent.shape[0]
+    pgain = leaf_gain(parent[:, 0], parent[:, 1], p.lambda_l1,
+                      p.lambda_l2, p.max_delta_step)
+    gshift = (pgain + p.min_gain_to_split).astype(jnp.float32)
+    BIG = jnp.float32(jnp.inf)
+    mn = (jnp.full((W,), -BIG, jnp.float32) if min_output is None else
+          jnp.broadcast_to(jnp.asarray(min_output, jnp.float32), (W,)))
+    mx = (jnp.full((W,), BIG, jnp.float32) if max_output is None else
+          jnp.broadcast_to(jnp.asarray(max_output, jnp.float32), (W,)))
+    z = jnp.zeros((W,), jnp.float32)
+    return jnp.stack([parent[:, 0], parent[:, 1], parent[:, 2],
+                      gshift, mn, mx, z, z], axis=-1)
+
+
+def split_scan_descriptors(num_bins, missing_type, feature_mask,
+                           monotone, penalty, f_pad: int):
+    """Per-feature descriptor operands padded to the kernel feature
+    width, (f_pad, 1) each.  Padded features get nb=1 / fmask=0 so
+    they can never win a tile."""
+    F = num_bins.shape[0]
+    padf = f_pad - F
+    nb = jnp.pad(num_bins.astype(jnp.int32), (0, padf),
+                 constant_values=1)[:, None]
+    mt = jnp.pad(missing_type.astype(jnp.int32), (0, padf))[:, None]
+    fm = jnp.pad(feature_mask.astype(jnp.int32), (0, padf))[:, None]
+    mono = (jnp.zeros((f_pad, 1), jnp.int32) if monotone is None else
+            jnp.pad(monotone.astype(jnp.int32), (0, padf))[:, None])
+    pen = (jnp.ones((f_pad, 1), jnp.float32) if penalty is None else
+           jnp.pad(penalty.astype(jnp.float32), (0, padf),
+                   constant_values=1.0)[:, None])
+    return nb, mt, fm, mono, pen
+
+
+def split_epilogue_rows(acc, lane, nb, mt, fm, mono, pen, scale, *,
+                        width: int, exact: bool, two_col: bool,
+                        b_pad: int, params: SplitParams,
+                        has_bounds: bool = False) -> jax.Array:
+    """Fused best-split epilogue over one accumulated multi-pass tile.
+
+    Called INSIDE ``histogram_pallas_multi``/``_routed`` on the last
+    row-tile grid step: ``acc`` is the (FC*b_pad, 128) raw-unit
+    accumulator, fully accumulated and still VMEM-resident.  The lane
+    extraction (column slice + hi/lo fold + two_col count proxy) and
+    the dequantization (``scale`` (1, 8) = [sg, sh, sc, ...]; ones on
+    the float path) replicate the XLA post-processing bit-for-bit, so
+    the scan sees exactly the values :func:`find_best_split` would
+    have read back from HBM.  ``lane`` is (W, 8) per-lane scalars
+    (:func:`split_lane_scalars` of the CHILD each lane measures);
+    descriptors are (FC, 1).  Returns (W, 16) partial rows in the
+    :func:`_tile_best` layout.
+    """
+    W = width
+    cols = 2 if two_col else (3 if exact else 6)
+    FC = acc.shape[0] // b_pad
+    a = acc[:, :cols * W].reshape(FC, b_pad, W, cols)
+    a = jnp.moveaxis(a, 2, 0)                    # (W, FC, Bp, cols)
+    if two_col:
+        g_r, h_r = a[..., 0], a[..., 1]
+        c_r = h_r                                # count := hess copy
+    elif not exact:
+        s = a[..., :3] + a[..., 3:]              # hi + lo passes
+        g_r, h_r, c_r = s[..., 0], s[..., 1], s[..., 2]
+    else:
+        g_r, h_r, c_r = a[..., 0], a[..., 1], a[..., 2]
+    sg = scale[:, 0:1][..., None]                # (1, 1, 1)
+    sh = scale[:, 1:2][..., None]
+    sc = scale[:, 2:3][..., None]
+    g, h, c = g_r * sg, h_r * sh, c_r * sc
+    pg = lane[:, 0:1][..., None]                 # (W, 1, 1)
+    ph = lane[:, 1:2][..., None]
+    pc = lane[:, 2:3][..., None]
+    gs = lane[:, 3:4][..., None]
+    mn = lane[:, 4:5][..., None] if has_bounds else None
+    mx = lane[:, 5:6][..., None] if has_bounds else None
+    gain, dirl, Lg, Lh, Lc = _scan_tile(
+        g, h, c, nb[None], mt[None], fm[None] > 0,
+        mono[None] if mono is not None else None,
+        pen[None].astype(jnp.float32) if pen is not None else None,
+        pg, ph, pc, gs, mn, mx, params)
+    row, _ = _tile_best(gain, dirl, Lg, Lh, Lc)  # (W, 16)
+    return row
+
+
+def finish_split_partials(part, fc: int, num_bins, missing_type,
+                          params: SplitParams, max_bin: int):
+    """Global stage of the two-stage reduction: (W, T, 16) per-tile
+    partial rows -> per-lane split records.  O(W*T) XLA work —
+    the only part of the fused path that is not in-kernel.  First-max
+    over tiles preserves the feature-major tie order (tiles are
+    contiguous feature ranges)."""
+    p = params
+    W = part.shape[0]
+    ti = jnp.argmax(part[..., 0], axis=1)               # (W,) first max
+    row = jnp.take_along_axis(part, ti[:, None, None], axis=1)[:, 0]
+    f_star = (ti * fc).astype(jnp.int32) + row[:, 1].astype(jnp.int32)
+    j_star = row[:, 2].astype(jnp.int32)
+    dir_left = row[:, 3] > 0.5
+    jidx = jnp.arange(max_bin, dtype=jnp.int32)
+    nb_f = num_bins[f_star]
+    if p.any_missing:
+        has_m = missing_type[f_star] != 0
+        nv_f = nb_f - has_m.astype(jnp.int32)
+    else:
+        has_m = jnp.zeros((W,), bool)
+        nv_f = nb_f
+    left_mask = (jidx[None, :] <= j_star[:, None]) & \
+        (jidx[None, :] < nv_f[:, None])
+    if p.any_missing:
+        left_mask = left_mask | \
+            (dir_left[:, None] & has_m[:, None] &
+             (jidx[None, :] == nb_f[:, None] - 1))
+    return {
+        "gain": row[:, 0],
+        "feature": f_star,
+        "threshold": j_star,
+        "default_left": dir_left,
+        "is_cat": jnp.zeros((W,), bool),
+        "left_mask": left_mask,
+        "left_stats": row[:, 4:7],
+    }
+
+
+def _split_tile(f: int) -> Tuple[int, int]:
+    """(padded feature count, features per kernel tile).  Small
+    feature sets run one tile; wide ones chunk at 256 (8-sublane
+    aligned) so each grid step's VMEM working set stays bounded and
+    the tile partials feed the global reduction."""
+    f8 = (f + 7) // 8 * 8
+    if f8 <= 256:
+        return f8, f8
+    return (f + 255) // 256 * 256, 256
+
+
+def _split_scan_kernel(g_ref, h_ref, c_ref, nb_ref, mt_ref, fm_ref,
+                       *rest, params: SplitParams, has_mono: bool,
+                       has_pen: bool, has_bounds: bool,
+                       with_pfg: bool):
+    """One (leaf-lane, feature-tile) grid step of the standalone
+    best-split kernel: scan the tile, reduce to one partial row.
+    mono/pen operands ride along only when present (the static flags
+    keep the traced expression tree identical to the XLA scan's —
+    see :func:`_scan_tile`); the per-feature-gain output exists only
+    when requested (a pallas output cannot be DCE'd, so an always-on
+    (W, F) store would tax every hot-path scan for a value only the
+    voting ballots and the parity tests read)."""
+    rest = list(rest)
+    mono = rest.pop(0)[...][None] if has_mono else None  # (1, FC, 1)
+    pen = rest.pop(0)[...][None].astype(jnp.float32) if has_pen \
+        else None
+    if with_pfg:
+        lane_ref, part_ref, pfg_ref = rest
+    else:
+        lane_ref, part_ref = rest
+    g = g_ref[...]                               # (1, FC, B)
+    h = h_ref[...]
+    c = c_ref[...]
+    nb = nb_ref[...][None]                       # (1, FC, 1)
+    mt = mt_ref[...][None]
+    fm = fm_ref[...][None] > 0
+    lane = lane_ref[...]                         # (1, 8)
+    pg = lane[:, 0:1][..., None]                 # (1, 1, 1)
+    ph = lane[:, 1:2][..., None]
+    pc = lane[:, 2:3][..., None]
+    gs = lane[:, 3:4][..., None]
+    mn = lane[:, 4:5][..., None] if has_bounds else None
+    mx = lane[:, 5:6][..., None] if has_bounds else None
+    gain, dirl, Lg, Lh, Lc = _scan_tile(g, h, c, nb, mt, fm, mono, pen,
+                                        pg, ph, pc, gs, mn, mx, params)
+    row, best_pf = _tile_best(gain, dirl, Lg, Lh, Lc)
+    part_ref[...] = row[:, None, :]              # (1, 1, 16)
+    if with_pfg:
+        pfg_ref[...] = best_pf                   # (1, FC, 1)
+
+
+@functools.partial(jax.jit, static_argnames=("params",
+                                             "with_per_feature_gain"))
+def find_best_split_pallas(hist: jax.Array, parent: jax.Array,
+                           num_bins: jax.Array, missing_type: jax.Array,
+                           feature_mask: jax.Array, params: SplitParams,
+                           monotone=None, penalty=None, min_output=None,
+                           max_output=None,
+                           with_per_feature_gain: bool = False):
+    """Pallas best-split search — the standalone tier of the kernel
+    family (see the section comment above).
+
+    hist: (F, B, 3) for one leaf or (W, F, B, 3) for a lane batch
+    (the kernel grid runs lanes natively — no vmap); parent: (3,) or
+    (W, 3); min_output/max_output: scalar or (W,).  Numerical
+    features only (``params.any_cat`` must be False).  Returns the
+    :func:`find_best_split` record dict (batched with a leading W dim
+    when the input is batched); ``is_cat`` is always False, and
+    ``per_feature_gain`` is present only when
+    ``with_per_feature_gain`` asks for it (the extra kernel output
+    cannot be dead-code-eliminated like the XLA scan's).
+    """
+    import jax.experimental.pallas as pl
+    from ..utils.env import pallas_interpret
+
+    p = params
+    assert not p.any_cat, \
+        "find_best_split_pallas is numerical-only (driver-gated)"
+    batched = hist.ndim == 4
+    if not batched:
+        hist = hist[None]
+        parent = jnp.asarray(parent)[None]
+        if min_output is not None:
+            min_output = jnp.asarray(min_output)[None]
+            max_output = jnp.asarray(max_output)[None]
+    W, F, B, _ = hist.shape
+    f_pad, fc = _split_tile(F)
+    nt = f_pad // fc
+    hp = hist.astype(jnp.float32)
+    if f_pad != F:
+        hp = jnp.pad(hp, ((0, 0), (0, f_pad - F), (0, 0), (0, 0)))
+    nb, mt, fm, mono, pen = split_scan_descriptors(
+        num_bins, missing_type, feature_mask, monotone, penalty, f_pad)
+    lane = split_lane_scalars(parent, p, min_output, max_output)
+    has_mono = monotone is not None
+    has_pen = penalty is not None
+    has_bounds = min_output is not None
+
+    chan_spec = pl.BlockSpec((1, fc, B), lambda w, j: (w, j, 0))
+    desc_spec = pl.BlockSpec((fc, 1), lambda w, j: (j, 0))
+    in_specs = [chan_spec] * 3 + [desc_spec] * 3
+    operands = [hp[..., 0], hp[..., 1], hp[..., 2], nb, mt, fm]
+    if has_mono:
+        in_specs.append(desc_spec)
+        operands.append(mono)
+    if has_pen:
+        in_specs.append(desc_spec)
+        operands.append(pen)
+    in_specs.append(pl.BlockSpec((1, 8), lambda w, j: (w, 0)))
+    operands.append(lane)
+
+    out_specs = [pl.BlockSpec((1, 1, _PART_LANES),
+                              lambda w, j: (w, j, 0))]
+    out_shape = [jax.ShapeDtypeStruct((W, nt, _PART_LANES),
+                                      jnp.float32)]
+    if with_per_feature_gain:
+        out_specs.append(pl.BlockSpec((1, fc, 1),
+                                      lambda w, j: (w, j, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((W, f_pad, 1),
+                                              jnp.float32))
+    res = pl.pallas_call(
+        functools.partial(_split_scan_kernel, params=p,
+                          has_mono=has_mono, has_pen=has_pen,
+                          has_bounds=has_bounds,
+                          with_pfg=with_per_feature_gain),
+        grid=(W, nt),                    # (leaf lanes, feature tiles)
+        in_specs=in_specs,
+        out_specs=out_specs if with_per_feature_gain else out_specs[0],
+        out_shape=out_shape if with_per_feature_gain else out_shape[0],
+        compiler_params=_split_compiler_params(),
+        interpret=pallas_interpret(),
+    )(*operands)
+
+    part = res[0] if with_per_feature_gain else res
+    rec = finish_split_partials(part, fc, num_bins, missing_type, p, B)
+    if with_per_feature_gain:
+        rec["per_feature_gain"] = res[1][:, :F, 0]
+    if not batched:
+        rec = {k: v[0] for k, v in rec.items()}
+    return rec
+
+
 def eval_forced_split(hist: jax.Array, parent: jax.Array, feat, thr,
                       num_bins: jax.Array, missing_type: jax.Array,
                       params: SplitParams, monotone=None,

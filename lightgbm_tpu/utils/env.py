@@ -20,6 +20,34 @@ def force_host_platform_devices(n: int) -> None:
     ).strip()
 
 
+def pallas_interpret_forced() -> bool:
+    """True when the ``LTPU_PALLAS_INTERPRET`` env lane is armed: every
+    Pallas kernel runs under ``pl.pallas_call(..., interpret=True)``
+    AND the driver treats the backend as kernel-capable, so the whole
+    kernel tier (histogram passes, routed kernels, the best-split
+    scan) executes on a CPU-only host — the tier-1 parity lane for
+    code paths that otherwise need a real TPU.  Interpreter-mode wall
+    time measures the interpreter, not the kernel; this is a
+    correctness lane, never a benchmark."""
+    return os.environ.get("LTPU_PALLAS_INTERPRET", "") not in ("", "0")
+
+
+def pallas_interpret() -> bool:
+    """Interpret-mode decision for a ``pl.pallas_call`` site: the env
+    lane above, or a CPU default backend (Mosaic kernels cannot
+    compile there, so a direct kernel call on CPU — e.g.
+    ``split_kernel=pallas`` under ``JAX_PLATFORMS=cpu`` — always runs
+    interpreted).  Read at trace time; jit caches key on shapes/static
+    args only, so flip the env before the first kernel trace."""
+    if pallas_interpret_forced():
+        return True
+    try:
+        import jax
+        return jax.default_backend() == "cpu"
+    except Exception:  # pragma: no cover - jax not importable
+        return False
+
+
 def strip_non_cpu_backends() -> None:
     """Drop accelerator backend factories registered by interpreter
     startup hooks (e.g. a site-wide PJRT plugin) so CPU-only runs can
@@ -32,6 +60,16 @@ def strip_non_cpu_backends() -> None:
         import jax
         import jax._src.xla_bridge as xb
 
+        # Pallas registers TPU lowering rules at import time and
+        # requires the "tpu" platform NAME to still be known — import
+        # it before dropping the factories so the interpret-mode CPU
+        # lane (split/histogram kernels under pallas_interpret) can
+        # import the module from cache afterwards
+        try:
+            import jax.experimental.pallas  # noqa: F401
+            from jax.experimental.pallas import tpu  # noqa: F401
+        except Exception:  # pragma: no cover - pallas-less builds
+            pass
         # site startup hooks may have already forced a different
         # platform selection through jax.config (overriding the env
         # var) — pin the config itself back to cpu

@@ -96,7 +96,12 @@ _TYPE_FIELDS: Dict[str, Tuple[Tuple[str, Any], ...]] = {
     # configured in-flight depth) and ``fetch_overlap_s`` (wall
     # between the block's dispatch and its fetch — the window its
     # device compute overlapped host work); triage_run.py flags
-    # depth > 0 with ~zero overlap as pipelining silently disabled
+    # depth > 0 with ~zero overlap as pipelining silently disabled.
+    # ``split_kernel`` records the best-split engine that ran inside
+    # the block (pallas = the fused histogram→split kernel tier, xla
+    # = the vectorized scans) and ``split_fallback`` the tier gate
+    # that rejected the kernel tier when it did; triage_run.py flags
+    # an XLA fallback on a TPU backend as MED.
     "superstep": (("iter", int), ("k", int),
                   ("duration_ms", (int, float))),
     "eval": (("iter", int), ("results", list)),

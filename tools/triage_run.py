@@ -185,6 +185,44 @@ def scan_anomalies(records):
                                f"schedule? eligibility flapping?), "
                                f"so the per-block fetch RTT is "
                                f"un-hidden again"))
+    # split kernel silently fell back to XLA on a TPU backend: the
+    # fused histogram→split pass is off, so every grow level
+    # round-trips the full (leaves x features x bins) histogram
+    # through HBM again.  An EXPLICIT split_kernel=xla is an operator
+    # choice, not an anomaly; everything else (categorical gate, EFB,
+    # learner, c2f, forced splits) deserves a look because the config
+    # may be one knob away from the fast tier.  Evaluated PER
+    # run_start SEGMENT (multi-run daemon/resume streams mix
+    # backends): superstep records pair with THEIR run's backend, and
+    # a segment with no supersteps (unfused runs) triages from its
+    # run_start tier decision.
+    segs, cur = [], None
+    for r in records:
+        if r.get("type") == "run_start":
+            cur = {"backend": str(r.get("backend", "")).lower(),
+                   "tier": r.get("tier") or {}, "ss": []}
+            segs.append(cur)
+        elif r.get("type") == "superstep" and cur is not None \
+                and "split_kernel" in r:
+            cur["ss"].append((r.get("split_kernel"),
+                              r.get("split_fallback")))
+    for seg in segs:
+        backend = seg["backend"]
+        if not backend or backend in ("cpu", "unknown", "?"):
+            continue
+        if seg["ss"]:
+            sk, reason = seg["ss"][-1]
+        else:
+            sk = seg["tier"].get("split_kernel")
+            reason = (seg["tier"].get("gates") or {}).get("split")
+        if sk == "xla" and reason and "split_kernel=xla" not in reason:
+            out.append(("MED", f"split kernel fell back to XLA on a "
+                               f"{backend} backend: {reason} — the "
+                               f"fused histogram→split pass is "
+                               f"disabled, every grow level "
+                               f"round-trips the full histogram "
+                               f"through HBM"))
+            break
     # weak-scaling regression: sharded super-steps at DIFFERENT mesh
     # sizes in one run (the weak-scale bench grid, or a resumed run on
     # a wider mesh) whose per-iteration time grows with the shard
