@@ -485,6 +485,163 @@ def serve_only():
     return 0
 
 
+def obs_only():
+    """Fast path (``python bench.py --obs-only``): measure what the
+    observability plane COSTS on the CPU smoke shapes and write
+    BENCH_obs_cpu.json — train wall and serve throughput with the
+    plane off vs fully on (telemetry JSONL + span tagging + metrics
+    registry + armed flight recorder).  The plane must stay under 2%
+    wall on these shapes (docs/Observability.md pins the bar).
+
+    OFF = the telemetry JSONL with span tagging (inseparable from the
+    telemetry layer once obs is loaded: a contextvar read per record);
+    ON adds the REST of the plane — Prometheus metrics registry +
+    counter mirror and the armed flight-recorder ring — so the cells
+    price the plane's optional half on top of the always-on half.
+    OFF cells
+    run before any ON cell: the telemetry-counter mirror is a
+    process-wide install, so arming it first would retro-tax the
+    baseline.  ``spread_pct`` records the off-rep min..max spread —
+    on a noisy 2-core container an overhead below the spread is a
+    noise-floor reading, and render_benchmarks.py says so."""
+    import datetime
+    import tempfile
+
+    if ensure_backend(variant="obs") is None:
+        return 0
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serve import ServeConfig, Server
+    from lightgbm_tpu.utils import telemetry as _telemetry
+    _telemetry.install_jax_hooks()
+
+    n_rows = int(os.environ.get("BENCH_OBS_ROWS", "20000"))
+    n_feat = 28
+    rounds = int(os.environ.get("BENCH_OBS_ROUNDS", "30"))
+    reps = int(os.environ.get("BENCH_OBS_REPS", "3"))
+    n_req = int(os.environ.get("BENCH_OBS_REQUESTS", "300"))
+    rng = np.random.RandomState(0)
+    X = rng.randn(n_rows, n_feat).astype(np.float32)
+    w = rng.randn(n_feat).astype(np.float32)
+    y = (1.0 / (1.0 + np.exp(-(X @ w) * 0.5)) >
+         rng.random_sample(n_rows)).astype(np.float32)
+    Xq = rng.randn(64, n_feat)
+    tmp = tempfile.mkdtemp(prefix="bench_obs_")
+
+    def train_wall(extra):
+        params = {"objective": "binary", "num_leaves": 31,
+                  "verbose": -1, "metric": "None", "fused_iters": 4,
+                  **extra}
+        d = lgb.Dataset(X, label=y, params=dict(params))
+        t0 = time.perf_counter()
+        bst = lgb.train(dict(params), d, num_boost_round=rounds)
+        wall = time.perf_counter() - t0
+        rec = getattr(bst._gbdt, "_telemetry", None)
+        if rec is not None:
+            rec.close(log=False)
+        return wall, bst
+
+    def serve_rps(booster, cfg):
+        srv = Server(booster, config=cfg)
+        srv.start()
+        srv.predict(Xq)                    # warm the bucket
+        t0 = time.perf_counter()
+        for _ in range(n_req):
+            srv.predict(Xq)
+        wall = time.perf_counter() - t0
+        srv.stop()                         # flushes the recorder too
+        return n_req / wall
+
+    def tele(name, i):
+        return {"telemetry_file": os.path.join(tmp,
+                                               f"{name}_{i}.jsonl")}
+
+    # discarded warmup: the first train/serve pass pays the XLA
+    # compiles; without it the OFF cells eat warmup the ON cells
+    # then ride, and the "overhead" comes out negative
+    _, warm_bst = train_wall(tele("warm", 0))
+    serve_rps(warm_bst, ServeConfig(port=0, batch_wait_ms=0.0,
+                                    timeout_ms=60000, metrics=False,
+                                    warmup=False))
+    # interleaved ABBA reps: container-level drift (page cache, CPU
+    # governor, co-tenants) dwarfs the plane's cost, so off/on
+    # alternate within each rep pair and the order flips per pair;
+    # the plane is UNINSTALLED after each on-cell so off-cells stay
+    # a true baseline
+    from lightgbm_tpu.obs import flight as _flight
+    from lightgbm_tpu.obs import metrics as _om
+
+    def one_train(on, i):
+        if not on:
+            return train_wall(tele("toff", i))[0]
+        w = train_wall({**tele("ton", i),
+                        "obs_flight_recorder": True,
+                        "obs_capture_dir":
+                            os.path.join(tmp, "caps")})[0]
+        _flight.uninstall()
+        return w
+
+    def one_serve(on, i):
+        r = serve_rps(warm_bst,
+                      ServeConfig(port=0, batch_wait_ms=0.0,
+                                  timeout_ms=60000, metrics=on,
+                                  warmup=False,
+                                  **tele("son" if on else "soff", i)))
+        if on:
+            _om.uninstall_telemetry_mirror()
+        return r
+
+    t_off, t_on, rps_off, rps_on = [], [], [], []
+    for i in range(reps):
+        for on in ((False, True) if i % 2 == 0 else (True, False)):
+            (t_on if on else t_off).append(one_train(on, i))
+    for i in range(reps):
+        for on in ((False, True) if i % 2 == 0 else (True, False)):
+            (rps_on if on else rps_off).append(one_serve(on, i))
+    t_off.sort(), t_on.sort(), rps_off.sort(), rps_on.sort()
+
+    def med(vals):
+        return vals[len(vals) // 2]
+
+    def spread(vals):
+        return round(100.0 * (vals[-1] - vals[0]) / med(vals), 2)
+
+    cells = [
+        {"cell": "train", "rows": n_rows, "rounds": rounds,
+         "off_s": round(med(t_off), 3), "on_s": round(med(t_on), 3),
+         "spread_pct": spread(t_off),
+         "overhead_pct": round(
+             100.0 * (med(t_on) - med(t_off)) / med(t_off), 2)},
+        {"cell": "serve", "requests": n_req, "rows_per_req": 64,
+         "off_rps": round(med(rps_off), 1),
+         "on_rps": round(med(rps_on), 1),
+         "spread_pct": spread(rps_off),
+         "overhead_pct": round(
+             100.0 * (med(rps_off) - med(rps_on)) / med(rps_off), 2)},
+    ]
+    for c in cells:
+        print(json.dumps({"obs_cell": c["cell"], **c}), flush=True)
+    out = {
+        "metric": "obs_overhead_cpu",
+        "unit": "percent",
+        "backend": "cpu",
+        "date": datetime.date.today().isoformat(),
+        "source": "JAX_PLATFORMS=cpu python bench.py --obs-only",
+        "env": "2-core CPU container",
+        "plane": "metrics registry + counter mirror + armed flight "
+                 "recorder, on top of telemetry JSONL + span tagging "
+                 "(always-on once obs loads, present in BOTH cells)",
+        "reps": reps,
+        "cells": cells,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_obs_cpu.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    print(json.dumps({"wrote": os.path.basename(path)}), flush=True)
+    return 0
+
+
 def ckpt_only():
     """Fast path (``python bench.py --ckpt-only``): measure the
     checkpoint subsystem's cost envelope on the CPU backend and write
@@ -1517,6 +1674,8 @@ if __name__ == "__main__":
         sys.exit(serve_only())
     if "--ckpt-only" in sys.argv:
         sys.exit(ckpt_only())
+    if "--obs-only" in sys.argv:
+        sys.exit(obs_only())
     if "--continual-only" in sys.argv:
         sys.exit(continual_only())
     if "--weakscale-only" in sys.argv:

@@ -212,6 +212,8 @@ def _task_serve(params: Dict[str, str], config: Config) -> None:
     (``serve/watcher.py``, ``docs/Resilience.md``)."""
     from .basic import Booster
     from .ckpt import CheckpointManager
+    from .obs import flight as _flight
+    from .obs import spans as _spans
     from .serve import (CheckpointWatcher, FleetConfig, RegistryTarget,
                         Server, ServeConfig)
     from .serve.http import serve_http
@@ -220,7 +222,14 @@ def _task_serve(params: Dict[str, str], config: Config) -> None:
         Log.fatal("No model file: set input_model=<file> (a model "
                   "file, a ckpt_* checkpoint directory, or a "
                   "checkpoint root)")
+    _flight.ensure_installed(config)
     server = Server(config=ServeConfig.from_params(config))
+    # a supervisor-spawned replica marks its boot against the spawn
+    # trace (LTPU_TRACE env carrier) without adopting it process-wide
+    boot_carrier = _spans.parse(os.environ.get(_spans.ENV_VAR, ""))
+    if boot_carrier is not None:
+        _spans.point("replica_boot", boot_carrier,
+                     recorder=server._recorder, pid=os.getpid())
     watcher = None
     if os.path.isdir(config.input_model):
         # serve straight from a training checkpoint directory/root:

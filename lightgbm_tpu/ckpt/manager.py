@@ -43,6 +43,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import spans as _spans
 from ..utils.log import Log
 from ..utils import telemetry as _telemetry
 from . import atomic
@@ -184,6 +185,12 @@ class CheckpointManager:
                      else {"learner": "serial", "num_shards": 1,
                            "mesh_shape": [1]}),
         })
+        # trace carrier (obs/spans.py): a watcher in ANOTHER process
+        # re-enters this context, so the saving run's trace continues
+        # through validate -> canary -> publish -> first served request
+        trace = _spans.format_carrier()
+        if trace:
+            meta["trace"] = trace
         buf = io.BytesIO()
         np.savez(buf, **arrays)
         state_bytes = buf.getvalue()

@@ -583,6 +583,18 @@ PARAMS: List[Param] = [
        "expose POST/GET /faults, the remote driving surface of the "
        "fault-injection registry (utils/faults.py) — chaos tests "
        "only, NEVER in production", group="serve"),
+    _p("serve_metrics", True, bool, ("serve_metrics_enabled",),
+       "expose GET /metrics (Prometheus text format) on the serve "
+       "HTTP front: live request counters by status, bounded latency/"
+       "occupancy histograms, queue-depth gauges, and every process-"
+       "wide telemetry counter mirrored as ltpu_telemetry_* — the "
+       "scrape surface FleetSupervisor.metrics_text aggregates "
+       "across replicas (docs/Observability.md)", group="serve"),
+    _p("serve_metrics_latency_buckets", "", str, (),
+       "comma-separated upper bounds (ms) of the serve latency "
+       "histogram buckets; '' = the built-in log-spaced ladder "
+       "0.5ms..30s.  Bounded histograms are why a long-lived "
+       "replica's /stats and /metrics memory is O(1)", group="serve"),
     # ---- fleet (resilience layer: serve/fleet.py, serve/watcher.py) ----
     _p("fleet_replicas", 2, int, ("serve_replicas",),
        "serve processes the fleet supervisor runs; each replica pins "
@@ -745,6 +757,34 @@ PARAMS: List[Param] = [
        "daemon trains a batch; 0 = checkpoint only at batch "
        "boundaries (the default keeps the exact quarantine rewind "
        "within keep_last_n retention)", group="continual", check=">=0"),
+    # ---- obs (observability plane: lightgbm_tpu/obs/) ----
+    _p("obs_flight_recorder", False, bool, ("flight_recorder",),
+       "arm the anomaly-triggered flight recorder (obs/flight.py): a "
+       "bounded in-memory ring of recent telemetry records plus the "
+       "online anomaly rules (retrace storm, pipelining-disabled, "
+       "XLA-fallback-on-TPU, stall, rollback, nonfinite — shared "
+       "with triage_run.py); a firing rule dumps the ring and, on "
+       "device backends, a time-boxed jax.profiler trace into "
+       "obs_capture_dir with a 'capture' telemetry record pointing "
+       "at it", group="obs"),
+    _p("obs_capture_dir", "", str, (),
+       "flight-recorder capture root; '' = obs_captures/ next to "
+       "telemetry_file (or the working directory)", group="obs"),
+    _p("obs_ring_records", 2048, int, (),
+       "flight-recorder ring capacity: how many recent telemetry "
+       "records a capture dumps", group="obs", check=">0"),
+    _p("obs_capture_profile_ms", 2000, int, (),
+       "length of the time-boxed jax.profiler trace a capture "
+       "records on a live device backend (0 skips profiling; the "
+       "trace stops on a daemon thread so the hot path never "
+       "blocks)", group="obs", check=">=0"),
+    _p("obs_capture_cooldown_s", 60.0, float, (),
+       "debounce between flight-recorder captures — an anomaly "
+       "storm costs a handful of dumps, not a disk", group="obs",
+       check=">=0"),
+    _p("obs_max_captures", 4, int, (),
+       "capture budget per process; further anomalies only log",
+       group="obs", check=">=1"),
 ]
 
 _PARAM_BY_NAME: Dict[str, Param] = {p.name: p for p in PARAMS}

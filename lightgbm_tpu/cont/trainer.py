@@ -57,6 +57,8 @@ from .. import engine as engine_mod
 from ..basic import Booster, Dataset
 from ..ckpt import CheckpointManager
 from ..config import Config
+from ..obs import flight as _flight
+from ..obs import spans as _spans
 from ..serve.registry import model_fingerprint
 from ..utils import faults as _faults
 from ..utils import telemetry as _telemetry
@@ -118,6 +120,9 @@ class ContinualTrainer:
         cfg = Config(self.params)
         self.cont = config or ContinualConfig.from_params(cfg)
         self.cont.validate()
+        # obs_flight_recorder=true arms the process-wide anomaly
+        # capture ring (obs/flight.py) for the whole daemon lifetime
+        _flight.ensure_installed(cfg)
         self.root = str(cfg.checkpoint_dir or "")
         if not self.root:
             raise ValueError("continual training requires "
@@ -335,12 +340,26 @@ class ContinualTrainer:
     # one batch
     # ------------------------------------------------------------------
     def _consume(self, batch: Batch) -> str:
-        errs = self.validator.check(batch)
-        if errs:
-            self.source.quarantine(batch, "validate",
-                                   "; ".join(errs)[:300])
-            return "quarantined"
-        return self._train_batch(batch)
+        # one TRACE per batch, rooted here (obs/spans.py): ingest ->
+        # validate -> train -> checkpoint happen under it, the
+        # checkpoint carries it to the watcher, the watcher to the
+        # fleet publish and the first served request — one joinable
+        # timeline across processes (tools/trace_view.py)
+        rec = self.recorder or _telemetry.get_recorder()
+        with _spans.span("batch", recorder=rec, root=True,
+                         announce=True, task="continual",
+                         batch=batch.name, rows=batch.rows) as sp:
+            with _spans.span("validate", recorder=rec,
+                             batch=batch.name):
+                errs = self.validator.check(batch)
+            if errs:
+                self.source.quarantine(batch, "validate",
+                                       "; ".join(errs)[:300])
+                sp.set(outcome="quarantined")
+                return "quarantined"
+            out = self._train_batch(batch)
+            sp.set(outcome=out)
+            return out
 
     def _next_is_refit(self) -> bool:
         return (self.cont.refit_every > 0 and
@@ -391,7 +410,7 @@ class ContinualTrainer:
             th = threading.Thread(
                 target=self._run_attempt,
                 args=(batch, refit, start_iter, target_iter, box, hb,
-                      alive),
+                      alive, _spans.current()),
                 name=f"ltpu-continual-{batch.name}", daemon=True)
             th.start()
             stalled = False
@@ -547,38 +566,46 @@ class ContinualTrainer:
 
     def _run_attempt(self, batch: Batch, refit: bool, start_iter: int,
                      target_iter: int, box: Dict[str, Any],
-                     hb: _Heartbeat, alive) -> None:
+                     hb: _Heartbeat, alive, carrier=None) -> None:
         try:
-            eng = self._engine_params()
-            hb.beat()
-            if refit:
-                self._refit_attempt(batch, eng, start_iter, box, hb)
-                return
-            ds = self._make_dataset(batch, eng)
-            hb.beat()
-            nv = self._newest_valid_iter()
-            resume = nv is not None and nv > start_iter
-            kw: Dict[str, Any] = {}
-            init_model = None
-            if resume:
-                # mid-batch snapshot exists (preempt/crash/stall):
-                # continue BIT-exactly from it; num_boost_round is the
-                # absolute target under resume
-                kw["resume_from"] = "auto"
-                rounds = target_iter
-            else:
-                rounds = target_iter - start_iter
-                if self._model_text is not None:
-                    init_model = Booster(model_str=self._model_text)
-            bst = engine_mod.train(
-                eng, ds, num_boost_round=rounds,
-                init_model=init_model,
-                callbacks=[self._step_callback(hb, alive)],
-                verbose_eval=False, **kw)
-            if not alive():
-                return                 # abandoned: result is stale
-            box["model_text"] = bst.model_to_string(num_iteration=-1)
-            box["iter"] = int(bst._gbdt.completed_iterations())
+            # contextvars do not flow into thread targets: re-enter
+            # the batch trace so engine.train's 'train' span (and the
+            # checkpoint saves, whose extra.json carries the context
+            # to the watcher) parent under the batch root
+            with _spans.use(carrier):
+                eng = self._engine_params()
+                hb.beat()
+                if refit:
+                    self._refit_attempt(batch, eng, start_iter, box,
+                                        hb)
+                    return
+                ds = self._make_dataset(batch, eng)
+                hb.beat()
+                nv = self._newest_valid_iter()
+                resume = nv is not None and nv > start_iter
+                kw: Dict[str, Any] = {}
+                init_model = None
+                if resume:
+                    # mid-batch snapshot exists (preempt/crash/stall):
+                    # continue BIT-exactly from it; num_boost_round is
+                    # the absolute target under resume
+                    kw["resume_from"] = "auto"
+                    rounds = target_iter
+                else:
+                    rounds = target_iter - start_iter
+                    if self._model_text is not None:
+                        init_model = Booster(
+                            model_str=self._model_text)
+                bst = engine_mod.train(
+                    eng, ds, num_boost_round=rounds,
+                    init_model=init_model,
+                    callbacks=[self._step_callback(hb, alive)],
+                    verbose_eval=False, **kw)
+                if not alive():
+                    return             # abandoned: result is stale
+                box["model_text"] = bst.model_to_string(
+                    num_iteration=-1)
+                box["iter"] = int(bst._gbdt.completed_iterations())
         except NumericalHealthError as exc:
             box["error"] = exc
         except BaseException as exc:       # noqa: BLE001 - the loop

@@ -356,8 +356,23 @@ def train(params: Dict[str, Any], train_set: Dataset,
         except Exception as exc:  # a full disk must not kill training
             Log.warning("checkpoint save failed (%s): %s", reason, exc)
 
+    import contextlib as _contextlib
     import time as _time
+
+    from .obs import flight as _flight
+    from .obs import spans as _spans
     from .utils.profiling import timed
+
+    # obs plane (docs/Observability.md): arm the anomaly-triggered
+    # flight recorder when asked, and run the loop under a 'train'
+    # span — a daemon batch's ambient trace makes it a child, a bare
+    # CLI run roots a fresh trace the checkpoint carries onward
+    _flight.ensure_installed(cfg)
+    _obs_stack = _contextlib.ExitStack()
+    _obs_stack.enter_context(_spans.span(
+        "train", recorder=getattr(booster._gbdt, "_telemetry", None),
+        announce=True, rounds=int(num_boost_round),
+        start_iter=int(start_iter)))
     t_train0 = _time.perf_counter()
     try:
         for i in range(start_iter, num_boost_round):
@@ -412,7 +427,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
                        else "final")
     finally:
         # handlers are process-global: restore them even when an
-        # update/eval/callback raises mid-loop
+        # update/eval/callback raises mid-loop.  The span closes with
+        # the in-flight exception (sys.exc_info() is live inside a
+        # finally) so a crashed run emits status="error", not "ok".
+        import sys as _sys
+        _obs_stack.__exit__(*_sys.exc_info())
         guard.restore()
     if booster.best_iteration <= 0:
         for item in (booster.eval_set() if booster._gbdt.metrics else []):

@@ -1,0 +1,25 @@
+"""The live observability plane (``docs/Observability.md``).
+
+Three pillars on top of the JSONL run-record discipline
+(``utils/telemetry.py``):
+
+- :mod:`~lightgbm_tpu.obs.spans` — cross-process trace spans: one
+  snapshot's ingest -> train -> checkpoint -> validate -> canary ->
+  publish -> first-served-request lifecycle as ONE joinable trace
+  (``tools/trace_view.py`` renders it).
+- :mod:`~lightgbm_tpu.obs.metrics` — process-wide counters / gauges /
+  bounded histograms exported in Prometheus text format
+  (``GET /metrics`` on the serve front;
+  ``FleetSupervisor.metrics_text`` aggregates replicas).
+- :mod:`~lightgbm_tpu.obs.flight` — a bounded ring of recent records
+  plus online anomaly triggers (``obs/rules.py``, shared with
+  ``triage_run.py``) that dump the ring and a time-boxed
+  ``jax.profiler`` capture the moment a run misbehaves.
+
+Everything here is stdlib-only and importable without jax.
+"""
+from . import metrics, rules, spans  # noqa: F401
+from .flight import FlightRecorder, ensure_installed  # noqa: F401
+
+__all__ = ["spans", "metrics", "rules", "FlightRecorder",
+           "ensure_installed"]

@@ -1,0 +1,219 @@
+"""Anomaly-triggered flight recorder: when a run misbehaves, leave
+artifacts, not a repro request.
+
+A :class:`FlightRecorder` registers as a telemetry emit observer
+(``utils/telemetry.py``): every record any recorder in the process
+emits lands in a bounded in-memory ring AND feeds the shared online
+anomaly rules (``obs/rules.py``).  When a trigger rule fires (retrace
+storm, pipelining-disabled, XLA-fallback-on-TPU, stall, rollback,
+nonfinite — ``rules.FLIGHT_TRIGGERS``), the recorder dumps a capture
+directory::
+
+    <obs_capture_dir>/capture_<seq>_<code>/
+      anomaly.json    # code, severity, message, wall_time, pid
+      ring.jsonl      # the last obs_ring_records telemetry records
+      profile/        # time-boxed jax.profiler trace (device backends)
+
+and emits a ``capture`` telemetry record pointing at it.  The profiler
+leg runs only when a device backend is live (``jax.default_backend()``
+not cpu, or ``LTPU_OBS_FORCE_PROFILE=1`` for tests): it starts a
+``jax.profiler`` trace and stops it after ``obs_capture_profile_ms``
+on a daemon thread, so the hot path never blocks on trace teardown.
+Captures are debounced (``obs_capture_cooldown_s``) and bounded
+(``obs_max_captures``) — an anomaly storm costs a handful of dumps,
+not a disk.
+
+Enable with ``obs_flight_recorder=true`` (params/CLI); ``engine.train``,
+``serve.Server`` and the continual daemon all call
+:func:`ensure_installed`, so whichever subsystem starts first arms the
+one process-wide instance.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils import telemetry as _telemetry
+from ..utils.log import Log
+from . import rules as _rules
+
+__all__ = ["FlightRecorder", "ensure_installed", "get_installed",
+           "uninstall"]
+
+
+class FlightRecorder:
+    """Bounded ring of recent telemetry records + online anomaly
+    triggers + capture dumps.  Thread-safe; one instance per process
+    is the intended shape (:func:`ensure_installed`)."""
+
+    def __init__(self, capture_dir: str, ring_records: int = 2048,
+                 profile_ms: float = 2000.0, cooldown_s: float = 60.0,
+                 max_captures: int = 4,
+                 triggers: Tuple[str, ...] = _rules.FLIGHT_TRIGGERS):
+        self.capture_dir = str(capture_dir)
+        self.ring: "deque[Dict[str, Any]]" = \
+            deque(maxlen=max(int(ring_records), 16))
+        self.profile_ms = float(profile_ms)
+        self.cooldown_s = float(cooldown_s)
+        self.max_captures = int(max_captures)
+        self.triggers = tuple(triggers)
+        self.captures: List[str] = []
+        self._lock = threading.Lock()
+        self._scanner = _rules.OnlineScanner()
+        self._last_capture = 0.0
+        self._seq = 0
+        self._reentrant = threading.local()
+
+    # -- observer (telemetry.add_emit_observer) ------------------------
+    def observe(self, rec: Dict[str, Any], recorder) -> None:
+        if getattr(self._reentrant, "busy", False):
+            return                      # our own capture record
+        with self._lock:
+            self.ring.append(rec)
+            anomalies = self._scanner.feed(rec)
+        for sev, code, msg in anomalies:
+            if code in self.triggers:
+                self.capture(code, sev, msg, recorder)
+
+    # -- capture -------------------------------------------------------
+    def capture(self, code: str, severity: str, message: str,
+                recorder=None) -> Optional[str]:
+        """Dump the ring (and start a device profile) for one firing
+        anomaly.  Returns the capture directory, or None when
+        debounced/bounded."""
+        now = time.monotonic()
+        with self._lock:
+            if len(self.captures) >= self.max_captures:
+                return None
+            if self._last_capture and \
+                    now - self._last_capture < self.cooldown_s:
+                return None
+            self._last_capture = now
+            self._seq += 1
+            seq = self._seq
+            ring = list(self.ring)
+        path = os.path.join(self.capture_dir,
+                            f"capture_{seq:03d}_{code}")
+        self._reentrant.busy = True
+        try:
+            os.makedirs(path, exist_ok=True)
+            with open(os.path.join(path, "anomaly.json"), "w") as f:
+                json.dump({"code": code, "severity": severity,
+                           "message": message, "pid": os.getpid(),
+                           "wall_time": round(time.time(), 3),
+                           "ring_records": len(ring)}, f,
+                          sort_keys=True, indent=1)
+            with open(os.path.join(path, "ring.jsonl"), "w") as f:
+                for r in ring:
+                    f.write(json.dumps(r, sort_keys=True) + "\n")
+            profiling = self._start_profile(path)
+            rec = recorder or _telemetry.get_recorder()
+            if rec is not None:
+                rec.emit("capture", trigger=code, path=path,
+                         severity=severity, message=str(message)[:300],
+                         ring_records=len(ring), profile=profiling)
+            _telemetry.counters.incr("obs_captures")
+            with self._lock:
+                self.captures.append(path)
+            Log.warning("flight recorder: %s anomaly captured -> %s "
+                        "(%d ring records%s)", code, path, len(ring),
+                        ", profiling" if profiling else "")
+            return path
+        except Exception as exc:  # noqa: BLE001 - never break the run
+            Log.warning("flight recorder: capture failed: %s", exc)
+            return None
+        finally:
+            self._reentrant.busy = False
+
+    def _start_profile(self, path: str) -> bool:
+        """Time-boxed ``jax.profiler`` trace into ``<path>/profile``.
+        Only on live device backends (cpu profiles are pure overhead;
+        force with LTPU_OBS_FORCE_PROFILE=1 for tests)."""
+        if self.profile_ms <= 0:           # 0 = profiling disabled
+            return False
+        force = os.environ.get("LTPU_OBS_FORCE_PROFILE", "") == "1"
+        try:
+            import jax
+            backend = jax.default_backend()
+        except Exception:  # noqa: BLE001 - no jax, no profile
+            return False
+        if backend in ("cpu",) and not force:
+            return False
+        prof_dir = os.path.join(path, "profile")
+        try:
+            jax.profiler.start_trace(prof_dir)
+        except Exception:  # noqa: BLE001 - profiler busy/unsupported
+            return False
+
+        def _stop():
+            time.sleep(max(self.profile_ms, 0.0) / 1e3)
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001
+                pass
+
+        threading.Thread(target=_stop, name="ltpu-obs-profile",
+                         daemon=True).start()
+        return True
+
+
+# ----------------------------------------------------------------------
+# process-wide install
+# ----------------------------------------------------------------------
+_INSTALLED: Optional[FlightRecorder] = None
+_INSTALL_LOCK = threading.Lock()
+
+
+def ensure_installed(config=None, capture_dir: Optional[str] = None
+                     ) -> Optional[FlightRecorder]:
+    """Arm the process-wide flight recorder when
+    ``obs_flight_recorder`` is on (idempotent; the first caller's
+    knobs win).  ``config`` is a resolved
+    :class:`~lightgbm_tpu.config.Config` (or anything with the
+    ``obs_*`` attributes); None reads defaults."""
+    global _INSTALLED
+    enabled = bool(getattr(config, "obs_flight_recorder", False))
+    if not enabled:
+        return _INSTALLED
+    with _INSTALL_LOCK:
+        if _INSTALLED is not None:
+            return _INSTALLED
+        root = capture_dir or \
+            str(getattr(config, "obs_capture_dir", "") or "")
+        if not root:
+            tele = str(getattr(config, "telemetry_file", "") or "")
+            base = os.path.dirname(os.path.abspath(tele)) if tele \
+                else os.getcwd()
+            root = os.path.join(base, "obs_captures")
+        fr = FlightRecorder(
+            root,
+            ring_records=int(getattr(config, "obs_ring_records", 2048)
+                             or 2048),
+            profile_ms=float(getattr(config, "obs_capture_profile_ms",
+                                     2000)),
+            cooldown_s=float(getattr(config, "obs_capture_cooldown_s",
+                                     60.0) or 0.0),
+            max_captures=int(getattr(config, "obs_max_captures", 4)
+                             or 4))
+        _telemetry.add_emit_observer(fr.observe)
+        _INSTALLED = fr
+        Log.info("flight recorder armed: ring=%d records, captures -> "
+                 "%s", fr.ring.maxlen, fr.capture_dir)
+        return fr
+
+
+def get_installed() -> Optional[FlightRecorder]:
+    return _INSTALLED
+
+
+def uninstall() -> None:
+    """Detach the process-wide instance (tests)."""
+    global _INSTALLED
+    with _INSTALL_LOCK:
+        if _INSTALLED is not None:
+            _telemetry.remove_emit_observer(_INSTALLED.observe)
+            _INSTALLED = None

@@ -1,0 +1,613 @@
+"""Process-wide live metrics: counters / gauges / bounded histograms
+exported in Prometheus text format.
+
+The telemetry layer (``utils/telemetry.py``) answers "what happened in
+this run" after the fact; this registry answers "what is happening
+RIGHT NOW" to a scraper.  Both are fed by the same call sites: the
+serve dispatcher observes each request into a labeled counter and a
+latency histogram at the same point it emits the ``serve`` record, and
+every process-wide telemetry counter (``telemetry.counters``) is
+mirrored into a ``ltpu_telemetry_*`` counter via
+:func:`install_telemetry_mirror` — so ``GET /metrics`` and the
+``run_end`` rollup agree bit-for-bit (pinned by the CI metrics-scrape
+smoke, ``tools/loadgen_serve.py``).
+
+Memory is O(1) by construction: counters and gauges are scalars per
+label set, histograms hold a FIXED bucket vector (no sample ring), and
+percentiles come from linear interpolation inside the owning bucket —
+the primitive the serve ``/stats`` rollups ride so a long-lived
+replica never grows.
+
+Fleet aggregation: :func:`aggregate` merges N replica scrapes into one
+exposition with a ``replica`` label per series
+(``FleetSupervisor.metrics_text``), the scrape surface a router tier
+consumes.  :func:`parse_text` is the shared parser (CI oracle checks,
+the aggregator itself).
+
+Stdlib-only; importable without jax.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from ..utils import telemetry as _telemetry
+
+__all__ = ["Counter", "Gauge", "Histogram", "RollingHistogram",
+           "MetricsRegistry", "get_registry", "render", "parse_text",
+           "aggregate", "install_telemetry_mirror",
+           "uninstall_telemetry_mirror", "DEFAULT_LATENCY_BUCKETS_MS",
+           "OCCUPANCY_BUCKETS"]
+
+# serving latencies: sub-ms engine dispatches through multi-second
+# stragglers, roughly log-spaced (le= upper bounds, ms)
+DEFAULT_LATENCY_BUCKETS_MS: Tuple[float, ...] = (
+    0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0, 30000.0)
+OCCUPANCY_BUCKETS: Tuple[float, ...] = (
+    0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
+
+_NAME_OK = set("abcdefghijklmnopqrstuvwxyz"
+               "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_:")
+
+
+def _sanitize(name: str) -> str:
+    return "".join(c if c in _NAME_OK else "_" for c in str(name))
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample formatting: integers without the trailing
+    ``.0`` (scrapers accept both; the compact form diffs cleanly)."""
+    f = float(v)
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _labels_str(labelnames: Tuple[str, ...],
+                labelvalues: Tuple[str, ...]) -> str:
+    if not labelnames:
+        return ""
+    inner = ",".join(
+        '%s="%s"' % (n, str(v).replace("\\", "\\\\").replace('"', '\\"')
+                     .replace("\n", "\\n"))
+        for n, v in zip(labelnames, labelvalues))
+    return "{" + inner + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str,
+                 labelnames: Tuple[str, ...]):
+        self.name = _sanitize(name)
+        self.help = str(help_)
+        self.labelnames = tuple(str(n) for n in labelnames)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], Any] = {}
+
+    def labels(self, **kw):
+        vals = tuple(str(kw.get(n, "")) for n in self.labelnames)
+        with self._lock:
+            child = self._children.get(vals)
+            if child is None:
+                child = self._new_child()
+                self._children[vals] = child
+            return child
+
+    def _default(self):
+        """The no-label child (created on first touch)."""
+        return self.labels()
+
+    def samples(self) -> List[Tuple[str, Tuple[str, ...],
+                                    Tuple[str, ...], float]]:
+        """(suffixed name, labelnames, labelvalues, value) rows."""
+        raise NotImplementedError
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        for name, lnames, lvals, value in self.samples():
+            lines.append(f"{name}{_labels_str(lnames, lvals)} "
+                         f"{_fmt(value)}")
+        return lines
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _new_child(self):
+        return _CounterChild()
+
+    def inc(self, by: float = 1.0, **labels) -> None:
+        self.labels(**labels).inc(by)
+
+    def value(self, **labels) -> float:
+        return self.labels(**labels).value
+
+    def samples(self):
+        with self._lock:
+            items = sorted(self._children.items())
+        return [(self.name, self.labelnames, vals, c.value)
+                for vals, c in items]
+
+
+class _CounterChild:
+    __slots__ = ("_lock", "value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0.0
+
+    def inc(self, by: float = 1.0) -> None:
+        with self._lock:
+            self.value += by
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help_, labelnames=(),
+                 callback: Optional[Callable[[], float]] = None):
+        super().__init__(name, help_, labelnames)
+        self._callback = callback
+
+    def _new_child(self):
+        return _GaugeChild()
+
+    def set(self, v: float, **labels) -> None:
+        self.labels(**labels).set(v)
+
+    def set_callback(self, fn: Callable[[], float]) -> None:
+        """Scrape-time gauge: ``fn()`` is evaluated at render.  Re-
+        setting replaces the previous callback (a fresh Server in the
+        same process takes the series over)."""
+        self._callback = fn
+
+    def samples(self):
+        if self._callback is not None:
+            try:
+                v = float(self._callback())
+            except Exception:  # noqa: BLE001 - a dead provider is 0
+                v = 0.0
+            return [(self.name, (), (), v)]
+        with self._lock:
+            items = sorted(self._children.items())
+        return [(self.name, self.labelnames, vals, g.value)
+                for vals, g in items]
+
+
+class _GaugeChild:
+    __slots__ = ("_lock", "value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram: O(len(buckets)) memory however many
+    observations arrive.  Also usable standalone (un-registered) — the
+    serve ``/stats`` rollup keeps a private one per server."""
+
+    kind = "histogram"
+
+    def __init__(self, name="", help_="", labelnames=(),
+                 buckets: Iterable[float] = DEFAULT_LATENCY_BUCKETS_MS):
+        super().__init__(name, help_, labelnames)
+        b = sorted(float(x) for x in buckets)
+        if not b:
+            raise ValueError("histogram needs at least one bucket")
+        self.buckets = tuple(b)
+
+    def _new_child(self):
+        return _HistogramChild(self.buckets)
+
+    def observe(self, v: float, **labels) -> None:
+        self.labels(**labels).observe(v)
+
+    def percentile(self, q: float, **labels) -> float:
+        return self.labels(**labels).percentile(q)
+
+    def count(self, **labels) -> int:
+        return self.labels(**labels).count
+
+    def samples(self):
+        with self._lock:
+            items = sorted(self._children.items())
+        out = []
+        for vals, h in items:
+            cum = 0
+            counts, total, s = h.snapshot()
+            for ub, c in zip(self.buckets, counts):
+                cum += c
+                out.append((self.name + "_bucket",
+                            self.labelnames + ("le",),
+                            vals + (_fmt(ub),), float(cum)))
+            out.append((self.name + "_bucket",
+                        self.labelnames + ("le",),
+                        vals + ("+Inf",), float(total)))
+            out.append((self.name + "_sum", self.labelnames, vals, s))
+            out.append((self.name + "_count", self.labelnames, vals,
+                        float(total)))
+        return out
+
+
+class _HistogramChild:
+    __slots__ = ("_lock", "_buckets", "_counts", "count", "sum",
+                 "_min", "_max")
+
+    def __init__(self, buckets: Tuple[float, ...]):
+        self._lock = threading.Lock()
+        self._buckets = buckets
+        self._counts = [0] * (len(buckets) + 1)  # +1 = overflow
+        self.count = 0
+        self.sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        lo, hi = 0, len(self._buckets)
+        while lo < hi:                      # first bucket with ub >= v
+            mid = (lo + hi) // 2
+            if v <= self._buckets[mid]:
+                hi = mid
+            else:
+                lo = mid + 1
+        with self._lock:
+            self._counts[lo] += 1
+            self.count += 1
+            self.sum += v
+            self._min = min(self._min, v)
+            self._max = max(self._max, v)
+
+    def snapshot(self) -> Tuple[List[int], int, float]:
+        with self._lock:
+            return list(self._counts[:-1]), self.count, self.sum
+
+    def percentile(self, q: float) -> float:
+        """Estimate by linear interpolation inside the owning bucket,
+        clamped to the observed min/max so tiny sample counts don't
+        report a bucket bound nothing ever hit."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self.count
+            vmin, vmax = self._min, self._max
+        if total == 0:
+            return 0.0
+        rank = q * total
+        cum = 0
+        lower = 0.0
+        for i, c in enumerate(counts):
+            upper = self._buckets[i] if i < len(self._buckets) else vmax
+            if cum + c >= rank and c > 0:
+                frac = (rank - cum) / c
+                est = lower + frac * (max(upper, lower) - lower)
+                return float(min(max(est, vmin), vmax))
+            cum += c
+            lower = upper
+        return float(vmax)
+
+
+class RollingHistogram:
+    """Two-epoch rotating bounded histogram: percentiles reflect the
+    LAST one-to-two ``window_s`` of observations, not the process
+    lifetime.  This is the recency property percentile comparisons
+    need — the rollback watchdog diffs a replica's /stats p99 before
+    vs after a deploy, and percentiles (unlike counters) cannot be
+    delta'd by the reader, so a lifetime histogram on a long-lived
+    replica would dilute a fresh latency regression below the tail
+    and never trip the trigger.  Memory stays O(buckets): rotation
+    swaps current into previous and clears, no samples are kept."""
+
+    def __init__(self, buckets: Iterable[float] =
+                 DEFAULT_LATENCY_BUCKETS_MS, window_s: float = 60.0):
+        b = tuple(sorted(float(x) for x in buckets))
+        if not b:
+            raise ValueError("histogram needs at least one bucket")
+        self.buckets = b
+        self.window_s = float(window_s)
+        self._lock = threading.Lock()
+        self._cur = _HistogramChild(b)
+        self._prev = _HistogramChild(b)
+        self._epoch = time.monotonic()
+
+    def _maybe_rotate(self, now: float) -> None:
+        # caller holds self._lock
+        if now - self._epoch >= self.window_s:
+            # a long quiet gap means BOTH epochs are stale
+            if now - self._epoch >= 2 * self.window_s:
+                self._prev = _HistogramChild(self.buckets)
+            else:
+                self._prev = self._cur
+            self._cur = _HistogramChild(self.buckets)
+            self._epoch = now
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._maybe_rotate(time.monotonic())
+            cur = self._cur
+        cur.observe(v)
+
+    def percentile(self, q: float) -> float:
+        with self._lock:
+            self._maybe_rotate(time.monotonic())
+            cur, prev = self._cur, self._prev
+        merged = _HistogramChild(self.buckets)
+        for h in (prev, cur):
+            with h._lock:
+                for i, c in enumerate(h._counts):
+                    merged._counts[i] += c
+                merged.count += h.count
+                merged.sum += h.sum
+                merged._min = min(merged._min, h._min)
+                merged._max = max(merged._max, h._max)
+        return merged.percentile(q)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._cur.count + self._prev.count
+
+
+# ----------------------------------------------------------------------
+# registry
+# ----------------------------------------------------------------------
+class MetricsRegistry:
+    """Named metrics with idempotent registration: asking for an
+    existing name returns the existing metric (kind/labels must
+    match), so independent subsystems share series safely."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _register(self, cls, name: str, help_: str,
+                  labelnames: Tuple[str, ...], **kw) -> _Metric:
+        name = _sanitize(name)
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls) or \
+                        m.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{m.kind} with labels {m.labelnames}")
+                return m
+            m = cls(name, help_, labelnames, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help_: str = "",
+                labelnames: Tuple[str, ...] = ()) -> Counter:
+        return self._register(Counter, name, help_, tuple(labelnames))
+
+    def gauge(self, name: str, help_: str = "",
+              labelnames: Tuple[str, ...] = ()) -> Gauge:
+        return self._register(Gauge, name, help_, tuple(labelnames))
+
+    def gauge_callback(self, name: str, fn: Callable[[], float],
+                       help_: str = "") -> Gauge:
+        g = self._register(Gauge, name, help_, ())
+        g.set_callback(fn)
+        return g
+
+    def release_gauge_callback(self, name: str, fn) -> None:
+        """Drop a scrape-time gauge callback IF it is still the
+        registered one — a stopped Server must release the closure
+        pinning it (and its models) without clobbering a newer
+        server's takeover of the series."""
+        with self._lock:
+            g = self._metrics.get(_sanitize(name))
+        if isinstance(g, Gauge) and g._callback is fn:
+            g._callback = None
+
+    def histogram(self, name: str, help_: str = "",
+                  labelnames: Tuple[str, ...] = (),
+                  buckets: Iterable[float] = DEFAULT_LATENCY_BUCKETS_MS
+                  ) -> Histogram:
+        return self._register(Histogram, name, help_, tuple(labelnames),
+                              buckets=buckets)
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._metrics.pop(_sanitize(name), None)
+
+    def render(self) -> str:
+        """The full Prometheus text exposition (scrape-during-write
+        safe: every metric snapshots under its own lock)."""
+        with self._lock:
+            metrics = [self._metrics[k]
+                       for k in sorted(self._metrics)]
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def render() -> str:
+    return _REGISTRY.render()
+
+
+# ----------------------------------------------------------------------
+# telemetry-counter mirror
+# ----------------------------------------------------------------------
+_MIRROR_LOCK = threading.Lock()
+_MIRROR_ON = False
+_MIRROR_HOOK = None
+
+
+def install_telemetry_mirror(registry: Optional[MetricsRegistry] = None
+                             ) -> None:
+    """Mirror every process-wide telemetry counter
+    (``telemetry.counters``, e.g. ``xla_compiles``,
+    ``serve_batches``) into ``ltpu_telemetry_<name>`` counters.
+    Idempotent; existing totals are seeded so the scrape equals the
+    snapshot from the first render on."""
+    global _MIRROR_ON, _MIRROR_HOOK
+    reg = registry or _REGISTRY
+    with _MIRROR_LOCK:
+        if _MIRROR_ON:
+            return
+        _MIRROR_ON = True
+
+    children: Dict[str, Any] = {}
+
+    def _hook(name: str, by: float) -> None:
+        # per-increment hot path: resolve the metric child ONCE per
+        # counter name (registry lookup + name sanitize are not free
+        # at serve request rates)
+        child = children.get(name)
+        if child is None:
+            child = reg.counter(
+                f"ltpu_telemetry_{name}",
+                "mirrored process-wide telemetry counter").labels()
+            children[name] = child
+        child.inc(by)
+
+    def _prime(snapshot: Dict[str, float]) -> None:
+        # runs atomically with hook registration (under the counter
+        # lock): seed/top-up every series to the snapshot, so no
+        # increment is ever double-counted or lost across the
+        # install window (the bit-for-bit scrape contract)
+        for name, value in snapshot.items():
+            c = reg.counter(f"ltpu_telemetry_{name}",
+                            "mirrored process-wide telemetry counter")
+            delta = value - c.value()
+            if delta > 0:
+                c.inc(delta)
+
+    _MIRROR_HOOK = _hook
+    _telemetry.counters.add_hook(_hook, prime=_prime)
+
+
+def uninstall_telemetry_mirror() -> None:
+    """Detach the counter mirror (tests / the obs-overhead bench's
+    interleaved off-cells).  Re-installing tops the series back up to
+    the live snapshot, so a scrape never goes backwards."""
+    global _MIRROR_ON, _MIRROR_HOOK
+    with _MIRROR_LOCK:
+        if not _MIRROR_ON:
+            return
+        _MIRROR_ON = False
+        hook, _MIRROR_HOOK = _MIRROR_HOOK, None
+    if hook is not None:
+        _telemetry.counters.remove_hook(hook)
+
+
+# ----------------------------------------------------------------------
+# exposition parsing + fleet aggregation
+# ----------------------------------------------------------------------
+def parse_text(text: str) -> Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                                  float]:
+    """Parse a Prometheus text exposition into
+    ``{(name, sorted label items): value}``.  Raises ``ValueError`` on
+    malformed sample lines — the CI smoke's "does /metrics parse"
+    gate."""
+    out: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        if "{" in line:
+            name, rest = line.split("{", 1)
+            if "}" not in rest:
+                raise ValueError(f"line {lineno}: unterminated labels")
+            labels_part, value_part = rest.rsplit("}", 1)
+            labels: List[Tuple[str, str]] = []
+            buf, i = labels_part, 0
+            while i < len(buf):
+                eq = buf.find("=", i)
+                if eq < 0:
+                    break
+                key = buf[i:eq].strip().lstrip(",").strip()
+                if eq + 1 >= len(buf) or buf[eq + 1] != '"':
+                    raise ValueError(f"line {lineno}: unquoted label "
+                                     f"value")
+                j = eq + 2
+                val_chars = []
+                while j < len(buf):
+                    c = buf[j]
+                    if c == "\\" and j + 1 < len(buf):
+                        nxt = buf[j + 1]
+                        val_chars.append({"n": "\n"}.get(nxt, nxt))
+                        j += 2
+                        continue
+                    if c == '"':
+                        break
+                    val_chars.append(c)
+                    j += 1
+                labels.append((key, "".join(val_chars)))
+                i = j + 1
+        else:
+            parts = line.split(None, 1)
+            if len(parts) != 2:
+                raise ValueError(f"line {lineno}: no value: {line!r}")
+            name, value_part = parts
+            labels = []
+        name = name.strip()
+        if not name or any(c not in _NAME_OK for c in name):
+            raise ValueError(f"line {lineno}: bad metric name {name!r}")
+        v = value_part.strip()
+        try:
+            value = math.inf if v == "+Inf" else \
+                (-math.inf if v == "-Inf" else float(v))
+        except ValueError:
+            raise ValueError(f"line {lineno}: bad value {v!r}")
+        out[(name, tuple(sorted(labels)))] = value
+    return out
+
+
+def aggregate(scrapes: List[Tuple[str, str]]) -> str:
+    """Merge per-replica expositions into one: every series gains a
+    ``replica="<label>"`` label; HELP/TYPE headers are kept once per
+    metric.  ``scrapes`` is ``[(replica_label, exposition_text), ...]``
+    (``FleetSupervisor.metrics_text`` feeds it from live /metrics
+    scrapes)."""
+    headers: Dict[str, List[str]] = {}
+    series: List[str] = []
+    for replica, text in scrapes:
+        rl = 'replica="%s"' % str(replica).replace('"', '\\"')
+        for line in text.splitlines():
+            s = line.strip()
+            if not s:
+                continue
+            if s.startswith("# "):
+                parts = s.split(None, 3)
+                if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                    headers.setdefault(parts[2], []).append(s)
+                continue
+            if "{" in s:
+                name, rest = s.split("{", 1)
+                series.append(f"{name}{{{rl},{rest}")
+            else:
+                parts = s.split(None, 1)
+                if len(parts) != 2:
+                    continue
+                series.append(f"{parts[0]}{{{rl}}} {parts[1]}")
+    lines: List[str] = []
+    seen_headers = set()
+    for metric, hdrs in sorted(headers.items()):
+        for h in hdrs:
+            key = (metric, h.split(None, 2)[1])
+            if key not in seen_headers:
+                seen_headers.add(key)
+                lines.append(h)
+    lines.extend(series)
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,315 @@
+"""Anomaly rules over the telemetry record stream — ONE implementation
+for three consumers:
+
+- **offline triage** (``tools/triage_run.py``): feed a whole run's
+  records, read :meth:`OnlineScanner.summary_anomalies` — the
+  aggregate messages the triage report has always printed.
+- **live tailing** (``triage_run.py --follow``): feed records as a
+  training/serving process appends them, print what
+  :meth:`OnlineScanner.feed` returns the moment a rule trips.
+- **the flight recorder** (``obs/flight.py``): feed every record as it
+  is emitted in-process; a firing rule triggers a ring dump + (device
+  backends) a time-boxed ``jax.profiler`` capture, so the FIRST
+  misbehaving TPU run leaves artifacts instead of needing a repro.
+
+The warmup-exemption discipline (which fused blocks are legitimately
+compile-bearing) lives here as :func:`superstep_warmups` — triage
+imports it rather than keeping a second copy.
+
+Stdlib-only; importable without jax.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+__all__ = ["WARMUP_ITERS", "FLIGHT_TRIGGERS", "superstep_warmups",
+           "OnlineScanner", "Anomaly"]
+
+# compiles after this many iterations are anomalous: steady-state
+# boosting re-runs the same jitted programs, so a climbing compile
+# counter past warmup is a retrace storm (shape drift, cache thrash)
+WARMUP_ITERS = 3
+
+# rule codes that trip the flight recorder by default (the anomaly set
+# ISSUE 13 names: retrace storm, pipelining-disabled,
+# XLA-fallback-on-TPU, stall, rollback, nonfinite)
+FLIGHT_TRIGGERS = ("retrace_storm", "pipelining_disabled",
+                   "xla_fallback", "stall", "rollback", "nonfinite")
+
+# (severity, code, message)
+Anomaly = Tuple[str, str, str]
+
+
+def superstep_warmups(records) -> Iterator[Tuple[Dict[str, Any], bool]]:
+    """Yield ``(record, is_warmup)`` for every superstep record — the
+    ONE definition of which fused blocks are compile-bearing.  The
+    scan program compiles once per distinct block size k (the
+    auto-sized tail block is a shorter scan) AND per mesh identity (a
+    sharded run's scan is a different program per learner x shard
+    count — the weak-scale grid runs several in one file), so the
+    FIRST superstep of each (k, learner, shards) is per-shape warmup.
+    Sharded runs get TWO warmup blocks: block 1 consumes the
+    single-device score the unfused bias iteration left behind,
+    block 2 runs on the mesh-replicated carry — same trace, two XLA
+    executables by input sharding, both structural.  A ``run_start``
+    resets the tracking: it marks a new process segment (a continual
+    daemon restart appending to the same JSONL) or a new booster
+    adopting the recorder (one booster per continual batch) — either
+    way a fresh jitted scan whose first block per shape is warmup,
+    not a retrace storm.  The first checkpoint save and the first
+    load per segment also compile once (the mid-block alignment
+    replay and the restore path run eager jnp ops), and those
+    compiles land in the NEXT superstep's counter delta — that
+    superstep is exempt too.  An elastic re-mesh (``recovery`` record,
+    event remesh/reshard — parallel/elastic.py) rebuilds the fused
+    scan for the survivor mesh: the next TWO superstep records are
+    exempt whatever their (k, learner, shards) key says — a recovery
+    back onto a width this run already trained at (transient loss, a
+    weak-scale grid that visited it) re-COMPILES even though the key
+    counter is past its allowance."""
+    state = _WarmupTracker()
+    for r in records:
+        out = state.feed(r)
+        if out is not None:
+            yield out
+
+
+class _WarmupTracker:
+    """The stateful core of :func:`superstep_warmups`, shared with the
+    online scanner (which cannot replay the stream per rule)."""
+
+    def __init__(self):
+        self.seen: Dict[Tuple[int, str, int], int] = {}
+        self.ckpt_firsts: set = set()
+        self.ckpt_pending = False
+        self.remesh_grace = 0
+
+    def feed(self, r: Dict[str, Any]
+             ) -> Optional[Tuple[Dict[str, Any], bool]]:
+        rtype = r.get("type")
+        if rtype == "run_start":
+            self.seen = {}
+            self.ckpt_firsts = set()
+            self.ckpt_pending = False
+            return None
+        if rtype == "recovery":
+            if r.get("event") in ("remesh", "reshard"):
+                self.remesh_grace = 2
+            return None
+        if rtype == "checkpoint":
+            event = r.get("event")
+            if event in ("save", "load") and \
+                    event not in self.ckpt_firsts:
+                self.ckpt_firsts.add(event)
+                self.ckpt_pending = True
+            return None
+        if rtype != "superstep":
+            return None
+        shards = int(r.get("num_shards", 1))
+        key = (int(r.get("k", 1)), r.get("learner", ""), shards)
+        n = self.seen.get(key, 0)
+        self.seen[key] = n + 1
+        warm = (n < (2 if shards > 1 else 1) or self.ckpt_pending or
+                self.remesh_grace > 0)
+        self.ckpt_pending = False
+        if self.remesh_grace > 0:
+            self.remesh_grace -= 1
+        return r, warm
+
+
+class OnlineScanner:
+    """Stateful record-at-a-time anomaly scanner.
+
+    :meth:`feed` returns anomalies the moment their rule trips (the
+    --follow / flight-recorder readout); :meth:`summary_anomalies`
+    renders the run-level aggregates afterwards, byte-compatible with
+    the triage report's historical messages for the rules that moved
+    here (retrace storms, pipelining-disabled, XLA fallback)."""
+
+    # instant rules need a debounce: one stall cascade must not dump
+    # the flight ring per record.  All state is BOUNDED: the armed
+    # flight recorder feeds one scanner for the process lifetime (a
+    # continual daemon emits a run_start per batch for weeks), so
+    # per-segment state keeps only the newest superstep's split
+    # decision and the segment deque is capped.
+    MAX_SEGMENTS = 256
+
+    def __init__(self):
+        self._warm = _WarmupTracker()
+        # aggregate state for summary_anomalies
+        self._ss_late = 0.0
+        self._ss_secs = 0.0
+        self._iter_late = 0.0
+        self._iter_secs = 0.0
+        self._overlap_total = 0
+        self._overlap_stalled = 0
+        self._segs: "deque[Dict[str, Any]]" = \
+            deque(maxlen=self.MAX_SEGMENTS)
+        self._cur_seg: Optional[Dict[str, Any]] = None
+        # one-shot instant flags
+        self._fired: set = set()
+
+    # -- helpers -------------------------------------------------------
+    def _seg_backend(self) -> str:
+        return self._cur_seg["backend"] if self._cur_seg else ""
+
+    # -- the scanner ---------------------------------------------------
+    def feed(self, r: Dict[str, Any]) -> List[Anomaly]:
+        out: List[Anomaly] = []
+        rtype = r.get("type")
+        if rtype == "run_start":
+            self._cur_seg = {
+                "backend": str(r.get("backend", "")).lower(),
+                "tier": r.get("tier") or {}, "ss_last": None,
+                "fallback_fired": False}
+            self._segs.append(self._cur_seg)
+        warm_out = self._warm.feed(r)
+        if rtype == "iteration":
+            if int(r.get("iter", 0)) >= WARMUP_ITERS:
+                c = (r.get("counters") or {}).get("xla_compiles", 0)
+                if c:
+                    secs = (r.get("counters") or {}).get(
+                        "xla_compile_secs", 0.0)
+                    self._iter_late += c
+                    self._iter_secs += secs
+                    out.append((
+                        "HIGH", "retrace_storm",
+                        f"retrace storm: {c:.0f} XLA compile(s) "
+                        f"({secs:.1f}s) at steady-state iteration "
+                        f"{r.get('iter')}"))
+        elif rtype == "superstep" and warm_out is not None:
+            rec, warm = warm_out
+            if not warm:
+                c = (rec.get("counters") or {}).get("xla_compiles", 0)
+                if c:
+                    secs = (rec.get("counters") or {}).get(
+                        "xla_compile_secs", 0.0)
+                    self._ss_late += c
+                    self._ss_secs += secs
+                    out.append((
+                        "HIGH", "retrace_storm",
+                        f"superstep retrace storm: {c:.0f} XLA "
+                        f"compile(s) ({secs:.1f}s) on a repeated "
+                        f"same-k super-step (iter "
+                        f"{rec.get('iter')}, k={rec.get('k')})"))
+                if int(rec.get("pipeline_depth", 0)) > 0:
+                    self._overlap_total += 1
+                    if float(rec.get("fetch_overlap_s", 0.0)) < 1e-5:
+                        self._overlap_stalled += 1
+                    if ("pipelining_disabled" not in self._fired and
+                            self._overlap_stalled >= 4 and
+                            self._overlap_stalled >
+                            self._overlap_total / 2):
+                        self._fired.add("pipelining_disabled")
+                        out.append((
+                            "MED", "pipelining_disabled",
+                            f"superstep pipelining silently disabled: "
+                            f"{self._overlap_stalled}/"
+                            f"{self._overlap_total} fused blocks show "
+                            f"~zero fetch overlap at "
+                            f"pipeline_depth > 0"))
+            if self._cur_seg is not None and "split_kernel" in rec:
+                self._cur_seg["ss_last"] = (rec.get("split_kernel"),
+                                            rec.get("split_fallback"))
+                backend = self._seg_backend()
+                reason = rec.get("split_fallback")
+                if (backend and backend not in ("cpu", "unknown", "?")
+                        and rec.get("split_kernel") == "xla"
+                        and reason
+                        and "split_kernel=xla" not in str(reason)
+                        and not self._cur_seg["fallback_fired"]):
+                    self._cur_seg["fallback_fired"] = True
+                    out.append((
+                        "MED", "xla_fallback",
+                        f"split kernel fell back to XLA on a "
+                        f"{backend} backend: {reason}"))
+        elif rtype == "continual":
+            event = r.get("event")
+            if event == "stall_restart":
+                out.append((
+                    "MED", "stall",
+                    f"train step on {r.get('batch', '?')} stalled "
+                    f"{float(r.get('stalled_s', 0.0)):.1f}s and was "
+                    f"abandoned by the watchdog (attempt "
+                    f"{r.get('attempt', '?')})"))
+            elif event == "nonfinite":
+                out.append((
+                    "HIGH", "nonfinite",
+                    f"numerical-health guard tripped: non-finite "
+                    f"training state at iteration "
+                    f"{r.get('iter', '?')} "
+                    f"({r.get('phase', '?')})"))
+        elif rtype == "fleet":
+            event = r.get("event")
+            if event == "rollback":
+                out.append((
+                    "HIGH", "rollback",
+                    f"deploy ROLLED BACK: {r.get('from_id', '?')} -> "
+                    f"{r.get('to_id', '?')} ({r.get('reason', '?')}: "
+                    f"{str(r.get('detail', ''))[:120]})"))
+            elif event == "circuit_open":
+                out.append((
+                    "HIGH", "circuit_open",
+                    f"replica circuit breaker OPEN on slot "
+                    f"{r.get('slot', '?')} (crash loop?)"))
+        elif rtype == "checkpoint" and r.get("event") == "fallback":
+            out.append((
+                "HIGH", "ckpt_fallback",
+                f"checkpoint candidate rejected "
+                f"(corrupt/truncated): "
+                f"{str(r.get('error', '?'))[:160]}"))
+        elif rtype == "recovery" and r.get("event") == "escalate":
+            out.append((
+                "HIGH", "escalate",
+                f"elastic recovery ESCALATED "
+                f"({r.get('reason', '?')})"))
+        return out
+
+    # -- run-level aggregates (the triage report's historical text) ---
+    def summary_anomalies(self) -> List[Tuple[str, str]]:
+        out: List[Tuple[str, str]] = []
+        if self._ss_late:
+            out.append(("HIGH", f"superstep retrace storm: "
+                                f"{self._ss_late:.0f} "
+                                f"XLA compiles ({self._ss_secs:.1f}s) on "
+                                f"repeated same-k super-steps — the fused "
+                                f"scan should compile once per block "
+                                f"size"))
+        if self._iter_late:
+            out.append(("HIGH", f"retrace storm: {self._iter_late:.0f} XLA "
+                                f"compiles ({self._iter_secs:.1f}s) AFTER "
+                                f"iteration {WARMUP_ITERS} — steady state "
+                                f"should re-run cached programs"))
+        if self._overlap_total:
+            stalled = self._overlap_stalled
+            if stalled > self._overlap_total / 2:
+                out.append(("MED", f"superstep pipelining silently "
+                                   f"disabled: {stalled}/"
+                                   f"{self._overlap_total} "
+                                   f"fused blocks show ~zero fetch "
+                                   f"overlap at pipeline_depth > 0 — "
+                                   f"every block is draining the "
+                                   f"in-flight queue (learning_rates "
+                                   f"schedule? eligibility flapping?), "
+                                   f"so the per-block fetch RTT is "
+                                   f"un-hidden again"))
+        for seg in self._segs:
+            backend = seg["backend"]
+            if not backend or backend in ("cpu", "unknown", "?"):
+                continue
+            if seg["ss_last"]:
+                sk, reason = seg["ss_last"]
+            else:
+                sk = seg["tier"].get("split_kernel")
+                reason = (seg["tier"].get("gates") or {}).get("split")
+            if sk == "xla" and reason and \
+                    "split_kernel=xla" not in reason:
+                out.append(("MED", f"split kernel fell back to XLA on a "
+                                   f"{backend} backend: {reason} — the "
+                                   f"fused histogram→split pass is "
+                                   f"disabled, every grow level "
+                                   f"round-trips the full histogram "
+                                   f"through HBM"))
+                break
+        return out

@@ -1,0 +1,240 @@
+"""Cross-process trace spans: one snapshot's whole lifecycle as one
+joinable trace.
+
+A trace is identified by a random ``trace_id``; every unit of work
+inside it is a ``span`` (random ``span_id``, parent link, duration,
+status) emitted as a ``span`` telemetry record through the ambient
+:class:`~lightgbm_tpu.utils.telemetry.RunRecorder`.  The ACTIVE span
+rides a ``contextvars.ContextVar``, so any telemetry record emitted
+while a span is open is automatically tagged with ``trace_id``/
+``span_id`` (``RunRecorder.emit``) — checkpoint saves, fleet publishes
+and served requests join the trace without their call sites knowing
+about tracing at all.
+
+Propagation carriers (how a trace crosses a process/transport seam):
+
+- **threads** — ``contextvars`` does not flow into ``threading.Thread``
+  targets: capture :func:`current` before spawning and re-enter with
+  :func:`use` inside the worker (``cont/trainer.py`` does this for its
+  per-batch attempt threads).
+- **environment** — ``LTPU_TRACE=<trace_id>:<span_id>``:
+  :func:`env_carrier` produces it, :func:`adopt_env` installs it as
+  the process root context (``serve/fleet.py`` stamps replica
+  subprocesses; the CLI adopts it at startup).
+- **HTTP** — header ``X-Ltpu-Trace``: :func:`http_headers` /
+  :func:`from_headers` (the fleet's ``POST /swap`` carries the publish
+  trace onto each replica; clients may send their own on /predict).
+- **checkpoints** — ``ckpt/manager.py`` records the saving context in
+  ``extra.json["trace"]``; the watcher re-enters it, so the daemon's
+  ingest->train->checkpoint trace continues through validate -> canary
+  -> publish -> the first request served by the new version, across
+  OS processes.  ``tools/trace_view.py`` renders the joined timeline
+  from the participating JSONL files.
+
+The module is stdlib-only and must stay importable without jax (it is
+loaded by the telemetry layer's trace-tagging hook).
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import time
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+from ..utils import telemetry as _telemetry
+
+__all__ = ["ENV_VAR", "HTTP_HEADER", "current", "use", "span", "point",
+           "parse", "format_carrier", "env_carrier", "adopt_env",
+           "http_headers", "from_headers", "new_trace_id"]
+
+ENV_VAR = "LTPU_TRACE"
+HTTP_HEADER = "X-Ltpu-Trace"
+
+# (trace_id, span_id) of the active span; None = no trace in flight
+_CTX: "contextvars.ContextVar[Optional[Tuple[str, str]]]" = \
+    contextvars.ContextVar("ltpu_trace_ctx", default=None)
+
+
+def new_trace_id() -> str:
+    return os.urandom(8).hex()
+
+
+def _new_span_id() -> str:
+    return os.urandom(4).hex()
+
+
+def current() -> Optional[Tuple[str, str]]:
+    """The active ``(trace_id, span_id)`` carrier, or None."""
+    return _CTX.get()
+
+
+def parse(text: Optional[str]) -> Optional[Tuple[str, str]]:
+    """Parse a ``trace_id:span_id`` carrier string (None on garbage —
+    a malformed header/env must never break the request it rode in
+    on)."""
+    if not text or not isinstance(text, str):
+        return None
+    parts = text.strip().split(":")
+    if len(parts) != 2 or not all(p and all(c in "0123456789abcdef"
+                                            for c in p) for p in parts):
+        return None
+    return parts[0], parts[1]
+
+
+def format_carrier(carrier: Optional[Tuple[str, str]] = None
+                   ) -> Optional[str]:
+    c = carrier if carrier is not None else _CTX.get()
+    return None if c is None else f"{c[0]}:{c[1]}"
+
+
+@contextlib.contextmanager
+def use(carrier: Optional[Tuple[str, str]]) -> Iterator[None]:
+    """Re-enter a propagated context (thread/env/HTTP/checkpoint
+    carrier).  ``use(None)`` is a no-op, so call sites don't need to
+    branch on whether a carrier arrived."""
+    if carrier is None:
+        yield
+        return
+    token = _CTX.set((str(carrier[0]), str(carrier[1])))
+    try:
+        yield
+    finally:
+        _CTX.reset(token)
+
+
+# ----------------------------------------------------------------------
+# carriers
+# ----------------------------------------------------------------------
+def env_carrier() -> Dict[str, str]:
+    """Env vars propagating the active context into a subprocess
+    (empty when no trace is in flight)."""
+    c = format_carrier()
+    return {ENV_VAR: c} if c else {}
+
+
+def adopt_env(environ=None) -> Optional[Tuple[str, str]]:
+    """Install the ``LTPU_TRACE`` carrier (if any) as this process's
+    root context.  Returns the adopted carrier."""
+    carrier = parse((environ or os.environ).get(ENV_VAR, ""))
+    if carrier is not None:
+        _CTX.set(carrier)
+    return carrier
+
+
+def http_headers() -> Dict[str, str]:
+    c = format_carrier()
+    return {HTTP_HEADER: c} if c else {}
+
+
+def from_headers(headers) -> Optional[Tuple[str, str]]:
+    """Extract the carrier from an ``email.message``-style header
+    mapping (the stdlib HTTP handler's ``self.headers``)."""
+    try:
+        return parse(headers.get(HTTP_HEADER))
+    except Exception:  # noqa: BLE001 - propagation is best-effort
+        return None
+
+
+# ----------------------------------------------------------------------
+# spans
+# ----------------------------------------------------------------------
+class _Span:
+    """Handle yielded by :func:`span` — lets the body attach result
+    attributes (``sp.set(key=value)``) that ride the emitted record."""
+
+    __slots__ = ("trace_id", "span_id", "attrs")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 attrs: Dict[str, Any]):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.attrs = attrs
+
+    def set(self, **kw) -> None:
+        self.attrs.update(kw)
+
+
+def _emit_span(recorder, name: str, trace_id: str, span_id: str,
+               parent_id: Optional[str], duration_ms: float,
+               status: str, attrs: Dict[str, Any]) -> None:
+    rec = recorder if recorder is not None \
+        else _telemetry.get_recorder()
+    if rec is None:
+        return
+    fields: Dict[str, Any] = dict(attrs)
+    fields.update(name=str(name), trace_id=trace_id, span_id=span_id,
+                  duration_ms=round(float(duration_ms), 3),
+                  status=status, pid=os.getpid())
+    if parent_id is not None:
+        fields["parent_id"] = parent_id
+    rec.emit("span", **fields)
+
+
+@contextlib.contextmanager
+def span(name: str, recorder=None, root: bool = False,
+         announce: bool = False, **attrs) -> Iterator[_Span]:
+    """Open a span: child of the active context (or a NEW trace root
+    when none is active or ``root=True``), active for the body, and
+    emitted as a ``span`` record on exit — to ``recorder`` when given,
+    else the process-default recorder, else dropped (the context still
+    propagates, so downstream records in recorder-carrying processes
+    keep their trace tags).
+
+    ``announce=True`` ALSO emits a ``status="open"`` record at entry
+    with the same ids: a process killed mid-span (SIGKILL chaos,
+    preemption) still leaves its trace root on disk, so a snapshot it
+    checkpointed before dying remains joinable.  Consumers dedupe by
+    ``span_id``, preferring the closed record
+    (``tools/trace_view.py``)."""
+    parent = None if root else _CTX.get()
+    trace_id = parent[0] if parent else new_trace_id()
+    span_id = _new_span_id()
+    sp = _Span(trace_id, span_id, dict(attrs))
+    token = _CTX.set((trace_id, span_id))
+    if announce:
+        try:
+            _emit_span(recorder, name, trace_id, span_id,
+                       parent[1] if parent else None, 0.0, "open",
+                       dict(attrs))
+        except Exception:  # noqa: BLE001 - tracing must never throw
+            pass
+    t0 = time.perf_counter()
+    status = "ok"
+    try:
+        yield sp
+    except BaseException as exc:
+        status = "error"
+        sp.attrs.setdefault("error", f"{type(exc).__name__}: "
+                                     f"{exc}"[:200])
+        raise
+    finally:
+        _CTX.reset(token)
+        try:
+            _emit_span(recorder, name, trace_id, span_id,
+                       parent[1] if parent else None,
+                       (time.perf_counter() - t0) * 1e3, status,
+                       sp.attrs)
+        except Exception:  # noqa: BLE001 - tracing must never throw
+            pass
+
+
+def point(name: str, carrier: Optional[Tuple[str, str]] = None,
+          recorder=None, **attrs) -> None:
+    """Emit a zero-duration marker span joined to ``carrier`` (or the
+    active context) — e.g. the first request served by a freshly
+    published model version."""
+    c = carrier if carrier is not None else _CTX.get()
+    if c is None:
+        return
+    try:
+        _emit_span(recorder, name, c[0], _new_span_id(), c[1], 0.0,
+                   "ok", dict(attrs))
+    except Exception:  # noqa: BLE001
+        pass
+
+
+# install the trace-tagging hook: every record emitted while a span is
+# active carries trace_id + span_id (utils/telemetry.py calls this
+# provider on each emit once any obs module is imported)
+_telemetry.set_trace_provider(current)

@@ -59,7 +59,8 @@ class Request:
 
     __slots__ = ("rid", "X", "raw", "priority", "deadline", "t_admit",
                  "version", "status", "result", "error",
-                 "retry_after_ms", "timings", "_done", "_finish_lock")
+                 "retry_after_ms", "timings", "trace", "_done",
+                 "_finish_lock")
 
     def __init__(self, rid: int, X: np.ndarray, raw: bool,
                  priority: int, deadline: Optional[float], version):
@@ -75,6 +76,10 @@ class Request:
         self.error: Optional[str] = None
         self.retry_after_ms = 0.0
         self.timings: Dict[str, float] = {}
+        # (trace_id, span_id) captured at admission: the serve record
+        # is emitted on a DISPATCHER thread, where the submitter's
+        # contextvar is not visible (obs/spans.py)
+        self.trace = None
         self._done = threading.Event()
         self._finish_lock = threading.Lock()
 

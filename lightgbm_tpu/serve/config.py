@@ -50,6 +50,12 @@ class ServeConfig:
     # expose POST/GET /faults (the fault-injection harness's remote
     # driving surface, utils/faults.py) — chaos tests only
     debug_faults: bool = False
+    # GET /metrics (Prometheus text): live counters/gauges/bounded
+    # histograms fed by the same call sites as the telemetry records
+    metrics: bool = True
+    # comma-separated latency-histogram bucket bounds in ms; () = the
+    # built-in log-spaced ladder (obs/metrics.py)
+    metrics_latency_buckets: tuple = ()
 
     @classmethod
     def from_params(cls, params: Union[None, Dict[str, Any], Any] = None
@@ -76,7 +82,12 @@ class ServeConfig:
             max_body_bytes=int(cfg.serve_max_body_bytes),
             drain_grace_s=float(cfg.serve_drain_grace_s),
             port_file=str(cfg.serve_port_file or ""),
-            debug_faults=bool(cfg.serve_debug_faults))
+            debug_faults=bool(cfg.serve_debug_faults),
+            metrics=bool(cfg.serve_metrics),
+            metrics_latency_buckets=tuple(
+                float(v) for v in
+                str(cfg.serve_metrics_latency_buckets or "").split(",")
+                if v.strip()))
 
     def validate(self) -> None:
         if self.max_batch_rows <= 0:
@@ -92,6 +103,12 @@ class ServeConfig:
             raise ValueError("serve_max_body_bytes must be > 0")
         if self.drain_grace_s < 0:
             raise ValueError("serve_drain_grace_s must be >= 0")
+        if self.metrics_latency_buckets and (
+                any(b <= 0 for b in self.metrics_latency_buckets) or
+                list(self.metrics_latency_buckets) !=
+                sorted(self.metrics_latency_buckets)):
+            raise ValueError("serve_metrics_latency_buckets must be "
+                             "ascending positive bounds (ms)")
 
 
 @dataclasses.dataclass

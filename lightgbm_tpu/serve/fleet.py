@@ -48,6 +48,8 @@ import urllib.request
 from random import Random
 from typing import Any, Callable, Dict, List, Optional
 
+from ..obs import metrics as _obs_metrics
+from ..obs import spans as _spans
 from ..utils import faults as _faults
 from ..utils.log import Log
 from .config import FleetConfig, ServeConfig
@@ -156,6 +158,10 @@ class ProcessReplica:
             [f"{k}={v}" for k, v in args.items()]
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
+        # propagate the active trace (if a span is open — e.g. the
+        # supervisor restarting a replica during a publish) so the
+        # replica can mark its boot against it (obs/spans.py)
+        env.update(_spans.env_carrier())
         env.update(self.env)
         log = open(self.log_path, "ab")
         try:
@@ -333,8 +339,11 @@ class FleetSupervisor:
             with self._lock:
                 slot.in_rotation = False
             return False
+        # the X-Ltpu-Trace carrier makes the replica's swap (and the
+        # first request the new version serves) join the publish trace
         st, out = _post_json(url, "/swap", {"model_str": text},
-                             timeout=60)
+                             timeout=60,
+                             headers=_spans.http_headers())
         if st == 200 and out.get("model_id") == mid:
             with self._lock:
                 slot.health_model_id = mid
@@ -372,6 +381,54 @@ class FleetSupervisor:
                                  .get("p99", 0.0)))
         return {"requests": float(total), "bad": float(bad),
                 "p99_ms": p99}
+
+    # -- fleet-level metrics aggregation -------------------------------
+    def metrics_text(self) -> str:
+        """One Prometheus exposition for the whole fleet: every
+        reachable replica's ``GET /metrics`` scrape re-labeled with
+        ``replica="<slot>"`` plus supervisor-level gauges (slot
+        states, desired model) — the scrape surface a router tier in
+        front of :meth:`endpoints` consumes
+        (``docs/Observability.md``)."""
+        with self._lock:
+            targets = [(s.index, s.url) for s in self._slots
+                       if s.state == "healthy" and s.url]
+            states = [(s.index, s.state, s.in_rotation)
+                      for s in self._slots]
+            desired = self._desired
+        scrapes = []
+        for index, url in targets:
+            try:
+                with urllib.request.urlopen(
+                        url + "/metrics",
+                        timeout=self.config.probe_timeout_s) as r:
+                    scrapes.append((str(index), r.read().decode()))
+            except Exception:              # noqa: BLE001 - probe only
+                continue
+        lines = [
+            "# HELP ltpu_fleet_replicas configured replica slots",
+            "# TYPE ltpu_fleet_replicas gauge",
+            f"ltpu_fleet_replicas {len(states)}",
+            "# HELP ltpu_fleet_in_rotation slots currently routable",
+            "# TYPE ltpu_fleet_in_rotation gauge",
+            f"ltpu_fleet_in_rotation "
+            f"{sum(1 for _, _, rot in states if rot)}",
+            "# HELP ltpu_fleet_slot_state per-slot supervisor state "
+            "(1 = the labeled state is current)",
+            "# TYPE ltpu_fleet_slot_state gauge",
+        ]
+        for index, state, _rot in states:
+            lines.append('ltpu_fleet_slot_state{slot="%d",state="%s"}'
+                         ' 1' % (index, state))
+        if desired is not None:
+            lines += [
+                "# HELP ltpu_fleet_desired_model_info desired model "
+                "fingerprint (value always 1)",
+                "# TYPE ltpu_fleet_desired_model_info gauge",
+                'ltpu_fleet_desired_model_info{model_id="%s"} 1'
+                % desired[0],
+            ]
+        return "\n".join(lines) + "\n" + _obs_metrics.aggregate(scrapes)
 
     # -- monitor -------------------------------------------------------
     def _backoff_s(self, slot: _Slot) -> float:
@@ -536,10 +593,12 @@ class FleetSupervisor:
 
 
 def _post_json(url: str, path: str, obj: Dict[str, Any],
-               timeout: float = 30.0):
+               timeout: float = 30.0,
+               headers: Optional[Dict[str, str]] = None):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
     req = urllib.request.Request(
-        url + path, data=json.dumps(obj).encode(),
-        headers={"Content-Type": "application/json"})
+        url + path, data=json.dumps(obj).encode(), headers=hdrs)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
             return r.status, json.loads(r.read())

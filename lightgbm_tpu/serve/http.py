@@ -20,6 +20,13 @@ to coalesce).  Routes:
   ``{"draining": true}`` once a drain begins, so supervisors and load
   balancers stop routing to a replica that is going away.
 - ``GET /stats``     queue depth, latency percentiles, engine cache.
+- ``GET /metrics``   Prometheus text exposition: live request
+  counters by status, bounded latency/occupancy histograms, queue
+  gauges, mirrored telemetry counters (``obs/metrics.py``;
+  ``serve_metrics=false`` hides the route).  ``POST`` routes honor an
+  ``X-Ltpu-Trace`` carrier header (``obs/spans.py``), so a fleet
+  publish's ``/swap`` — and the records it causes — join the
+  publishing trace.
 - ``GET /model``     the active version's reference-format model text
   (the watcher's rollback-baseline capture).
 - ``POST/GET /faults``  remote driving surface of the fault-injection
@@ -43,6 +50,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from ..obs import spans as _spans
 from ..utils import faults as _faults
 from ..utils.log import Log
 from .admission import (QueueSaturated, RequestShed, RequestTimeout,
@@ -72,6 +80,16 @@ def _json_handler_for(server: Server):
             self.send_header("Content-Length", str(len(body)))
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_text(self, code: int, text: str,
+                       content_type: str = "text/plain; "
+                                           "version=0.0.4") -> None:
+            body = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
 
@@ -165,6 +183,17 @@ def _json_handler_for(server: Server):
                 self._send(503 if server.draining else 200, body)
             elif self.path == "/stats":
                 self._send(200, server.stats())
+            elif self.path == "/metrics":
+                if not server.config.metrics:
+                    self._send(404, {"error": "serve_metrics is off",
+                                     "code": "no_route"})
+                else:
+                    # Prometheus text exposition: live counters by
+                    # status, bounded latency/occupancy histograms,
+                    # queue gauges, mirrored telemetry counters —
+                    # FleetSupervisor.metrics_text aggregates these
+                    # per replica (docs/Observability.md)
+                    self._send_text(200, server.metrics_text())
             elif self.path == "/model":
                 ver = server.registry.current()
                 if ver is None:
@@ -185,15 +214,20 @@ def _json_handler_for(server: Server):
                                  "code": "no_route"})
 
         def _post(self):
-            if self.path == "/predict":
-                self._predict()
-            elif self.path == "/swap":
-                self._swap()
-            elif self.path == "/faults":
-                self._faults()
-            else:
-                self._send(404, {"error": f"no route {self.path}",
-                                 "code": "no_route"})
+            # trace propagation (obs/spans.py): an X-Ltpu-Trace
+            # carrier makes this request's records join the sender's
+            # trace — the fleet's /swap carries the publish trace, a
+            # client may carry its own onto /predict
+            with _spans.use(_spans.from_headers(self.headers)):
+                if self.path == "/predict":
+                    self._predict()
+                elif self.path == "/swap":
+                    self._swap()
+                elif self.path == "/faults":
+                    self._faults()
+                else:
+                    self._send(404, {"error": f"no route {self.path}",
+                                     "code": "no_route"})
 
         def _predict(self):
             # fault-injection point ``http.request``: "error" answers

@@ -17,15 +17,15 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import deque
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..obs import metrics as _obs_metrics
+from ..obs import spans as _spans
 from ..utils import faults as _faults
 from ..utils.log import Log
 from ..utils.telemetry import counters as _tele_counters
-from ..utils.telemetry import percentile as _percentile
 from .admission import (AdmissionQueue, QueueSaturated, Request,
                         ServerClosed)
 from .batcher import Batch, MicroBatcher
@@ -54,9 +54,24 @@ class Server:
         self._threads: List[threading.Thread] = []
         self._rid = 0
         self._rid_lock = threading.Lock()
-        self._lat_ring: "deque[float]" = deque(maxlen=4096)
+        # bounded ROLLING histogram (obs/metrics.py): /stats
+        # percentiles come from fixed buckets over the last one-to-
+        # two minutes, so a long-lived replica's stats memory is O(1)
+        # AND its p99 reflects current behavior — the rollback
+        # watchdog compares p99 across a deploy, which a lifetime
+        # histogram would dilute on a replica with request history.
+        # Kept SEPARATE from the registry's ltpu_serve_latency_ms on
+        # purpose: /stats is per-server and recency-windowed, the
+        # registry series is process-wide and cumulative (Prometheus
+        # scrapers window buckets themselves)
+        lat_buckets = self.config.metrics_latency_buckets or \
+            _obs_metrics.DEFAULT_LATENCY_BUCKETS_MS
+        self._lat_hist = _obs_metrics.RollingHistogram(
+            buckets=lat_buckets)
         self._counts: Dict[str, int] = {}
         self._counts_lock = threading.Lock()
+        self._metrics = self._make_metrics(lat_buckets) \
+            if self.config.metrics else None
         self._recorder = self._make_recorder(telemetry)
         self._owns_recorder = telemetry is None and \
             self._recorder is not None
@@ -68,6 +83,67 @@ class Server:
             get_engine().set_cache_size(self.config.predict_cache_slots)
         if booster is not None:
             self.registry.publish(booster)
+
+    def _make_metrics(self, lat_buckets) -> Dict[str, Any]:
+        """Register this server's live-metrics series (GET /metrics).
+        Counters/histograms are process-wide and fed at the SAME call
+        sites as the telemetry records, so the scrape matches the
+        run_end rollups bit-for-bit; gauges are scrape-time callbacks
+        re-pointed at the newest server."""
+        _obs_metrics.install_telemetry_mirror()
+        reg = _obs_metrics.get_registry()
+        m = {
+            "requests": reg.counter(
+                "ltpu_serve_requests_total",
+                "serve requests by terminal status", ("status",)),
+            "rows": reg.counter(
+                "ltpu_serve_rows_total",
+                "rows admitted into terminal requests", ("status",)),
+            "latency": reg.histogram(
+                "ltpu_serve_latency_ms",
+                "total request latency (ok requests)",
+                buckets=lat_buckets),
+            "occupancy": reg.histogram(
+                "ltpu_serve_batch_occupancy",
+                "dispatch-batch fill fraction",
+                buckets=_obs_metrics.OCCUPANCY_BUCKETS),
+            "swaps": reg.counter(
+                "ltpu_serve_swaps_total", "model hot-swaps"),
+        }
+        # request-path fast lane: labeled children resolved once, not
+        # per request (the registry lookup costs real microseconds at
+        # serve rates)
+        m["lat_child"] = m["latency"].labels()
+        m["occ_child"] = m["occupancy"].labels()
+        m["req_children"] = {}
+        # gauges capture self: remember the closures so stop() can
+        # release them (a dead server must not stay pinned in the
+        # process-global registry through its scrape callbacks)
+        m["gauges"] = {
+            "ltpu_serve_queue_requests":
+                ("admitted requests pending dispatch",
+                 lambda: self.queue.depth()[0]),
+            "ltpu_serve_queue_rows":
+                ("admitted rows pending dispatch",
+                 lambda: self.queue.depth()[1]),
+            "ltpu_serve_draining":
+                ("1 once a graceful drain began",
+                 lambda: 1.0 if self.draining else 0.0),
+            "ltpu_serve_model_version":
+                ("active published model version",
+                 lambda: float(self.version() or 0)),
+        }
+        for name, (help_, fn) in m["gauges"].items():
+            reg.gauge_callback(name, fn, help_)
+        return m
+
+    def _metric_children(self, status: str):
+        ch = self._metrics["req_children"].get(status)
+        if ch is None:                     # benign race: idempotent
+            ch = (self._metrics["requests"].labels(status=status),
+                  self._metrics["rows"].labels(status=status))
+            self._metrics["req_children"][status] = ch
+        return ch
 
     def _make_recorder(self, telemetry):
         from ..utils import telemetry as _t
@@ -112,6 +188,10 @@ class Server:
             for r in leftovers:
                 if r.finish("error", error="server stopped"):
                     self._emit(r)
+        if self._metrics is not None:
+            reg = _obs_metrics.get_registry()
+            for name, (_help, fn) in self._metrics["gauges"].items():
+                reg.release_gauge_callback(name, fn)
         if self._owns_recorder and self._recorder is not None:
             self._recorder.close()
             self._recorder = None
@@ -144,9 +224,17 @@ class Server:
         swap).  In-flight requests complete against their admitted
         version; only new admissions see the new one."""
         t0 = time.monotonic()
-        ver = self.registry.publish(booster=booster,
-                                    model_file=model_file,
-                                    model_str=model_str)
+        with _spans.span("swap", recorder=self._recorder) as sp:
+            ver = self.registry.publish(booster=booster,
+                                        model_file=model_file,
+                                        model_str=model_str)
+            sp.set(version=ver.version, model_id=ver.model_id)
+            # the publish trace rides the version: the FIRST request
+            # this version serves emits a joined marker span, closing
+            # the daemon->checkpoint->publish->served-request loop
+            ver.publish_trace = _spans.current()
+        if self._metrics is not None:
+            self._metrics["swaps"].inc()
         if self._recorder is not None:
             self._recorder.emit(
                 "serve", status="swap", rows=0,
@@ -194,6 +282,10 @@ class Server:
             self._rid += 1
             rid = self._rid
         req = Request(rid, X, raw, priority, deadline, ver)
+        # the serve record is emitted on a dispatcher thread; carry
+        # the submitter's trace context (HTTP header / caller span)
+        # on the request so the record still joins its trace
+        req.trace = _spans.current()
         try:
             shed = self.queue.admit(req)
         except QueueSaturated as exc:
@@ -285,8 +377,32 @@ class Server:
             _tele_counters.incr(f"serve_{status}")
         with self._counts_lock:
             self._counts[status] = self._counts.get(status, 0) + 1
+        if status == "ok":
+            self._lat_hist.observe(req.timings.get("total_ms", 0.0))
+        if self._metrics is not None:
+            c_req, c_rows = self._metric_children(status)
+            c_req.inc()
+            c_rows.inc(req.rows)
             if status == "ok":
-                self._lat_ring.append(req.timings.get("total_ms", 0.0))
+                self._metrics["lat_child"].observe(
+                    req.timings.get("total_ms", 0.0))
+                if batch is not None:
+                    self._metrics["occ_child"].observe(
+                        batch.occupancy)
+        ver = req.version
+        pub_trace = getattr(ver, "publish_trace", None) if ver else None
+        if status == "ok" and pub_trace is not None:
+            # first served request of a freshly published version:
+            # emit one marker span joined to the publish trace
+            with self._counts_lock:
+                pub_trace, ver.publish_trace = ver.publish_trace, None
+            if pub_trace is not None:
+                _spans.point("first_request", pub_trace,
+                             recorder=self._recorder,
+                             version=ver.version, model_id=ver.model_id,
+                             rows=req.rows,
+                             total_ms=round(
+                                 req.timings.get("total_ms", 0.0), 3))
         if self._recorder is None:
             return
         fields: Dict[str, Any] = {
@@ -300,6 +416,8 @@ class Server:
         if req.version is not None:
             fields["version"] = req.version.version
             fields["model_id"] = req.version.model_id
+        if req.trace is not None:
+            fields["trace_id"], fields["span_id"] = req.trace
         if batch is not None:
             fields["batch_rows"] = batch.rows
             fields["bucket_rows"] = batch.bucket_rows
@@ -312,7 +430,6 @@ class Server:
         from ..ops.predict import get_engine
         with self._counts_lock:
             counts = dict(self._counts)
-            lat = sorted(self._lat_ring)
         depth_reqs, depth_rows = self.queue.depth()
         ver = self.registry.current()
         return {
@@ -322,12 +439,20 @@ class Server:
             "queue_requests": depth_reqs,
             "queue_rows": depth_rows,
             "requests": counts,
+            # interpolated from the bounded histogram (O(1) memory
+            # and no per-scrape sort, whatever the request count)
             "latency_ms": {
-                "p50": round(_percentile(lat, 0.50), 3),
-                "p95": round(_percentile(lat, 0.95), 3),
-                "p99": round(_percentile(lat, 0.99), 3),
+                "p50": round(self._lat_hist.percentile(0.50), 3),
+                "p95": round(self._lat_hist.percentile(0.95), 3),
+                "p99": round(self._lat_hist.percentile(0.99), 3),
             },
             "retry_after_ms": self.queue.retry_after_ms(),
             "engine_cache": get_engine().cache_info(),
             "versions": self.registry.history(),
         }
+
+    def metrics_text(self) -> str:
+        """The Prometheus exposition ``GET /metrics`` serves (the
+        process-wide registry: this server's series plus every
+        mirrored telemetry counter)."""
+        return _obs_metrics.render()

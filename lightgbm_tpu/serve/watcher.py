@@ -47,7 +47,10 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+import json as _json
+
 from ..ckpt.manager import CheckpointManager
+from ..obs import spans as _spans
 from ..utils import faults as _faults
 from ..utils.log import Log
 from .config import FleetConfig
@@ -361,12 +364,32 @@ class CheckpointWatcher:
             return
         self._process(iter_, path, now)
 
+    @staticmethod
+    def _snapshot_trace(path: str):
+        """The trace carrier the saving process recorded in
+        ``extra.json`` (``ckpt/manager.py``) — how the daemon's
+        ingest->train->checkpoint trace continues through this
+        watcher's validate->canary->publish, across OS processes."""
+        try:
+            with open(os.path.join(path, "extra.json")) as f:
+                return _spans.parse((_json.load(f) or {}).get("trace"))
+        except Exception:                  # noqa: BLE001 - optional
+            return None
+
     def _process(self, iter_: int, path: str, now: float) -> None:
+        with _spans.use(self._snapshot_trace(path)):
+            self._process_in_trace(iter_, path, now)
+
+    def _process_in_trace(self, iter_: int, path: str,
+                          now: float) -> None:
         self._last_iter = iter_            # a bad snapshot is not retried
         name = os.path.basename(path)
-        mode = _faults.fire("watcher.validate")
-        errs = ["injected fault (watcher.validate:reject)"] \
-            if mode == "reject" else CheckpointManager.validate(path)
+        with _spans.span("watcher_validate", recorder=self.recorder,
+                         path=name) as sp:
+            mode = _faults.fire("watcher.validate")
+            errs = ["injected fault (watcher.validate:reject)"] \
+                if mode == "reject" else CheckpointManager.validate(path)
+            sp.set(errors=len(errs))
         if errs:
             msg = "; ".join(errs)[:300]
             Log.warning("watcher: SKIP %s — manifest validation "
@@ -400,14 +423,19 @@ class CheckpointWatcher:
             return                         # already serving this model
         if self.canary is not None:
             from ..basic import Booster
-            try:
-                booster = Booster(model_str=model_text)
-            except Exception as exc:       # noqa: BLE001 - bad model
-                self._emit("publish_skip", reason="canary", path=name,
-                           iter=iter_,
-                           error=f"model parse failed: {exc}"[:300])
-                return
-            errs = self.canary.check(booster)
+            with _spans.span("watcher_canary", recorder=self.recorder,
+                             path=name, model_id=mid) as sp:
+                try:
+                    booster = Booster(model_str=model_text)
+                except Exception as exc:   # noqa: BLE001 - bad model
+                    sp.set(parse_failed=True)
+                    self._emit("publish_skip", reason="canary",
+                               path=name, iter=iter_,
+                               error=f"model parse failed: "
+                                     f"{exc}"[:300])
+                    return
+                errs = self.canary.check(booster)
+                sp.set(errors=len(errs))
             if errs:
                 msg = "; ".join(errs)[:300]
                 Log.warning("watcher: SKIP %s — canary failed: %s",
@@ -425,7 +453,13 @@ class CheckpointWatcher:
         prev = active if active is not None else self._baseline
         t0 = time.monotonic()
         try:
-            pub_id = self.target.publish_model(model_text, source=path)
+            # inside the publish span the fleet's /swap requests (and
+            # through them each replica's first served request) carry
+            # the trace that began at the daemon's batch root
+            with _spans.span("publish", recorder=self.recorder,
+                             path=name, model_id=mid):
+                pub_id = self.target.publish_model(model_text,
+                                                   source=path)
         except Exception as exc:           # noqa: BLE001 - target down
             Log.warning("watcher: publish of %s failed: %s", name, exc)
             self._emit("publish_skip", reason="error", path=name,

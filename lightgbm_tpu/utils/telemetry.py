@@ -58,6 +58,7 @@ __all__ = [
     "counters_snapshot", "install_jax_hooks", "validate_record",
     "lint_file", "read_records", "parse_bench_artifact",
     "latest_good_bench", "get_recorder", "set_recorder", "percentile",
+    "set_trace_provider", "add_emit_observer", "remove_emit_observer",
 ]
 
 
@@ -74,7 +75,7 @@ SCHEMA_VERSION = 1
 
 RECORD_TYPES = ("run_start", "iteration", "superstep", "eval", "predict",
                 "serve", "checkpoint", "fleet", "continual", "recovery",
-                "run_end")
+                "span", "capture", "run_end")
 
 # per-type required fields on top of the common envelope; values are
 # (field, type-or-types) pairs the lint enforces
@@ -158,6 +159,23 @@ _TYPE_FIELDS: Dict[str, Tuple[Tuple[str, Any], ...]] = {
     # loudly into the checkpoint restart story).  triage_run.py rolls
     # these up and flags repeated re-meshes of one run as HIGH.
     "recovery": (("event", str),),
+    # one record per closed trace span (obs/spans.py): ``trace_id``
+    # joins spans (and trace-tagged records of every other type)
+    # emitted by ANY process into one timeline — the continual
+    # daemon's per-batch root, the checkpoint save, the watcher's
+    # validate/canary/publish and the first request the published
+    # version serves all share one trace_id across OS processes
+    # (env / HTTP-header / checkpoint-extra propagation).
+    # ``parent_id`` is absent on trace roots; ``status`` is ok|error.
+    # ``tools/trace_view.py`` renders the joined timeline.
+    "span": (("name", str), ("trace_id", str), ("span_id", str),
+             ("duration_ms", (int, float))),
+    # one record per flight-recorder capture (obs/flight.py):
+    # ``trigger`` is the firing rule code (retrace_storm |
+    # pipelining_disabled | xla_fallback | stall | rollback |
+    # nonfinite), ``path`` the capture directory holding
+    # anomaly.json + ring.jsonl (+ profile/ on device backends).
+    "capture": (("trigger", str), ("path", str)),
     "run_end": (("summary", dict),),
 }
 
@@ -166,15 +184,49 @@ _TYPE_FIELDS: Dict[str, Tuple[Tuple[str, Any], ...]] = {
 # process-wide counters (compile/retrace events, predict-cache traffic)
 # ----------------------------------------------------------------------
 class _Counters:
-    """Thread-safe monotonic counters; recorders snapshot-and-diff."""
+    """Thread-safe monotonic counters; recorders snapshot-and-diff.
+    Hooks (``add_hook``) observe every increment — the obs metrics
+    registry mirrors the counters into Prometheus series through one
+    (``obs/metrics.py``), so live scrapes and run_end rollups agree
+    bit-for-bit."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._c: Dict[str, float] = {}
+        self._hooks: List[Any] = []
 
     def incr(self, name: str, by: float = 1.0) -> None:
+        # hooks fire INSIDE the lock: paired with add_hook's atomic
+        # prime-then-register, no increment can land between a
+        # mirror's seed snapshot and its hook activation (which would
+        # skew the bit-for-bit scrape oracle forever).  Hooks must not
+        # call back into incr.
         with self._lock:
             self._c[name] = self._c.get(name, 0.0) + by
+            for fn in self._hooks:
+                try:
+                    fn(name, by)
+                except Exception:  # noqa: BLE001 - hooks never break
+                    pass
+
+    def add_hook(self, fn, prime=None) -> None:
+        """Register an increment hook.  ``prime`` (if given) runs
+        UNDER the counter lock with a snapshot of current values
+        immediately before the hook activates — the atomic
+        seed-then-subscribe a mirror needs."""
+        with self._lock:
+            if fn in self._hooks:
+                return
+            if prime is not None:
+                try:
+                    prime(dict(self._c))
+                except Exception:  # noqa: BLE001
+                    pass
+            self._hooks = self._hooks + [fn]
+
+    def remove_hook(self, fn) -> None:
+        with self._lock:
+            self._hooks = [h for h in self._hooks if h is not fn]
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
@@ -239,6 +291,39 @@ def install_jax_hooks() -> bool:
             pass
         _HOOKS_INSTALLED = ok
         return ok
+
+
+# ----------------------------------------------------------------------
+# obs-plane hooks: trace tagging + emit observers
+# ----------------------------------------------------------------------
+# set by obs/spans.py at import: () -> Optional[(trace_id, span_id)].
+# When a span is active, every emitted record is tagged with the
+# trace context, so ANY record type joins its trace without the call
+# site knowing about tracing.
+_TRACE_PROVIDER: Optional[Any] = None
+
+# observers see every record ANY recorder in this process emits (the
+# flight recorder's ring + online anomaly rules, obs/flight.py);
+# called OUTSIDE the recorder lock with (record, recorder)
+_EMIT_OBSERVERS: List[Any] = []
+_OBSERVER_LOCK = threading.Lock()
+
+
+def set_trace_provider(fn) -> None:
+    global _TRACE_PROVIDER
+    _TRACE_PROVIDER = fn
+
+
+def add_emit_observer(fn) -> None:
+    with _OBSERVER_LOCK:
+        if fn not in _EMIT_OBSERVERS:
+            _EMIT_OBSERVERS.append(fn)
+
+
+def remove_emit_observer(fn) -> None:
+    with _OBSERVER_LOCK:
+        if fn in _EMIT_OBSERVERS:
+            _EMIT_OBSERVERS.remove(fn)
 
 
 # ----------------------------------------------------------------------
@@ -330,6 +415,16 @@ class RunRecorder:
         rec = {"schema": SCHEMA_VERSION, "type": rtype,
                "wall_time": round(time.time(), 3)}
         rec.update(fields)
+        # trace tagging: records emitted under an active span join its
+        # trace (span records carry their OWN ids and are left alone)
+        if _TRACE_PROVIDER is not None and rtype != "span" \
+                and "trace_id" not in rec:
+            try:
+                ctx = _TRACE_PROVIDER()
+            except Exception:  # noqa: BLE001 - tagging is best-effort
+                ctx = None
+            if ctx is not None:
+                rec["trace_id"], rec["span_id"] = ctx
         with self._lock:
             if self._closed:
                 return rec
@@ -342,6 +437,14 @@ class RunRecorder:
                 # one atomic write per record: concurrent emitters must
                 # never interleave partial lines
                 self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
+        if _EMIT_OBSERVERS:
+            with _OBSERVER_LOCK:
+                observers = list(_EMIT_OBSERVERS)
+            for fn in observers:
+                try:
+                    fn(rec, self)
+                except Exception:  # noqa: BLE001 - observers never break
+                    pass
         return rec
 
     def _aggregate(self, rec: Dict[str, Any]) -> None:
@@ -454,6 +557,10 @@ class RunRecorder:
             }.get(rec.get("event"))
             if key:
                 self._agg[key] = self._agg.get(key, 0) + 1
+        elif t == "span":
+            self._agg["spans"] = self._agg.get("spans", 0) + 1
+        elif t == "capture":
+            self._agg["captures"] = self._agg.get("captures", 0) + 1
         elif t == "predict":
             self._agg["predicts"] = self._agg.get("predicts", 0) + 1
             self._agg["predict_rows"] = \
@@ -560,6 +667,9 @@ class RunRecorder:
                     f"{s.get('serve_shed', 0):.0f} shed, "
                     f"{s.get('serve_timeout', 0):.0f} timeout, "
                     f"{s.get('serve_rejected', 0):.0f} rejected)")
+            if s.get("captures"):
+                parts.append(f"{s['captures']:.0f} flight-recorder "
+                             f"capture(s)")
             if self.path:
                 parts.append(f"records -> {self.path}")
             Log.info("%s", ", ".join(parts))

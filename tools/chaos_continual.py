@@ -54,6 +54,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np  # noqa: E402
 
@@ -236,12 +237,18 @@ def main(argv=None):
     from lightgbm_tpu.serve.watcher import CanarySet
     os.makedirs(root, exist_ok=True)
     X_canary = np.random.RandomState(77).randn(32, N_FEAT)
+    # the watcher writes its own stream: publishes carry the trace_id
+    # the daemon's checkpoints propagated, so the span-continuity lint
+    # below can join the two processes' files
+    from lightgbm_tpu.utils.telemetry import RunRecorder
+    watcher_tele = os.path.join(workdir, "watcher_telemetry.jsonl")
+    watcher_rec = RunRecorder(watcher_tele)
     server = Server(config=ServeConfig(warmup=False)).start()
     watcher = CheckpointWatcher(
         root, RegistryTarget(server),
         config=FleetConfig(watch_poll_s=0.25, rollback_window_s=0.5,
                            rollback_min_requests=1),
-        canary=CanarySet(X_canary)).start()
+        canary=CanarySet(X_canary), recorder=watcher_rec).start()
     stop_traffic = threading.Event()
 
     def traffic():
@@ -391,6 +398,19 @@ def main(argv=None):
         stop_traffic.set()
         watcher.stop()
         server.stop()
+        watcher_rec.close(log=False)
+
+    # ---- span continuity: every publish joins a daemon trace root --
+    # (tools/trace_view.py; the daemon wrote `telemetry`, the watcher
+    # wrote its own stream — the two processes' records must join,
+    # SIGKILL/preempt restarts included, via the announce-at-entry
+    # root records)
+    from trace_view import lint_publish_continuity, load_records
+    span_errs = lint_publish_continuity(
+        load_records([telemetry, watcher_tele]), require_processes=2)
+    ok &= check("every published snapshot joins a daemon-side trace "
+                "root across both processes", not span_errs,
+                "; ".join(span_errs[:3]))
 
     result = {"ok": bool(ok), "checks": CHECKS,
               "oracle_iter": oracle_iter,

@@ -1,0 +1,326 @@
+"""Observability-plane chaos e2e (ISSUE 13 acceptance, CI job).
+
+One run demonstrates, across REAL OS processes:
+
+1. **joined trace** — a continual daemon (subprocess) consumes
+   batches; each batch roots a trace that rides its checkpoint into
+   the parent-process watcher (validate -> publish) and onto a
+   2-replica ProcessReplica fleet via the /swap trace header, down to
+   the ``first_request`` span each replica emits — rendered by
+   ``tools/trace_view.py`` and gated by its publish-continuity lint
+   (>= 2 OS processes per joined trace).
+2. **flight recorder** — an injected stall (``trainer.step:hang``)
+   trips the watchdog; the daemon's armed flight recorder
+   (``obs_flight_recorder=true``) dumps a capture directory whose
+   ``capture`` record links the ring dump.
+3. **live metrics** — every replica's ``GET /metrics`` parses as
+   Prometheus text and its request counters match BOTH the client-side
+   oracle counts and the replica's own telemetry records bit-for-bit;
+   the fleet aggregate (``FleetSupervisor.metrics_text``) parses and
+   carries per-replica labels.
+
+Exits non-zero on any failed check; writes a JSON check report.
+
+    JAX_PLATFORMS=cpu python tools/chaos_obs.py --workdir obs_work \\
+        --telemetry obs_telemetry.jsonl --out obs_chaos.json
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+CHECKS = {}
+
+
+def check(name, ok, detail=""):
+    CHECKS[name] = {"ok": bool(ok), "detail": str(detail)[:300]}
+    print(f"  [{'ok' if ok else 'FAIL'}] {name}"
+          f"{(' — ' + str(detail)[:120]) if detail and not ok else ''}",
+          flush=True)
+    return bool(ok)
+
+
+def write_batches(ingest, n=3, rows=400, feats=6, seed=0):
+    rng = np.random.RandomState(seed)
+    os.makedirs(ingest, exist_ok=True)
+    for i in range(n):
+        X = rng.randn(rows, feats)
+        y = (X[:, 0] + 0.3 * rng.randn(rows) > 0).astype(np.float64)
+        np.savez(os.path.join(ingest, f"batch_{i:03d}.npz"), X=X, y=y)
+
+
+def wait_for(pred, timeout, what, poll=0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(poll)
+    print(f"  timeout waiting for {what}", flush=True)
+    return False
+
+
+def read_jsonl(path):
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        pass
+    except OSError:
+        pass
+    return out
+
+
+def get(url, path, timeout=10):
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def post_predict(url, rows, timeout=30):
+    req = urllib.request.Request(
+        url + "/predict", data=json.dumps({"rows": rows}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--workdir", default="obs_work")
+    ap.add_argument("--telemetry", default="obs_telemetry.jsonl")
+    ap.add_argument("--out", default="obs_chaos.json")
+    ap.add_argument("--requests", type=int, default=24)
+    args = ap.parse_args(argv)
+
+    import subprocess
+
+    from lightgbm_tpu.obs import metrics as obs_metrics
+    from lightgbm_tpu.serve import (CheckpointWatcher, FleetConfig,
+                                    FleetSupervisor, FleetTarget)
+    from lightgbm_tpu.serve.fleet import ProcessReplica
+    from lightgbm_tpu.serve.registry import model_fingerprint
+    from lightgbm_tpu.utils import telemetry as tele
+    from trace_view import (lint_publish_continuity, load_records,
+                            render_trace, traces)
+
+    work = os.path.abspath(args.workdir)
+    os.makedirs(work, exist_ok=True)
+    ingest = os.path.join(work, "ingest")
+    root = os.path.join(work, "ckpts")
+    captures = os.path.join(work, "obs_captures")
+    daemon_tele = os.path.join(work, "daemon_telemetry.jsonl")
+    write_batches(ingest)
+    ok = True
+
+    # ---- phase 1: daemon subprocess with an injected stall ----------
+    print("== phase 1: continual daemon (subprocess) with injected "
+          "stall -> flight-recorder capture ==", flush=True)
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        # 4th heartbeat of trainer.step hangs ONCE: past the 2-step
+        # compile grace, so the watchdog (5s) abandons the attempt and
+        # the retry finishes the batch
+        "LTPU_FAULTS": "trainer.step:hang@4",
+    })
+    cmd = [sys.executable, "-m", "lightgbm_tpu", "task=continual",
+           f"checkpoint_dir={root}", f"continual_ingest_dir={ingest}",
+           f"telemetry_file={daemon_tele}",
+           "obs_flight_recorder=true", f"obs_capture_dir={captures}",
+           "obs_capture_cooldown_s=0",
+           "continual_stall_timeout_s=5",
+           "continual_rounds_per_batch=4", "continual_max_batches=3",
+           "continual_idle_exit_s=3", "objective=binary",
+           "num_leaves=7", "verbose=-1", "metric=None"]
+    log_path = os.path.join(work, "daemon.log")
+    with open(log_path, "ab") as log:
+        rc = subprocess.run(cmd, stdout=log, stderr=log, env=env,
+                            cwd=work, timeout=600).returncode
+    ok &= check("daemon exited cleanly", rc == 0,
+                f"rc={rc} (log: {log_path})")
+    daemon_recs = read_jsonl(daemon_tele)
+    stalls = [r for r in daemon_recs if r.get("type") == "continual"
+              and r.get("event") == "stall_restart"]
+    ok &= check("injected stall tripped the watchdog", bool(stalls))
+    caps = [r for r in daemon_recs if r.get("type") == "capture"]
+    ok &= check("flight recorder emitted a capture record",
+                bool(caps), f"{len(caps)} capture records")
+    cap_ok = False
+    if caps:
+        cap = caps[0]
+        cap_dir = cap.get("path", "")
+        cap_ok = (cap.get("trigger") == "stall" and
+                  os.path.isfile(os.path.join(cap_dir, "ring.jsonl"))
+                  and os.path.isfile(os.path.join(cap_dir,
+                                                  "anomaly.json")))
+        if cap_ok:
+            n_ring = sum(1 for _ in open(os.path.join(cap_dir,
+                                                      "ring.jsonl")))
+            cap_ok = n_ring == int(cap.get("ring_records", -1))
+    ok &= check("capture record links ring dump (trigger=stall)",
+                cap_ok, caps[0] if caps else "no capture")
+    snaps = sorted(glob.glob(os.path.join(root, "ckpt_*")))
+    ok &= check("daemon produced checkpoints", len(snaps) >= 2,
+                f"{len(snaps)} snapshots")
+    if not snaps:
+        return finish(args, False)
+
+    # ---- phase 2: fleet of 2 ProcessReplicas + traced publish -------
+    print("== phase 2: 2-replica fleet, watcher publish rides the "
+          "daemon trace ==", flush=True)
+    rec = tele.RunRecorder(os.path.abspath(args.telemetry))
+    replica_tele = [os.path.join(work, f"replica_{i}_telemetry.jsonl")
+                    for i in range(2)]
+
+    def factory(i):
+        return ProcessReplica(
+            snaps[0], work, slot=i,
+            params={"telemetry_file": replica_tele[i],
+                    "serve_batch_wait_ms": "0.5"})
+
+    fcfg = FleetConfig(replicas=2, watch_poll_s=0.3,
+                       probe_interval_s=0.2)
+    sup = FleetSupervisor(factory, fcfg, recorder=rec)
+    watcher = None
+    try:
+        sup.start(wait_healthy_s=90)
+        ok &= check("fleet started (2 replicas)",
+                    len(sup.endpoints()) == 2, sup.slots())
+        with open(os.path.join(snaps[-1], "model.txt")) as f:
+            want_fp = model_fingerprint(f.read())
+        watcher = CheckpointWatcher(root, FleetTarget(sup), config=fcfg,
+                                    recorder=rec)
+        for _ in range(len(snaps) + 2):
+            watcher.poll_once()
+        converged = wait_for(
+            lambda: sorted(sup.active_models().values()) ==
+            [want_fp, want_fp], 60, "fleet convergence on the newest "
+                                    "snapshot")
+        ok &= check("watcher published the newest snapshot fleet-wide",
+                    converged, sup.active_models())
+
+        # ---- phase 3: traffic + metrics oracle ----------------------
+        print("== phase 3: traffic, /metrics oracle, fleet aggregate "
+              "==", flush=True)
+        urls = sup.endpoints()
+        rng = np.random.RandomState(7)
+        sent = {u: 0 for u in urls}
+        for i in range(args.requests):
+            u = urls[i % len(urls)]
+            out = post_predict(u, rng.randn(3, 6).tolist())
+            if len(out.get("predictions", [])) == 3:
+                sent[u] += 1
+        ok &= check("all requests answered",
+                    sum(sent.values()) == args.requests, sent)
+        agg_series = 0
+        for i, u in enumerate(urls):
+            text = get(u, "/metrics")
+            try:
+                parsed = obs_metrics.parse_text(text)
+            except ValueError as exc:
+                ok &= check(f"replica {i} /metrics parses", False, exc)
+                continue
+            ok &= check(f"replica {i} /metrics parses",
+                        len(parsed) > 10, f"{len(parsed)} series")
+            got_ok = parsed.get(("ltpu_serve_requests_total",
+                                 (("status", "ok"),)), 0.0)
+            ok &= check(
+                f"replica {i} ok-request counter matches the client "
+                f"oracle", got_ok == sent[u],
+                f"scrape={got_ok} oracle={sent[u]}")
+            mirror = parsed.get(("ltpu_telemetry_serve_requests", ()),
+                                0.0)
+            total = sum(v for (n, ls), v in parsed.items()
+                        if n == "ltpu_serve_requests_total")
+            ok &= check(
+                f"replica {i} mirrored telemetry counter agrees "
+                f"bit-for-bit", mirror == total,
+                f"mirror={mirror} status-sum={total}")
+        fleet_text = sup.metrics_text()
+        try:
+            fleet_parsed = obs_metrics.parse_text(fleet_text)
+            agg_series = len(fleet_parsed)
+            fleet_ok_sum = sum(
+                v for (n, ls), v in fleet_parsed.items()
+                if n == "ltpu_serve_requests_total" and
+                ("status", "ok") in ls)
+            per_replica = {n for (n, ls) in fleet_parsed
+                           if any(k == "replica" for k, _ in ls)}
+            ok &= check("fleet /metrics aggregate parses with "
+                        "per-replica labels",
+                        agg_series > 20 and len(per_replica) > 5,
+                        f"{agg_series} series")
+            ok &= check("fleet aggregate ok-requests == client oracle",
+                        fleet_ok_sum == args.requests,
+                        f"agg={fleet_ok_sum} sent={args.requests}")
+        except ValueError as exc:
+            ok &= check("fleet /metrics aggregate parses", False, exc)
+    finally:
+        if watcher is not None:
+            watcher.stop()
+        sup.stop()
+        rec.close(log=False)
+
+    # ---- phase 4: replica telemetry vs scrape + trace lint ----------
+    print("== phase 4: joined-trace lint across processes ==",
+          flush=True)
+    for i, path in enumerate(replica_tele):
+        recs = read_jsonl(path)
+        served = [r for r in recs if r.get("type") == "serve"
+                  and r.get("status") != "swap"]
+        want = sent.get(urls[i]) if i < len(urls) else None
+        ok &= check(f"replica {i} telemetry records == scrape oracle",
+                    want is not None and len(served) == want,
+                    f"records={len(served)} oracle={want}")
+    files = [daemon_tele, os.path.abspath(args.telemetry)] + \
+        [p for p in replica_tele if os.path.isfile(p)]
+    records = load_records(files)
+    errs = lint_publish_continuity(records, require_processes=2,
+                                   require_spans=("publish",
+                                                  "first_request"))
+    ok &= check("every fleet publish joins a daemon-side trace root "
+                "across >= 2 OS processes", not errs, "; ".join(errs))
+    by_trace = traces(records)
+    pubs = [r for r in records if r.get("type") == "fleet"
+            and r.get("event") == "publish" and r.get("trace_id")]
+    if pubs:
+        tid = pubs[-1]["trace_id"]
+        print(f"-- joined trace (rendered by tools/trace_view.py) --")
+        for line in render_trace(tid, by_trace[tid]["spans"],
+                                 by_trace[tid]["events"]):
+            print(line)
+    # schema lint every participating stream
+    for path in files:
+        n, lint_errs = tele.lint_file(path)
+        ok &= check(f"schema lint {os.path.basename(path)}",
+                    not lint_errs,
+                    "; ".join(lint_errs[:3]))
+    return finish(args, ok)
+
+
+def finish(args, ok):
+    n_ok = sum(1 for c in CHECKS.values() if c["ok"])
+    result = {"ok": bool(ok), "checks": CHECKS,
+              "passed": n_ok, "total": len(CHECKS)}
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1, sort_keys=True)
+    print(f"chaos obs: {n_ok}/{len(CHECKS)} checks passed -> "
+          f"{args.out}", flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

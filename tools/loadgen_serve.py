@@ -66,6 +66,45 @@ def _get(url, path, timeout=30):
     return json.loads(r.read())
 
 
+def _get_text(url, path, timeout=30):
+    r = urllib.request.urlopen(url + path, timeout=timeout)
+    return r.read().decode()
+
+
+def check_metrics_scrape(url, counts, swaps_expected=None):
+    """Scrape ``GET /metrics``, parse it as Prometheus text, and diff
+    the per-status request counters against the CLIENT-side oracle
+    ``counts`` — the live-metrics half of the CI serve smoke (the
+    scrape must match what the clients actually observed bit-for-bit).
+    Returns a summary dict with any mismatches."""
+    from lightgbm_tpu.obs import metrics as obs_metrics
+    text = _get_text(url, "/metrics")
+    parsed = obs_metrics.parse_text(text)      # raises on malformed
+    by_status = {dict(ls).get("status", ""): v
+                 for (name, ls), v in parsed.items()
+                 if name == "ltpu_serve_requests_total"}
+    # client-side 5xx buckets are server-side "error" statuses
+    oracle = {}
+    for key, v in counts.items():
+        oracle_key = "error" if key.startswith("http_") else key
+        oracle[oracle_key] = oracle.get(oracle_key, 0) + v
+    mismatches = {
+        k: {"scrape": by_status.get(k, 0.0), "oracle": oracle.get(k, 0)}
+        for k in set(by_status) | set(oracle)
+        if by_status.get(k, 0.0) != oracle.get(k, 0)}
+    out = {
+        "series": len(parsed),
+        "by_status": by_status,
+        "total": sum(by_status.values()),
+        "swaps": parsed.get(("ltpu_serve_swaps_total", ()), 0.0),
+        "mismatches": mismatches,
+        "passed": not mismatches and len(parsed) > 10,
+    }
+    if swaps_expected is not None:
+        out["passed"] = out["passed"] and out["swaps"] == swaps_expected
+    return out
+
+
 from lightgbm_tpu.utils.telemetry import (  # noqa: E402 - jax-free
     percentile as _percentile)
 
@@ -184,6 +223,10 @@ def selftest(args):
         res = drive(url, args.requests, args.threads, args.rows_max,
                     n_features=8, swap_model_file=swap_file)
         res["stats"] = _get(url, "/stats")
+        # metrics-scrape smoke: /metrics must parse as Prometheus
+        # text and its request counters must equal the client oracle
+        res["metrics"] = check_metrics_scrape(url, res["counts"],
+                                              swaps_expected=1)
     finally:
         httpd.shutdown()
         server.stop()
@@ -196,7 +239,8 @@ def selftest(args):
           and res["counts"].get("ok", 0) > 0
           and res.get("swap", {}).get("status") == 200
           and res["counts"].get("shed", 0) == 0
-          and res["counts"].get("timeout", 0) == 0)
+          and res["counts"].get("timeout", 0) == 0
+          and res["metrics"]["passed"])
     res["passed"] = ok
     return res, 0 if ok else 1
 

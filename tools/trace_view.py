@@ -1,0 +1,273 @@
+"""Render cross-process trace timelines from telemetry JSONL files.
+
+Spans (``obs/spans.py``) carry ``trace_id``/``span_id``/``parent_id``;
+every other record emitted under an active span carries the same
+``trace_id`` — so one snapshot's daemon-side batch, its checkpoint
+save, the watcher's validate/canary/publish in ANOTHER process, and
+the first request each replica served all join into one timeline.
+Point this tool at every participating JSONL file::
+
+    python tools/trace_view.py daemon.jsonl watcher.jsonl replica*.jsonl
+    python tools/trace_view.py RUN.jsonl --trace 1a2b3c4d5e6f7890
+    python tools/trace_view.py *.jsonl --lint-publish-continuity \\
+        --require-processes 2      # CI gate (chaos e2es)
+
+``--lint-publish-continuity`` exits non-zero unless every fleet
+``publish`` record joins back to a daemon/trainer-side trace root (a
+root span named ``batch`` or ``train``) — the "no orphan deploys"
+invariant the chaos e2es pin.
+"""
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+BAR_COLS = 36
+
+
+def load_records(paths: List[str]) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    rec["_file"] = os.path.basename(path)
+                    out.append(rec)
+    return out
+
+
+def traces(records: List[Dict[str, Any]]
+           ) -> Dict[str, Dict[str, List[Dict[str, Any]]]]:
+    """{trace_id: {"spans": [...], "events": [...]}} over all files.
+    Announce/close span pairs (``status="open"`` emitted at entry so a
+    SIGKILLed process still leaves its root) are deduped by span_id,
+    preferring the closed record."""
+    out: Dict[str, Dict[str, List[Dict[str, Any]]]] = {}
+    by_sid: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    for r in records:
+        tid = r.get("trace_id")
+        if not tid:
+            continue
+        ent = out.setdefault(tid, {"spans": [], "events": []})
+        if r.get("type") != "span":
+            ent["events"].append(r)
+            continue
+        key = (tid, r.get("span_id", ""))
+        prev = by_sid.get(key)
+        if prev is None:
+            by_sid[key] = r
+            ent["spans"].append(r)
+        elif prev.get("status") == "open" and \
+                r.get("status") != "open":
+            ent["spans"][ent["spans"].index(prev)] = r
+            by_sid[key] = r
+    return out
+
+
+def _span_start(s: Dict[str, Any]) -> float:
+    return float(s.get("wall_time", 0.0)) - \
+        float(s.get("duration_ms", 0.0)) / 1e3
+
+
+def _attr_str(s: Dict[str, Any]) -> str:
+    parts = []
+    for key in ("batch", "path", "model_id", "version", "rows",
+                "outcome", "trigger", "error"):
+        if key in s:
+            v = s[key]
+            if key == "model_id" and isinstance(v, str):
+                v = v[:10]
+            if key == "error":
+                v = str(v)[:60]
+            parts.append(f"{key}={v}")
+    return (" (" + ", ".join(parts) + ")") if parts else ""
+
+
+def render_trace(tid: str, spans: List[Dict[str, Any]],
+                 events: List[Dict[str, Any]]) -> List[str]:
+    spans = sorted(spans, key=_span_start)
+    pids = sorted({s.get("pid") for s in spans if s.get("pid")} |
+                  {e.get("pid") for e in events if e.get("pid")} - {None})
+    t0 = min([_span_start(s) for s in spans] +
+             [float(e.get("wall_time", 0.0)) for e in events])
+    t1 = max([float(s.get("wall_time", 0.0)) for s in spans] +
+             [float(e.get("wall_time", 0.0)) for e in events])
+    total = max(t1 - t0, 1e-6)
+    by_id = {s.get("span_id"): s for s in spans}
+    children: Dict[Optional[str], List[Dict[str, Any]]] = {}
+    for s in spans:
+        parent = s.get("parent_id")
+        if parent is not None and parent not in by_id:
+            parent = None                  # orphan: parent in a lost file
+        children.setdefault(parent, []).append(s)
+    ev_by_span: Dict[str, List[Dict[str, Any]]] = {}
+    for e in events:
+        ev_by_span.setdefault(e.get("span_id", ""), []).append(e)
+
+    lines = [f"trace {tid} — {len(spans)} spans, {len(events)} "
+             f"events, {len(pids)} process(es) "
+             f"{pids if pids else ''}, {total * 1e3:.0f} ms"]
+
+    def bar(start: float, dur_s: float) -> str:
+        a = int(round((start - t0) / total * BAR_COLS))
+        w = max(int(round(dur_s / total * BAR_COLS)), 1)
+        a = min(a, BAR_COLS - 1)
+        w = min(w, BAR_COLS - a)
+        return " " * a + "#" * w + " " * (BAR_COLS - a - w)
+
+    def walk(span: Dict[str, Any], depth: int) -> None:
+        start = _span_start(span)
+        dur = float(span.get("duration_ms", 0.0)) / 1e3
+        status = span.get("status", "ok")
+        flag = "" if status == "ok" else f"  !! {status}"
+        lines.append(
+            f"  [{bar(start, dur)}] {'  ' * depth}"
+            f"{span.get('name', '?'):<18s} "
+            f"+{(start - t0) * 1e3:7.0f}ms {dur * 1e3:8.1f}ms  "
+            f"pid {span.get('pid', '?')}"
+            f"{_attr_str(span)}{flag}")
+        for e in sorted(ev_by_span.get(span.get("span_id"), []),
+                        key=lambda r: float(r.get("wall_time", 0.0))):
+            off = (float(e.get("wall_time", 0.0)) - t0) * 1e3
+            detail = e.get("event") or e.get("status") or ""
+            lines.append(f"  [{' ' * BAR_COLS}] {'  ' * (depth + 1)}"
+                         f"* {e.get('type')}"
+                         f"{('/' + str(detail)) if detail else '':<14s}"
+                         f" +{off:7.0f}ms  pid {e.get('pid', '?')}"
+                         f" [{e.get('_file', '?')}]")
+        for child in sorted(children.get(span.get("span_id"), []),
+                            key=_span_start):
+            walk(child, depth + 1)
+
+    for root in sorted(children.get(None, []), key=_span_start):
+        walk(root, 0)
+    # events whose enclosing span record never landed in any file
+    spanless = [e for sid, evs in ev_by_span.items()
+                if sid not in by_id for e in evs]
+    for e in sorted(spanless,
+                    key=lambda r: float(r.get("wall_time", 0.0))):
+        off = (float(e.get("wall_time", 0.0)) - t0) * 1e3
+        lines.append(f"  [{' ' * BAR_COLS}] * {e.get('type')}"
+                     f"/{e.get('event', e.get('status', ''))} "
+                     f"+{off:7.0f}ms [{e.get('_file', '?')}]")
+    return lines
+
+
+# ----------------------------------------------------------------------
+# CI lints
+# ----------------------------------------------------------------------
+ROOT_SPAN_NAMES = ("batch", "train")
+
+
+def lint_publish_continuity(records: List[Dict[str, Any]],
+                            require_processes: int = 0,
+                            require_spans: Tuple[str, ...] = ()
+                            ) -> List[str]:
+    """Problems (empty = pass): every fleet ``publish`` record must
+    carry a trace that joins back to a daemon/trainer-side root span
+    (``batch``/``train``).  Optionally require the joined trace to
+    span >= N OS processes and to contain specific span names
+    (``first_request`` proves publish -> served-request continuity)."""
+    errs: List[str] = []
+    by_trace = traces(records)
+    publishes = [r for r in records
+                 if r.get("type") == "fleet" and
+                 r.get("event") == "publish"]
+    if not publishes:
+        errs.append("no fleet publish records found (nothing to lint)")
+        return errs
+    for pub in publishes:
+        label = f"publish of {pub.get('path', '?')} " \
+                f"(model {str(pub.get('model_id', '?'))[:10]})"
+        tid = pub.get("trace_id")
+        if not tid:
+            errs.append(f"{label}: record carries NO trace_id — the "
+                        f"publish is an orphan")
+            continue
+        ent = by_trace.get(tid, {"spans": [], "events": []})
+        roots = [s for s in ent["spans"] if "parent_id" not in s]
+        if not any(s.get("name") in ROOT_SPAN_NAMES for s in roots):
+            errs.append(f"{label}: trace {tid} has no "
+                        f"{'/'.join(ROOT_SPAN_NAMES)} root span — it "
+                        f"does not join back to a daemon-side trace "
+                        f"root")
+            continue
+        pids = {s.get("pid") for s in ent["spans"]} | \
+               {e.get("pid") for e in ent["events"]}
+        pids.discard(None)
+        if require_processes and len(pids) < require_processes:
+            errs.append(f"{label}: trace {tid} spans {len(pids)} "
+                        f"process(es), need >= {require_processes}")
+        names = {s.get("name") for s in ent["spans"]}
+        for want in require_spans:
+            if want not in names:
+                errs.append(f"{label}: trace {tid} is missing a "
+                            f"{want!r} span")
+    return errs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("files", nargs="+",
+                    help="telemetry JSONL files (all trace "
+                         "participants: daemon, watcher, replicas)")
+    ap.add_argument("--trace", help="render only this trace_id")
+    ap.add_argument("--lint-publish-continuity", action="store_true",
+                    help="exit non-zero unless every fleet publish "
+                         "joins a daemon-side trace root")
+    ap.add_argument("--require-processes", type=int, default=0,
+                    help="with the lint: joined traces must span >= N "
+                         "OS processes")
+    ap.add_argument("--require-span", action="append", default=[],
+                    help="with the lint: joined traces must contain "
+                         "this span name (repeatable)")
+    args = ap.parse_args(argv)
+
+    records = load_records(args.files)
+    if args.lint_publish_continuity:
+        errs = lint_publish_continuity(
+            records, require_processes=args.require_processes,
+            require_spans=tuple(args.require_span))
+        if errs:
+            print(f"span-continuity lint: {len(errs)} problem(s):")
+            for e in errs:
+                print(f"  {e}")
+            return 1
+        n = len([r for r in records if r.get("type") == "fleet"
+                 and r.get("event") == "publish"])
+        print(f"span-continuity lint OK: {n} publish(es) all join a "
+              f"daemon-side trace root")
+        return 0
+
+    by_trace = traces(records)
+    if not by_trace:
+        print("no traced records found")
+        return 0
+    wanted = [args.trace] if args.trace else sorted(
+        by_trace,
+        key=lambda t: min(_span_start(s) for s in
+                          by_trace[t]["spans"]) if by_trace[t]["spans"]
+        else 0.0)
+    for tid in wanted:
+        ent = by_trace.get(tid)
+        if ent is None:
+            print(f"trace {tid}: not found")
+            return 1
+        if not ent["spans"]:
+            continue
+        for line in render_trace(tid, ent["spans"], ent["events"]):
+            print(line)
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

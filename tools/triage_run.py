@@ -9,26 +9,34 @@ code broken?" readout round 5 didn't have.
     python tools/triage_run.py RUN.jsonl --baseline PRIOR.jsonl
     python tools/triage_run.py RUN.jsonl --check         # schema lint
     python tools/triage_run.py RUN.jsonl --check --quiet # CI gate
+    python tools/triage_run.py RUN.jsonl --follow        # live tail
 
 ``--check`` exits non-zero on any malformed record (CI's schema gate);
 ``--baseline`` compares per-iteration phase medians against a prior
-run's JSONL and ranks the regressions.
+run's JSONL and ranks the regressions; ``--follow`` tails a LIVE
+stream and prints anomalies the moment their rule trips — the same
+online rule evaluator (``lightgbm_tpu/obs/rules.py``) the in-process
+flight recorder (``obs/flight.py``) triggers captures from, so the
+offline report, the live tail and the capture triggers can never
+disagree about what counts as an anomaly.
 """
 import argparse
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+from lightgbm_tpu.obs import rules as obs_rules  # noqa: E402
 from lightgbm_tpu.utils.telemetry import (  # noqa: E402
     lint_file, read_records)
 
-# compiles after this many iterations are anomalous: steady-state
-# boosting re-runs the same jitted programs, so a climbing compile
-# counter past warmup is a retrace storm (shape drift, cache thrash)
-WARMUP_ITERS = 3
+# re-exported from the shared rule module (obs/rules.py) — the one
+# definition of steady-state warmup and fused-block compile exemption
+WARMUP_ITERS = obs_rules.WARMUP_ITERS
+_superstep_warmups = obs_rules.superstep_warmups
 
 
 def _median(vals):
@@ -70,159 +78,19 @@ def iter_durations(records):
     return out
 
 
-def _superstep_warmups(records):
-    """Yield ``(record, is_warmup)`` for every superstep record — the
-    ONE definition of which fused blocks are compile-bearing.  The
-    scan program compiles once per distinct block size k (the
-    auto-sized tail block is a shorter scan) AND per mesh identity (a
-    sharded run's scan is a different program per learner x shard
-    count — the weak-scale grid runs several in one file), so the
-    FIRST superstep of each (k, learner, shards) is per-shape warmup.
-    Sharded runs get TWO warmup blocks: block 1 consumes the
-    single-device score the unfused bias iteration left behind,
-    block 2 runs on the mesh-replicated carry — same trace, two XLA
-    executables by input sharding, both structural.  A ``run_start``
-    resets the tracking: it marks a new process segment (a continual
-    daemon restart appending to the same JSONL) or a new booster
-    adopting the recorder (one booster per continual batch) — either
-    way a fresh jitted scan whose first block per shape is warmup,
-    not a retrace storm.  The first checkpoint save and the first
-    load per segment also compile once (the mid-block alignment
-    replay and the restore path run eager jnp ops), and those
-    compiles land in the NEXT superstep's counter delta — that
-    superstep is exempt too.  An elastic re-mesh (``recovery`` record,
-    event remesh/reshard — parallel/elastic.py) rebuilds the fused
-    scan for the survivor mesh: the next TWO superstep records are
-    exempt whatever their (k, learner, shards) key says — a recovery
-    back onto a width this run already trained at (transient loss, a
-    weak-scale grid that visited it) re-COMPILES even though the key
-    counter is past its allowance."""
-    seen = {}
-    ckpt_firsts = set()
-    ckpt_pending = False
-    remesh_grace = 0
-    for r in records:
-        rtype = r.get("type")
-        if rtype == "run_start":
-            seen = {}
-            ckpt_firsts = set()
-            ckpt_pending = False
-            continue
-        if rtype == "recovery":
-            if r.get("event") in ("remesh", "reshard"):
-                remesh_grace = 2
-            continue
-        if rtype == "checkpoint":
-            event = r.get("event")
-            if event in ("save", "load") and event not in ckpt_firsts:
-                ckpt_firsts.add(event)
-                ckpt_pending = True
-            continue
-        if rtype != "superstep":
-            continue
-        shards = int(r.get("num_shards", 1))
-        key = (int(r.get("k", 1)), r.get("learner", ""), shards)
-        n = seen.get(key, 0)
-        seen[key] = n + 1
-        warm = (n < (2 if shards > 1 else 1) or ckpt_pending or
-                remesh_grace > 0)
-        ckpt_pending = False
-        if remesh_grace > 0:
-            remesh_grace -= 1
-        yield r, warm
-
-
 def scan_anomalies(records):
-    """Ordered (severity, message) anomaly list for one run."""
+    """Ordered (severity, message) anomaly list for one run.
+
+    The compile/pipelining/split-kernel rules live in the SHARED rule
+    module (``obs/rules.py`` — the flight recorder and ``--follow``
+    evaluate them online); this function renders their run-level
+    aggregates and keeps the offline-only statistics (weak scaling,
+    spike checks, subsystem rollup scans) local."""
     out = []
-    iters = [r for r in records if r.get("type") == "iteration"]
-    post_warmup = [r for r in iters if r.get("iter", 0) >= WARMUP_ITERS]
-    compiles_late = sum((r.get("counters") or {}).get("xla_compiles", 0)
-                       for r in post_warmup)
-    # compiles on a REPEATED (k, learner, shards) superstep are a real
-    # retrace storm (warmup rule: _superstep_warmups)
-    ss_late, ss_secs = 0.0, 0.0
-    for r, warm in _superstep_warmups(records):
-        c = (r.get("counters") or {}).get("xla_compiles", 0)
-        if c and not warm:
-            ss_late += c
-            ss_secs += (r.get("counters") or {}).get(
-                "xla_compile_secs", 0.0)
-    if ss_late:
-        out.append(("HIGH", f"superstep retrace storm: {ss_late:.0f} "
-                            f"XLA compiles ({ss_secs:.1f}s) on "
-                            f"repeated same-k super-steps — the fused "
-                            f"scan should compile once per block "
-                            f"size"))
-    if compiles_late:
-        secs = sum((r.get("counters") or {}).get("xla_compile_secs", 0.0)
-                   for r in post_warmup)
-        out.append(("HIGH", f"retrace storm: {compiles_late:.0f} XLA "
-                            f"compiles ({secs:.1f}s) AFTER iteration "
-                            f"{WARMUP_ITERS} — steady state should "
-                            f"re-run cached programs"))
-    # pipelining silently disabled: superstep records claim a pipeline
-    # depth > 0 yet their fetch-overlap window is ~zero — the block
-    # was dispatched and fetched back-to-back, so the one device->host
-    # round-trip per block is stalling the loop again (a drain point
-    # firing every block: a learning_rates schedule, eligibility
-    # flapping, or a bug).  Warmup-exempt blocks are skipped with the
-    # shared _superstep_warmups rule: the FIRST block of a run (and of
-    # each shape/mesh/checkpoint/remesh segment) legitimately has no
-    # predecessor to overlap.
-    overlaps = [float(r.get("fetch_overlap_s", 0.0))
-                for r, warm in _superstep_warmups(records)
-                if not warm and int(r.get("pipeline_depth", 0)) > 0]
-    if overlaps:
-        stalled = sum(1 for v in overlaps if v < 1e-5)
-        if stalled > len(overlaps) / 2:
-            out.append(("MED", f"superstep pipelining silently "
-                               f"disabled: {stalled}/{len(overlaps)} "
-                               f"fused blocks show ~zero fetch "
-                               f"overlap at pipeline_depth > 0 — "
-                               f"every block is draining the "
-                               f"in-flight queue (learning_rates "
-                               f"schedule? eligibility flapping?), "
-                               f"so the per-block fetch RTT is "
-                               f"un-hidden again"))
-    # split kernel silently fell back to XLA on a TPU backend: the
-    # fused histogram→split pass is off, so every grow level
-    # round-trips the full (leaves x features x bins) histogram
-    # through HBM again.  An EXPLICIT split_kernel=xla is an operator
-    # choice, not an anomaly; everything else (categorical gate, EFB,
-    # learner, c2f, forced splits) deserves a look because the config
-    # may be one knob away from the fast tier.  Evaluated PER
-    # run_start SEGMENT (multi-run daemon/resume streams mix
-    # backends): superstep records pair with THEIR run's backend, and
-    # a segment with no supersteps (unfused runs) triages from its
-    # run_start tier decision.
-    segs, cur = [], None
+    scanner = obs_rules.OnlineScanner()
     for r in records:
-        if r.get("type") == "run_start":
-            cur = {"backend": str(r.get("backend", "")).lower(),
-                   "tier": r.get("tier") or {}, "ss": []}
-            segs.append(cur)
-        elif r.get("type") == "superstep" and cur is not None \
-                and "split_kernel" in r:
-            cur["ss"].append((r.get("split_kernel"),
-                              r.get("split_fallback")))
-    for seg in segs:
-        backend = seg["backend"]
-        if not backend or backend in ("cpu", "unknown", "?"):
-            continue
-        if seg["ss"]:
-            sk, reason = seg["ss"][-1]
-        else:
-            sk = seg["tier"].get("split_kernel")
-            reason = (seg["tier"].get("gates") or {}).get("split")
-        if sk == "xla" and reason and "split_kernel=xla" not in reason:
-            out.append(("MED", f"split kernel fell back to XLA on a "
-                               f"{backend} backend: {reason} — the "
-                               f"fused histogram→split pass is "
-                               f"disabled, every grow level "
-                               f"round-trips the full histogram "
-                               f"through HBM"))
-            break
+        scanner.feed(r)
+    out.extend(scanner.summary_anomalies())
     # weak-scaling regression: sharded super-steps at DIFFERENT mesh
     # sizes in one run (the weak-scale bench grid, or a resumed run on
     # a wider mesh) whose per-iteration time grows with the shard
@@ -649,6 +517,71 @@ def triage(records, baseline=None):
     return "\n".join(lines)
 
 
+def follow(path, idle_timeout_s=0.0, poll_s=0.25, out=sys.stdout):
+    """Tail a live telemetry JSONL and print anomalies AS THEY FIRE
+    (the online half of the shared rule evaluator, ``obs/rules.py``).
+    Waits for the file to appear; a partially-written trailing line is
+    re-read on the next poll (the writer appends whole lines, so only
+    the tail can be torn).  Exits after ``idle_timeout_s`` with no new
+    data (0 = run until interrupted).  Returns the number of instant
+    anomalies printed."""
+    scanner = obs_rules.OnlineScanner()
+    n_fired = 0
+    n_records = 0
+    t_idle = time.monotonic()
+    f = None
+    try:
+        while True:
+            if f is None:
+                try:
+                    f = open(path)
+                    print(f"following {path} ...", file=out, flush=True)
+                except OSError:
+                    if idle_timeout_s > 0 and \
+                            time.monotonic() - t_idle > idle_timeout_s:
+                        print(f"no file after {idle_timeout_s:.0f}s: "
+                              f"{path}", file=out)
+                        return n_fired
+                    time.sleep(poll_s)
+                    continue
+            where = f.tell()
+            line = f.readline()
+            if not line or not line.endswith("\n"):
+                f.seek(where)              # torn tail: retry whole line
+                if idle_timeout_s > 0 and \
+                        time.monotonic() - t_idle > idle_timeout_s:
+                    break
+                time.sleep(poll_s)
+                continue
+            t_idle = time.monotonic()
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            n_records += 1
+            for sev, code, msg in scanner.feed(rec):
+                n_fired += 1
+                stamp = time.strftime("%H:%M:%S")
+                print(f"{stamp} [{sev}] {code}: {msg}", file=out,
+                      flush=True)
+            if rec.get("type") == "capture":
+                stamp = time.strftime("%H:%M:%S")
+                print(f"{stamp} [CAPTURE] {rec.get('trigger', '?')} "
+                      f"-> {rec.get('path', '?')}", file=out,
+                      flush=True)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if f is not None:
+            f.close()
+    print(f"followed {n_records} records, {n_fired} anomalies fired",
+          file=out, flush=True)
+    return n_fired
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("run", help="telemetry JSONL to triage")
@@ -657,7 +590,17 @@ def main(argv=None):
                     help="schema-lint only; exit 1 on malformed records")
     ap.add_argument("--quiet", action="store_true",
                     help="suppress OK output (CI mode)")
+    ap.add_argument("--follow", action="store_true",
+                    help="tail the (possibly still-growing) JSONL and "
+                         "print anomalies as they fire")
+    ap.add_argument("--follow-timeout", type=float, default=0.0,
+                    help="with --follow: exit after this many seconds "
+                         "without new records (0 = until Ctrl-C)")
     args = ap.parse_args(argv)
+
+    if args.follow:
+        follow(args.run, idle_timeout_s=args.follow_timeout)
+        return 0
 
     if args.check:
         n, errs = lint_file(args.run)
