@@ -420,6 +420,255 @@ def run_variant(lgb, params, train, n_meas, auc_fn, profiling=None,
     return out
 
 
+def router_only():
+    """Fast path (``python bench.py --router-only``): aggregate fleet
+    throughput and latency THROUGH the routing front
+    (``serve/router.py``) vs clients round-robining
+    ``FleetSupervisor.endpoints()`` directly — steady state, a mid-run
+    deploy, and an injected backend brownout with hedging on vs off.
+    Records BENCH_router_cpu.json (rendered into docs/Benchmarks.md
+    by tools/render_benchmarks.py) with the acceptance pins: hedging
+    bounds the brownout p99 below the no-hedge cell, every
+    budget-shed request is a STRUCTURED 429, and zero requests drop
+    through the router across every cell."""
+    import datetime
+    import threading as _threading
+
+    if ensure_backend(variant="router") is None:
+        return 0
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serve import (FleetConfig, FleetSupervisor,
+                                    InprocReplica, Router,
+                                    RouterConfig, ServeConfig)
+    from lightgbm_tpu.serve.router import route_http
+    from lightgbm_tpu.utils import faults as _faults
+    from lightgbm_tpu.utils import telemetry as _telemetry
+    from lightgbm_tpu.utils.telemetry import percentile
+    _telemetry.install_jax_hooks()
+
+    n_features = 28
+    rng = np.random.RandomState(0)
+    X = rng.randn(20000, n_features).astype(np.float32)
+    w = rng.randn(n_features).astype(np.float32)
+    y = (1.0 / (1.0 + np.exp(-(X @ w) * 0.5)) >
+         rng.random_sample(20000)).astype(np.float32)
+
+    def train(rounds, seed):
+        d = lgb.Dataset(X, label=y, params={"objective": "binary",
+                                            "verbose": -1})
+        return lgb.train({"objective": "binary", "num_leaves": 31,
+                          "verbose": -1, "metric": "None",
+                          "seed": seed}, d, num_boost_round=rounds)
+
+    b1, b2 = train(20, 1), train(20, 2)
+    forest = (f"{b1.num_trees()}-tree 31-leaf binary forest over "
+              f"{n_features} features, 2 in-process replicas")
+    n_req = int(os.environ.get("BENCH_ROUTER_REQUESTS", "300"))
+    threads = 4
+    rows_per_req = 32
+
+    sup = FleetSupervisor(
+        lambda i: InprocReplica(b1, config=ServeConfig(
+            port=0, batch_wait_ms=1.0, timeout_ms=60000)),
+        FleetConfig(replicas=2, probe_interval_s=0.1,
+                    probe_timeout_s=5.0))
+    sup.start(wait_healthy_s=60)
+
+    def drive(post_one, label, mid_deploy=False):
+        """n_req fixed-size requests from `threads` clients through
+        ``post_one(client_rng) -> (ok, latency_ms)``."""
+        lat, lock = [], _threading.Lock()
+        dropped = [0]
+        issued = [0]
+        deploy_at = n_req // 2 if mid_deploy else -1
+
+        def client(tid):
+            r = np.random.RandomState(100 + tid)
+            while True:
+                with lock:
+                    if issued[0] >= n_req:
+                        return
+                    issued[0] += 1
+                    i = issued[0]
+                if i == deploy_at:
+                    sup.publish_model(b2.model_to_string())
+                    continue
+                t0 = time.time()
+                ok = post_one(r)
+                ms = (time.time() - t0) * 1e3
+                with lock:
+                    if ok:
+                        lat.append(ms)
+                    else:
+                        dropped[0] += 1
+
+        t_start = time.time()
+        cls = [_threading.Thread(target=client, args=(i,))
+               for i in range(threads)]
+        for t in cls:
+            t.start()
+        for t in cls:
+            t.join()
+        wall = time.time() - t_start
+        lat.sort()
+        cell = {
+            "label": label,
+            "requests": len(lat),
+            "dropped": dropped[0],
+            "wall_s": round(wall, 3),
+            "req_per_s": round(len(lat) / max(wall, 1e-9), 1),
+            "rows_per_s": round(len(lat) * rows_per_req /
+                                max(wall, 1e-9)),
+            "p50_ms": round(percentile(lat, 0.50), 2),
+            "p99_ms": round(percentile(lat, 0.99), 2),
+        }
+        return cell
+
+    def http_post(url, path, body, timeout=60):
+        import urllib.error
+        import urllib.request
+        req = urllib.request.Request(
+            url + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read())
+            except ValueError:
+                return e.code, {}
+        except Exception:              # noqa: BLE001 - counted
+            return 599, {}
+
+    def direct_one(r):
+        """The pre-router client: round-robin endpoints() yourself."""
+        eps = sup.endpoints()
+        if not eps:
+            return False
+        lo = int(r.randint(0, len(X) - rows_per_req))
+        url = eps[int(r.randint(0, len(eps)))]
+        st, out = http_post(url, "/predict",
+                            {"rows": X[lo:lo + rows_per_req].tolist()})
+        return st == 200
+
+    def arm_brownout():
+        """ONE slow replica: every attempt forwarded to backend 0 of
+        the route's URL order is delayed 200 ms (vs the ~10 ms mean)
+        — the hedge goes to the OTHER backend and wins the race."""
+        _faults.configure("router.backend:sleepb0_200@*")
+
+    cells = []
+    shed_stats = {}
+    try:
+        cells.append(drive(direct_one, "direct round-robin"))
+        print(json.dumps({"router_cell": cells[-1]}), flush=True)
+
+        for label, hedge_ms, brownout, mid_deploy in (
+                ("router", 60.0, False, False),
+                ("router + mid-run deploy", 60.0, False, True),
+                ("router + brownout, hedge off", 0.0, True, False),
+                ("router + brownout, hedge on", 60.0, True, False)):
+            router = Router(RouterConfig(
+                port=0, probe_interval_s=0.1, probe_timeout_s=5.0,
+                timeout_ms=60000.0, hedge_ms=hedge_ms, max_retries=3))
+            router.add_model("default", supervisor=sup)
+            httpd, _ = route_http(router, port=0, background=True)
+            url = "http://127.0.0.1:%d" % httpd.server_address[1]
+
+            def router_one(r, url=url):
+                lo = int(r.randint(0, len(X) - rows_per_req))
+                st, _o = http_post(
+                    url, "/predict",
+                    {"rows": X[lo:lo + rows_per_req].tolist()})
+                return st == 200
+            if brownout:
+                arm_brownout()
+            cell = drive(router_one, label, mid_deploy=mid_deploy)
+            _faults.configure("")
+            st = router.stats()
+            cell["hedges"] = st["hedges"]
+            cell["hedge_wins"] = st["hedge_wins"]
+            cell["retries"] = st["retries"]
+            cells.append(cell)
+            print(json.dumps({"router_cell": cell}), flush=True)
+            httpd.shutdown()
+            httpd.server_close()
+            router.stop()
+
+        # shed cell: a tight admission budget must shed every excess
+        # request with a STRUCTURED 429 (code + retry_after_ms +
+        # Retry-After header), never an error or a backend touch
+        router = Router(RouterConfig(
+            port=0, probe_interval_s=0.1, probe_timeout_s=5.0,
+            timeout_ms=60000.0, hedge_ms=0.0,
+            rows_per_s=rows_per_req * 4.0,
+            burst_rows=rows_per_req * 4))
+        router.add_model("default", supervisor=sup)
+        httpd, _ = route_http(router, port=0, background=True)
+        url = "http://127.0.0.1:%d" % httpd.server_address[1]
+        structured, unstructured, ok_n = 0, 0, 0
+        for _ in range(80):
+            lo = 0
+            st, out = http_post(
+                url, "/predict",
+                {"rows": X[lo:lo + rows_per_req].tolist()})
+            if st == 200:
+                ok_n += 1
+            elif st == 429 and out.get("code") == "backpressure" \
+                    and out.get("retry_after_ms") is not None:
+                structured += 1
+            else:
+                unstructured += 1
+        shed_stats = {"ok": ok_n, "shed_structured": structured,
+                      "shed_unstructured": unstructured}
+        print(json.dumps({"router_shed": shed_stats}), flush=True)
+        httpd.shutdown()
+        httpd.server_close()
+        router.stop()
+    finally:
+        _faults.configure("")
+        sup.stop()
+
+    by_label = {c["label"]: c for c in cells}
+    pins = {
+        "zero_dropped": all(c["dropped"] == 0 for c in cells
+                            if c["label"].startswith("router")),
+        "hedge_bounds_p99":
+            by_label["router + brownout, hedge on"]["p99_ms"] <
+            by_label["router + brownout, hedge off"]["p99_ms"],
+        "sheds_all_structured":
+            shed_stats.get("shed_structured", 0) > 0 and
+            shed_stats.get("shed_unstructured", 0) == 0,
+    }
+    out = {
+        "metric": "router_front_cpu",
+        "unit": "ms",
+        "backend": "cpu",
+        "date": datetime.date.today().isoformat(),
+        "source": "JAX_PLATFORMS=cpu python bench.py --router-only",
+        "env": "2-core CPU container",
+        "forest": forest,
+        "config": {"replicas": 2, "threads": threads,
+                   "rows_per_request": rows_per_req,
+                   "requests": n_req, "hedge_ms": 60.0,
+                   "brownout": "router.backend:sleepb0_200@* — every "
+                               "attempt to replica 0 delayed 200 ms "
+                               "(one slow replica)"},
+        "cells": cells,
+        "shed": shed_stats,
+        "pins": pins,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_router_cpu.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    print(json.dumps({"wrote": os.path.basename(path),
+                      "pins": pins}), flush=True)
+    return 0 if all(pins.values()) else 1
+
+
 def serve_only():
     """Fast path (``python bench.py --serve-only``): train a small
     booster pair on the CPU backend and record the online-serving
@@ -1672,6 +1921,8 @@ def main():
 if __name__ == "__main__":
     if "--serve-only" in sys.argv:
         sys.exit(serve_only())
+    if "--router-only" in sys.argv:
+        sys.exit(router_only())
     if "--ckpt-only" in sys.argv:
         sys.exit(ckpt_only())
     if "--obs-only" in sys.argv:

@@ -253,6 +253,42 @@ def _task_serve(params: Dict[str, str], config: Config) -> None:
         server.stop()
 
 
+def _task_route(params: Dict[str, str], config: Config) -> None:
+    """Routing front (``serve/router.py``, ``docs/Routing.md``): a
+    shared-nothing HTTP router balancing over the replica URLs in
+    ``route_backends`` with live health/draining/fingerprint
+    awareness, bounded retries + hedging, per-backend circuit
+    breakers and per-model admission budgets.  Runs until a
+    SIGTERM/SIGINT drains it.  Programmatic deployments attach
+    FleetSupervisors instead (``Router.add_model``)."""
+    from .serve.config import RouterConfig
+    from .serve.router import Router, parse_backends_spec, route_http
+
+    rcfg = RouterConfig.from_params(config)
+    table = parse_backends_spec(rcfg.backends)
+    if not table:
+        Log.fatal("task=route requires route_backends=<url[,name=url+"
+                  "url...]> (static table) — programmatic routers use "
+                  "Router.add_model")
+    recorder = None
+    if config.telemetry_file:
+        from .utils import telemetry as _telemetry
+        recorder = _telemetry.RunRecorder(
+            config.telemetry_file, run_info={"task": "route",
+                                             "backend": "none"})
+    router = Router(rcfg, recorder=recorder)
+    for name, urls in table.items():
+        router.add_model(name, urls=urls,
+                         replica_model="default" if name == "default"
+                         else name)
+    try:
+        route_http(router)
+    finally:
+        router.stop()
+        if recorder is not None:
+            recorder.close()
+
+
 def _task_continual(params: Dict[str, str], config: Config) -> None:
     """Continual training daemon (``docs/Continual.md``): tail
     ``continual_ingest_dir`` for batch shards, gate each through the
@@ -315,7 +351,7 @@ def main(argv: List[str] = None) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
         print("tasks: train | predict | convert_model | refit | serve "
-              "| continual")
+              "| route | continual")
         return 0
     params = _parse_args(argv)
     config = Config(params)
@@ -330,6 +366,8 @@ def main(argv: List[str] = None) -> int:
         _task_refit(params, config)
     elif task == "serve":
         _task_serve(params, config)
+    elif task in ("route", "router"):
+        _task_route(params, config)
     elif task in ("continual", "continual_train"):
         _task_continual(params, config)
     else:

@@ -595,6 +595,82 @@ PARAMS: List[Param] = [
        "histogram buckets; '' = the built-in log-spaced ladder "
        "0.5ms..30s.  Bounded histograms are why a long-lived "
        "replica's /stats and /metrics memory is O(1)", group="serve"),
+    # ---- route (resilient routing front: serve/router.py) ----
+    _p("route_host", "127.0.0.1", str, (),
+       "bind address of the task=route HTTP routing front",
+       group="route"),
+    _p("route_port", 9700, int, (),
+       "port of the routing front (0 = ephemeral)", group="route",
+       check=">=0"),
+    _p("route_port_file", "", str, (),
+       "when set, the routing front writes its bound port here once "
+       "listening (ephemeral-port discovery, like serve_port_file)",
+       group="route"),
+    _p("route_probe_interval_s", 0.25, float, (),
+       "backend /healthz scrape cadence: the balancer's live view of "
+       "health, draining state and per-tenant fingerprints — a "
+       "mid-drain or stale-model replica leaves the rotation within "
+       "one scrape", group="route", check=">0"),
+    _p("route_probe_timeout_s", 2.0, float, (),
+       "per-scrape timeout; an unreachable backend leaves the "
+       "rotation until a scrape succeeds again", group="route",
+       check=">0"),
+    _p("route_timeout_ms", 10000.0, float, (),
+       "per-request total routing budget: retries, backoff sleeps and "
+       "the hedge all fit INSIDE it (a per-request timeout_ms field "
+       "tightens it further); exhausted -> structured 504",
+       group="route", check=">0"),
+    _p("route_max_retries", 2, int, (),
+       "routing attempts beyond the first on connect failure / 5xx "
+       "(each to a different backend when one exists; the tail-latency "
+       "hedge does not count against this bound)", group="route",
+       check=">=0"),
+    _p("route_backoff_base_ms", 25.0, float, (),
+       "retry backoff base: attempt n waits base * 2^(n-1) ms (capped "
+       "at route_backoff_max_ms) plus deterministic jitter, clamped "
+       "to the request's remaining budget", group="route", check=">=0"),
+    _p("route_backoff_max_ms", 1000.0, float, (),
+       "retry backoff cap", group="route", check=">=0"),
+    _p("route_backoff_jitter", 0.5, float, (),
+       "jitter fraction on the retry backoff (deterministic per "
+       "request id/attempt, seeded by `seed` — spreads a retry herd "
+       "without making tests flaky)", group="route", check=">=0"),
+    _p("route_hedge_ms", 75.0, float, (),
+       "tail-latency hedging: once the first attempt has been silent "
+       "this long, a second attempt goes to a DIFFERENT backend; the "
+       "first answer wins and the loser's connection is cancelled "
+       "(one hedge per request; 0 disables).  obs/rules.py flags a "
+       "hedge rate above 20% as MED — hedges are a tail rescue, not "
+       "a steady state", group="route", check=">=0"),
+    _p("route_breaker_failures", 3, int, (),
+       "per-backend circuit breaker: consecutive forwarding failures "
+       "before the backend leaves the balancer's rotation",
+       group="route", check=">=1"),
+    _p("route_breaker_cooldown_s", 5.0, float, (),
+       "after this long an open backend circuit half-opens and "
+       "exactly ONE probe request is let through (single-flight); "
+       "success closes the circuit, failure re-opens it",
+       group="route", check=">=0"),
+    _p("route_rows_per_s", 0.0, float, (),
+       "per-model admission budget: token-bucket refill rate in "
+       "rows/s (0 = unlimited).  An exhausted budget sheds with a "
+       "structured 429 + Retry-After BEFORE any backend sees the "
+       "request; priority > 0 requests may overdraw one extra burst "
+       "before shedding (cheap traffic sheds first).  Override per "
+       "model via Router.add_model", group="route", check=">=0"),
+    _p("route_burst_rows", 8192, int, (),
+       "per-model token-bucket burst capacity in rows", group="route",
+       check=">0"),
+    _p("route_max_inflight", 256, int, (),
+       "per-model in-flight request cap at the router (0 = "
+       "unlimited); beyond it low-priority requests shed with 429",
+       group="route", check=">=0"),
+    _p("route_backends", "", str, (),
+       "static backend table for task=route: comma-separated entries "
+       "'http://host:port' (default tenant) or "
+       "'name=http://a:1+http://b:2' (named tenant over several "
+       "replicas).  Programmatic routers attach FleetSupervisors "
+       "instead (Router.add_model)", group="route"),
     # ---- fleet (resilience layer: serve/fleet.py, serve/watcher.py) ----
     _p("fleet_replicas", 2, int, ("serve_replicas",),
        "serve processes the fleet supervisor runs; each replica pins "

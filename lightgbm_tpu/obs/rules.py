@@ -144,6 +144,11 @@ class OnlineScanner:
         self._iter_secs = 0.0
         self._overlap_total = 0
         self._overlap_stalled = 0
+        # router rollups (serve/router.py): hedge/shed rates judged
+        # once enough requests have been seen
+        self._rt_requests = 0
+        self._rt_hedges = 0
+        self._rt_shed = 0
         self._segs: "deque[Dict[str, Any]]" = \
             deque(maxlen=self.MAX_SEGMENTS)
         self._cur_seg: Optional[Dict[str, Any]] = None
@@ -253,6 +258,42 @@ class OnlineScanner:
                     "HIGH", "circuit_open",
                     f"replica circuit breaker OPEN on slot "
                     f"{r.get('slot', '?')} (crash loop?)"))
+        elif rtype == "router":
+            event = r.get("event")
+            if event == "breaker_open":
+                out.append((
+                    "HIGH", "router_breaker",
+                    f"router circuit breaker OPEN on backend "
+                    f"{r.get('backend', '?')} "
+                    f"({str(r.get('detail', ''))[:120]})"))
+            elif event == "request":
+                self._rt_requests += 1
+                if r.get("hedged"):
+                    self._rt_hedges += 1
+                if r.get("status") == "shed":
+                    self._rt_shed += 1
+                n = self._rt_requests
+                if n >= 50:
+                    if ("router_hedge_rate" not in self._fired and
+                            self._rt_hedges > 0.20 * n):
+                        self._fired.add("router_hedge_rate")
+                        out.append((
+                            "MED", "router_hedge_rate",
+                            f"router hedge rate "
+                            f"{self._rt_hedges}/{n} requests (> 20%) "
+                            f"— hedging is rescuing the tail "
+                            f"constantly; a backend is slow, not "
+                            f"occasionally unlucky"))
+                    if ("router_shed_rate" not in self._fired and
+                            self._rt_shed > 0.05 * n):
+                        self._fired.add("router_shed_rate")
+                        out.append((
+                            "HIGH", "router_shed_rate",
+                            f"router budget-shed rate "
+                            f"{self._rt_shed}/{n} requests (> 5%) — "
+                            f"admission budgets are turning real "
+                            f"traffic away; raise route_rows_per_s "
+                            f"or add replicas"))
         elif rtype == "checkpoint" and r.get("event") == "fallback":
             out.append((
                 "HIGH", "ckpt_fallback",
@@ -269,6 +310,22 @@ class OnlineScanner:
     # -- run-level aggregates (the triage report's historical text) ---
     def summary_anomalies(self) -> List[Tuple[str, str]]:
         out: List[Tuple[str, str]] = []
+        if self._rt_requests >= 20:
+            n = self._rt_requests
+            if self._rt_hedges > 0.20 * n:
+                out.append(("MED", f"router hedge rate "
+                                   f"{self._rt_hedges}/{n} requests "
+                                   f"(> 20%) — the tail-latency hedge "
+                                   f"is a rescue path, not a steady "
+                                   f"state; a backend is consistently "
+                                   f"slow"))
+            if self._rt_shed > 0.05 * n:
+                out.append(("HIGH", f"router budget-shed rate "
+                                    f"{self._rt_shed}/{n} requests "
+                                    f"(> 5%) — admission budgets are "
+                                    f"turning real traffic away; "
+                                    f"raise route_rows_per_s or add "
+                                    f"replicas"))
         if self._ss_late:
             out.append(("HIGH", f"superstep retrace storm: "
                                 f"{self._ss_late:.0f} "

@@ -28,22 +28,29 @@ Large-scale Tree Boosting" for the low-latency inference focus):
 - :mod:`.watcher`    — checkpoint-root watcher (manifest verify +
   canary scoring before auto-publish) and the telemetry-driven
   rollback controller.
+- :mod:`.router`     — the resilient routing front above the fleet:
+  health/draining/fingerprint-aware balancing, bounded retries +
+  tail-latency hedging, per-backend circuit breakers, per-model
+  admission budgets, and the multi-model tenancy table
+  (``POST /v1/<model>/predict``, ``docs/Routing.md``).
 """
 from .admission import (AdmissionQueue, QueueSaturated, Request,
                         RequestShed, RequestTimeout, ServeError,
-                        ServerClosed)
-from .config import FleetConfig, ServeConfig
+                        ServerClosed, UnknownModel)
+from .config import FleetConfig, RouterConfig, ServeConfig
 from .fleet import FleetSupervisor, InprocReplica, ProcessReplica
 from .registry import ModelRegistry, ModelVersion, model_fingerprint
+from .router import Router, route_http
 from .server import Server
 from .watcher import (CanarySet, CheckpointWatcher, FleetTarget,
                       RegistryTarget)
 
 __all__ = [
-    "Server", "ServeConfig", "FleetConfig", "ModelRegistry",
-    "ModelVersion", "model_fingerprint", "AdmissionQueue", "Request",
-    "ServeError", "QueueSaturated", "RequestShed", "RequestTimeout",
-    "ServerClosed", "FleetSupervisor", "InprocReplica",
-    "ProcessReplica", "CanarySet", "CheckpointWatcher", "FleetTarget",
+    "Server", "ServeConfig", "FleetConfig", "RouterConfig",
+    "ModelRegistry", "ModelVersion", "model_fingerprint",
+    "AdmissionQueue", "Request", "ServeError", "QueueSaturated",
+    "RequestShed", "RequestTimeout", "ServerClosed", "UnknownModel",
+    "FleetSupervisor", "InprocReplica", "ProcessReplica", "Router",
+    "route_http", "CanarySet", "CheckpointWatcher", "FleetTarget",
     "RegistryTarget",
 ]

@@ -51,6 +51,11 @@ class ServerClosed(ServeError):
     """Server is not accepting requests."""
 
 
+class UnknownModel(ServeError):
+    """Request names a model the tenancy table has no registry for
+    (HTTP 404 — distinct from 429 budget shed and 503 no-backend)."""
+
+
 class Request:
     """One predict request; completion is an event the submitting
     thread (or HTTP handler) waits on.  ``version`` is pinned at

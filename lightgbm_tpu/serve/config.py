@@ -112,6 +112,106 @@ class ServeConfig:
 
 
 @dataclasses.dataclass
+class RouterConfig:
+    """Resolved knobs of the routing front (``serve/router.py``): the
+    shared-nothing HTTP router in front of one or more replica fleets.
+    Canonical definitions live in the ``route`` group of the
+    ``lightgbm_tpu/config.py`` registry."""
+
+    host: str = "127.0.0.1"
+    port: int = 9700
+    port_file: str = ""
+    # balancer: /healthz scrape cadence + timeout per backend
+    probe_interval_s: float = 0.25
+    probe_timeout_s: float = 2.0
+    # per-request total budget; retries/hedges fit INSIDE it
+    timeout_ms: float = 10000.0
+    # bounded retries on connect failure / 5xx (attempts beyond the
+    # first; the hedge does not count against this)
+    max_retries: int = 2
+    # retry backoff: attempt n waits base * 2^(n-1) ms (capped), plus
+    # deterministic jitter seeded by (seed, request id, attempt) —
+    # clamped to the request's REMAINING budget
+    backoff_base_ms: float = 25.0
+    backoff_max_ms: float = 1000.0
+    backoff_jitter: float = 0.5
+    # tail-latency hedge: a second attempt to a DIFFERENT backend once
+    # the first has been silent this long; first answer wins, the
+    # loser's connection is torn down.  0 disables.
+    hedge_ms: float = 75.0
+    # per-backend circuit breaker feeding the balancer
+    breaker_failures: int = 3
+    breaker_cooldown_s: float = 5.0
+    # per-model admission budgets (defaults; override per model via
+    # Router.add_model): token-bucket rows/s (0 = unlimited), burst
+    # capacity in rows, and an in-flight request cap (0 = unlimited).
+    # Priority > 0 requests may overdraw one extra burst/cap before
+    # shedding — cheap traffic sheds first.
+    rows_per_s: float = 0.0
+    burst_rows: int = 8192
+    max_inflight: int = 256
+    max_body_bytes: int = 33554432
+    metrics: bool = True
+    seed: int = 0
+    # static backend table for the CLI (task=route):
+    # "url" / "name=url+url" entries, comma separated
+    backends: str = ""
+
+    @classmethod
+    def from_params(cls, params: Union[None, Dict[str, Any], Any] = None
+                    ) -> "RouterConfig":
+        from ..config import Config
+        if params is None:
+            cfg = Config()
+        elif isinstance(params, Config):
+            cfg = params
+        else:
+            cfg = Config(dict(params))
+        return cls(
+            host=str(cfg.route_host),
+            port=int(cfg.route_port),
+            port_file=str(cfg.route_port_file or ""),
+            probe_interval_s=float(cfg.route_probe_interval_s),
+            probe_timeout_s=float(cfg.route_probe_timeout_s),
+            timeout_ms=float(cfg.route_timeout_ms),
+            max_retries=int(cfg.route_max_retries),
+            backoff_base_ms=float(cfg.route_backoff_base_ms),
+            backoff_max_ms=float(cfg.route_backoff_max_ms),
+            backoff_jitter=float(cfg.route_backoff_jitter),
+            hedge_ms=float(cfg.route_hedge_ms),
+            breaker_failures=int(cfg.route_breaker_failures),
+            breaker_cooldown_s=float(cfg.route_breaker_cooldown_s),
+            rows_per_s=float(cfg.route_rows_per_s),
+            burst_rows=int(cfg.route_burst_rows),
+            max_inflight=int(cfg.route_max_inflight),
+            max_body_bytes=int(cfg.serve_max_body_bytes),
+            metrics=bool(cfg.serve_metrics),
+            seed=int(cfg.seed) if cfg.seed is not None else 0,
+            backends=str(cfg.route_backends or ""))
+
+    def validate(self) -> None:
+        if self.probe_interval_s <= 0 or self.probe_timeout_s <= 0:
+            raise ValueError("route probe interval/timeout must be > 0")
+        if self.timeout_ms <= 0:
+            raise ValueError("route_timeout_ms must be > 0")
+        if self.max_retries < 0:
+            raise ValueError("route_max_retries must be >= 0")
+        if self.backoff_base_ms < 0 or \
+                self.backoff_max_ms < self.backoff_base_ms:
+            raise ValueError("route backoff must satisfy 0 <= base "
+                             "<= max")
+        if not 0 <= self.backoff_jitter <= 1:
+            raise ValueError("route_backoff_jitter must be in [0, 1]")
+        if self.hedge_ms < 0:
+            raise ValueError("route_hedge_ms must be >= 0")
+        if self.breaker_failures < 1 or self.breaker_cooldown_s < 0:
+            raise ValueError("route breaker thresholds out of range")
+        if self.rows_per_s < 0 or self.burst_rows < 1 or \
+                self.max_inflight < 0:
+            raise ValueError("route admission budget out of range")
+
+
+@dataclasses.dataclass
 class FleetConfig:
     """Resolved knobs of the resilience layer: the replica supervisor
     (``serve/fleet.py``), the checkpoint watcher and the rollback

@@ -216,7 +216,8 @@ class ProcessReplica:
 class _Slot:
     __slots__ = ("index", "handle", "url", "state", "probe_fails",
                  "failures", "next_restart_at", "start_deadline",
-                 "opened_at", "in_rotation", "health_model_id")
+                 "opened_at", "in_rotation", "health_model_id",
+                 "health_models", "draining")
 
     def __init__(self, index: int):
         self.index = index
@@ -230,6 +231,13 @@ class _Slot:
         self.opened_at = 0.0
         self.in_rotation = False
         self.health_model_id: Optional[str] = None
+        # per-tenant fingerprints from the last /healthz body (the
+        # ``models`` map) — what reconciliation and endpoints() compare
+        # against the fleet's desired set
+        self.health_models: Dict[str, Optional[str]] = {}
+        # the last probe answered 503 {"draining": true}: deliberately
+        # finishing admitted work, must not be routed to OR killed
+        self.draining = False
 
 
 class FleetSupervisor:
@@ -246,7 +254,10 @@ class FleetSupervisor:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._desired: Optional[tuple] = None   # (model_id, model_text)
+        # desired model state per tenant name: {name: (model_id,
+        # model_text)}.  The single-model API (publish_model with no
+        # name) lives under the "default" tenant.
+        self._desired: Dict[str, tuple] = {}
 
     # -- telemetry -----------------------------------------------------
     def _emit(self, event: str, **fields) -> None:
@@ -284,38 +295,68 @@ class FleetSupervisor:
             slot.in_rotation = False
 
     # -- introspection / routing --------------------------------------
+    def _routable(self, slot: _Slot) -> bool:
+        """Caller holds the lock.  A slot is routable only when its
+        last probe was healthy and non-draining AND every desired
+        tenant's fingerprint matches the replica's last-reported one —
+        so a mid-drain or stale-model replica never reaches clients,
+        even in the window between publish_model setting the desired
+        state and the per-slot swaps landing."""
+        if not (slot.in_rotation and slot.url) or slot.draining:
+            return False
+        for name, (mid, _text) in self._desired.items():
+            if slot.health_models.get(name) != mid:
+                return False
+        return True
+
     def endpoints(self) -> List[str]:
-        """Base URLs of in-rotation replicas (healthy AND serving the
-        desired model)."""
+        """Base URLs of routable replicas: healthy, not draining, and
+        serving every desired tenant's CURRENT fingerprint — so even
+        clients that round-robin this list themselves never hit a
+        mid-deploy or mid-drain replica."""
         with self._lock:
-            return [s.url for s in self._slots
-                    if s.in_rotation and s.url]
+            return [s.url for s in self._slots if self._routable(s)]
 
     def slots(self) -> List[Dict[str, Any]]:
         with self._lock:
             return [{"index": s.index, "state": s.state, "url": s.url,
                      "failures": s.failures,
                      "in_rotation": s.in_rotation,
-                     "model_id": s.health_model_id}
+                     "draining": s.draining,
+                     "model_id": s.health_model_id,
+                     "models": dict(s.health_models)}
                     for s in self._slots]
 
     def handle(self, index: int):
         return self._slots[index].handle
 
-    def active_models(self) -> Dict[int, Optional[str]]:
-        """Last-probed model_id per slot (healthy slots only)."""
+    def active_models(self, model: str = "default"
+                      ) -> Dict[int, Optional[str]]:
+        """Last-probed fingerprint of one tenant per healthy slot."""
         with self._lock:
-            return {s.index: s.health_model_id for s in self._slots
-                    if s.state == "healthy"}
+            return {s.index: s.health_models.get(
+                        model, s.health_model_id if model == "default"
+                        else None)
+                    for s in self._slots if s.state == "healthy"}
+
+    def desired_fingerprint(self, model: str = "default"
+                            ) -> Optional[str]:
+        """The fingerprint the named tenant is converging onto (what a
+        router tier filters stale replicas against), or None before
+        any publish."""
+        with self._lock:
+            d = self._desired.get(model)
+            return d[0] if d else None
 
     # -- model state ---------------------------------------------------
-    def publish_model(self, model_text: str, source: str = "") -> str:
-        """Set the fleet's desired model and swap every healthy
+    def publish_model(self, model_text: str, source: str = "",
+                      model: str = "default") -> str:
+        """Set the named tenant's desired model and swap every healthy
         replica now; the monitor re-swaps stragglers and restarted
         replicas until the whole fleet converges."""
         mid = model_fingerprint(model_text)
         with self._lock:
-            self._desired = (mid, model_text)
+            self._desired[model] = (mid, model_text)
             targets = [(s, s.url) for s in self._slots
                        if s.state == "healthy" and s.url]
         # once _desired is set the publish cannot fail as a whole: a
@@ -324,7 +365,7 @@ class FleetSupervisor:
         # exception for a model the fleet is already converging onto
         for slot, url in targets:
             try:
-                self._swap_slot(slot, mid, model_text, url)
+                self._swap_slot(slot, model, mid, model_text, url)
             except Exception as exc:       # noqa: BLE001 - reconciled
                 Log.warning("fleet: replica %d swap errored: %s",
                             slot.index, exc)
@@ -332,7 +373,7 @@ class FleetSupervisor:
                     slot.in_rotation = False
         return mid
 
-    def _swap_slot(self, slot: _Slot, mid: str, text: str,
+    def _swap_slot(self, slot: _Slot, name: str, mid: str, text: str,
                    url: Optional[str] = None) -> bool:
         url = url or slot.url
         if url is None:                    # crashed since being listed
@@ -341,16 +382,20 @@ class FleetSupervisor:
             return False
         # the X-Ltpu-Trace carrier makes the replica's swap (and the
         # first request the new version serves) join the publish trace
-        st, out = _post_json(url, "/swap", {"model_str": text},
+        path = "/swap" if name == "default" else f"/v1/{name}/swap"
+        st, out = _post_json(url, path, {"model_str": text},
                              timeout=60,
                              headers=_spans.http_headers())
         if st == 200 and out.get("model_id") == mid:
             with self._lock:
-                slot.health_model_id = mid
+                slot.health_models[name] = mid
+                if name == "default":
+                    slot.health_model_id = mid
                 slot.in_rotation = slot.state == "healthy"
             return True
-        Log.warning("fleet: replica %d swap failed (HTTP %s: %s)",
-                    slot.index, st, str(out.get("error", ""))[:120])
+        Log.warning("fleet: replica %d swap of %r failed (HTTP %s: %s)",
+                    slot.index, name, st,
+                    str(out.get("error", ""))[:120])
         with self._lock:
             slot.in_rotation = False       # stale model: out of rotation
         return False
@@ -395,7 +440,7 @@ class FleetSupervisor:
                        if s.state == "healthy" and s.url]
             states = [(s.index, s.state, s.in_rotation)
                       for s in self._slots]
-            desired = self._desired
+            desired = dict(self._desired)
         scrapes = []
         for index, url in targets:
             try:
@@ -420,14 +465,16 @@ class FleetSupervisor:
         for index, state, _rot in states:
             lines.append('ltpu_fleet_slot_state{slot="%d",state="%s"}'
                          ' 1' % (index, state))
-        if desired is not None:
+        if desired:
             lines += [
                 "# HELP ltpu_fleet_desired_model_info desired model "
-                "fingerprint (value always 1)",
+                "fingerprint per tenant (value always 1)",
                 "# TYPE ltpu_fleet_desired_model_info gauge",
-                'ltpu_fleet_desired_model_info{model_id="%s"} 1'
-                % desired[0],
             ]
+            for name in sorted(desired):
+                lines.append(
+                    'ltpu_fleet_desired_model_info{model="%s",'
+                    'model_id="%s"} 1' % (name, desired[name][0]))
         return "\n".join(lines) + "\n" + _obs_metrics.aggregate(scrapes)
 
     # -- monitor -------------------------------------------------------
@@ -472,6 +519,8 @@ class FleetSupervisor:
             slot.url = None
             slot.in_rotation = False
             slot.health_model_id = None
+            slot.health_models = {}
+            slot.draining = False
             slot.failures += 1
             failures = slot.failures
         if handle is not None:
@@ -544,17 +593,26 @@ class FleetSupervisor:
                 continue
             ok, health = self._probe(url)
             if ok:
+                body = health or {}
                 with self._lock:
                     slot.probe_fails = 0
                     slot.failures = 0
                     slot.state = "healthy"
-                    slot.health_model_id = (health or {}).get("model_id")
-                    desired = self._desired
-                if desired is not None and \
-                        slot.health_model_id != desired[0]:
+                    slot.draining = False
+                    slot.health_model_id = body.get("model_id")
+                    models = body.get("models")
+                    slot.health_models = dict(models) \
+                        if isinstance(models, dict) else \
+                        {"default": body.get("model_id")}
+                    stale = [(n, d) for n, d in self._desired.items()
+                             if slot.health_models.get(n) != d[0]]
+                if stale:
                     # reconcile: restarted/straggler replica still on
-                    # an old model rejoins only once re-swapped
-                    self._swap_slot(slot, desired[0], desired[1])
+                    # an old model (for ANY tenant) rejoins only once
+                    # every stale tenant is re-swapped
+                    for name, (mid, text) in stale:
+                        if not self._swap_slot(slot, name, mid, text):
+                            break
                 else:
                     with self._lock:
                         slot.in_rotation = True
@@ -568,8 +626,10 @@ class FleetSupervisor:
                 # normal process-exit path once the drain completes.
                 with self._lock:
                     slot.in_rotation = False
+                    slot.draining = True
                     slot.probe_fails = 0
                     slot.health_model_id = None
+                    slot.health_models = {}
                 continue
             if state == "starting":
                 if now > slot.start_deadline:

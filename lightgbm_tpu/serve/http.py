@@ -16,6 +16,12 @@ to coalesce).  Routes:
 - ``POST /swap``     ``{"model_file": path}`` or ``{"model_str": s}``
   -> ``{"version": v, "model_id": id}`` (blocks through flatten +
   pre-warm; in-flight requests finish on their admitted version).
+- ``POST /v1/<model>/predict`` / ``POST /v1/<model>/swap``
+  multi-model tenancy: the named tenant's registry (created on first
+  swap) — one replica serves many boosters, tenants never mixing in a
+  device batch (requests pin their version at admission).  An
+  unpublished name answers a structured 404 ``unknown_model``; the
+  bare routes alias the ``default`` tenant.
 - ``GET /healthz``   liveness + active version/model_id; 503 with
   ``{"draining": true}`` once a drain begins, so supervisors and load
   balancers stop routing to a replica that is going away.
@@ -54,8 +60,22 @@ from ..obs import spans as _spans
 from ..utils import faults as _faults
 from ..utils.log import Log
 from .admission import (QueueSaturated, RequestShed, RequestTimeout,
-                        ServeError, ServerClosed)
+                        ServeError, ServerClosed, UnknownModel)
 from .server import Server
+
+
+def split_model_route(path: str):
+    """Parse a tenancy route ``/v1/<model>/<verb>`` into
+    ``(model, "/<verb>")``; any other path returns ``(None, path)``
+    (un-prefixed routes act on the default tenant).  Shared with the
+    router front (``serve/router.py``), so both tiers agree on the
+    URL shape."""
+    if path.startswith("/v1/"):
+        parts = path.split("/")
+        # ["", "v1", "<model>", "<verb>"]
+        if len(parts) == 4 and parts[2] and parts[3]:
+            return parts[2], "/" + parts[3]
+    return None, path
 
 
 class _BadRequest(Exception):
@@ -178,6 +198,10 @@ def _json_handler_for(server: Server):
                         "draining": server.draining,
                         "version": ver.version if ver else None,
                         "model_id": ver.model_id if ver else None,
+                        # per-tenant fingerprints: the supervisor's
+                        # reconciler and the router's scrape read this
+                        # to spot stale-model replicas mid-deploy
+                        "models": server.models(),
                         "queue_requests": depth_reqs,
                         "queue_rows": depth_rows}
                 self._send(503 if server.draining else 200, body)
@@ -219,17 +243,20 @@ def _json_handler_for(server: Server):
             # trace — the fleet's /swap carries the publish trace, a
             # client may carry its own onto /predict
             with _spans.use(_spans.from_headers(self.headers)):
-                if self.path == "/predict":
-                    self._predict()
-                elif self.path == "/swap":
-                    self._swap()
+                # tenancy routes: /v1/<model>/predict|swap act on the
+                # named registry; bare routes on the default tenant
+                model, verb = split_model_route(self.path)
+                if verb == "/predict":
+                    self._predict(model)
+                elif verb == "/swap":
+                    self._swap(model)
                 elif self.path == "/faults":
                     self._faults()
                 else:
                     self._send(404, {"error": f"no route {self.path}",
                                      "code": "no_route"})
 
-        def _predict(self):
+        def _predict(self, model=None):
             # fault-injection point ``http.request``: "error" answers
             # a structured 500; "drop" closes the connection with no
             # response (a client-visible transport failure)
@@ -266,8 +293,15 @@ def _json_handler_for(server: Server):
                                   f"{exc}")
             try:
                 req = server.submit(X, priority=priority,
-                                    timeout_ms=timeout_ms, raw=raw)
+                                    timeout_ms=timeout_ms, raw=raw,
+                                    model=model)
                 out = req.value()
+            except UnknownModel as exc:
+                # tenancy 404: the name is not in this replica's
+                # routing table (vs 429 budget / 503 shed-or-drain)
+                self._send(404, {"error": str(exc),
+                                 "code": "unknown_model"})
+                return
             except QueueSaturated as exc:
                 # RFC 7231 Retry-After is integer seconds; the precise
                 # hint rides in the JSON retry_after_ms field
@@ -295,7 +329,7 @@ def _json_handler_for(server: Server):
                 "model_id": req.version.model_id,
                 "total_ms": round(req.timings.get("total_ms", 0.0), 3)})
 
-        def _swap(self):
+        def _swap(self, model=None):
             if self._drain_reject():
                 return
             body = self._read_json()
@@ -305,12 +339,13 @@ def _json_handler_for(server: Server):
                                   "model_str")
             try:
                 v = server.swap(model_file=body.get("model_file"),
-                                model_str=body.get("model_str"))
+                                model_str=body.get("model_str"),
+                                model=model)
             except Exception as exc:      # noqa: BLE001 - client input
                 self._send(400, {"error": f"swap failed: {exc}",
                                  "code": "swap_failed"})
                 return
-            ver = server.registry.current()
+            ver = server.registry_for(model).current()
             self._send(200, {"version": v,
                              "model_id": ver.model_id if ver else None})
 

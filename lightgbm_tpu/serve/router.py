@@ -1,0 +1,1250 @@
+"""Resilient routing front: the layer above the replica fleet.
+
+``FleetSupervisor`` (PR 6) made one fleet of replicas self-healing,
+but clients still round-robined ``endpoints()`` themselves — a dying,
+draining or stale-fingerprint replica surfaced as user-visible errors.
+The :class:`Router` is the shared-nothing stdlib-HTTP tier that makes
+backend failures invisible and opens multi-model tenancy:
+
+- **live-aware balancing** — the router scrapes every backend's
+  ``/healthz`` on its own cadence (``route_probe_interval_s``),
+  reading health, ``draining`` and the per-tenant ``models``
+  fingerprint map, and picks the least-loaded routable backend
+  (in-flight count, then the scraped queue depth, round-robin tie
+  break).  A mid-drain replica or one whose fingerprint lags the
+  fleet's desired model during a deploy never receives a request.
+- **failure masking** — every request runs under a total timeout
+  budget (``route_timeout_ms``); connect failures and 5xx answers
+  retry on a different backend with exponential backoff plus
+  deterministic jitter (seeded by request id/attempt — a retry herd
+  spreads without flaky tests), bounded by ``route_max_retries`` and
+  always clamped to the remaining budget.
+- **tail-latency hedging** — once the first attempt has been silent
+  ``route_hedge_ms``, a second attempt goes to a DIFFERENT backend;
+  the first answer wins and the loser's connection is torn down
+  (cancelled losers never feed the circuit breaker or double-count
+  request metrics — pinned by ``tests/test_router.py``).
+- **circuit breaking** — consecutive forwarding failures open a
+  per-backend breaker that feeds the balancer; after
+  ``route_breaker_cooldown_s`` the circuit half-opens and exactly ONE
+  probe request is let through (single-flight), closing on success.
+- **admission budgets** — per-model token buckets (rows/s + burst)
+  and in-flight caps shed excess load with a structured 429 +
+  ``Retry-After`` BEFORE any backend sees the request; priority > 0
+  requests may overdraw one extra burst, so cheap traffic sheds
+  first.
+- **multi-model tenancy** — a named routing table
+  (``POST /v1/<model>/predict``) over the replicas' per-model
+  registries (``serve/server.py``), so one fleet serves many boosters
+  — the seam the continual daemon's publish tier left open.
+
+Fault-injection points ``router.backend`` (``sleep_<ms>`` brownout /
+``error`` per forwarded attempt) and ``router.admit`` (``shed``) drive
+the chaos e2e (``tools/chaos_router.py``) deterministically.  Every
+client-facing request emits one ``router`` telemetry record and feeds
+the ``ltpu_router_*`` Prometheus series (``GET /metrics``); a routed
+request carrying an ``X-Ltpu-Trace`` header stays ONE joinable trace
+across client -> router -> replica (``obs/spans.py``,
+``tools/trace_view.py``).  See ``docs/Routing.md``.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from random import Random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..obs import metrics as _obs_metrics
+from ..obs import spans as _spans
+from ..utils import faults as _faults
+from ..utils.log import Log
+from .config import RouterConfig
+from .http import split_model_route
+
+__all__ = ["Router", "RouterConfig", "TokenBucket", "CircuitBreaker",
+           "backoff_ms", "route_http", "parse_backends_spec"]
+
+
+def backoff_ms(config: RouterConfig, rid: int, attempt: int) -> float:
+    """Retry backoff for ``attempt`` (1-based) of request ``rid``:
+    exponential base capped at ``backoff_max_ms`` plus deterministic
+    jitter seeded by (seed, rid, attempt) — a pure function, so tests
+    replay it exactly and a herd of retries still spreads out."""
+    base = min(config.backoff_base_ms * (2 ** max(attempt - 1, 0)),
+               config.backoff_max_ms)
+    u = Random(config.seed * 1_000_003 + rid * 9176 + attempt).random()
+    return base * (1.0 + config.backoff_jitter * u)
+
+
+class TokenBucket:
+    """Per-model admission budget: rows/s refill, ``burst`` capacity.
+    ``rate <= 0`` disables (always admits).  Priority > 0 requests may
+    overdraw one extra burst (the reserve that keeps important traffic
+    flowing while cheap traffic sheds)."""
+
+    def __init__(self, rows_per_s: float, burst_rows: int):
+        self.rate = float(rows_per_s)
+        self.burst = max(int(burst_rows), 1)
+        self._tokens = float(self.burst)
+        self._t = time.monotonic()
+        self._lock = threading.Lock()
+
+    def set_rate(self, rows_per_s: float,
+                 burst_rows: Optional[int] = None) -> None:
+        """Retune at runtime (operator surface; the chaos e2e tightens
+        a model's budget mid-run)."""
+        with self._lock:
+            self.rate = float(rows_per_s)
+            if burst_rows is not None:
+                self.burst = max(int(burst_rows), 1)
+                self._tokens = min(self._tokens, float(self.burst))
+
+    def try_take(self, rows: int, priority: int = 0,
+                 now: Optional[float] = None) -> float:
+        """0.0 when admitted (tokens consumed); otherwise the
+        suggested retry-after in ms (nothing consumed).  A request
+        larger than the whole burst charges the burst — it could
+        never accumulate more tokens than that, so shedding it with
+        a finite Retry-After would loop a well-behaved client
+        forever (same rule as the serve queue's oversize-on-empty
+        admission)."""
+        with self._lock:
+            if self.rate <= 0:
+                return 0.0
+            t = time.monotonic() if now is None else now
+            self._tokens = min(float(self.burst),
+                               self._tokens + (t - self._t) * self.rate)
+            self._t = t
+            charge = min(int(rows), self.burst)
+            floor = -float(self.burst) if priority > 0 else 0.0
+            if self._tokens - charge >= floor:
+                self._tokens -= charge
+                return 0.0
+            deficit = charge - (self._tokens - floor)
+            return max(deficit / self.rate * 1e3, 1.0)
+
+
+class CircuitBreaker:
+    """Per-backend breaker: ``failures`` consecutive forwarding
+    failures open it; after ``cooldown_s`` it half-opens and
+    :meth:`acquire` admits exactly ONE probe (single-flight — pinned
+    by ``tests/test_router.py``).  The probe's success closes the
+    circuit, its failure re-opens it; a CANCELLED probe (hedged loser)
+    releases the slot without a verdict."""
+
+    def __init__(self, failures: int, cooldown_s: float):
+        self.threshold = max(int(failures), 1)
+        self.cooldown_s = float(cooldown_s)
+        self.state = "closed"              # closed|open|half_open
+        self.failures = 0
+        self.opened_at = 0.0
+        self._probe_inflight = False
+        self._lock = threading.Lock()
+
+    def acquire(self, now: float) -> bool:
+        """May an attempt go to this backend now?  Claims the
+        half-open probe slot when it grants one."""
+        with self._lock:
+            if self.state == "closed":
+                return True
+            if self.state == "open":
+                if self.cooldown_s >= 0 and \
+                        now - self.opened_at >= self.cooldown_s:
+                    self.state = "half_open"
+                    self._probe_inflight = True
+                    return True
+                return False
+            # half_open: single-flight
+            if not self._probe_inflight:
+                self._probe_inflight = True
+                return True
+            return False
+
+    def on_success(self) -> bool:
+        """Returns True when this success CLOSED an open/half-open
+        circuit (the breaker_close telemetry event)."""
+        with self._lock:
+            was = self.state != "closed"
+            self.state = "closed"
+            self.failures = 0
+            self._probe_inflight = False
+            return was
+
+    def on_failure(self, now: float) -> bool:
+        """Returns True when this failure OPENED the circuit (the
+        breaker_open telemetry event)."""
+        with self._lock:
+            self._probe_inflight = False
+            self.failures += 1
+            if self.state == "half_open" or \
+                    self.failures >= self.threshold:
+                newly = self.state != "open"
+                self.state = "open"
+                self.opened_at = now
+                return newly
+            return False
+
+    def on_cancel(self) -> None:
+        """A cancelled attempt (hedged loser) reached no verdict: it
+        must neither open nor close the circuit, only release the
+        half-open probe slot it may hold."""
+        with self._lock:
+            self._probe_inflight = False
+
+
+class _Backend:
+    """One replica URL with the router's live view of it."""
+
+    __slots__ = ("url", "host", "port", "healthy", "draining", "models",
+                 "queue_rows", "inflight", "breaker")
+
+    def __init__(self, url: str, breaker: CircuitBreaker):
+        self.url = url.rstrip("/")
+        u = urllib.parse.urlsplit(self.url)
+        self.host = u.hostname or "127.0.0.1"
+        self.port = u.port or 80
+        self.healthy = False
+        self.draining = False
+        self.models: Dict[str, Optional[str]] = {}
+        self.queue_rows = 0
+        self.inflight = 0
+        self.breaker = breaker
+
+
+class _ModelRoute:
+    """One routing-table entry: a named model over a backend source."""
+
+    __slots__ = ("name", "replica_model", "source", "desired_fp",
+                 "bucket", "max_inflight", "inflight", "urls")
+
+    def __init__(self, name: str, source: Callable[[], List[str]],
+                 desired_fp: Optional[Callable[[], Optional[str]]],
+                 replica_model: str, bucket: TokenBucket,
+                 max_inflight: int):
+        self.name = name
+        self.replica_model = replica_model
+        self.source = source
+        self.desired_fp = desired_fp
+        self.bucket = bucket
+        self.max_inflight = int(max_inflight)
+        self.inflight = 0
+        self.urls: List[str] = []
+
+
+class _Attempt:
+    __slots__ = ("backend", "is_hedge", "conn", "cancelled", "done")
+
+    def __init__(self, backend: _Backend, is_hedge: bool):
+        self.backend = backend
+        self.is_hedge = is_hedge
+        self.conn: Optional[http.client.HTTPConnection] = None
+        self.cancelled = False
+        self.done = False
+
+
+class _Result:
+    __slots__ = ("code", "body", "status", "attempts", "retries",
+                 "hedged", "hedge_won", "backend", "headers")
+
+    def __init__(self, code: int, body: bytes, status: str,
+                 attempts: int = 0, retries: int = 0,
+                 hedged: bool = False, hedge_won: bool = False,
+                 backend: str = "",
+                 headers: Optional[Dict[str, str]] = None):
+        self.code = code
+        self.body = body
+        self.status = status
+        self.attempts = attempts
+        self.retries = retries
+        self.hedged = hedged
+        self.hedge_won = hedge_won
+        self.backend = backend
+        self.headers = headers or {}
+
+
+def _json_result(code: int, status: str, obj: Dict[str, Any],
+                 **kw) -> _Result:
+    return _Result(code, json.dumps(obj).encode(), status, **kw)
+
+
+def parse_backends_spec(spec: str) -> Dict[str, List[str]]:
+    """Parse the ``route_backends`` static table: comma-separated
+    ``http://host:port`` entries (default tenant) or
+    ``name=http://a+http://b`` (named tenant over several URLs)."""
+    out: Dict[str, List[str]] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            name, urls = part.split("=", 1)
+            name = name.strip()
+        else:
+            name, urls = "default", part
+        if not name:
+            raise ValueError(f"route_backends entry {part!r}: empty "
+                             f"model name")
+        for url in urls.split("+"):
+            url = url.strip()
+            if not url.startswith("http://"):
+                # the forwarding client is plain http.client — an
+                # https backend would be spoken to in CLEARTEXT, so
+                # reject it loudly at config time
+                raise ValueError(f"route_backends entry {part!r}: "
+                                 f"{url!r} must be an http:// URL "
+                                 f"(TLS termination belongs in front "
+                                 f"of the router)")
+            out.setdefault(name, []).append(url)
+    return out
+
+
+class Router:
+    """The routing front; see the module docstring.  Models are added
+    with :meth:`add_model` (a FleetSupervisor, or static URLs), then
+    :meth:`start` begins the scrape loop and :func:`route_http` (or
+    ``task=route``) serves clients."""
+
+    def __init__(self, config: Optional[RouterConfig] = None,
+                 recorder=None):
+        self.config = config or RouterConfig()
+        self.config.validate()
+        self.recorder = recorder
+        self._routes: Dict[str, _ModelRoute] = {}
+        self._backends: Dict[str, _Backend] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.draining = False
+        self._rid = 0
+        self._rr = 0
+        self._counts: Dict[str, int] = {}
+        self._hedges = 0
+        self._hedge_wins = 0
+        self._retries_total = 0
+        lat_buckets = _obs_metrics.DEFAULT_LATENCY_BUCKETS_MS
+        self._lat_hist = _obs_metrics.RollingHistogram(
+            buckets=lat_buckets)
+        self._metrics = self._make_metrics(lat_buckets) \
+            if self.config.metrics else None
+
+    # -- metrics -------------------------------------------------------
+    def _make_metrics(self, lat_buckets) -> Dict[str, Any]:
+        _obs_metrics.install_telemetry_mirror()
+        reg = _obs_metrics.get_registry()
+        m = {
+            "requests": reg.counter(
+                "ltpu_router_requests_total",
+                "client-facing routed requests by terminal status",
+                ("status",)),
+            "rows": reg.counter(
+                "ltpu_router_rows_total",
+                "rows in terminal routed requests", ("status",)),
+            "attempts": reg.counter(
+                "ltpu_router_attempts_total",
+                "backend forwarding attempts by outcome (cancelled = "
+                "hedged loser, not a backend failure)", ("result",)),
+            "hedges": reg.counter(
+                "ltpu_router_hedges_total",
+                "tail-latency hedges by result", ("result",)),
+            "retries": reg.counter(
+                "ltpu_router_retries_total", "forwarding retries"),
+            "shed": reg.counter(
+                "ltpu_router_shed_total",
+                "requests shed by the per-model admission budget",
+                ("model",)),
+            "latency": reg.histogram(
+                "ltpu_router_latency_ms",
+                "total routed latency (ok requests)",
+                buckets=lat_buckets),
+        }
+        m["lat_child"] = m["latency"].labels()
+        m["req_children"] = {}
+        m["att_children"] = {}
+        m["gauges"] = {
+            "ltpu_router_backends_routable":
+                ("backends currently routable (healthy, not draining)",
+                 lambda: float(sum(
+                     1 for b in list(self._backends.values())
+                     if b.healthy and not b.draining))),
+            "ltpu_router_inflight":
+                ("routed requests currently in flight",
+                 lambda: float(sum(r.inflight for r in
+                                   list(self._routes.values())))),
+            "ltpu_router_breakers_open":
+                ("backend circuit breakers currently open",
+                 lambda: float(sum(
+                     1 for b in list(self._backends.values())
+                     if b.breaker.state == "open"))),
+        }
+        for name, (help_, fn) in m["gauges"].items():
+            reg.gauge_callback(name, fn, help_)
+        return m
+
+    def _metric_req(self, status: str):
+        ch = self._metrics["req_children"].get(status)
+        if ch is None:                     # benign race: idempotent
+            ch = (self._metrics["requests"].labels(status=status),
+                  self._metrics["rows"].labels(status=status))
+            self._metrics["req_children"][status] = ch
+        return ch
+
+    def _metric_attempt(self, result: str) -> None:
+        if self._metrics is None:
+            return
+        ch = self._metrics["att_children"].get(result)
+        if ch is None:
+            ch = self._metrics["attempts"].labels(result=result)
+            self._metrics["att_children"][result] = ch
+        ch.inc()
+
+    def _emit(self, event: str, **fields) -> None:
+        if self.recorder is not None:
+            self.recorder.emit("router", event=event, **fields)
+
+    # -- routing table -------------------------------------------------
+    def add_model(self, name: str, supervisor=None,
+                  urls: Optional[List[str]] = None,
+                  replica_model: Optional[str] = None,
+                  rows_per_s: Optional[float] = None,
+                  burst_rows: Optional[int] = None,
+                  max_inflight: Optional[int] = None) -> None:
+        """Register a named model over a backend source: a
+        :class:`~.fleet.FleetSupervisor` (live slot URLs + the desired
+        fingerprint, so stale replicas are excluded during a deploy)
+        or a static URL list.  ``replica_model`` is the tenant name on
+        the replicas (defaults to ``name``); budget knobs default to
+        the ``route_*`` config."""
+        if supervisor is None and urls is None:
+            raise ValueError("add_model needs a supervisor or urls")
+        for u in urls or ():
+            if not u.startswith("http://"):
+                raise ValueError(f"backend {u!r} must be an http:// "
+                                 f"URL (the router forwards plain "
+                                 f"HTTP)")
+        rep = replica_model if replica_model is not None else name
+        if supervisor is not None:
+            def source(sup=supervisor):
+                return [s["url"] for s in sup.slots() if s["url"]]
+
+            def desired(sup=supervisor, rep=rep):
+                return sup.desired_fingerprint(rep)
+        else:
+            frozen = [u.rstrip("/") for u in urls]
+
+            def source(frozen=frozen):
+                return list(frozen)
+            desired = None
+        bucket = TokenBucket(
+            self.config.rows_per_s if rows_per_s is None else rows_per_s,
+            self.config.burst_rows if burst_rows is None else burst_rows)
+        route = _ModelRoute(
+            name, source, desired, rep, bucket,
+            self.config.max_inflight if max_inflight is None
+            else max_inflight)
+        with self._lock:
+            self._routes[name] = route
+
+    def model_route(self, name: str) -> Optional[_ModelRoute]:
+        """The live routing-table entry (operator surface: retune
+        ``route.bucket`` / ``route.max_inflight`` at runtime)."""
+        with self._lock:
+            return self._routes.get(name)
+
+    def models(self) -> List[str]:
+        with self._lock:
+            return sorted(self._routes)
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "Router":
+        if self._thread is not None:
+            return self
+        self._scrape()                     # synchronous first view
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._scrape_loop,
+                                        name="ltpu-router", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.draining = True
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if self._metrics is not None:
+            reg = _obs_metrics.get_registry()
+            for name, (_help, fn) in self._metrics["gauges"].items():
+                reg.release_gauge_callback(name, fn)
+
+    def __enter__(self) -> "Router":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- scraping ------------------------------------------------------
+    def _scrape_loop(self) -> None:
+        while not self._stop.wait(self.config.probe_interval_s):
+            try:
+                self._scrape()
+            except Exception as exc:       # noqa: BLE001 - keep going
+                Log.warning("router: scrape tick failed: %s", exc)
+
+    def _scrape(self) -> None:
+        with self._lock:
+            routes = list(self._routes.values())
+        live: set = set()
+        for route in routes:
+            try:
+                urls = [u.rstrip("/") for u in route.source() if u]
+            except Exception as exc:       # noqa: BLE001 - source flaky
+                Log.warning("router: backend source for %r failed: %s",
+                            route.name, exc)
+                continue
+            with self._lock:
+                route.urls = urls
+            live.update(urls)
+        with self._lock:
+            for url in live:
+                if url not in self._backends:
+                    self._backends[url] = _Backend(
+                        url, CircuitBreaker(
+                            self.config.breaker_failures,
+                            self.config.breaker_cooldown_s))
+            stale = [u for u in self._backends if u not in live]
+            for u in stale:
+                del self._backends[u]
+            targets = list(self._backends.values())
+
+        def probe_one(b: _Backend) -> None:
+            ok, body = self._probe(b.url)
+            if body is None:
+                b.healthy = False
+                b.draining = False
+                b.models = {}
+                return
+            b.draining = bool(body.get("draining"))
+            b.healthy = ok and not b.draining
+            models = body.get("models")
+            b.models = dict(models) if isinstance(models, dict) else \
+                {"default": body.get("model_id")}
+            b.queue_rows = int(body.get("queue_rows", 0) or 0)
+
+        # probe CONCURRENTLY: one hung backend (accepts, never
+        # answers) must not stall the whole fleet's health view past
+        # the advertised cadence — a draining/stale replica still
+        # leaves the rotation within ~one interval + probe timeout
+        if len(targets) <= 1:
+            for b in targets:
+                probe_one(b)
+        else:
+            probers = [threading.Thread(target=probe_one, args=(b,),
+                                        name="ltpu-router-probe",
+                                        daemon=True) for b in targets]
+            for t in probers:
+                t.start()
+            for t in probers:
+                t.join(self.config.probe_timeout_s + 1.0)
+
+    def _probe(self, url: str):
+        try:
+            with urllib.request.urlopen(
+                    url + "/healthz",
+                    timeout=self.config.probe_timeout_s) as r:
+                obj = json.loads(r.read())
+            return bool(obj.get("ok")), obj
+        except urllib.error.HTTPError as e:
+            # a draining replica answers 503 with a JSON body — that
+            # is information, not a dead backend
+            try:
+                return False, json.loads(e.read())
+            except Exception:              # noqa: BLE001 - probe only
+                return False, None
+        except Exception:                  # noqa: BLE001 - probe only
+            return False, None
+
+    # -- balancing -----------------------------------------------------
+    def _pick(self, route: _ModelRoute, exclude: set,
+              now: float) -> Optional[_Backend]:
+        """Least-loaded routable backend not in ``exclude`` whose
+        breaker admits an attempt (claiming the half-open probe slot
+        when it does).  Routable = scraped healthy, not draining,
+        serving the tenant, and — when the route knows its desired
+        fingerprint (a supervisor-attached model mid-deploy) —
+        serving the CURRENT fingerprint."""
+        with self._lock:
+            urls = list(route.urls)
+            backends = dict(self._backends)
+        want = route.desired_fp() if route.desired_fp is not None \
+            else None
+        cands: List[_Backend] = []
+        for url in urls:
+            b = backends.get(url)
+            if b is None or not b.healthy or b.draining:
+                continue
+            if url in exclude:
+                continue
+            fp = b.models.get(route.replica_model)
+            if fp is None:
+                continue                   # tenant not on this replica
+            if want is not None and fp != want:
+                continue                   # stale mid-deploy
+            cands.append(b)
+        if not cands:
+            return None
+        # round-robin rotation, then a stable least-loaded sort: equal
+        # loads spread across the fleet instead of camping on slot 0
+        with self._lock:
+            off = self._rr % len(cands)
+            self._rr += 1
+        cands = cands[off:] + cands[:off]
+        cands.sort(key=lambda b: (b.inflight, b.queue_rows))
+        for b in cands:
+            if b.breaker.acquire(now):
+                return b
+        return None
+
+    # -- the request engine --------------------------------------------
+    def route_request(self, model: str, raw_body: bytes, rows: int,
+                      priority: int = 0,
+                      timeout_ms: Optional[float] = None,
+                      carrier: Optional[Tuple[str, str]] = None
+                      ) -> _Result:
+        """Route one predict request: admission budget -> balanced
+        forwarding with retries + hedging inside the timeout budget.
+        Returns the client-facing :class:`_Result` (the backend's body
+        passes through byte-identical on success; router metadata
+        rides response headers)."""
+        t0 = time.monotonic()
+        with self._lock:
+            self._rid += 1
+            rid = self._rid
+            route = self._routes.get(model)
+        if route is None:
+            return self._finish(rid, model, rows, t0, _json_result(
+                404, "unknown_model",
+                {"error": f"no model {model!r} in the routing table",
+                 "code": "unknown_model"}))
+        # -- admission budget (before any backend sees the request).
+        # The in-flight cap is checked AND claimed in one critical
+        # section (concurrent admissions cannot overshoot it), and it
+        # is checked BEFORE the token bucket so a cap-shed request
+        # never silently drains budget tokens it won't use.
+        retry_ms = 0.0
+        admitted_inflight = False
+        if route.max_inflight > 0:
+            cap = route.max_inflight * (2 if priority > 0 else 1)
+            with self._lock:
+                if route.inflight >= cap:
+                    retry_ms = 50.0
+                else:
+                    route.inflight += 1
+                    admitted_inflight = True
+        try:
+            if retry_ms <= 0:
+                retry_ms = route.bucket.try_take(rows, priority)
+            if _faults.fire("router.admit") == "shed":
+                retry_ms = max(retry_ms, 1.0)
+            if retry_ms > 0:
+                if self._metrics is not None:
+                    self._metrics["shed"].labels(model=model).inc()
+                retry_s = max(int(-(-retry_ms // 1e3)), 1)
+                return self._finish(rid, model, rows, t0, _json_result(
+                    429, "shed",
+                    {"error": f"admission budget exhausted for model "
+                              f"{model!r}", "code": "backpressure",
+                     "retry_after_ms": round(retry_ms, 1)},
+                    headers={"Retry-After": str(retry_s)}))
+            budget_ms = self.config.timeout_ms
+            if timeout_ms is not None and timeout_ms > 0:
+                budget_ms = min(budget_ms, float(timeout_ms))
+            deadline = t0 + budget_ms / 1e3
+            fwd_headers = {"Content-Type": "application/json"}
+            if carrier is not None:
+                fwd_headers[_spans.HTTP_HEADER] = \
+                    f"{carrier[0]}:{carrier[1]}"
+            if route.max_inflight <= 0:
+                with self._lock:
+                    route.inflight += 1
+                admitted_inflight = True
+            res = self._attempt_loop(route, raw_body, rid, deadline,
+                                     fwd_headers)
+        finally:
+            if admitted_inflight:
+                with self._lock:
+                    route.inflight -= 1
+        return self._finish(rid, model, rows, t0, res)
+
+    def _finish(self, rid: int, model: str, rows: int, t0: float,
+                res: _Result) -> _Result:
+        total_ms = round((time.monotonic() - t0) * 1e3, 3)
+        with self._lock:
+            self._counts[res.status] = \
+                self._counts.get(res.status, 0) + 1
+            if res.hedged:
+                self._hedges += 1
+                if res.hedge_won:
+                    self._hedge_wins += 1
+            self._retries_total += res.retries
+        if res.status == "ok":
+            self._lat_hist.observe(total_ms)
+        if self._metrics is not None:
+            c_req, c_rows = self._metric_req(res.status)
+            c_req.inc()
+            c_rows.inc(rows)
+            if res.status == "ok":
+                self._metrics["lat_child"].observe(total_ms)
+            if res.retries:
+                self._metrics["retries"].inc(res.retries)
+            if res.hedged:
+                self._metrics["hedges"].labels(
+                    result="win" if res.hedge_won else "loss").inc()
+        fields: Dict[str, Any] = {
+            "status": res.status, "model": model, "rows": rows,
+            "total_ms": total_ms, "attempts": res.attempts,
+            "retries": res.retries, "rid": rid,
+        }
+        if res.hedged:
+            fields["hedged"] = True
+            fields["hedge_won"] = bool(res.hedge_won)
+        if res.backend:
+            fields["backend"] = res.backend
+        self._emit("request", **fields)
+        res.headers.setdefault("X-Ltpu-Router-Attempts",
+                               str(res.attempts))
+        if res.backend:
+            res.headers.setdefault("X-Ltpu-Router-Backend", res.backend)
+        return res
+
+    def _attempt_loop(self, route: _ModelRoute, raw_body: bytes,
+                      rid: int, deadline: float,
+                      fwd_headers: Dict[str, str]) -> _Result:
+        cond = threading.Condition()
+        state: Dict[str, Any] = {"winner": None, "failures": [],
+                                 "live": 0}
+        attempts: List[_Attempt] = []
+        used: set = set()
+        retries_left = self.config.max_retries
+        n_retries = 0
+        hedged = False
+        hedge_won = False
+        first_error = ""
+
+        def launch(backend: _Backend, is_hedge: bool) -> _Attempt:
+            att = _Attempt(backend, is_hedge)
+            attempts.append(att)
+            used.add(backend.url)
+            with self._lock:
+                backend.inflight += 1
+            with cond:
+                state["live"] += 1
+            threading.Thread(
+                target=self._run_attempt,
+                args=(att, route, raw_body, deadline, fwd_headers,
+                      cond, state),
+                name="ltpu-route-attempt", daemon=True).start()
+            return att
+
+        now = time.monotonic()
+        b = self._pick(route, used, now)
+        if b is None:
+            # convergence grace: a just-published tenant (or a fleet
+            # mid-restart) can lag the scrape by one interval — wait a
+            # bounded beat for the view to catch up before 503ing
+            grace = min(deadline,
+                        now + max(3 * self.config.probe_interval_s,
+                                  0.5))
+            while b is None and time.monotonic() < grace:
+                time.sleep(self.config.probe_interval_s / 2)
+                b = self._pick(route, used, time.monotonic())
+        if b is None:
+            return _json_result(
+                503, "no_backend",
+                {"error": f"no routable backend for model "
+                          f"{route.name!r}", "code": "no_backend"},
+                headers={"Retry-After": "1"})
+        launch(b, False)
+        # the hedge timer starts when the attempt LAUNCHES — after
+        # any convergence-grace wait above, or a stale `now` would
+        # fire the hedge immediately on every grace-delayed request
+        now = time.monotonic()
+        hedge_at = now + self.config.hedge_ms / 1e3 \
+            if self.config.hedge_ms > 0 else None
+
+        def cancel_losers(winner_att: Optional[_Attempt]) -> None:
+            with cond:
+                losers = [a for a in attempts
+                          if a is not winner_att and not a.done]
+                for a in losers:
+                    a.cancelled = True
+            for a in losers:
+                conn = a.conn
+                if conn is not None:
+                    try:
+                        conn.close()       # tears the socket: the
+                    except Exception:      # noqa: BLE001
+                        pass               # loser unblocks + self-cleans
+
+        while True:
+            fail = None
+            with cond:
+                if state["winner"] is None and not state["failures"]:
+                    now = time.monotonic()
+                    wait_until = deadline
+                    if not hedged and hedge_at is not None:
+                        wait_until = min(wait_until, hedge_at)
+                    if now < wait_until:
+                        cond.wait(max(wait_until - now, 0.001))
+                if state["winner"] is not None:
+                    att, status, body, retry_after = state["winner"]
+                    hedge_won = att.is_hedge
+                    winner = att
+                else:
+                    winner = None
+                    if state["failures"]:
+                        fail = state["failures"].pop(0)
+                    live = state["live"]
+            now = time.monotonic()
+            if winner is not None:
+                cancel_losers(winner)
+                hdrs: Dict[str, str] = {}
+                if retry_after:
+                    hdrs["Retry-After"] = retry_after
+                # winners are definitive answers only (_run_attempt
+                # classifies 429/5xx as retryable failures): 200 or a
+                # passed-through client-fault 4xx
+                out_status = "ok" if status == 200 else "bad_request"
+                return _Result(status, body, out_status,
+                               attempts=len(attempts),
+                               retries=n_retries, hedged=hedged,
+                               hedge_won=hedged and hedge_won,
+                               backend=winner.backend.url,
+                               headers=hdrs)
+            if fail is not None:
+                first_error = first_error or fail[1]
+                if live > 0:
+                    continue               # a hedge is still running
+                if retries_left <= 0 or now >= deadline:
+                    st_f, ra = fail[2], fail[3]
+                    if st_f in (429, 503):
+                        # every backend answered backpressure: pass
+                        # it through STRUCTURED, preserving the
+                        # Retry-After hint, so well-behaved clients
+                        # can still back off correctly.  Status
+                        # "backpressure" (NOT "shed"): backend
+                        # saturation is a different signal from the
+                        # router's own admission budget, and the
+                        # shed-rate anomaly must not fire for it
+                        try:
+                            ra_ms = max(float(ra) * 1e3, 1.0)
+                        except (TypeError, ValueError):
+                            ra_ms = 1000.0
+                        return _json_result(
+                            st_f, "backpressure",
+                            {"error": f"all {len(attempts)} "
+                                      f"attempt(s) backpressured; "
+                                      f"last: {fail[1][:160]}",
+                             "code": "backpressure",
+                             "retry_after_ms": round(ra_ms, 1)},
+                            attempts=len(attempts),
+                            retries=n_retries, hedged=hedged,
+                            headers={"Retry-After": ra or "1"})
+                    if st_f == 504:
+                        return _json_result(
+                            504, "timeout",
+                            {"error": f"backend deadline expired on "
+                                      f"all {len(attempts)} "
+                                      f"attempt(s)",
+                             "code": "timeout"},
+                            attempts=len(attempts),
+                            retries=n_retries, hedged=hedged)
+                    return _json_result(
+                        502, "upstream",
+                        {"error": f"all {len(attempts)} attempt(s) "
+                                  f"failed; last: {first_error[:200]}",
+                         "code": "upstream"},
+                        attempts=len(attempts), retries=n_retries,
+                        hedged=hedged)
+                retries_left -= 1
+                n_retries += 1
+                pause = backoff_ms(self.config, rid, n_retries) / 1e3
+                pause = min(pause, max(deadline - now - 0.005, 0.0))
+                if pause > 0:
+                    time.sleep(pause)
+                now = time.monotonic()
+                b = self._pick(route, used, now) or \
+                    self._pick(route, set(), now)
+                if b is None:
+                    return _json_result(
+                        503, "no_backend",
+                        {"error": f"no routable backend left for "
+                                  f"model {route.name!r} after "
+                                  f"{len(attempts)} attempt(s)",
+                         "code": "no_backend"},
+                        attempts=len(attempts), retries=n_retries,
+                        hedged=hedged,
+                        headers={"Retry-After": "1"})
+                launch(b, False)
+                # re-arm the hedge timer: the NEW attempt earns its
+                # own silence window — a stale timer would hedge
+                # every retry instantly, doubling backend load during
+                # a plain failure-retry storm
+                if hedge_at is not None:
+                    hedge_at = time.monotonic() + \
+                        self.config.hedge_ms / 1e3
+                continue
+            if not hedged and hedge_at is not None and \
+                    now >= hedge_at and live == 1:
+                b = self._pick(route, used, now)
+                if b is not None:
+                    hedged = True
+                    launch(b, True)
+                else:
+                    hedge_at = None        # nobody to hedge to
+                continue
+            if now >= deadline:
+                cancel_losers(None)
+                return _json_result(
+                    504, "timeout",
+                    {"error": f"routing budget "
+                              f"({self.config.timeout_ms:.0f} ms "
+                              f"cap) exhausted", "code": "timeout"},
+                    attempts=len(attempts), retries=n_retries,
+                    hedged=hedged)
+
+    def _run_attempt(self, att: _Attempt, route: _ModelRoute,
+                     raw_body: bytes, deadline: float,
+                     fwd_headers: Dict[str, str], cond, state) -> None:
+        status = None
+        body = b""
+        retry_after = ""
+        err: Optional[str] = None
+        err_timeout = False
+        try:
+            # fault point ``router.backend``: sleep_<ms> = injected
+            # brownout on this attempt (the hedge must race around
+            # it), sleepb<i>_<ms> = brownout pinned to ONE backend
+            # (index i in the route's URL order — the "one slow
+            # replica" scenario the hedging bench measures), error =
+            # the connection dies
+            mode = _faults.fire("router.backend")
+            if mode.startswith("sleep_"):
+                time.sleep(max(float(mode.split("_", 1)[1]), 0.0) / 1e3)
+            elif mode.startswith("sleepb"):
+                idx_s, ms_s = mode[6:].split("_", 1)
+                with self._lock:
+                    urls = list(route.urls)
+                if int(idx_s) < len(urls) and \
+                        att.backend.url == urls[int(idx_s)]:
+                    time.sleep(max(float(ms_s), 0.0) / 1e3)
+            elif mode == "error":
+                raise OSError("injected fault (router.backend:error)")
+            timeout = max(deadline - time.monotonic(), 0.05)
+            conn = http.client.HTTPConnection(
+                att.backend.host, att.backend.port, timeout=timeout)
+            att.conn = conn
+            rep = route.replica_model
+            path = "/predict" if rep == "default" \
+                else f"/v1/{rep}/predict"
+            conn.request("POST", path, raw_body, headers=fwd_headers)
+            resp = conn.getresponse()
+            body = resp.read()
+            status = resp.status
+            retry_after = resp.headers.get("Retry-After", "") or ""
+        except Exception as exc:           # noqa: BLE001 - classified
+            err = f"{type(exc).__name__}: {exc}"
+            # socket.timeout is the CLIENT's remaining budget
+            # expiring, not the backend misbehaving — a tight
+            # per-request timeout_ms must not open breakers on
+            # healthy backends (same policy as a backend 504)
+            err_timeout = isinstance(exc, TimeoutError)
+        finally:
+            with self._lock:
+                att.backend.inflight -= 1
+        now = time.monotonic()
+        opened = False
+        with cond:
+            state["live"] -= 1
+            att.done = True
+            if att.cancelled:
+                # hedged loser torn down by the winner: no verdict —
+                # neither a breaker event nor a second request count
+                att.backend.breaker.on_cancel()
+                self._metric_attempt("cancelled")
+                cond.notify_all()
+                return
+            if err is None and status is not None and \
+                    status not in (429, 500, 502, 503, 504):
+                # a definitive answer (2xx or a client-fault 4xx):
+                # pass it through; first definitive answer wins
+                closed = att.backend.breaker.on_success()
+                self._metric_attempt("ok")
+                if state["winner"] is None:
+                    state["winner"] = (att, status, body, retry_after)
+                cond.notify_all()
+                if closed:
+                    self._emit("breaker_close",
+                               backend=att.backend.url)
+                return
+            # retryable failure: transport error or 5xx/429.  Only
+            # breaker-penalize genuine backend faults (transport, 500,
+            # 502) — 429/503/504 are the backend doing admission
+            # control, not being broken.
+            detail = err if err is not None else \
+                f"HTTP {status}: {body[:120]!r}"
+            if (err is not None and not err_timeout) or \
+                    status in (500, 502):
+                opened = att.backend.breaker.on_failure(now)
+            else:
+                att.backend.breaker.on_cancel()
+            self._metric_attempt("error")
+            state["failures"].append((att, detail, status,
+                                      retry_after))
+            cond.notify_all()
+        if opened:
+            Log.warning("router: circuit OPEN on backend %s (%s)",
+                        att.backend.url, detail[:120])
+            self._emit("breaker_open", backend=att.backend.url,
+                       failures=att.backend.breaker.failures,
+                       detail=str(detail)[:200])
+
+    # -- introspection -------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            routes = dict(self._routes)
+            backends = dict(self._backends)
+            counts = dict(self._counts)
+            hedges, wins = self._hedges, self._hedge_wins
+            retries = self._retries_total
+        return {
+            "draining": self.draining,
+            "models": {
+                name: {
+                    "backends": list(r.urls),
+                    "inflight": r.inflight,
+                    "max_inflight": r.max_inflight,
+                    "rows_per_s": r.bucket.rate,
+                    "desired": r.desired_fp()
+                    if r.desired_fp is not None else None,
+                } for name, r in routes.items()},
+            "backends": {
+                url: {
+                    "healthy": b.healthy, "draining": b.draining,
+                    "inflight": b.inflight,
+                    "queue_rows": b.queue_rows,
+                    "breaker": b.breaker.state,
+                    "models": dict(b.models),
+                } for url, b in backends.items()},
+            "requests": counts,
+            "hedges": hedges, "hedge_wins": wins, "retries": retries,
+            "latency_ms": {
+                "p50": round(self._lat_hist.percentile(0.50), 3),
+                "p95": round(self._lat_hist.percentile(0.95), 3),
+                "p99": round(self._lat_hist.percentile(0.99), 3),
+            },
+        }
+
+    def healthz(self) -> Dict[str, Any]:
+        with self._lock:
+            routes = dict(self._routes)
+            backends = dict(self._backends)
+        routable = {
+            name: sum(1 for u in r.urls
+                      if (b := backends.get(u)) is not None
+                      and b.healthy and not b.draining)
+            for name, r in routes.items()}
+        return {"ok": not self.draining, "draining": self.draining,
+                "role": "router", "models": routable,
+                "backends": len(backends)}
+
+    def metrics_text(self) -> str:
+        return _obs_metrics.render()
+
+
+# ----------------------------------------------------------------------
+# HTTP front
+# ----------------------------------------------------------------------
+def _router_handler_for(router: Router):
+    class RouteHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _send(self, code: int, body: bytes,
+                  headers: Optional[Dict[str, str]] = None,
+                  content_type: str = "application/json") -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_json(self, code: int, obj: Dict[str, Any],
+                       headers: Optional[Dict[str, str]] = None
+                       ) -> None:
+            self._send(code, json.dumps(obj).encode(), headers)
+
+        def log_message(self, fmt, *args):
+            Log.debug("router http: " + fmt, *args)
+
+        def do_GET(self):
+            try:
+                self._get()
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            except Exception as exc:       # noqa: BLE001 - last resort
+                Log.warning("router http: unhandled %s: %s",
+                            type(exc).__name__, exc)
+
+        def _get(self):
+            if self.path == "/healthz":
+                body = router.healthz()
+                self._send_json(503 if router.draining else 200, body)
+            elif self.path == "/stats":
+                self._send_json(200, router.stats())
+            elif self.path == "/metrics":
+                if not router.config.metrics:
+                    self._send_json(404, {"error": "metrics are off",
+                                          "code": "no_route"})
+                else:
+                    self._send(200, router.metrics_text().encode(),
+                               content_type="text/plain; "
+                                            "version=0.0.4")
+            else:
+                self._send_json(404, {"error": f"no route {self.path}",
+                                      "code": "no_route"})
+
+        def do_POST(self):
+            try:
+                self._post()
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            except Exception as exc:       # noqa: BLE001 - last resort
+                Log.warning("router http: unhandled %s: %s",
+                            type(exc).__name__, exc)
+                try:
+                    self._send_json(500, {"error": f"internal: {exc}",
+                                          "code": "internal"})
+                except Exception:          # noqa: BLE001 - socket dead
+                    pass
+
+        def _post(self):
+            model, verb = split_model_route(self.path)
+            if verb != "/predict":
+                self._send_json(404, {"error": f"no route {self.path}",
+                                      "code": "no_route"})
+                return
+            if router.draining:
+                self.close_connection = True
+                self._send_json(503, {"error": "router is draining",
+                                      "code": "draining",
+                                      "draining": True},
+                                headers={"Retry-After": "1"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+            except (TypeError, ValueError):
+                n = -1
+            if n < 0 or n > router.config.max_body_bytes:
+                self.close_connection = True
+                self._send_json(
+                    413 if n > 0 else 400,
+                    {"error": f"bad or oversized body ({n} bytes)",
+                     "code": "body_too_large" if n > 0
+                     else "bad_content_length"})
+                return
+            raw = self.rfile.read(n) if n else b"{}"
+            try:
+                obj = json.loads(raw or b"{}")
+                rows_field = obj["rows"]
+                rows = len(rows_field)
+                if not isinstance(rows_field, list) or rows == 0:
+                    raise ValueError("rows must be a non-empty list")
+                priority = int(obj.get("priority", 0))
+                timeout_ms = obj.get("timeout_ms")
+                if timeout_ms is not None:
+                    timeout_ms = float(timeout_ms)
+            except (KeyError, ValueError, TypeError) as exc:
+                self.close_connection = True
+                self._send_json(400, {"error": f"bad request body: "
+                                               f"{exc}",
+                                      "code": "bad_rows"})
+                return
+            # enter the client's trace context (X-Ltpu-Trace): the
+            # router record joins it, and the carrier forwards to the
+            # replica — client -> router -> replica stays ONE trace
+            carrier = _spans.from_headers(self.headers)
+            with _spans.use(carrier):
+                res = router.route_request(
+                    model or "default", raw, rows, priority=priority,
+                    timeout_ms=timeout_ms, carrier=carrier)
+            self._send(res.code, res.body, res.headers)
+
+    return RouteHandler
+
+
+def route_http(router: Router, host: Optional[str] = None,
+               port: Optional[int] = None, background: bool = False
+               ) -> Tuple[ThreadingHTTPServer,
+                          Optional[threading.Thread]]:
+    """Start the router's scrape loop and HTTP front.  With
+    ``background=True`` the accept loop runs in a daemon thread and
+    returns immediately; otherwise this blocks until SIGTERM/SIGINT,
+    then drains (new work 503s, the accept loop closes)."""
+    router.start()
+    host = router.config.host if host is None else host
+    port = router.config.port if port is None else port
+    httpd = ThreadingHTTPServer((host, port),
+                                _router_handler_for(router))
+    httpd.daemon_threads = True
+    if router.config.port_file:
+        tmp = router.config.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("%d\n" % httpd.server_address[1])
+        os.replace(tmp, router.config.port_file)
+    Log.info("router: listening on http://%s:%d (models: %s)",
+             *httpd.server_address[:2],
+             ",".join(router.models()) or "-")
+    accept = threading.Thread(target=httpd.serve_forever,
+                              name="ltpu-router-http", daemon=True)
+    accept.start()
+    if background:
+        return httpd, accept
+
+    stop_evt = threading.Event()
+    previous: Dict[int, Any] = {}
+
+    def _on_signal(signum, frame):
+        Log.info("router: signal %d — draining", signum)
+        stop_evt.set()
+
+    installed = False
+    if threading.current_thread() is threading.main_thread():
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            previous[sig] = signal.signal(sig, _on_signal)
+        installed = True
+    try:
+        stop_evt.wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        try:
+            router.draining = True
+            time.sleep(0.2)                # let in-flight responses out
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            router.stop()
+            if installed:
+                for sig, old in previous.items():
+                    signal.signal(sig, old)
+    Log.info("router: drained and stopped")
+    return httpd, None

@@ -27,10 +27,14 @@ from ..utils import faults as _faults
 from ..utils.log import Log
 from ..utils.telemetry import counters as _tele_counters
 from .admission import (AdmissionQueue, QueueSaturated, Request,
-                        ServerClosed)
+                        ServerClosed, UnknownModel)
 from .batcher import Batch, MicroBatcher
 from .config import ServeConfig
 from .registry import ModelRegistry
+
+#: the registry name un-prefixed routes (``/predict``, ``/swap``)
+#: resolve to; named tenants ride ``/v1/<model>/...``
+DEFAULT_MODEL = "default"
 
 
 class Server:
@@ -46,9 +50,17 @@ class Server:
             self.config.queue_rows, self.config.queue_requests,
             batch_rows_hint=self.config.max_batch_rows)
         self.batcher = MicroBatcher(self.queue, self.config)
-        self.registry = ModelRegistry(
-            chunk_rows=self.config.max_batch_rows,
-            warm=self.config.warmup)
+        # multi-model tenancy: one ModelRegistry per named model, all
+        # sharing this server's queue/batcher/dispatchers (requests pin
+        # their ModelVersion at admission and the batcher groups by
+        # version identity, so tenants never mix in a device batch).
+        # ``registry`` stays the default tenant for the single-model
+        # API surface.
+        self._registries: Dict[str, ModelRegistry] = {
+            DEFAULT_MODEL: ModelRegistry(
+                chunk_rows=self.config.max_batch_rows,
+                warm=self.config.warmup)}
+        self._registries_lock = threading.Lock()
         self._stop = threading.Event()
         self.draining = False
         self._threads: List[threading.Thread] = []
@@ -218,21 +230,78 @@ class Server:
         self.stop()
 
     # -- model management ------------------------------------------------
+    @property
+    def registry(self) -> ModelRegistry:
+        """The default tenant's registry (the single-model API)."""
+        return self._registries[DEFAULT_MODEL]
+
+    def registry_for(self, model: Optional[str],
+                     create: bool = False) -> ModelRegistry:
+        """The named tenant's registry.  ``create=True`` (the swap
+        path) opens the tenancy seam: publishing to a new name creates
+        its registry; the request path NEVER creates one — an unknown
+        name raises :class:`UnknownModel` (HTTP 404)."""
+        name = model or DEFAULT_MODEL
+        with self._registries_lock:
+            reg = self._registries.get(name)
+            if reg is None:
+                if not create:
+                    raise UnknownModel(
+                        f"no model {name!r} published (known: "
+                        f"{sorted(self._registries)})")
+                reg = ModelRegistry(
+                    chunk_rows=self.config.max_batch_rows,
+                    warm=self.config.warmup)
+                self._registries[name] = reg
+        return reg
+
+    def models(self) -> Dict[str, Optional[str]]:
+        """{model name: active fingerprint} across tenants (the
+        ``/healthz`` body's ``models`` map — what the fleet
+        supervisor's reconciler and the router's scrape read)."""
+        with self._registries_lock:
+            regs = dict(self._registries)
+        out: Dict[str, Optional[str]] = {}
+        for name, reg in regs.items():
+            ver = reg.current()
+            out[name] = ver.model_id if ver is not None else None
+        return out
+
     def swap(self, booster=None, model_file: Optional[str] = None,
-             model_str: Optional[str] = None) -> int:
+             model_str: Optional[str] = None,
+             model: Optional[str] = None) -> int:
         """Publish a new model version (flatten + pre-warm + atomic
-        swap).  In-flight requests complete against their admitted
-        version; only new admissions see the new one."""
+        swap) to the named tenant (default when ``model`` is None).
+        In-flight requests complete against their admitted version;
+        only new admissions see the new one."""
         t0 = time.monotonic()
-        with _spans.span("swap", recorder=self._recorder) as sp:
-            ver = self.registry.publish(booster=booster,
-                                        model_file=model_file,
-                                        model_str=model_str)
-            sp.set(version=ver.version, model_id=ver.model_id)
-            # the publish trace rides the version: the FIRST request
-            # this version serves emits a joined marker span, closing
-            # the daemon->checkpoint->publish->served-request loop
-            ver.publish_trace = _spans.current()
+        name = model or DEFAULT_MODEL
+        with self._registries_lock:
+            created = name not in self._registries
+        reg = self.registry_for(model, create=True)
+        try:
+            with _spans.span("swap", recorder=self._recorder) as sp:
+                ver = reg.publish(booster=booster,
+                                  model_file=model_file,
+                                  model_str=model_str)
+                sp.set(version=ver.version, model_id=ver.model_id,
+                       model=name)
+                # the publish trace rides the version: the FIRST
+                # request this version serves emits a joined marker
+                # span, closing the daemon->checkpoint->publish->
+                # served-request loop
+                ver.publish_trace = _spans.current()
+        except BaseException:
+            # a failed FIRST publish to a new name must not leave an
+            # empty tenant behind: it would answer 500 (no model
+            # published) instead of the documented 404 unknown_model
+            # and pollute the /healthz models map
+            if created:
+                with self._registries_lock:
+                    cur = self._registries.get(name)
+                    if cur is reg and reg.current() is None:
+                        del self._registries[name]
+            raise
         if self._metrics is not None:
             self._metrics["swaps"].inc()
         if self._recorder is not None:
@@ -240,6 +309,7 @@ class Server:
                 "serve", status="swap", rows=0,
                 total_ms=round((time.monotonic() - t0) * 1e3, 3),
                 version=ver.version, model_id=ver.model_id,
+                model=model or DEFAULT_MODEL,
                 warmup=ver.warmup_info)
         return ver.version
 
@@ -250,13 +320,16 @@ class Server:
     # -- client surface --------------------------------------------------
     def submit(self, data, priority: int = 0,
                timeout_ms: Optional[float] = None,
-               raw: bool = False) -> Request:
-        """Admit one predict request; returns the request future
+               raw: bool = False,
+               model: Optional[str] = None) -> Request:
+        """Admit one predict request against the named tenant (default
+        when ``model`` is None); returns the request future
         (``.value()`` blocks for the result or raises).  Raises
-        :class:`QueueSaturated` immediately on backpressure."""
+        :class:`QueueSaturated` immediately on backpressure and
+        :class:`UnknownModel` for an unpublished tenant name."""
         if not self._threads:
             raise ServerClosed("server not started (call start())")
-        ver = self.registry.require()
+        ver = self.registry_for(model).require()
         X = np.ascontiguousarray(np.asarray(data, np.float64))
         if X.ndim == 1:
             X = X[None, :]
@@ -299,12 +372,13 @@ class Server:
 
     def predict(self, data, priority: int = 0,
                 timeout_ms: Optional[float] = None,
-                raw: bool = False) -> np.ndarray:
+                raw: bool = False,
+                model: Optional[str] = None) -> np.ndarray:
         """Blocking predict through the micro-batching scheduler.
         Output matches ``Booster.predict`` (``raw=True`` matches
         ``raw_score=True``)."""
         req = self.submit(data, priority=priority,
-                          timeout_ms=timeout_ms, raw=raw)
+                          timeout_ms=timeout_ms, raw=raw, model=model)
         # grace beyond the deadline: the dispatcher times the request
         # out itself; this guard only catches a wedged worker
         grace = None
@@ -435,6 +509,7 @@ class Server:
         return {
             "version": ver.version if ver else None,
             "model_id": ver.model_id if ver else None,
+            "models": self.models(),
             "draining": self.draining,
             "queue_requests": depth_reqs,
             "queue_rows": depth_rows,

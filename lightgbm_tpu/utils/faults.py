@@ -69,6 +69,22 @@ point                  modes its call site interprets
 ``elastic.remesh``     ``error`` — one re-mesh attempt raises
                        (recovery degrades to a narrower survivor set,
                        bounded by ``elastic_min_shards``)
+``router.backend``     fired once per FORWARDED routing attempt
+                       (``serve/router.py``, primary and hedge alike):
+                       ``sleep_<ms>`` — the attempt is delayed before
+                       the backend sees it (injected brownout; the
+                       hedge/retry machinery must make it invisible);
+                       ``sleepb<i>_<ms>`` — the delay applies only
+                       when the attempt targets backend index ``i``
+                       of the route's URL order (ONE slow replica —
+                       the hedging bench's brownout cell);
+                       ``error`` — the attempt fails the way a dead
+                       backend connection does (drives retry, backoff
+                       and the per-backend circuit breaker)
+``router.admit``       ``shed`` — the per-model admission budget
+                       reports exhaustion for this request (a
+                       structured 429 + Retry-After without having to
+                       actually flood the token bucket)
 =====================  =================================================
 
 A spec naming a point outside this table arms nothing — a typo'd
@@ -117,7 +133,8 @@ KNOWN_POINTS = frozenset({
     "ckpt.save", "watcher.validate", "watcher.canary", "serve.dispatch",
     "http.request", "fleet.spawn", "ingest.read", "ingest.validate",
     "trainer.step", "trainer.refit", "mesh.collective",
-    "mesh.heartbeat", "elastic.remesh",
+    "mesh.heartbeat", "elastic.remesh", "router.backend",
+    "router.admit",
 })
 
 

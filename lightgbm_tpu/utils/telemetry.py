@@ -75,7 +75,7 @@ SCHEMA_VERSION = 1
 
 RECORD_TYPES = ("run_start", "iteration", "superstep", "eval", "predict",
                 "serve", "checkpoint", "fleet", "continual", "recovery",
-                "span", "capture", "run_end")
+                "router", "span", "capture", "run_end")
 
 # per-type required fields on top of the common envelope; values are
 # (field, type-or-types) pairs the lint enforces
@@ -159,6 +159,23 @@ _TYPE_FIELDS: Dict[str, Tuple[Tuple[str, Any], ...]] = {
     # loudly into the checkpoint restart story).  triage_run.py rolls
     # these up and flags repeated re-meshes of one run as HIGH.
     "recovery": (("event", str),),
+    # one record per routing-front event (serve/router.py): ``event``
+    # is request (one CLIENT-facing routed request: model/status/rows/
+    # total_ms/attempts/retries + hedged/hedge_won when the tail-
+    # latency hedge fired — status ok|shed|backpressure|timeout|
+    # upstream|no_backend|unknown_model|bad_request (shed = the
+    # router's own admission budget; backpressure = every backend
+    # answered 429/503 and the hint passed through); a request that
+    # needed a
+    # retry or a hedge and still answered 200 is status ok, failures
+    # made invisible being the router's whole job) | breaker_open /
+    # breaker_close (the per-backend circuit breaker feeding the
+    # balancer: backend + failures) | scrape_error (a /healthz scrape
+    # failed).  The run_end summary rolls up request/hedge/shed/retry
+    # counts and p50/p95/p99 routed latency; obs/rules.py flags hedge
+    # rate > 20% (MED), budget-shed rate > 5% (HIGH) and breaker
+    # opens (HIGH).
+    "router": (("event", str),),
     # one record per closed trace span (obs/spans.py): ``trace_id``
     # joins spans (and trace-tagged records of every other type)
     # emitted by ANY process into one timeline — the continual
@@ -389,6 +406,10 @@ class RunRecorder:
         self._serve_lat_n = 0
         self._serve_occ_sum = 0.0
         self._serve_occ_n = 0
+        # routed-request latency ring (serve/router.py), same bounded
+        # most-recent-samples policy as the serve ring
+        self._router_lat: List[float] = []
+        self._router_lat_n = 0
         self._base = counters.snapshot()
         install_jax_hooks()
         with _OPEN_LOCK:
@@ -547,6 +568,38 @@ class RunRecorder:
                 self._agg["continual_batch_ms"] = round(
                     self._agg.get("continual_batch_ms", 0.0) +
                     float(rec.get("duration_ms", 0.0)), 3)
+        elif t == "router":
+            event = rec.get("event")
+            if event == "breaker_open":
+                self._agg["router_breaker_opens"] = \
+                    self._agg.get("router_breaker_opens", 0) + 1
+                return
+            if event != "request":
+                return
+            status = rec.get("status")
+            self._agg["router_requests"] = \
+                self._agg.get("router_requests", 0) + 1
+            self._agg["router_rows"] = \
+                self._agg.get("router_rows", 0) + int(rec.get("rows", 0))
+            self._agg["router_retries"] = \
+                self._agg.get("router_retries", 0) + \
+                int(rec.get("retries", 0))
+            if rec.get("hedged"):
+                self._agg["router_hedges"] = \
+                    self._agg.get("router_hedges", 0) + 1
+                if rec.get("hedge_won"):
+                    self._agg["router_hedge_wins"] = \
+                        self._agg.get("router_hedge_wins", 0) + 1
+            if status != "ok":
+                self._agg[f"router_{status}"] = \
+                    self._agg.get(f"router_{status}", 0) + 1
+                return
+            v = float(rec.get("total_ms", 0.0))
+            if len(self._router_lat) < 65536:
+                self._router_lat.append(v)
+            else:
+                self._router_lat[self._router_lat_n % 65536] = v
+            self._router_lat_n += 1
         elif t == "recovery":
             key = {
                 "detect": "recovery_detects",
@@ -591,6 +644,14 @@ class RunRecorder:
             if self._serve_occ_n:
                 out["serve_mean_occupancy"] = round(
                     self._serve_occ_sum / self._serve_occ_n, 4)
+            if self._router_lat:
+                lat = sorted(self._router_lat)
+                out["router_total_ms_p50"] = \
+                    round(percentile(lat, 0.50), 3)
+                out["router_total_ms_p95"] = \
+                    round(percentile(lat, 0.95), 3)
+                out["router_total_ms_p99"] = \
+                    round(percentile(lat, 0.99), 3)
             if self._phase_totals:
                 out["phase_totals_ms"] = {
                     k: round(v, 3) for k, v in sorted(

@@ -1,0 +1,682 @@
+"""Resilient routing front (serve/router.py, docs/Routing.md).
+
+Pins the ISSUE 14 acceptance contract:
+
+- deterministic retry backoff jitter (pure function, replayable);
+- retries honor the remaining timeout budget — a request can never
+  overrun ``route_timeout_ms`` by retrying;
+- per-backend circuit breaker: half-open probes are SINGLE-flight;
+- tail-latency hedging: first answer wins, the loser is cancelled
+  and never double-counts request metrics or feeds the breaker;
+- per-model admission budgets: token bucket + in-flight caps shed
+  with a structured 429 + Retry-After before any backend is touched;
+- tenancy status mapping: 404 unknown model vs 429 budget vs 503 no
+  routable backend;
+- FleetSupervisor.endpoints() excludes draining and stale-fingerprint
+  replicas (the satellite fix) — even non-router clients stop hitting
+  mid-deploy replicas.
+
+Most tests drive the router over tiny fake stdlib backends (no jax,
+no boosters — the engine under test is the routing logic); the fleet
+integration rides the same InprocReplica stack as test_fleet.py.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.serve import RouterConfig
+from lightgbm_tpu.serve.router import (CircuitBreaker, Router,
+                                       TokenBucket, backoff_ms,
+                                       parse_backends_spec, route_http)
+from lightgbm_tpu.utils import faults
+from lightgbm_tpu.utils.telemetry import RunRecorder, validate_record
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    faults.reset()
+    yield
+    faults.clear()
+    faults.reset()
+
+
+# ----------------------------------------------------------------------
+# fake backend: a minimal replica (healthz + predict) with knobs
+# ----------------------------------------------------------------------
+class FakeBackend:
+    def __init__(self, model_id="fp0", models=None, delay_ms=0.0,
+                 fail=False, draining=False, queue_rows=0,
+                 shed=False):
+        self.shed = shed
+        self.model_id = model_id
+        self.models = dict(models) if models is not None \
+            else {"default": model_id}
+        self.delay_ms = delay_ms
+        self.fail = fail
+        self.draining = draining
+        self.queue_rows = queue_rows
+        self.predict_hits = 0
+        self._lock = threading.Lock()
+        be = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, obj, headers=None):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    body = {"ok": not be.draining,
+                            "draining": be.draining,
+                            "model_id": be.model_id,
+                            "models": dict(be.models),
+                            "queue_rows": be.queue_rows,
+                            "queue_requests": 0}
+                    self._send(503 if be.draining else 200, body)
+                else:
+                    self._send(404, {"code": "no_route"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n) if n else b"{}"
+                if not self.path.endswith("/predict"):
+                    self._send(404, {"code": "no_route"})
+                    return
+                with be._lock:
+                    be.predict_hits += 1
+                if be.delay_ms:
+                    time.sleep(be.delay_ms / 1e3)
+                if be.shed:
+                    self._send(429, {"error": "queue saturated",
+                                     "code": "backpressure",
+                                     "retry_after_ms": 2000.0},
+                               headers={"Retry-After": "2"})
+                    return
+                if be.fail:
+                    self._send(500, {"error": "backend down",
+                                     "code": "injected"})
+                    return
+                rows = len(json.loads(raw).get("rows", []))
+                self._send(200, {"predictions": [0.25] * rows,
+                                 "model_id": be.model_id,
+                                 "version": 1,
+                                 "echo_trace": self.headers.get(
+                                     "X-Ltpu-Trace")})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        self.url = "http://127.0.0.1:%d" % self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        try:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+        except Exception:              # noqa: BLE001 - teardown
+            pass
+
+
+def _cfg(**kw):
+    base = dict(port=0, probe_interval_s=0.05, probe_timeout_s=2.0,
+                timeout_ms=5000.0, hedge_ms=0.0, max_retries=2,
+                backoff_base_ms=5.0, backoff_max_ms=20.0,
+                breaker_failures=2, breaker_cooldown_s=0.3)
+    base.update(kw)
+    return RouterConfig(**base)
+
+
+def _router_over(backends, recorder=None, **cfg_kw):
+    router = Router(_cfg(**cfg_kw), recorder=recorder)
+    router.add_model("default",
+                     urls=[b.url for b in backends])
+    router.start()
+    return router
+
+
+def _body(rows=4):
+    return json.dumps({"rows": [[0.0] * 8] * rows}).encode()
+
+
+# ----------------------------------------------------------------------
+# unit: backoff / bucket / breaker / spec parsing
+# ----------------------------------------------------------------------
+def test_backoff_deterministic_and_bounded():
+    cfg = _cfg(backoff_base_ms=25.0, backoff_max_ms=400.0,
+               backoff_jitter=0.5)
+    for rid in (1, 7, 123):
+        for attempt in (1, 2, 3, 6):
+            a = backoff_ms(cfg, rid, attempt)
+            b = backoff_ms(cfg, rid, attempt)
+            assert a == b, "jitter must replay exactly"
+            base = min(25.0 * 2 ** (attempt - 1), 400.0)
+            assert base <= a <= base * 1.5
+    # different (rid, attempt) seeds spread
+    vals = {backoff_ms(cfg, rid, 1) for rid in range(32)}
+    assert len(vals) > 16
+
+
+def test_token_bucket_budget_and_priority_reserve():
+    tb = TokenBucket(rows_per_s=100.0, burst_rows=50)
+    now = time.monotonic()
+    assert tb.try_take(50, now=now) == 0.0          # burst admits
+    wait = tb.try_take(10, now=now)
+    assert wait > 0.0                               # empty: shed
+    # priority > 0 may overdraw one extra burst before shedding
+    assert tb.try_take(10, priority=1, now=now) == 0.0
+    assert tb.try_take(45, priority=1, now=now) > 0.0
+    # refill admits again
+    assert tb.try_take(10, now=now + 10.0) == 0.0
+    # rate 0 = unlimited
+    assert TokenBucket(0.0, 1).try_take(10 ** 9) == 0.0
+    # a request bigger than the whole burst charges the burst (it
+    # could never wait its way in — shedding it with a finite
+    # Retry-After would loop a well-behaved client forever)
+    tb2 = TokenBucket(rows_per_s=100.0, burst_rows=50)
+    n2 = time.monotonic()
+    assert tb2.try_take(500, now=n2) == 0.0
+    assert tb2.try_take(1, now=n2) > 0.0           # drained to 0
+
+
+def test_breaker_half_open_probe_is_single_flight():
+    br = CircuitBreaker(failures=2, cooldown_s=0.1)
+    now = time.monotonic()
+    assert br.acquire(now)
+    assert not br.on_failure(now)
+    assert br.on_failure(now)                       # opens
+    assert br.state == "open"
+    assert not br.acquire(now + 0.05)               # cooling down
+    assert br.acquire(now + 0.2)                    # THE probe
+    assert not br.acquire(now + 0.2)                # single-flight
+    assert not br.acquire(now + 0.2)
+    assert br.on_success()                          # probe verdict
+    assert br.state == "closed"
+    assert br.acquire(now + 0.2)
+    # a half-open probe that FAILS re-opens immediately
+    br2 = CircuitBreaker(failures=2, cooldown_s=0.1)
+    br2.on_failure(now)
+    br2.on_failure(now)
+    assert br2.acquire(now + 0.2)
+    # a failed probe re-opens (and re-announces: a fresh
+    # breaker_open event is correct — the backend is still down)
+    assert br2.on_failure(now + 0.2)
+    assert br2.state == "open"
+    assert not br2.acquire(now + 0.25)              # cooldown restarts
+    # a CANCELLED probe (hedged loser) releases the slot, no verdict
+    br3 = CircuitBreaker(failures=1, cooldown_s=0.1)
+    br3.on_failure(now)
+    assert br3.acquire(now + 0.2)
+    br3.on_cancel()
+    assert br3.state == "half_open"
+    assert br3.acquire(now + 0.2)                   # slot free again
+
+
+def test_parse_backends_spec():
+    table = parse_backends_spec(
+        "http://a:1, m2=http://b:2+http://c:3,m3=http://d:4")
+    assert table == {"default": ["http://a:1"],
+                     "m2": ["http://b:2", "http://c:3"],
+                     "m3": ["http://d:4"]}
+    with pytest.raises(ValueError):
+        parse_backends_spec("m2=notaurl")
+
+
+# ----------------------------------------------------------------------
+# engine: retries / budget / hedging / breaker through fake backends
+# ----------------------------------------------------------------------
+def test_roundtrip_and_body_passthrough():
+    be = FakeBackend()
+    router = _router_over([be])
+    try:
+        res = router.route_request("default", _body(3), 3)
+        assert res.code == 200 and res.status == "ok"
+        out = json.loads(res.body)
+        assert out["predictions"] == [0.25] * 3
+        assert out["model_id"] == "fp0"
+        assert res.headers["X-Ltpu-Router-Attempts"] == "1"
+    finally:
+        router.stop()
+        be.close()
+
+
+def test_retry_masks_transient_failure():
+    be1, be2 = FakeBackend(), FakeBackend()
+    router = _router_over([be1, be2])
+    try:
+        # first forwarded attempt dies; the retry must answer 200
+        faults.configure("router.backend:error@1")
+        res = router.route_request("default", _body(2), 2)
+        assert res.code == 200 and res.status == "ok"
+        assert res.attempts == 2 and res.retries == 1
+    finally:
+        router.stop()
+        be1.close()
+        be2.close()
+
+
+def test_retry_honors_remaining_timeout_budget():
+    be = FakeBackend()
+    router = _router_over([be], timeout_ms=400.0, max_retries=50,
+                          backoff_base_ms=60.0, backoff_max_ms=120.0)
+    try:
+        faults.configure("router.backend:error@*")
+        t0 = time.monotonic()
+        res = router.route_request("default", _body(2), 2)
+        wall = time.monotonic() - t0
+        # 502 retries-exhausted, 503 breaker-opened-everything, or
+        # 504 budget gone — never a hang, never a 200 from nowhere
+        assert res.code in (502, 503, 504)
+        assert res.status in ("upstream", "no_backend", "timeout")
+        # the budget is a HARD ceiling: backoff sleeps clamp to the
+        # remainder, so 50 nominal retries cannot overrun it
+        assert wall < 1.0, f"budget overrun: {wall:.2f}s"
+    finally:
+        router.stop()
+        be.close()
+
+
+def test_breaker_opens_and_half_open_probe_single_flight_e2e():
+    slow_probe = FakeBackend(delay_ms=250.0)
+    healthy = FakeBackend()
+    rec = RunRecorder(None, keep_records=True)
+    router = Router(_cfg(breaker_failures=1, breaker_cooldown_s=0.2,
+                         max_retries=3),
+                    recorder=rec)
+    router.add_model("default", urls=[slow_probe.url, healthy.url])
+    router.start()
+    try:
+        slow_probe.fail = True
+        # drive until the failing backend's breaker opens; clients
+        # still see 200 via the retry to the healthy backend
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            res = router.route_request("default", _body(1), 1)
+            assert res.code == 200
+            st = router.stats()["backends"][slow_probe.url]["breaker"]
+            if st == "open":
+                break
+        assert router.stats()["backends"][slow_probe.url]["breaker"] \
+            == "open"
+        assert any(r.get("event") == "breaker_open"
+                   for r in rec.records if r.get("type") == "router")
+        # recover the backend, wait out the cooldown, then burst:
+        # during the slow probe's 250 ms in flight every other request
+        # must ride the healthy backend — the probe is single-flight
+        slow_probe.fail = False
+        time.sleep(0.25)
+        base_hits = slow_probe.predict_hits
+        results = []
+
+        def one():
+            results.append(router.route_request("default", _body(1), 1))
+        threads = [threading.Thread(target=one) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(r.code == 200 for r in results)
+        assert slow_probe.predict_hits - base_hits <= 1, \
+            "half-open probe must be single-flight"
+        # the probe's success closes the circuit
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                router.stats()["backends"][slow_probe.url]["breaker"] \
+                != "closed":
+            time.sleep(0.05)
+        assert router.stats()["backends"][slow_probe.url]["breaker"] \
+            == "closed"
+    finally:
+        router.stop()
+        rec.close()
+        slow_probe.close()
+        healthy.close()
+
+
+def test_hedged_loser_cancelled_and_never_double_counts():
+    slow = FakeBackend(delay_ms=600.0)
+    fast = FakeBackend(queue_rows=50)      # dispreferred on first pick
+    rec = RunRecorder(None, keep_records=True)
+    router = Router(_cfg(hedge_ms=60.0), recorder=rec)
+    router.add_model("default", urls=[slow.url, fast.url])
+    router.start()
+    try:
+        from lightgbm_tpu.obs import metrics as obs_metrics
+        reg = obs_metrics.get_registry()
+        req_counter = reg.counter("ltpu_router_requests_total",
+                                  labelnames=("status",))
+        base_ok = req_counter.value(status="ok")
+        lat_hist = reg.histogram("ltpu_router_latency_ms")
+        base_lat = lat_hist.count()
+        t0 = time.monotonic()
+        res = router.route_request("default", _body(2), 2)
+        wall_ms = (time.monotonic() - t0) * 1e3
+        assert res.code == 200 and res.status == "ok"
+        assert res.hedged and res.hedge_won
+        assert res.backend == fast.url
+        # the hedge bounded the latency well under the slow backend
+        assert wall_ms < 500.0, wall_ms
+        st = router.stats()
+        assert st["requests"] == {"ok": 1}
+        assert st["hedges"] == 1 and st["hedge_wins"] == 1
+        # metrics: ONE request, ONE latency sample — the cancelled
+        # loser contributes only an attempts{result=cancelled}
+        assert req_counter.value(status="ok") - base_ok == 1
+        assert lat_hist.count() - base_lat == 1
+        recs = [r for r in rec.records if r.get("type") == "router"
+                and r.get("event") == "request"]
+        assert len(recs) == 1
+        assert recs[0]["hedged"] and recs[0]["hedge_won"]
+        # the loser must be cancelled (not a breaker failure): wait
+        # for its thread to finish its 600 ms sleep and check state
+        att_counter = reg.counter("ltpu_router_attempts_total",
+                                  labelnames=("result",))
+        deadline = time.monotonic() + 5
+        base = att_counter.value(result="cancelled")
+        while time.monotonic() < deadline and \
+                att_counter.value(result="cancelled") == base and \
+                base == 0:
+            time.sleep(0.05)
+        assert router.stats()["backends"][slow.url]["breaker"] \
+            == "closed"
+        # records lint clean against the schema
+        for r in rec.records:
+            assert not validate_record(r), validate_record(r)
+    finally:
+        router.stop()
+        rec.close()
+        slow.close()
+        fast.close()
+
+
+def test_tenancy_status_mapping_404_429_503():
+    be = FakeBackend(models={"a": "fpa", "default": "fp0"})
+    rec = RunRecorder(None, keep_records=True)
+    router = Router(_cfg(), recorder=rec)
+    router.add_model("a", urls=[be.url])
+    # a tiny budget for "b" over the same backend: sheds immediately
+    router.add_model("b", urls=[be.url], replica_model="a",
+                     rows_per_s=0.001, burst_rows=1)
+    # "c" routes to a dead port: no routable backend
+    router.add_model("c", urls=["http://127.0.0.1:9"],
+                     replica_model="a")
+    router.start()
+    try:
+        # 404: not in the routing table at all
+        res = router.route_request("nope", _body(1), 1)
+        assert res.code == 404 and res.status == "unknown_model"
+        assert json.loads(res.body)["code"] == "unknown_model"
+        # 200: the named tenant routes
+        assert router.route_request("a", _body(1), 1).code == 200
+        # 429: admission budget exhausted BEFORE any backend touch.
+        # The first request spends the (tiny) burst — oversize
+        # requests charge at most the burst, never shed forever —
+        # and the second sheds
+        assert router.route_request("b", _body(5), 5).code == 200
+        hits = be.predict_hits
+        res = router.route_request("b", _body(5), 5)
+        assert res.code == 429 and res.status == "shed"
+        body = json.loads(res.body)
+        assert body["code"] == "backpressure"
+        assert body["retry_after_ms"] > 0
+        assert "Retry-After" in res.headers
+        assert be.predict_hits == hits, \
+            "shed request must never reach a backend"
+        # 503: table knows the model but no backend is routable
+        res = router.route_request("c", _body(1), 1)
+        assert res.code == 503 and res.status == "no_backend"
+        assert res.headers.get("Retry-After")
+        # the router.admit fault point forces the shed path too
+        faults.configure("router.admit:shed@*")
+        res = router.route_request("a", _body(1), 1)
+        assert res.code == 429
+        for r in rec.records:
+            assert not validate_record(r), validate_record(r)
+    finally:
+        router.stop()
+        rec.close()
+        be.close()
+
+
+def test_backend_backpressure_passes_through_structured():
+    """A fleet whose replicas ALL answer 429: the router retries,
+    then passes the backpressure through structured (Retry-After
+    preserved) as status 'backpressure' — NOT the router's own
+    budget 'shed', so the shed-rate anomaly stays silent."""
+    b1, b2 = FakeBackend(shed=True), FakeBackend(shed=True)
+    router = _router_over([b1, b2], max_retries=1)
+    try:
+        res = router.route_request("default", _body(2), 2)
+        assert res.code == 429 and res.status == "backpressure"
+        body = json.loads(res.body)
+        assert body["code"] == "backpressure"
+        assert body["retry_after_ms"] >= 1.0
+        assert res.headers.get("Retry-After") == "2"
+        st = router.stats()
+        assert st["requests"] == {"backpressure": 1}
+        # backend admission control never feeds the breaker
+        assert all(b["breaker"] == "closed"
+                   for b in st["backends"].values())
+    finally:
+        router.stop()
+        b1.close()
+        b2.close()
+
+
+def test_failed_first_swap_does_not_create_tenant():
+    from lightgbm_tpu.serve import (ServeConfig, Server,
+                                    UnknownModel)
+    b1, X = _train_small(3, seed=1)
+    srv = Server(b1, config=ServeConfig(port=0, batch_wait_ms=0.5,
+                                        timeout_ms=30000)).start()
+    try:
+        with pytest.raises(Exception):
+            srv.swap(model_str="not a model", model="ghost")
+        # the failed first publish must not leave an empty tenant:
+        # the request path still answers unknown_model (404), not a
+        # 'no model published' 500, and /healthz stays clean
+        assert "ghost" not in srv.models()
+        with pytest.raises(UnknownModel):
+            srv.submit(X[:2], model="ghost")
+        # a later SUCCESSFUL swap to the same name works
+        srv.swap(booster=b1, model="ghost")
+        assert srv.models()["ghost"] is not None
+        srv.predict(X[:2], model="ghost")
+    finally:
+        srv.stop()
+
+
+def test_inflight_cap_sheds_low_priority_first():
+    be = FakeBackend(delay_ms=300.0)
+    router = _router_over([be], max_inflight=1, timeout_ms=3000.0)
+    try:
+        codes = {}
+        lock = threading.Lock()
+
+        def fire(priority, key):
+            res = router.route_request("default", _body(1), 1,
+                                       priority=priority)
+            with lock:
+                codes[key] = res.code
+        t1 = threading.Thread(target=fire, args=(0, "first"))
+        t1.start()
+        time.sleep(0.1)                    # first occupies the cap
+        # low priority sheds at the cap; priority > 0 overdraws
+        res_low = router.route_request("default", _body(1), 1)
+        assert res_low.code == 429
+        t2 = threading.Thread(target=fire, args=(1, "prio"))
+        t2.start()
+        t1.join()
+        t2.join()
+        assert codes == {"first": 200, "prio": 200}
+    finally:
+        router.stop()
+        be.close()
+
+
+def test_draining_and_stale_backends_leave_rotation():
+    good = FakeBackend(model_id="fpX")
+    drainer = FakeBackend(model_id="fpX", draining=True)
+    router = _router_over([good, drainer])
+    try:
+        time.sleep(0.2)
+        for _ in range(6):
+            res = router.route_request("default", _body(1), 1)
+            assert res.code == 200
+        assert drainer.predict_hits == 0, \
+            "draining backend must never be routed to"
+    finally:
+        router.stop()
+        good.close()
+        drainer.close()
+
+
+# ----------------------------------------------------------------------
+# HTTP front
+# ----------------------------------------------------------------------
+def test_http_front_roundtrip_and_structured_errors():
+    be = FakeBackend()
+    router = _router_over([be])
+    httpd, _ = route_http(router, port=0, background=True)
+    url = "http://127.0.0.1:%d" % httpd.server_address[1]
+
+    def post(path, data, timeout=20):
+        req = urllib.request.Request(
+            url + path, data=data,
+            headers={"Content-Type": "application/json"})
+        try:
+            r = urllib.request.urlopen(req, timeout=timeout)
+            return r.status, json.loads(r.read()), dict(r.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read()), dict(e.headers)
+    try:
+        st, out, hdrs = post("/predict", _body(3))
+        assert st == 200 and out["predictions"] == [0.25] * 3
+        assert hdrs.get("X-Ltpu-Router-Attempts") == "1"
+        assert hdrs.get("X-Ltpu-Router-Backend") == be.url
+        st, out, _ = post("/predict", b'{"nope": 1}')
+        assert st == 400 and out["code"] == "bad_rows"
+        st, out, _ = post("/v1/ghost/predict", _body(1))
+        assert st == 404 and out["code"] == "unknown_model"
+        with urllib.request.urlopen(url + "/healthz", timeout=10) as r:
+            h = json.loads(r.read())
+        assert h["ok"] and h["role"] == "router"
+        with urllib.request.urlopen(url + "/stats", timeout=10) as r:
+            s = json.loads(r.read())
+        assert s["requests"].get("ok") == 1
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "ltpu_router_requests_total" in text
+        from lightgbm_tpu.obs import metrics as obs_metrics
+        obs_metrics.parse_text(text)       # must be valid Prometheus
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        router.stop()
+        be.close()
+
+
+# ----------------------------------------------------------------------
+# fleet integration: endpoints() hygiene (the satellite fix)
+# ----------------------------------------------------------------------
+def _train_small(rounds=3, seed=0):
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(0)
+    X = rng.randn(600, 6)
+    y = (X[:, 0] > 0).astype(float)
+    d = lgb.Dataset(X, label=y, params={"objective": "binary",
+                                        "verbose": -1})
+    return lgb.train({"objective": "binary", "num_leaves": 7,
+                      "verbose": -1, "metric": "None", "seed": seed},
+                     d, num_boost_round=rounds), X
+
+
+def test_fleet_endpoints_exclude_stale_and_draining():
+    from lightgbm_tpu.serve import (FleetConfig, FleetSupervisor,
+                                    InprocReplica, ServeConfig,
+                                    model_fingerprint)
+    b1, X = _train_small(3, seed=1)
+    b2, _ = _train_small(5, seed=2)
+    cfg = FleetConfig(replicas=2, probe_interval_s=0.1,
+                      probe_timeout_s=3.0)
+    sup = FleetSupervisor(
+        lambda i: InprocReplica(b1, config=ServeConfig(
+            port=0, batch_wait_ms=0.5, timeout_ms=30000)), cfg)
+    sup.start(wait_healthy_s=30)
+    try:
+        assert len(sup.endpoints()) == 2
+        text2 = b2.model_to_string(num_iteration=-1)
+        fp2 = model_fingerprint(text2)
+        # simulate the publish lag window: desired is set but no
+        # replica has swapped yet — endpoints() must go EMPTY (stale
+        # fingerprints), then converge once the monitor reconciles
+        with sup._lock:
+            sup._desired["default"] = (fp2, text2)
+        assert sup.endpoints() == [], \
+            "stale-fingerprint replicas must leave the rotation"
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and \
+                len(sup.endpoints()) < 2:
+            time.sleep(0.05)
+        assert len(sup.endpoints()) == 2
+        assert sup.desired_fingerprint() == fp2
+        assert set(sup.active_models().values()) == {fp2}
+        # a replica whose last probe reported draining leaves too
+        sup._slots[0].draining = True
+        assert len(sup.endpoints()) == 1
+    finally:
+        sup.stop()
+
+
+def test_fleet_multi_model_publish_and_reconcile():
+    from lightgbm_tpu.serve import (FleetConfig, FleetSupervisor,
+                                    InprocReplica, ServeConfig,
+                                    model_fingerprint)
+    b1, X = _train_small(3, seed=1)
+    b2, _ = _train_small(4, seed=3)
+    cfg = FleetConfig(replicas=2, probe_interval_s=0.1,
+                      probe_timeout_s=3.0)
+    sup = FleetSupervisor(
+        lambda i: InprocReplica(b1, config=ServeConfig(
+            port=0, batch_wait_ms=0.5, timeout_ms=30000)), cfg)
+    sup.start(wait_healthy_s=30)
+    try:
+        text2 = b2.model_to_string(num_iteration=-1)
+        fp2 = sup.publish_model(text2, model="m2")
+        assert fp2 == model_fingerprint(text2)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and (
+                set(sup.active_models("m2").values()) != {fp2} or
+                len(sup.endpoints()) < 2):
+            time.sleep(0.05)
+        assert set(sup.active_models("m2").values()) == {fp2}
+        # both tenants current -> both replicas routable
+        assert len(sup.endpoints()) == 2
+        # the default tenant kept its original model
+        url = sup.endpoints()[0]
+        req = urllib.request.Request(
+            url + "/v1/m2/predict",
+            data=json.dumps({"rows": X[:2].tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+        assert out["model_id"] == fp2
+    finally:
+        sup.stop()

@@ -1,0 +1,367 @@
+"""Router chaos e2e: the routing front under replica SIGKILL, injected
+backend brownout, hedging races, budget exhaustion and a mid-run
+multi-model publish (``serve/router.py``, ``docs/Routing.md``).
+
+    python tools/chaos_router.py --workdir router_work \\
+        --telemetry router_telemetry.jsonl --out router_chaos.json
+
+A 2-replica PROCESS fleet (``serve/fleet.py``) runs under an
+in-process :class:`Router` while concurrent mixed-model clients
+hammer it.  The run exits non-zero unless:
+
+- ZERO dropped responses reach clients (any non-200/429 through the
+  router is a drop — masking failures is the router's whole job) and
+  ZERO mixed-fingerprint responses (every 200 is checked against the
+  per-fingerprint prediction oracle);
+- a replica SIGKILL mid-traffic is invisible (retry/failover);
+- an injected backend brownout (``router.backend:sleep_*`` on
+  scattered attempt ordinals) is hedged around — hedge wins > 0;
+- a tightened admission budget sheds with STRUCTURED 429s (JSON
+  ``code=backpressure`` + ``retry_after_ms`` + ``Retry-After``
+  header) and never touches a backend;
+- a mid-run multi-model publish (tenant ``m2``) and a mid-run default
+  deploy both converge with zero dropped/mixed responses;
+- a traced request forms ONE joinable client -> router -> replica
+  trace across OS processes (``tools/trace_view.py``
+  ``--lint-route-continuity``).
+"""
+import argparse
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _post(url, path, obj, timeout=60, headers=None):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(url + path,
+                                 data=json.dumps(obj).encode(),
+                                 headers=hdrs)
+    try:
+        r = urllib.request.urlopen(req, timeout=timeout)
+        return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read()), dict(e.headers)
+        except ValueError:
+            return e.code, {"error": "unparseable body"}, {}
+    except (urllib.error.URLError, OSError) as e:
+        return 599, {"error": f"transport: {e}"}, {}
+
+
+def _wait_until(cond, timeout_s, desc, poll=0.1):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(poll)
+    print(f"router chaos: TIMEOUT waiting for {desc}", flush=True)
+    return None
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--workdir", default="router_work")
+    ap.add_argument("--telemetry", default="router_telemetry.jsonl")
+    ap.add_argument("--threads", type=int, default=4)
+    ap.add_argument("--out", help="summary JSON path")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import spans as _spans
+    from lightgbm_tpu.serve import (FleetConfig, FleetSupervisor,
+                                    ProcessReplica, Router,
+                                    RouterConfig, model_fingerprint)
+    from lightgbm_tpu.serve.router import route_http
+    from lightgbm_tpu.utils import faults
+    from lightgbm_tpu.utils.telemetry import RunRecorder
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    work = os.path.abspath(args.workdir)
+    shutil.rmtree(work, ignore_errors=True)
+    os.makedirs(work)
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(2000, 8)
+    y = (X[:, 0] + 0.4 * rng.randn(2000) > 0).astype(float)
+
+    def train(rounds, seed):
+        d = lgb.Dataset(X, label=y, params={"objective": "binary",
+                                            "verbose": -1})
+        return lgb.train({"objective": "binary", "num_leaves": 15,
+                          "verbose": -1, "metric": "None",
+                          "seed": seed}, d, num_boost_round=rounds)
+
+    print("router chaos: training model set", flush=True)
+    bA1, bA2, bB = train(4, 1), train(7, 2), train(5, 3)
+    mA1 = os.path.join(work, "model_a1.txt")
+    bA1.save_model(mA1)
+
+    # per-fingerprint oracle, keyed the way replicas key /predict's
+    # model_id: fingerprint of the LOADED booster's model text
+    def fp_preds(bst):
+        text = bst.model_to_string(num_iteration=-1)
+        loaded = lgb.Booster(model_str=text)
+        return (model_fingerprint(
+            loaded.model_to_string(num_iteration=-1)),
+            loaded.predict(X), text)
+
+    fpA1, predsA1, textA1 = fp_preds(bA1)
+    fpA2, predsA2, textA2 = fp_preds(bA2)
+    fpB, predsB, textB = fp_preds(bB)
+    oracle = {fpA1: predsA1, fpA2: predsA2, fpB: predsB}
+    print(f"router chaos: fingerprints a1={fpA1} a2={fpA2} b={fpB}",
+          flush=True)
+
+    recorder = RunRecorder(args.telemetry or None,
+                           run_info={"task": "router_chaos"},
+                           keep_records=True)
+    fcfg = FleetConfig(replicas=2, probe_interval_s=0.2,
+                       probe_timeout_s=5.0, fail_threshold=3,
+                       backoff_base_s=0.2, backoff_max_s=2.0,
+                       circuit_failures=10)
+
+    def factory(i):
+        return ProcessReplica(
+            mA1, work, slot=i,
+            params={"serve_drain_grace_s": "5",
+                    "serve_batch_wait_ms": "1",
+                    "serve_timeout_ms": "30000",
+                    "telemetry_file": os.path.join(
+                        work, f"replica_{i}_telemetry.jsonl")},
+            env={"PYTHONPATH": repo + os.pathsep +
+                 os.environ.get("PYTHONPATH", "")})
+
+    checks = {}
+    counts = {"ok": 0, "ok_m2": 0, "backpressure": 0, "dropped": 0,
+              "mixed_fingerprint": 0, "shed_structured": 0,
+              "shed_unstructured": 0}
+    lock = threading.Lock()
+    stop = threading.Event()
+    m2_live = threading.Event()
+    errors = []
+
+    sup = FleetSupervisor(factory, fcfg, recorder)
+    print("router chaos: starting 2 process replicas", flush=True)
+    sup.start(wait_healthy_s=180)
+    checks["fleet_started"] = len(sup.endpoints()) == 2
+
+    rcfg = RouterConfig(port=0, probe_interval_s=0.15,
+                        probe_timeout_s=5.0, timeout_ms=30000.0,
+                        max_retries=4, hedge_ms=75.0,
+                        breaker_failures=4, breaker_cooldown_s=1.0)
+    router = Router(rcfg, recorder=recorder)
+    router.add_model("default", supervisor=sup)
+    router.add_model("m2", supervisor=sup, replica_model="m2")
+    httpd, _ = route_http(router, port=0, background=True)
+    url = "http://127.0.0.1:%d" % httpd.server_address[1]
+    print(f"router chaos: router at {url}", flush=True)
+
+    def check_response(st, out, hdrs, lo, n, kind):
+        """Count one client-visible response; the oracle check is the
+        zero-mixed-fingerprint acceptance gate."""
+        if st == 200:
+            mid = out.get("model_id")
+            exp = oracle.get(mid)
+            got = np.asarray(out.get("predictions", ()))
+            if exp is None or got.shape != (n,) or \
+                    not np.allclose(got, exp[lo:lo + n],
+                                    rtol=1e-9, atol=1e-9):
+                with lock:
+                    counts["mixed_fingerprint"] += 1
+                    errors.append(f"{kind}: model_id {mid} does not "
+                                  f"match its predictions "
+                                  f"(rows {lo}:{lo + n})")
+            else:
+                with lock:
+                    counts["ok_m2" if kind == "m2" else "ok"] += 1
+            return
+        if st == 429:
+            with lock:
+                counts["backpressure"] += 1
+                if out.get("code") == "backpressure" and \
+                        out.get("retry_after_ms") is not None and \
+                        hdrs.get("Retry-After"):
+                    counts["shed_structured"] += 1
+                else:
+                    counts["shed_unstructured"] += 1
+                    errors.append(f"unstructured 429: {out} {hdrs}")
+            time.sleep(max(float(out.get("retry_after_ms", 20.0)),
+                           5.0) / 1e3)
+            return
+        with lock:
+            counts["dropped"] += 1
+            errors.append(f"{kind}: HTTP {st} reached the client: "
+                          f"{str(out.get('error', ''))[:120]}")
+
+    def client(tid):
+        r = np.random.RandomState(1000 + tid)
+        while not stop.is_set():
+            lo = int(r.randint(0, len(X) - 64))
+            n = int(r.randint(1, 48))
+            body = {"rows": X[lo:lo + n].tolist()}
+            if m2_live.is_set() and r.random_sample() < 0.35:
+                st, out, hdrs = _post(url, "/v1/m2/predict", body,
+                                      timeout=60)
+                check_response(st, out, hdrs, lo, n, "m2")
+            else:
+                st, out, hdrs = _post(url, "/predict", body,
+                                      timeout=60)
+                check_response(st, out, hdrs, lo, n, "default")
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(args.threads)]
+    for t in threads:
+        t.start()
+
+    def ok_total():
+        with lock:
+            return counts["ok"] + counts["ok_m2"]
+
+    try:
+        # phase 0: steady traffic through the router
+        checks["warm_traffic"] = bool(
+            _wait_until(lambda: ok_total() >= 50, 120,
+                        "50 ok responses through the router"))
+
+        # phase 1: SIGKILL replica 0 — the router must mask it
+        print("router chaos: phase 1 — SIGKILL replica 0", flush=True)
+        base = ok_total()
+        sup.handle(0).kill()
+        checks["traffic_through_kill"] = bool(
+            _wait_until(lambda: ok_total() >= base + 40, 120,
+                        "traffic while a replica is dead"))
+        checks["replica_restarted"] = bool(
+            _wait_until(lambda: len(sup.endpoints()) == 2, 120,
+                        "replica restart"))
+
+        # phase 2: mid-run MULTI-MODEL publish: tenant m2 goes live on
+        # the same fleet while default traffic flows
+        print("router chaos: phase 2 — publish tenant m2", flush=True)
+        st, out, _ = _post(url, "/v1/m2/predict",
+                           {"rows": X[:2].tolist()})
+        checks["m2_503_before_publish"] = st == 503 and \
+            out.get("code") == "no_backend"
+        sup.publish_model(textB, model="m2")
+        checks["m2_published"] = bool(_wait_until(
+            lambda: set(sup.active_models("m2").values()) == {fpB} and
+            len(sup.endpoints()) == 2, 120, "m2 on both replicas"))
+        m2_live.set()
+        base_m2 = counts["ok_m2"]
+        checks["m2_traffic"] = bool(
+            _wait_until(lambda: counts["ok_m2"] >= base_m2 + 25, 120,
+                        "mixed-model traffic"))
+
+        # phase 3: injected backend brownout on scattered attempt
+        # ordinals (router.backend:sleep_*) — the hedge must win races
+        # against the slowed attempts, keeping the tail bounded
+        print("router chaos: phase 3 — brownout + hedging race",
+              flush=True)
+        st0 = router.stats()
+        n0 = faults.hits("router.backend")
+        spec = ",".join(f"router.backend:sleep_400@{k}"
+                        for k in range(n0 + 1, n0 + 121, 3))
+        faults.configure(spec)
+        base = ok_total()
+        _wait_until(lambda: ok_total() >= base + 80, 180,
+                    "traffic through the brownout")
+        faults.configure("")
+        st1 = router.stats()
+        checks["hedges_fired"] = \
+            st1["hedges"] - st0["hedges"] > 0
+        checks["hedge_wins"] = \
+            st1["hedge_wins"] - st0["hedge_wins"] > 0
+        print(f"router chaos: hedges {st1['hedges'] - st0['hedges']}, "
+              f"wins {st1['hedge_wins'] - st0['hedge_wins']}",
+              flush=True)
+
+        # phase 4: budget exhaustion — tighten m2's token bucket; the
+        # flood must shed with structured 429s, never touch a backend
+        print("router chaos: phase 4 — budget exhaustion", flush=True)
+        route = router.model_route("m2")
+        route.bucket.set_rate(1.0, burst_rows=8)
+        base_shed = counts["shed_structured"]
+        checks["budget_sheds"] = bool(_wait_until(
+            lambda: counts["shed_structured"] >= base_shed + 10, 120,
+            "structured 429 sheds"))
+        route.bucket.set_rate(0.0)
+        checks["sheds_all_structured"] = \
+            counts["shed_unstructured"] == 0
+
+        # phase 5: mid-run DEFAULT deploy under load — the router must
+        # never route to a stale-fingerprint replica (oracle covers
+        # both models, so any stale response counts as mixed)
+        print("router chaos: phase 5 — deploy a2 under load",
+              flush=True)
+        sup.publish_model(textA2, model="default")
+        checks["a2_converged"] = bool(_wait_until(
+            lambda: set(sup.active_models().values()) == {fpA2} and
+            len(sup.endpoints()) == 2, 120, "fleet on a2"))
+        base = ok_total()
+        checks["traffic_after_deploy"] = bool(
+            _wait_until(lambda: ok_total() >= base + 40, 120,
+                        "post-deploy traffic"))
+
+        # phase 6: one TRACED request — client span -> X-Ltpu-Trace ->
+        # router record -> replica serve record, one joinable trace
+        print("router chaos: phase 6 — trace continuity", flush=True)
+        with _spans.span("client_request", recorder=recorder,
+                         root=True):
+            st, out, _ = _post(url, "/predict",
+                               {"rows": X[:3].tolist()},
+                               headers=_spans.http_headers())
+        checks["traced_request_ok"] = st == 200
+        time.sleep(1.0)                    # let replica JSONL flush
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        httpd.shutdown()
+        httpd.server_close()
+        router.stop()
+        sup.stop()
+        recorder.close()
+
+    # trace continuity lint across the three processes' JSONL files
+    from trace_view import lint_route_continuity, load_records
+    files = [args.telemetry] + [
+        os.path.join(work, f"replica_{i}_telemetry.jsonl")
+        for i in range(2)
+        if os.path.exists(os.path.join(work,
+                                       f"replica_{i}_telemetry.jsonl"))]
+    lint_errs = lint_route_continuity(load_records(files),
+                                      require_processes=2)
+    checks["route_trace_continuity"] = not lint_errs
+    for e in lint_errs:
+        errors.append(f"trace lint: {e}")
+
+    checks["zero_dropped"] = counts["dropped"] == 0
+    checks["zero_mixed_fingerprint"] = counts["mixed_fingerprint"] == 0
+    res = {
+        "mode": "router_chaos",
+        "counts": counts,
+        "checks": checks,
+        "errors": errors[:10],
+        "passed": all(checks.values()),
+    }
+    print(json.dumps(res), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(res, f, indent=1, sort_keys=True)
+    return 0 if res["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -245,6 +245,143 @@ def selftest(args):
     return res, 0 if ok else 1
 
 
+def router_selftest(args):
+    """CI smoke for the routing front: a 2-replica in-process fleet
+    under a Router, concurrent mixed-model clients (default + a
+    mid-run published tenant), and the metrics-scrape oracle — the
+    router's ``ltpu_router_requests_total`` counters must equal the
+    client-side counts bit-for-bit.  Exits nonzero on any dropped or
+    mixed-model response."""
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import metrics as obs_metrics
+    from lightgbm_tpu.serve import (FleetConfig, FleetSupervisor,
+                                    InprocReplica, Router,
+                                    RouterConfig, ServeConfig)
+    from lightgbm_tpu.serve.router import route_http
+    from lightgbm_tpu.utils.telemetry import RunRecorder
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(2000, 8)
+    y = (X[:, 0] + 0.4 * rng.randn(2000) > 0).astype(float)
+
+    def train(rounds, seed):
+        d = lgb.Dataset(X, label=y, params={"objective": "binary",
+                                            "verbose": -1})
+        return lgb.train({"objective": "binary", "num_leaves": 15,
+                          "verbose": -1, "metric": "None",
+                          "seed": seed}, d, num_boost_round=rounds)
+
+    b1, b2 = train(4, 1), train(6, 2)
+    exp1, exp2 = b1.predict(X), b2.predict(X)
+    recorder = RunRecorder(args.telemetry or None,
+                           run_info={"task": "router"},
+                           keep_records=True)
+    sup = FleetSupervisor(
+        lambda i: InprocReplica(b1, config=ServeConfig(
+            port=0, batch_wait_ms=1.0, timeout_ms=30000)),
+        FleetConfig(replicas=2, probe_interval_s=0.1,
+                    probe_timeout_s=5.0), recorder)
+    sup.start(wait_healthy_s=60)
+    router = Router(RouterConfig(port=0, probe_interval_s=0.1,
+                                 probe_timeout_s=5.0,
+                                 timeout_ms=30000.0, hedge_ms=100.0),
+                    recorder=recorder)
+    router.add_model("default", supervisor=sup)
+    router.add_model("m2", supervisor=sup, replica_model="m2")
+    httpd, _ = route_http(router, port=0, background=True)
+    url = "http://127.0.0.1:%d" % httpd.server_address[1]
+
+    lock = threading.Lock()
+    counts = {}
+    errors = []
+    swapped = threading.Event()
+
+    def bump(key):
+        with lock:
+            counts[key] = counts.get(key, 0) + 1
+
+    def client(tid):
+        r = np.random.RandomState(1000 + tid)
+        per_client = args.requests // max(args.threads, 1)
+        for i in range(per_client):
+            lo = int(r.randint(0, len(X) - 64))
+            n = int(r.randint(1, min(args.rows_max, 64) + 1))
+            body = {"rows": X[lo:lo + n].tolist()}
+            use_m2 = swapped.is_set() and r.random_sample() < 0.4
+            path = "/v1/m2/predict" if use_m2 else "/predict"
+            st, out = _post(url, path, body)
+            if st == 200:
+                exp = exp2 if use_m2 else exp1
+                got = np.asarray(out.get("predictions", ()))
+                if got.shape == (n,) and np.allclose(
+                        got, exp[lo:lo + n], rtol=1e-9, atol=1e-9):
+                    bump("ok")
+                else:
+                    bump("mixed")
+                    errors.append(f"{path}: response does not match "
+                                  f"the model's predictions")
+            elif st == 429:
+                bump("shed")
+                time.sleep(max(float(out.get("retry_after_ms", 10)),
+                               1.0) / 1e3)
+            else:
+                bump(f"http_{st}")
+                errors.append(f"{path}: HTTP {st}: "
+                              f"{str(out.get('error', ''))[:120]}")
+            if tid == 0 and i == per_client // 2 and \
+                    not swapped.is_set():
+                # mid-run multi-model publish: tenant m2 goes live
+                sup.publish_model(b2.model_to_string(), model="m2")
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline and \
+                        len(sup.endpoints()) < 2:
+                    time.sleep(0.05)
+                swapped.set()
+
+    try:
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(args.threads)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+        stats = router.stats()
+        # metrics-scrape oracle: the router's own counters must equal
+        # the client-observed counts bit-for-bit
+        text = _get_text(url, "/metrics")
+        parsed = obs_metrics.parse_text(text)
+        by_status = {dict(ls).get("status", ""): v
+                     for (name, ls), v in parsed.items()
+                     if name == "ltpu_router_requests_total"}
+        scrape_ok = by_status.get("ok", 0.0)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        router.stop()
+        sup.stop()
+        recorder.close()
+    res = {
+        "mode": "router",
+        "counts": counts,
+        "wall_s": round(wall, 3),
+        "req_per_s": round(counts.get("ok", 0) / max(wall, 1e-9), 1),
+        "router_stats": {k: stats[k] for k in
+                         ("requests", "hedges", "hedge_wins",
+                          "retries", "latency_ms")},
+        "metrics_ok_scrape": scrape_ok,
+        "errors": errors[:10],
+    }
+    ok = (not errors and counts.get("ok", 0) > 0
+          and scrape_ok == counts.get("ok", 0)
+          and swapped.is_set())
+    res["passed"] = ok
+    return res, 0 if ok else 1
+
+
 def _wait_until(cond, timeout_s, desc, poll=0.1):
     """Poll ``cond`` until truthy; returns its value or None on
     timeout (the caller records the failed check instead of raising —
@@ -574,6 +711,10 @@ def main(argv=None):
                     help="train + serve in-process (CI smoke)")
     ap.add_argument("--fleet", action="store_true",
                     help="supervised replica-fleet chaos e2e (CI)")
+    ap.add_argument("--router", action="store_true",
+                    help="routing-front smoke: in-process fleet under "
+                         "a Router, mixed-model clients, metrics "
+                         "oracle (CI)")
     ap.add_argument("--workdir", default="fleet_work",
                     help="--fleet: scratch directory (models, "
                          "checkpoints, replica logs)")
@@ -591,6 +732,8 @@ def main(argv=None):
 
     if args.fleet:
         res, rc = fleet_selftest(args)
+    elif args.router:
+        res, rc = router_selftest(args)
     elif args.selftest:
         res, rc = selftest(args)
     elif args.url:

@@ -214,6 +214,58 @@ def lint_publish_continuity(records: List[Dict[str, Any]],
     return errs
 
 
+def lint_route_continuity(records: List[Dict[str, Any]],
+                          require_processes: int = 0) -> List[str]:
+    """Problems (empty = pass): at least one routed request must form
+    ONE joinable trace across client -> router -> replica — a trace
+    containing a client-side root span, a ``router`` request record,
+    and a replica-side ``serve`` record.  Optionally require the
+    joined trace to span >= N OS processes (the router chaos e2e runs
+    the replicas as subprocesses)."""
+    errs: List[str] = []
+    by_trace = traces(records)
+    routed = [r for r in records if r.get("type") == "router" and
+              r.get("event") == "request" and r.get("trace_id")]
+    if not routed:
+        return ["no trace-tagged router request records found "
+                "(nothing to lint)"]
+    ok = 0
+    reasons: List[str] = []
+    for rec in routed:
+        tid = rec["trace_id"]
+        ent = by_trace.get(tid, {"spans": [], "events": []})
+        names = {s.get("name") for s in ent["spans"]}
+        has_serve = any(e.get("type") == "serve"
+                        for e in ent["events"])
+        pids = {s.get("pid") for s in ent["spans"]} | \
+               {e.get("pid") for e in ent["events"]}
+        pids.discard(None)
+        # non-span records (a replica's serve record) carry no pid —
+        # the file they landed in still identifies their process
+        files = {r.get("_file") for r in
+                 ent["spans"] + ent["events"]}
+        files.discard(None)
+        n_procs = max(len(pids), len(files))
+        if not names:
+            reasons.append(f"trace {tid}: no spans (client root "
+                           f"missing)")
+            continue
+        if not has_serve:
+            reasons.append(f"trace {tid}: no replica-side serve "
+                           f"record joined")
+            continue
+        if require_processes and n_procs < require_processes:
+            reasons.append(f"trace {tid}: spans {n_procs} "
+                           f"process(es), need >= {require_processes}")
+            continue
+        ok += 1
+    if not ok:
+        errs.append("no routed request forms a client -> router -> "
+                    "replica trace:")
+        errs.extend(reasons[:10])
+    return errs
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("files", nargs="+",
@@ -223,6 +275,9 @@ def main(argv=None) -> int:
     ap.add_argument("--lint-publish-continuity", action="store_true",
                     help="exit non-zero unless every fleet publish "
                          "joins a daemon-side trace root")
+    ap.add_argument("--lint-route-continuity", action="store_true",
+                    help="exit non-zero unless a routed request forms "
+                         "one client -> router -> replica trace")
     ap.add_argument("--require-processes", type=int, default=0,
                     help="with the lint: joined traces must span >= N "
                          "OS processes")
@@ -232,6 +287,20 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     records = load_records(args.files)
+    if args.lint_route_continuity:
+        errs = lint_route_continuity(
+            records, require_processes=args.require_processes)
+        if errs:
+            print(f"route-continuity lint: {len(errs)} problem(s):")
+            for e in errs:
+                print(f"  {e}")
+            return 1
+        n = len([r for r in records if r.get("type") == "router"
+                 and r.get("event") == "request"
+                 and r.get("trace_id")])
+        print(f"route-continuity lint OK: {n} traced routed "
+              f"request(s), client -> router -> replica joined")
+        return 0
     if args.lint_publish_continuity:
         errs = lint_publish_continuity(
             records, require_processes=args.require_processes,

@@ -238,6 +238,30 @@ def scan_anomalies(records):
             out.append(("MED", f"{len(errors)} watcher error(s); "
                                f"last: "
                                f"{str(errors[-1].get('error', '?'))[:140]}"))
+    routers = [r for r in records if r.get("type") == "router"]
+    if routers:
+        # rate-based router rules (hedge > 20% MED, budget-shed > 5%
+        # HIGH) come from the shared scanner's summary above; the
+        # breaker scan is offline-only rollup detail
+        opens = [r for r in routers if r.get("event") == "breaker_open"]
+        if opens:
+            out.append(("HIGH", f"router circuit breaker OPENED "
+                                f"{len(opens)} time(s); backends: "
+                                f"{sorted({r.get('backend', '?') for r in opens})}"
+                                f" — a backend failed repeatedly and "
+                                f"left the balancer rotation"))
+        upstream = [r for r in routers
+                    if r.get("event") == "request" and
+                    r.get("status") in ("upstream", "no_backend",
+                                        "timeout")]
+        reqs = [r for r in routers if r.get("event") == "request"]
+        if upstream and len(upstream) / max(len(reqs), 1) > 0.01:
+            out.append(("HIGH", f"router failed to mask "
+                                f"{len(upstream)}/{len(reqs)} "
+                                f"requests (upstream/no_backend/"
+                                f"timeout > 1%) — retries + hedging "
+                                f"ran out of healthy backends or "
+                                f"budget"))
     recov = [r for r in records if r.get("type") == "recovery"]
     if recov:
         remeshes = [r for r in recov if r.get("event") == "remesh"]
@@ -474,6 +498,18 @@ def triage(records, baseline=None):
                 f"restarts, "
                 f"{s.get('continual_nonfinite', 0):.0f} non-finite "
                 f"aborts, {s.get('continual_resumes', 0):.0f} resumes")
+        if s.get("router_requests"):
+            lines.append(
+                f"router      : {s['router_requests']:.0f} requests "
+                f"({s.get('router_rows', 0):.0f} rows), p50/p95/p99 "
+                f"{s.get('router_total_ms_p50', 0):.1f}/"
+                f"{s.get('router_total_ms_p95', 0):.1f}/"
+                f"{s.get('router_total_ms_p99', 0):.1f} ms, "
+                f"{s.get('router_retries', 0):.0f} retries, "
+                f"{s.get('router_hedges', 0):.0f} hedges "
+                f"({s.get('router_hedge_wins', 0):.0f} wins), "
+                f"{s.get('router_shed', 0):.0f} shed, "
+                f"{s.get('router_breaker_opens', 0):.0f} breaker-opens")
         if s.get("serve_requests"):
             lines.append(
                 f"serve       : {s['serve_requests']:.0f} requests "
