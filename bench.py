@@ -1918,6 +1918,138 @@ def main():
     return 0
 
 
+def ingest_only():
+    """Fast path (``python bench.py --ingest-only``): measure the
+    out-of-core streamed ingest's cost envelope on the CPU backend
+    and write BENCH_ingest_cpu.json — streamed bin-pass throughput,
+    cache write/load (verify) bandwidth, prefetch overlap fraction of
+    the double-buffered host->device upload, and streamed-vs-resident
+    train wall on the CPU smoke shape (docs/Streaming.md)."""
+    import datetime
+    import tempfile
+
+    if ensure_backend(variant="ingest") is None:
+        return 0
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.io.cache import chunk_grid
+    from lightgbm_tpu.io.stream import BlockFetcher
+    from lightgbm_tpu.utils import telemetry as _telemetry
+    _telemetry.install_jax_hooks()
+
+    n_rows = int(os.environ.get("BENCH_INGEST_ROWS", "120000"))
+    n_features = 28
+    rounds = int(os.environ.get("BENCH_INGEST_ROUNDS", "10"))
+    chunk = int(os.environ.get("BENCH_INGEST_CHUNK", "16000"))
+    rng = np.random.RandomState(0)
+    X = rng.randn(n_rows, n_features)
+    w = rng.randn(n_features)
+    y = (1.0 / (1.0 + np.exp(-(X @ w) * 0.5)) >
+         rng.random_sample(n_rows)).astype(np.float32)
+    raw_mb = X.nbytes / 1e6
+
+    base = {"objective": "binary", "num_leaves": 31, "verbose": -1,
+            "metric": "None", "num_iterations": rounds,
+            "fused_iters": 4}
+    cells = {}
+    with tempfile.TemporaryDirectory() as td:
+        stem = os.path.join(td, "raw")
+        np.save(stem + ".X.npy", X)
+        np.save(stem + ".y.npy", y)
+        cache = os.path.join(td, "cache")
+        p = dict(base, stream_ingest=True, stream_cache_dir=cache,
+                 stream_chunk_rows=chunk)
+
+        # -- bin pass (fresh ingest, mmap source -> sealed cache) ----
+        t0 = time.time()
+        d1 = lgb.Dataset(stem + ".X.npy", params=p)
+        d1.construct()
+        bin_wall = time.time() - t0
+        info = d1._constructed.stream
+        binned_mb = np.asarray(d1._constructed.binned).nbytes / 1e6
+        cells["bin_pass"] = {
+            "wall_s": round(bin_wall, 3),
+            "raw_mb": round(raw_mb, 2),
+            "raw_mb_per_s": round(raw_mb / max(bin_wall, 1e-9), 2),
+            "cache_write_mb": round(binned_mb, 2),
+            "cache_write_mb_per_s": round(
+                binned_mb / max(bin_wall, 1e-9), 2),
+            "chunks": len(chunk_grid(n_rows, info.chunk_rows)),
+        }
+
+        # -- cache load (sealed reopen + full sha256 verify) ---------
+        t0 = time.time()
+        d2 = lgb.Dataset(stem + ".X.npy", params=p)
+        d2.construct()
+        load_wall = time.time() - t0
+        assert d2._constructed.stream.from_cache
+        cells["cache_load"] = {
+            "wall_s": round(load_wall, 3),
+            "verify_mb_per_s": round(
+                binned_mb / max(load_wall, 1e-9), 2)}
+
+        # -- double-buffered upload: prefetch on vs off --------------
+        window = int(os.environ.get("BENCH_INGEST_WINDOW", "8000"))
+        binned = d2._constructed.binned
+        up = {}
+        for label, pf in (("prefetch_on", True), ("prefetch_off",
+                                                  False)):
+            f = BlockFetcher(binned, n_rows=n_rows,
+                             n_pad=n_rows + (-n_rows) % 8,
+                             out_cols=n_features, window_rows=window,
+                             prefetch=pf)
+            buf = f.upload()
+            buf.block_until_ready()
+            up[label] = f.stats()
+        cells["upload"] = {
+            "windows": up["prefetch_on"]["windows"],
+            "window_rows": window,
+            "bytes_mb": round(up["prefetch_on"]["bytes"] / 1e6, 2),
+            "on_ms": up["prefetch_on"]["duration_ms"],
+            "off_ms": up["prefetch_off"]["duration_ms"],
+            "overlap_s": up["prefetch_on"]["overlap_s"],
+            "overlap_fraction": round(
+                up["prefetch_on"]["overlap_s"] /
+                max(up["prefetch_on"]["prep_s"], 1e-9), 3)}
+
+        # -- streamed vs resident train wall -------------------------
+        t0 = time.time()
+        lgb.train(dict(p), d2, verbose_eval=False)
+        streamed_wall = time.time() - t0
+        d0 = lgb.Dataset(X, label=y, params=dict(base))
+        t0 = time.time()
+        lgb.train(dict(base), d0, verbose_eval=False)
+        resident_wall = time.time() - t0
+        cells["train"] = {
+            "rounds": rounds,
+            "streamed_wall_s": round(streamed_wall, 3),
+            "resident_wall_s": round(resident_wall, 3),
+            "streamed_over_resident": round(
+                streamed_wall / max(resident_wall, 1e-9), 3)}
+        print(json.dumps({"ingest_cells": cells}), flush=True)
+
+    out = {
+        "metric": "streamed_ingest_cpu",
+        "unit": "mixed",
+        "backend": "cpu",
+        "date": datetime.date.today().isoformat(),
+        "source": "JAX_PLATFORMS=cpu python bench.py --ingest-only",
+        "env": "2-core CPU container",
+        "forest": (f"31-leaf binary forest, {n_rows} x {n_features} "
+                   f"train matrix, {rounds} iterations, "
+                   f"{chunk}-row ingest chunks"),
+        "config": {"rows": n_rows, "features": n_features,
+                   "rounds": rounds, "chunk_rows": chunk},
+        "cells": cells,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_ingest_cpu.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    print(json.dumps({"wrote": os.path.basename(path)}), flush=True)
+    return 0
+
+
 if __name__ == "__main__":
     if "--serve-only" in sys.argv:
         sys.exit(serve_only())
@@ -1929,6 +2061,8 @@ if __name__ == "__main__":
         sys.exit(obs_only())
     if "--continual-only" in sys.argv:
         sys.exit(continual_only())
+    if "--ingest-only" in sys.argv:
+        sys.exit(ingest_only())
     if "--weakscale-only" in sys.argv:
         sys.exit(weakscale_only())
     sys.exit(main())

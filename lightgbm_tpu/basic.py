@@ -137,6 +137,48 @@ class Dataset:
                         for s in spec]
             self.categorical_feature = list(spec)
 
+        stream_ok = False
+        if getattr(cfg, "stream_ingest", False) and \
+                self.reference is None and self.used_indices is None:
+            if isinstance(self.data, (str, os.PathLike)):
+                # only the streamed loader's own formats: a directory
+                # of npz shards or an .X.npy mmap pair.  CSV/LibSVM/
+                # binary-dataset paths fall through to the normal
+                # loader rather than failing inside the stream path
+                path = str(self.data)
+                stem = path[:-len(".X.npy")] \
+                    if path.endswith(".X.npy") else path
+                stream_ok = os.path.isdir(path) or \
+                    os.path.exists(stem + ".X.npy")
+            else:
+                stream_ok = self.data is not None and \
+                    not hasattr(self.data, "tocsc")
+            if not stream_ok:
+                Log.warning("stream_ingest=true ignored: %r is not a "
+                            "streamable source (ndarray, <stem>.X.npy "
+                            "mmap pair, or npz shard directory); "
+                            "using the in-memory loader",
+                            type(self.data).__name__
+                            if not isinstance(self.data,
+                                              (str, os.PathLike))
+                            else str(self.data))
+        if stream_ok:
+            # out-of-core streamed ingest (docs/Streaming.md): the raw
+            # matrix is binned chunk-by-chunk into the crash-safe
+            # mmap cache and never fully materializes on the host;
+            # the trained model is byte-identical to this same data
+            # through the in-memory path.  Validation sets (reference
+            # is set) stay on the in-memory alignment path.
+            from .io import stream as stream_mod
+            self._constructed = stream_mod.ingest_dataset(
+                self.data, label=label, weight=weight, group=group,
+                init_score=self.init_score, config=cfg,
+                feature_name=self.feature_name,
+                categorical_feature=self.categorical_feature)
+            self.raw_mat = None
+            if self.feature_name == "auto":
+                self.feature_name = self._constructed.feature_names
+            return self
         if isinstance(self.data, (str, os.PathLike)):
             from .utils.file_io import is_remote, localize
             remote = is_remote(str(self.data))

@@ -185,6 +185,13 @@ class CheckpointManager:
                      else {"learner": "serial", "num_shards": 1,
                            "mesh_shape": [1]}),
         })
+        # streamed-ingest cache identity (io/stream.py): resume must
+        # find the SAME binned cache and reuse it — a restore that
+        # re-binned is a MED anomaly (docs/Streaming.md)
+        stream_id = g.stream_identity() \
+            if hasattr(g, "stream_identity") else None
+        if stream_id is not None:
+            meta["stream"] = stream_id
         # trace carrier (obs/spans.py): a watcher in ANOTHER process
         # re-enters this context, so the saving run's trace continues
         # through validate -> canary -> publish -> first served request
@@ -222,6 +229,8 @@ class CheckpointManager:
         manifest = {"schema": SCHEMA_VERSION, "iteration": iteration,
                     "reason": str(reason), "created": meta["created"],
                     "mesh": meta["mesh"], "blobs": blobs}
+        if "stream" in meta:
+            manifest["stream"] = meta["stream"]
         _fsync_write(os.path.join(staging, _MANIFEST),
                      json.dumps(manifest, sort_keys=True,
                                 indent=1).encode("utf-8"))
@@ -412,6 +421,48 @@ class CheckpointManager:
                              from_learner=ck_kind,
                              to_learner=cur["learner"],
                              iter=int(meta.get("iter", -1)))
+        ck_stream = meta.get("stream")
+        if ck_stream:
+            # the manifest attests this training data was ALREADY
+            # binned into a published cache: the restoring dataset
+            # must have reused it (same key, manifest-valid open) —
+            # a fresh re-bin here means the resume paid work the
+            # cache existed to prevent (MED anomaly, obs/rules.py)
+            cur = g.stream_identity() \
+                if hasattr(g, "stream_identity") else None
+            info = getattr(getattr(g, "train_set", None),
+                           "stream", None) if cur is not None else None
+            # a fresh ingest that ran BEFORE this checkpoint existed
+            # (same-process save->restore) wasted nothing; only a
+            # re-bin AFTER the manifest attested the cache counts
+            hit = bool(cur and
+                       cur.get("cache_key") == ck_stream.get("cache_key")
+                       and info is not None and info.rebinned == 0
+                       and (info.from_cache or info.mappers_reused or
+                            float(meta.get("created", 0.0)) >=
+                            getattr(info, "ingested_at", 0.0)))
+            if not hit:
+                Log.warning(
+                    "checkpoint records streamed-ingest cache %s but "
+                    "the resuming dataset %s — the resume re-binned "
+                    "data the cache should have served",
+                    str(ck_stream.get("cache_key", "?"))[:16],
+                    "re-ingested from scratch" if cur is None or
+                    info is None or not (info.from_cache or
+                                         info.mappers_reused)
+                    else "re-binned chunks" if info.rebinned
+                    else "is keyed to different data/config")
+            _telemetry.counters.incr("ingest_resumes")
+            rec = self.recorder or _telemetry.get_recorder() or \
+                getattr(g, "_telemetry", None)
+            if rec is not None:
+                rec.emit("ingest", event="resume", cache_hit=hit,
+                         expected_key=str(
+                             ck_stream.get("cache_key", ""))[:16],
+                         actual_key=str((cur or {}).get(
+                             "cache_key", ""))[:16],
+                         rebinned=int(getattr(info, "rebinned", 0)
+                                      if info is not None else 0))
         raw = None
         if booster.train_set is not None:
             raw = booster.train_set.raw_mat

@@ -230,6 +230,60 @@ PARAMS: List[Param] = [
     _p("two_round", False, bool,
        ("two_round_loading", "use_two_round_loading"),
        "two-round data loading (low memory)", group="io"),
+    # ---- out-of-core streaming ingest (io/stream.py, io/cache.py,
+    # docs/Streaming.md) ----
+    _p("stream_ingest", False, bool, ("stream", "out_of_core"),
+       "out-of-core streamed ingest (docs/Streaming.md): the raw "
+       "matrix is read chunk-by-chunk (ndarray, <stem>.X.npy mmap "
+       "pair, or a directory of npz shards), bin mappers are fit once "
+       "from a single streamed sample pass, and the binned matrix is "
+       "published to a crash-safe content-keyed mmap cache under "
+       "stream_cache_dir (per-chunk sha256 attestations, manifest "
+       "LAST) that training uploads in budgeted double-buffered "
+       "host->device windows.  The trained model is byte-identical "
+       "to the in-memory path; a SIGKILL mid-ingest resumes without "
+       "re-fitting a mapper or re-binning a published chunk, and a "
+       "corrupt/truncated chunk is re-binned ALONE", group="io"),
+    _p("stream_cache_dir", "", str, ("stream_cache", "ingest_cache_dir"),
+       "root directory for the crash-safe binned dataset cache "
+       "(required when stream_ingest=true).  One content-keyed "
+       "subdirectory per (source, binning config) pair; checkpoint "
+       "manifests record the cache identity so resume reuses the "
+       "cache instead of re-binning (a miss is a MED anomaly)",
+       group="io"),
+    _p("stream_chunk_rows", 0, int, ("ingest_chunk_rows",),
+       "rows per streamed ingest chunk (the unit of crash-safe "
+       "publish and single-chunk repair).  0 sizes chunks from "
+       "stream_host_budget_mb; explicit values above the budget are "
+       "clamped with an ingest/clamp telemetry record (graceful "
+       "degradation instead of an OOM kill)", group="io", check=">=0"),
+    _p("stream_host_budget_mb", 256, int, ("stream_budget_mb",),
+       "host staging budget for streamed ingest and the host->device "
+       "upload windows: no raw chunk, binned window or in-flight "
+       "transfer buffer exceeds this working-set bound — larger "
+       "datasets degrade to smaller chunk windows, never to an OOM "
+       "kill", group="io", check=">=1"),
+    _p("stream_window_rows", 0, int, (),
+       "rows per host->device upload window of the streamed "
+       "construction (the double-buffered BlockFetcher unit).  0 "
+       "sizes windows from stream_host_budget_mb; explicit values "
+       "above the budget are clamped like stream_chunk_rows",
+       group="io", check=">=0"),
+    _p("stream_read_retries", 3, int, (),
+       "bounded retries for TRANSIENT raw-chunk read failures under "
+       "exponential backoff (the cont/source.py policy, shared); "
+       "exhausted retries quarantine the chunk (HIGH anomaly) and "
+       "ingest fails loudly after binning every other chunk",
+       group="io", check=">=0"),
+    _p("stream_backoff_base_s", 0.1, float, (),
+       "base of the streamed-ingest exponential read backoff",
+       group="io", check=">=0"),
+    _p("stream_prefetch", True, bool, (),
+       "double-buffer the host->device upload windows: a prefetch "
+       "thread prepares window i+1 (mmap page-in, transpose, pad, "
+       "EFB transform) while window i's async device copy runs.  "
+       "~zero measured overlap with streaming enabled is a MED "
+       "anomaly (obs/rules.py)", group="io"),
     _p("save_binary", False, bool, ("is_save_binary", "is_save_binary_file"),
        "save dataset to binary file", group="io"),
     _p("header", False, bool, ("has_header",), "input data has header",

@@ -130,6 +130,14 @@ class ContinualTrainer:
                              "also the publish root)")
         self.keep_last_n = max(int(cfg.keep_last_n or 2), 2)
         self.refit_decay = float(cfg.refit_decay_rate)
+        # streamed per-batch ingest (docs/Streaming.md): resolved
+        # through Config so the registered aliases (stream,
+        # out_of_core) work like everywhere else
+        self._stream_batches = bool(getattr(cfg, "stream_ingest",
+                                            False))
+        self._stream_cache_dir = str(
+            getattr(cfg, "stream_cache_dir", "") or
+            os.path.join(self.root, "_stream_cache"))
         self.recorder = recorder
         self.mgr = CheckpointManager(self.root, self.keep_last_n,
                                      recorder)
@@ -190,6 +198,17 @@ class ContinualTrainer:
             kw["weight"] = np.asarray(batch.weight)
         if batch.group is not None:
             kw["group"] = np.asarray(batch.group)
+        if self._stream_batches:
+            # out-of-core batches (docs/Streaming.md): construction
+            # routes through the crash-safe binned cache, so a daemon
+            # restart mid-batch re-ingests the SAME content key and
+            # reuses the fit mappers + every published chunk instead
+            # of re-binning — the BatchSource seam's resume contract.
+            # mmap-pair shards stay on disk end to end.
+            params = dict(eng_params)
+            params["stream_cache_dir"] = self._stream_cache_dir
+            return Dataset(batch.X, label=np.asarray(batch.y),
+                           params=params, **kw)
         return Dataset(np.ascontiguousarray(np.asarray(batch.X)),
                        label=np.asarray(batch.y),
                        params=dict(eng_params), **kw)
@@ -498,6 +517,14 @@ class ContinualTrainer:
         if mode == "refit":
             self.stats["refits"] += 1
         self._write_ledger()
+        if self._stream_batches:
+            # retention for per-batch binned caches: a finished batch
+            # no longer needs its cache (only the INFLIGHT batch's
+            # restart does); keep a small tail for producers that
+            # replay recent shards
+            from ..io import stream as stream_mod
+            stream_mod.prune_cache_root(self._stream_cache_dir,
+                                        keep_last=2)
         self._emit("batch", batch=batch.name, rows=batch.rows,
                    mode=mode, iter=int(self._model_iter),
                    start_iter=int(start_iter),

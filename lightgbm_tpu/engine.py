@@ -184,7 +184,15 @@ def train(params: Dict[str, Any], train_set: Dataset,
     ``resume_from`` (param or keyword; ``'auto'`` discovers the newest
     valid snapshot) continues BIT-EXACTLY from the saved boundary —
     even from a snapshot taken mid-fused-block under a sharded
-    learner — see ``docs/Checkpointing.md``."""
+    learner — see ``docs/Checkpointing.md``.
+
+    With ``stream_ingest=true`` the train set is binned OUT-OF-CORE
+    (``docs/Streaming.md``): raw rows stream chunk-by-chunk into a
+    crash-safe content-keyed mmap cache, the booster uploads it in
+    budgeted double-buffered host->device windows, the model is
+    byte-identical to the in-memory path, and checkpoint manifests
+    record the cache identity so resume never re-bins published
+    chunks."""
     params = dict(params)
     # canonical name first, then aliases (Config resolution order);
     # num_boost_round is accepted for reference-python compatibility

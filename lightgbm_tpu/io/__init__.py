@@ -1,0 +1,4 @@
+"""Data-plane modules: parsing, binning, the constructed dataset,
+EFB bundling, the native-accelerated binners, and the out-of-core
+streaming ingest (``stream.py``) over the crash-safe binned cache
+(``cache.py``) — see ``docs/Streaming.md``."""

@@ -1,0 +1,387 @@
+"""Crash-safe on-disk binned dataset cache (out-of-core ingest).
+
+One cache directory holds ONE binned dataset, keyed by a content hash
+of (raw source identity, binning configuration).  The layout follows
+the PR 5 atomic-writer discipline — every commit record is written
+with temp + fsync + rename, and the dataset-level manifest is written
+LAST — but the unit of durability here is the CHUNK, not the whole
+dataset: a SIGKILL, a torn write or bit rot costs a re-bin of exactly
+the chunks whose attestation fails, never the dataset::
+
+    <dir>/
+      prelude.npz       # label/weight/group/init_score + serialized
+                        # bin mappers (fit ONCE from the streamed
+                        # sample pass; resume NEVER re-fits)
+      prelude.json      # prelude attestation: key, rows, dtype,
+                        # chunk grid, sha256 — atomic, written after
+                        # the npz is durable
+      binned.dat        # (rows, used_features) uint8/16, row-major,
+                        # preallocated; chunks are written in place
+                        # and fsynced range-by-range
+      chunk_00007.json  # per-chunk attestation {start, rows, sha256}
+                        # — atomic, written only AFTER its byte range
+                        # is durable, so a valid chunk meta implies a
+                        # valid range (modulo later corruption, which
+                        # the sha256 verify-on-load catches)
+      manifest.json     # written LAST: the dataset is COMPLETE
+
+Failure matrix (docs/Streaming.md):
+
+- crash before ``prelude.json``      -> fresh ingest (nothing reused)
+- crash mid-binning                  -> mappers + published chunks
+  reused; only unpublished chunks are re-binned
+- crash before ``manifest.json``     -> same as mid-binning with zero
+  chunks left to bin
+- corrupt / truncated chunk bytes    -> sha256 verify-on-load fails
+  for THAT chunk; it alone is re-binned from the raw source
+- ``binned.dat`` truncated (lost
+  tail)                              -> the file is re-extended and
+  the chunks past the cut fail verification and re-bin
+- torn ``manifest.json``             -> ignored; the per-chunk
+  attestations carry the resume (newest valid state wins)
+
+Fault injection: ``stream.cache_write`` (``utils/faults.py``) fires
+once per prelude / chunk / manifest write with modes ``error`` (the
+write raises ``OSError``), ``crash`` (die mid-range with torn bytes on
+disk, like SIGKILL), ``truncate`` (publish normally, then tear bytes
+off the FINAL range — lost pages the verify must catch), ``hang`` and
+``sleep_<ms>``.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..ckpt import atomic
+from ..utils import faults as _faults
+from ..utils.faults import InjectedFault
+from ..utils.log import Log
+
+__all__ = ["CacheError", "BinnedCache", "chunk_grid", "dataset_key"]
+
+SCHEMA_VERSION = 1
+_PRELUDE_NPZ = "prelude.npz"
+_PRELUDE_META = "prelude.json"
+_BINNED = "binned.dat"
+_MANIFEST = "manifest.json"
+
+
+class CacheError(Exception):
+    """The cache directory is unusable for this dataset."""
+
+
+def chunk_grid(rows: int, chunk_rows: int) -> List[Tuple[int, int]]:
+    """Fixed chunk grid [(start, stop), ...] covering ``rows``.  The
+    grid is part of the cache identity: resume reuses the PRELUDE's
+    recorded grid, so a config change between runs cannot silently
+    mis-align attestations with byte ranges."""
+    chunk_rows = max(int(chunk_rows), 1)
+    return [(s, min(s + chunk_rows, rows))
+            for s in range(0, max(rows, 1), chunk_rows)]
+
+
+def dataset_key(source_identity: str, bin_sig: Dict[str, Any]) -> str:
+    """Content key of one (source, binning config) pair."""
+    blob = json.dumps({"source": source_identity, "bin": bin_sig},
+                      sort_keys=True).encode("utf-8")
+    return hashlib.sha256(blob).hexdigest()
+
+
+def _sha256_bytes(view) -> str:
+    h = hashlib.sha256()
+    h.update(view)
+    return h.hexdigest()
+
+
+def _consume_write_fault(mode: str, what: str) -> None:
+    """Interpret a ``stream.cache_write`` fault mode at a write site."""
+    if not mode:
+        return
+    if mode == "error":
+        raise OSError(f"injected fault (stream.cache_write:error) "
+                      f"writing {what}")
+    if mode == "hang":
+        time.sleep(3600.0)
+    if mode.startswith("sleep_"):
+        try:
+            time.sleep(float(mode[len("sleep_"):]) / 1e3)
+        except ValueError:
+            pass
+
+
+class BinnedCache:
+    """One binned dataset on disk (see module docstring for layout)."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self.key: str = ""
+        self.rows = 0
+        self.cols = 0
+        self.dtype = np.dtype(np.uint8)
+        self.chunk_rows = 0
+        self._mm: Optional[np.memmap] = None
+
+    # -- naming --------------------------------------------------------
+    def _chunk_meta_path(self, i: int) -> str:
+        return os.path.join(self.path, f"chunk_{i:05d}.json")
+
+    @property
+    def binned_path(self) -> str:
+        return os.path.join(self.path, _BINNED)
+
+    @property
+    def manifest_path(self) -> str:
+        return os.path.join(self.path, _MANIFEST)
+
+    def grid(self) -> List[Tuple[int, int]]:
+        return chunk_grid(self.rows, self.chunk_rows)
+
+    def _range_bytes(self, start: int, stop: int) -> Tuple[int, int]:
+        row = self.cols * self.dtype.itemsize
+        return start * row, stop * row
+
+    # -- prelude (mappers + metadata, fit/gathered ONCE) ---------------
+    def write_prelude(self, key: str, rows: int, cols: int,
+                      dtype: np.dtype, chunk_rows: int,
+                      arrays: Dict[str, np.ndarray],
+                      extra: Dict[str, Any]) -> None:
+        """Publish the sample-pass products (serialized mappers +
+        label/weight/group metadata).  Atomic: npz first, attestation
+        second — a crash between the two leaves no prelude and the
+        next ingest re-runs the sample pass."""
+        os.makedirs(self.path, exist_ok=True)
+        mode = _faults.fire("stream.cache_write")
+        _consume_write_fault(mode, "prelude")
+        npz_path = os.path.join(self.path, _PRELUDE_NPZ)
+        import io as _io
+        buf = _io.BytesIO()
+        np.savez(buf, **arrays)
+        data = buf.getvalue()
+        if mode == "crash":
+            with open(npz_path, "wb") as f:
+                f.write(data[: max(len(data) // 2, 1)])
+            raise InjectedFault("injected crash mid-prelude write")
+        atomic.atomic_write_bytes(npz_path, data)
+        meta = {"schema": SCHEMA_VERSION, "key": str(key),
+                "rows": int(rows), "cols": int(cols),
+                "dtype": np.dtype(dtype).name,
+                "chunk_rows": int(chunk_rows),
+                "bytes": len(data), "sha256": _sha256_bytes(data),
+                "created": round(time.time(), 3)}
+        meta.update(extra or {})
+        atomic.atomic_write_text(
+            os.path.join(self.path, _PRELUDE_META),
+            json.dumps(meta, sort_keys=True))
+        self._adopt_meta(meta)
+
+    def _adopt_meta(self, meta: Dict[str, Any]) -> None:
+        self.key = str(meta["key"])
+        self.rows = int(meta["rows"])
+        self.cols = int(meta["cols"])
+        self.dtype = np.dtype(meta["dtype"])
+        self.chunk_rows = int(meta["chunk_rows"])
+
+    def read_prelude_meta(self) -> Optional[Dict[str, Any]]:
+        """The prelude attestation, verified against the npz bytes;
+        None when absent or torn (resume re-runs the sample pass)."""
+        try:
+            with open(os.path.join(self.path, _PRELUDE_META)) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if not isinstance(meta, dict) or \
+                meta.get("schema") != SCHEMA_VERSION:
+            return None
+        try:
+            with open(os.path.join(self.path, _PRELUDE_NPZ), "rb") as f:
+                data = f.read()
+        except OSError:
+            return None
+        if len(data) != int(meta.get("bytes", -1)) or \
+                _sha256_bytes(data) != meta.get("sha256"):
+            return None
+        return meta
+
+    def read_prelude_arrays(self) -> Dict[str, np.ndarray]:
+        with np.load(os.path.join(self.path, _PRELUDE_NPZ),
+                     allow_pickle=False) as z:
+            return {k: z[k] for k in z.files}
+
+    # -- the binned matrix ---------------------------------------------
+    def _expected_bytes(self) -> int:
+        return self.rows * self.cols * self.dtype.itemsize
+
+    def open_binned(self, writable: bool = False) -> np.memmap:
+        """Map ``binned.dat``; a writer (re)creates or re-extends it to
+        the expected size (a truncated file keeps its valid prefix —
+        the chunks past the cut simply fail verification)."""
+        want = self._expected_bytes()
+        path = self.binned_path
+        size = os.path.getsize(path) if os.path.exists(path) else -1
+        if size != want:
+            if not writable:
+                raise CacheError(
+                    f"{path}: {size} bytes on disk, expected {want}")
+            with open(path, "ab" if size >= 0 else "wb") as f:
+                f.truncate(want)
+                f.flush()
+                os.fsync(f.fileno())
+        self._mm = np.memmap(path, dtype=self.dtype, mode="r+"
+                             if writable else "r",
+                             shape=(self.rows, self.cols))
+        return self._mm
+
+    @property
+    def binned(self) -> np.memmap:
+        if self._mm is None:
+            self.open_binned(writable=False)
+        return self._mm
+
+    # -- chunks --------------------------------------------------------
+    def chunk_meta(self, i: int) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self._chunk_meta_path(i)) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return meta if isinstance(meta, dict) else None
+
+    def chunk_sha(self, start: int, stop: int) -> str:
+        mm = self.binned if self._mm is None else self._mm
+        return _sha256_bytes(np.ascontiguousarray(mm[start:stop]).data)
+
+    def chunk_valid(self, i: int, start: int, stop: int) -> bool:
+        """A chunk is valid when its attestation exists AND the byte
+        range still hashes to it (sha256 verify-on-load)."""
+        meta = self.chunk_meta(i)
+        if meta is None:
+            return False
+        if int(meta.get("start", -1)) != start or \
+                int(meta.get("rows", -1)) != stop - start:
+            return False
+        return self.chunk_sha(start, stop) == meta.get("sha256")
+
+    def write_chunk(self, i: int, start: int, arr: np.ndarray) -> None:
+        """Write one binned chunk in place, make its bytes durable,
+        then publish the attestation (chunk-manifest-last)."""
+        mode = _faults.fire("stream.cache_write")
+        _consume_write_fault(mode, f"chunk {i}")
+        stop = start + arr.shape[0]
+        mm = self._mm if self._mm is not None \
+            else self.open_binned(writable=True)
+        if mode == "crash":
+            half = max(arr.shape[0] // 2, 1)
+            mm[start:start + half] = arr[:half]
+            mm.flush()
+            raise InjectedFault(f"injected crash mid-chunk {i}")
+        mm[start:stop] = arr
+        mm.flush()          # msync the dirty range before attesting
+        meta = {"schema": SCHEMA_VERSION, "index": int(i),
+                "start": int(start), "rows": int(arr.shape[0]),
+                "bytes": int(arr.nbytes),
+                "sha256": _sha256_bytes(
+                    np.ascontiguousarray(arr).data)}
+        atomic.atomic_write_text(self._chunk_meta_path(i),
+                                 json.dumps(meta, sort_keys=True))
+        if mode == "truncate":
+            # publish normally, then tear bytes off the range (lost
+            # pages after the attestation): verify-on-load MUST catch
+            mm[start + (stop - start) // 2:stop] = 0
+            mm.flush()
+
+    def valid_chunks(self) -> Dict[int, bool]:
+        """Verify EVERY chunk of the grid against its attestation."""
+        return {i: self.chunk_valid(i, s, e)
+                for i, (s, e) in enumerate(self.grid())}
+
+    # -- manifest (dataset-complete commit record) ---------------------
+    def finalize(self, extra: Optional[Dict[str, Any]] = None) -> None:
+        mode = _faults.fire("stream.cache_write")
+        _consume_write_fault(mode, "manifest")
+        if mode == "crash":
+            raise InjectedFault("injected crash before manifest")
+        chunks = []
+        for i, (s, e) in enumerate(self.grid()):
+            meta = self.chunk_meta(i)
+            if meta is None:
+                raise CacheError(f"finalize: chunk {i} has no "
+                                 f"attestation")
+            chunks.append(meta)
+        manifest = {"schema": SCHEMA_VERSION, "key": self.key,
+                    "rows": self.rows, "cols": self.cols,
+                    "dtype": self.dtype.name,
+                    "chunk_rows": self.chunk_rows,
+                    "chunks": chunks,
+                    "created": round(time.time(), 3)}
+        manifest.update(extra or {})
+        atomic.atomic_write_text(self.manifest_path,
+                                 json.dumps(manifest, sort_keys=True))
+
+    def read_manifest(self) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self.manifest_path) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if not isinstance(manifest, dict) or \
+                manifest.get("schema") != SCHEMA_VERSION:
+            return None
+        return manifest
+
+    # -- opening -------------------------------------------------------
+    @classmethod
+    def open(cls, path: str, key: Optional[str] = None
+             ) -> Optional["BinnedCache"]:
+        """Open a SEALED cache (manifest present and consistent with
+        the prelude); None when there is no sealed cache here.  A
+        sealed cache may still carry corrupt chunks — callers verify
+        with :meth:`valid_chunks` and re-bin the failures."""
+        cache = cls(path)
+        manifest = cache.read_manifest()
+        if manifest is None:
+            return None
+        prelude = cache.read_prelude_meta()
+        if prelude is None or prelude.get("key") != manifest.get("key"):
+            return None
+        if key is not None and manifest.get("key") != key:
+            return None
+        cache._adopt_meta(manifest)
+        try:
+            cache.open_binned(writable=False)
+        except (OSError, ValueError, CacheError):
+            return None
+        return cache
+
+    @classmethod
+    def resume(cls, path: str, key: str) -> Optional["BinnedCache"]:
+        """Open a PARTIAL cache for resumed ingest: a valid prelude
+        with the matching key is enough — published chunks are reused,
+        the rest are re-binned.  None when the prelude is absent, torn
+        or keyed to different data/config."""
+        cache = cls(path)
+        prelude = cache.read_prelude_meta()
+        if prelude is None or prelude.get("key") != str(key):
+            return None
+        cache._adopt_meta(prelude)
+        return cache
+
+    @staticmethod
+    def wipe(path: str) -> None:
+        """Remove a cache directory that belongs to DIFFERENT data or
+        config (key mismatch).  Refuses to remove a directory that
+        does not look like a cache (no prelude/manifest markers)."""
+        if not os.path.isdir(path):
+            return
+        names = set(os.listdir(path))
+        if names and not ({_PRELUDE_META, _MANIFEST} & names):
+            raise CacheError(f"refusing to wipe {path}: not a binned "
+                             f"dataset cache")
+        shutil.rmtree(path, ignore_errors=True)
+        Log.warning("stream: wiped stale cache at %s (key mismatch)",
+                    path)

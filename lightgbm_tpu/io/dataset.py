@@ -29,6 +29,43 @@ from .binning import (BIN_CATEGORICAL, BinMapper, find_bin_mappers,
 _BINARY_MAGIC = b"LGBTPU_DATASET_V1\n"
 
 
+def bin_rows(X: np.ndarray, mappers: List[BinMapper],
+             used: Sequence[int], dtype) -> np.ndarray:
+    """Bin a block of raw rows against FIXED mappers ->
+    ``(rows, len(used))``.  Row-independent, so the streamed ingest
+    (``io/stream.py``) bins chunk-by-chunk through the SAME code the
+    in-memory path runs over the whole matrix — the cached matrix is
+    byte-identical by construction, not by coincidence."""
+    num_data = X.shape[0]
+    from .binning import BIN_NUMERICAL, KZERO
+    num_js = [j for j, f in enumerate(used)
+              if mappers[f].bin_type == BIN_NUMERICAL]
+    binned = None
+    if num_js:
+        # numerical columns take the one-pass native binner;
+        # categorical columns (rare, python dict mapping) overwrite
+        # their slices below
+        from . import native
+        binned = native.bin_matrix(
+            X, [used[j] for j in num_js],
+            [mappers[used[j]].bin_upper_bound for j in num_js],
+            [mappers[used[j]].missing_type for j in num_js],
+            [mappers[used[j]].num_bin for j in num_js], KZERO, dtype)
+    if binned is not None and len(num_js) < len(used):
+        full = np.zeros((num_data, len(used)), dtype=dtype)
+        full[:, num_js] = binned
+        binned = full
+        for j, f in enumerate(used):
+            if mappers[f].bin_type != BIN_NUMERICAL:
+                binned[:, j] = mappers[f].value_to_bin(
+                    X[:, f]).astype(dtype)
+    if binned is None:
+        binned = np.zeros((num_data, len(used)), dtype=dtype)
+        for j, f in enumerate(used):
+            binned[:, j] = mappers[f].value_to_bin(X[:, f]).astype(dtype)
+    return binned
+
+
 class Metadata:
     """label / weight / query / init_score container
     (``dataset.h:36-248``)."""
@@ -148,32 +185,7 @@ class TpuDataset:
         used = [i for i, m in enumerate(mappers) if not m.is_trivial]
         dtype = np.uint8 if all(mappers[i].num_bin <= 256 for i in used) \
             else np.uint16
-        binned = None
-        from .binning import BIN_NUMERICAL, KZERO
-        num_js = [j for j, f in enumerate(used)
-                  if mappers[f].bin_type == BIN_NUMERICAL]
-        if num_js:
-            # numerical columns take the one-pass native binner;
-            # categorical columns (rare, python dict mapping) overwrite
-            # their slices below
-            from . import native
-            binned = native.bin_matrix(
-                X, [used[j] for j in num_js],
-                [mappers[used[j]].bin_upper_bound for j in num_js],
-                [mappers[used[j]].missing_type for j in num_js],
-                [mappers[used[j]].num_bin for j in num_js], KZERO, dtype)
-        if binned is not None and len(num_js) < len(used):
-            full = np.zeros((num_data, len(used)), dtype=dtype)
-            full[:, num_js] = binned
-            binned = full
-            for j, f in enumerate(used):
-                if mappers[f].bin_type != BIN_NUMERICAL:
-                    binned[:, j] = mappers[f].value_to_bin(
-                        X[:, f]).astype(dtype)
-        if binned is None:
-            binned = np.zeros((num_data, len(used)), dtype=dtype)
-            for j, f in enumerate(used):
-                binned[:, j] = mappers[f].value_to_bin(X[:, f]).astype(dtype)
+        binned = bin_rows(X, mappers, used, dtype)
         meta = Metadata(num_data)
         meta.set_label(label if label is not None else np.zeros(num_data))
         meta.set_weight(weight)

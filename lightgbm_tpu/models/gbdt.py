@@ -398,16 +398,47 @@ class GBDT:
                 learner, self.grow_params, num_shards, mesh)
             Log.info("tree_learner=%s over a %d-way device mesh",
                      learner, num_shards)
-        if self._bundles is not None:
-            xt = self._bundles.bundle_matrix(train_set.binned).T  # (G, N)
+        self._stream_upload = None
+        stream_info = getattr(train_set, "stream", None)
+        if stream_info is not None:
+            # streamed dataset (io/stream.py): the binned matrix is a
+            # read-only mmap over the crash-safe cache — upload it in
+            # budgeted double-buffered windows instead of
+            # materializing the full (F_pad, n_pad) transpose on the
+            # host.  The resulting device array is value-identical to
+            # the in-memory path's, so everything downstream (fused
+            # scans, sharded placement, checkpoint replay) is shared.
+            from ..io.stream import BlockFetcher
+            out_cols = self._bundles.num_groups \
+                if self._bundles is not None else self._F_pad
+            fetcher = BlockFetcher(
+                train_set.binned, n_rows=n, n_pad=self._n_pad,
+                out_cols=out_cols,
+                window_rows=stream_info.window_rows,
+                transform=(self._bundles.bundle_matrix
+                           if self._bundles is not None else None),
+                prefetch=stream_info.prefetch,
+                read_retries=int(getattr(config, "stream_read_retries",
+                                         3)),
+                backoff_base_s=float(getattr(config,
+                                             "stream_backoff_base_s",
+                                             0.1)))
+            self._xt = fetcher.upload()
+            self._stream_upload = fetcher.stats()
         else:
-            xt = train_set.binned.T  # (F, N) narrow uint8/16
-        col_pad = 0 if self._bundles is not None else self._F_pad - F
-        xt = np.pad(xt, ((0, col_pad), (0, self._n_pad - n)))
-        # NARROW dtype end to end: host->device link (14 MB/s tunnel)
-        # AND device residency (uint8 = 295 MB at bench shape vs 1.18 GB
-        # int32); the pallas kernels and routing selects widen per tile
-        self._xt = jnp.asarray(xt)
+            if self._bundles is not None:
+                xt = self._bundles.bundle_matrix(
+                    train_set.binned).T  # (G, N)
+            else:
+                xt = train_set.binned.T  # (F, N) narrow uint8/16
+            col_pad = 0 if self._bundles is not None \
+                else self._F_pad - F
+            xt = np.pad(xt, ((0, col_pad), (0, self._n_pad - n)))
+            # NARROW dtype end to end: host->device link (14 MB/s
+            # tunnel) AND device residency (uint8 = 295 MB at bench
+            # shape vs 1.18 GB int32); the pallas kernels and routing
+            # selects widen per tile
+            self._xt = jnp.asarray(xt)
         self._base_mask = jnp.asarray(
             np.pad(np.ones(n, np.float32), (0, self._n_pad - n)))
         if self._F_pad != F:
@@ -496,6 +527,15 @@ class GBDT:
             from ..utils import telemetry as _tele_mod
             if _tele_mod.get_recorder() is not None:
                 self.attach_telemetry(_tele_mod.get_recorder())
+        if self._stream_upload:
+            # the streamed construction finished before the recorder
+            # attached: publish the upload's prefetch-overlap stats
+            # now (the ingest/prefetch record obs/rules.py watches)
+            from ..utils import telemetry as _tele_mod
+            rec = self._telemetry or _tele_mod.get_recorder()
+            if rec is not None:
+                rec.emit("ingest", event="prefetch",
+                         **self._stream_upload)
 
     # ------------------------------------------------------------------
     def _constraint_tuples(self, config: Config, train_set: TpuDataset,
@@ -1560,6 +1600,11 @@ class GBDT:
         dispatch's pre-state, so one restore rewinds across BOTH (all)
         blocks' RNG/quantization-stream consumption, and every queued
         block dies with it.  Returns True when a fence was armed."""
+        # the abort fence extends to in-flight host->device STREAM
+        # copies (io/stream.py BlockFetcher): a re-mesh rebuilding
+        # construction must never race a stale upload window
+        from ..io.stream import abort_active_fetchers
+        abort_active_fetchers()
         fence = self.__dict__.pop("_dispatch_fence", None)
         self._sq = []
         if fence is None:
@@ -1577,6 +1622,18 @@ class GBDT:
         return (blk is not None and blk["served"] < len(blk["trees"])
                 and blk.get("lr") == self.shrinkage_rate and
                 self._fused_ok())
+
+    def stream_identity(self) -> Optional[Dict]:
+        """The streamed-ingest cache identity this booster trains
+        from, or None (in-memory dataset).  Checkpoint manifests
+        record it so resume can verify the cache was REUSED instead
+        of silently re-binned (docs/Streaming.md resume contract)."""
+        info = getattr(self.train_set, "stream", None)
+        if info is None:
+            return None
+        return {"cache_key": info.cache_key,
+                "cache_dir": info.cache_dir,
+                "chunk_rows": int(info.chunk_rows)}
 
     def mesh_identity(self) -> Dict:
         """The live mesh topology — recorded in checkpoint manifests
@@ -2426,15 +2483,35 @@ class GBDT:
             # per-tree leaf assignments are discrete and recomputable
             # exactly from the restored trees (init_from_model does
             # the same); constant trees keep their None sentinel
-            if raw is None:
-                Log.fatal("resuming %s requires the training set's raw "
-                          "matrix (free_raw_data=False)",
-                          type(self).__name__)
             dt = np.uint8 if cfg.num_leaves <= 256 else np.uint16
-            self._train_leaf_idx = [
-                None if t.num_leaves <= 1 else
-                t.predict_leaf_index(raw).astype(dt)
-                for t in self._models]
+            if raw is not None:
+                self._train_leaf_idx = [
+                    None if t.num_leaves <= 1 else
+                    t.predict_leaf_index(raw).astype(dt)
+                    for t in self._models]
+            else:
+                # streamed dataset: replay chunk-by-chunk off the raw
+                # source (docs/Streaming.md), like init_from_model
+                src = getattr(self.train_set, "raw_source", None)
+                sinfo = getattr(self.train_set, "stream", None)
+                if src is None or sinfo is None:
+                    Log.fatal("resuming %s requires the training "
+                              "set's raw matrix (free_raw_data="
+                              "False)", type(self).__name__)
+                from ..io.cache import chunk_grid
+                parts: List[List[np.ndarray]] = \
+                    [[] for _ in self._models]
+                for start, stop in chunk_grid(self.num_data,
+                                              sinfo.chunk_rows):
+                    blk = src.read_rows(start, stop)
+                    for i, t in enumerate(self._models):
+                        if t.num_leaves > 1:
+                            parts[i].append(
+                                t.predict_leaf_index(blk).astype(dt))
+                self._train_leaf_idx = [
+                    None if t.num_leaves <= 1 else
+                    np.concatenate(parts[i])
+                    for i, t in enumerate(self._models)]
             for vs in self.valid_sets:
                 vs.leaf_idx_per_tree = [
                     None if t.num_leaves <= 1 else
@@ -2683,21 +2760,45 @@ class GBDT:
         self.models = [copy.deepcopy(t) for t in models]
         self.iter = len(models) // max(self.num_tree_per_iteration, 1)
         self._trees_dispatched = len(models)
-        if raw is None:
-            Log.fatal("continue-training requires the training set's raw "
-                      "matrix (free_raw_data=False)")
         k = self.num_tree_per_iteration
+        dt = np.uint8 if self.config.num_leaves <= 256 else np.uint16
         add = np.zeros((k, self.num_data), np.float32)
-        for i, tree in enumerate(self.models):
-            add[i % k] += tree.predict(raw)
+        leaf_idx: List[Optional[np.ndarray]] = []
+        if raw is not None:
+            for i, tree in enumerate(self.models):
+                add[i % k] += tree.predict(raw)
+            if self._track_train_leaf:
+                leaf_idx = [t.predict_leaf_index(raw).astype(dt)
+                            for t in self.models]
+        else:
+            # streamed dataset (docs/Streaming.md): the raw matrix is
+            # out-of-core by design — replay the seed trees CHUNK by
+            # chunk off the raw source (tree predict is row-wise, so
+            # the chunked replay is exact)
+            src = getattr(self.train_set, "raw_source", None)
+            info = getattr(self.train_set, "stream", None)
+            if src is None or info is None:
+                Log.fatal("continue-training requires the training "
+                          "set's raw matrix (free_raw_data=False)")
+            from ..io.cache import chunk_grid
+            parts: List[List[np.ndarray]] = [[] for _ in self.models] \
+                if self._track_train_leaf else []
+            for start, stop in chunk_grid(self.num_data,
+                                          info.chunk_rows):
+                blk = src.read_rows(start, stop)
+                for i, tree in enumerate(self.models):
+                    add[i % k, start:stop] += tree.predict(blk)
+                    if self._track_train_leaf:
+                        parts[i].append(
+                            tree.predict_leaf_index(blk).astype(dt))
+            if self._track_train_leaf:
+                leaf_idx = [np.concatenate(p) for p in parts]
         self._score = self._score + jnp.asarray(
             np.pad(add, ((0, 0), (0, self._score.shape[1] - add.shape[1]))))
         if self._track_train_leaf:
             # DART needs per-tree train-leaf assignments to drop and
             # renormalize the seeded trees
-            dt = np.uint8 if self.config.num_leaves <= 256 else np.uint16
-            self._train_leaf_idx = [
-                t.predict_leaf_index(raw).astype(dt) for t in self.models]
+            self._train_leaf_idx = leaf_idx
 
     def refit(self, X: np.ndarray, y: np.ndarray, weight=None,
               decay_rate: float = 0.9) -> None:

@@ -149,6 +149,14 @@ class OnlineScanner:
         self._rt_requests = 0
         self._rt_hedges = 0
         self._rt_shed = 0
+        # streamed-ingest rollups (io/stream.py): prefetch overlap is
+        # judged once enough windows have streamed, mirroring the
+        # pipelining-disabled rule
+        self._ing_prefetches = 0
+        self._ing_windows = 0
+        self._ing_overlap_s = 0.0
+        self._ing_quarantines = 0
+        self._ing_resume_miss: Optional[Dict[str, Any]] = None
         self._segs: "deque[Dict[str, Any]]" = \
             deque(maxlen=self.MAX_SEGMENTS)
         self._cur_seg: Optional[Dict[str, Any]] = None
@@ -294,6 +302,44 @@ class OnlineScanner:
                             f"admission budgets are turning real "
                             f"traffic away; raise route_rows_per_s "
                             f"or add replicas"))
+        elif rtype == "ingest":
+            event = r.get("event")
+            if event == "quarantine":
+                self._ing_quarantines += 1
+                out.append((
+                    "HIGH", "ingest_quarantine",
+                    f"streamed-ingest chunk "
+                    f"{r.get('chunk', r.get('batch', '?'))} "
+                    f"QUARANTINED ({r.get('reason', '?')}: "
+                    f"{str(r.get('error', ''))[:120]}) — the training "
+                    f"matrix cannot silently lose rows; ingest fails "
+                    f"loudly after binning every other chunk"))
+            elif event == "resume" and not r.get("cache_hit", True):
+                self._ing_resume_miss = r
+                out.append((
+                    "MED", "ingest_cache_miss",
+                    f"streamed-ingest cache MISS on resume (expected "
+                    f"{r.get('expected_key', '?')}, got "
+                    f"{r.get('actual_key', '?')}, "
+                    f"{r.get('rebinned', 0)} chunk(s) re-binned) — a "
+                    f"re-bin the checkpoint manifest should have "
+                    f"prevented"))
+            elif event == "prefetch" and r.get("prefetch"):
+                self._ing_prefetches += 1
+                self._ing_windows += int(r.get("windows", 0))
+                self._ing_overlap_s += float(r.get("overlap_s", 0.0))
+                if ("ingest_prefetch_stalled" not in self._fired and
+                        self._ing_windows >= 8 and
+                        self._ing_overlap_s < 1e-5):
+                    self._fired.add("ingest_prefetch_stalled")
+                    out.append((
+                        "MED", "ingest_prefetch_stalled",
+                        f"stream prefetch overlap ~0 across "
+                        f"{self._ing_windows} upload windows with "
+                        f"double-buffering enabled — window prep is "
+                        f"serializing behind the device copies "
+                        f"(stream_host_budget_mb too small? prefetch "
+                        f"thread starved?)"))
         elif rtype == "checkpoint" and r.get("event") == "fallback":
             out.append((
                 "HIGH", "ckpt_fallback",
@@ -326,6 +372,30 @@ class OnlineScanner:
                                     f"turning real traffic away; "
                                     f"raise route_rows_per_s or add "
                                     f"replicas"))
+        if self._ing_quarantines:
+            out.append(("HIGH", f"streamed ingest quarantined "
+                                f"{self._ing_quarantines} chunk(s) — "
+                                f"transient-read retries exhausted or "
+                                f"deterministic parse failures; the "
+                                f"retry run only owes the quarantined "
+                                f"chunks (every other one is "
+                                f"published)"))
+        if self._ing_resume_miss is not None:
+            r = self._ing_resume_miss
+            out.append(("MED", f"streamed-ingest cache miss on resume "
+                               f"(expected {r.get('expected_key', '?')}"
+                               f", got {r.get('actual_key', '?')}) — "
+                               f"the checkpoint manifest recorded a "
+                               f"published cache this resume re-binned "
+                               f"anyway"))
+        if self._ing_prefetches and self._ing_windows >= 8 and \
+                self._ing_overlap_s < 1e-5:
+            out.append(("MED", f"stream prefetch overlap ~0 across "
+                               f"{self._ing_windows} host->device "
+                               f"upload windows with double-buffering "
+                               f"enabled — the window prep cost is "
+                               f"fully serialized again (mirrors the "
+                               f"pipelining-disabled rule)"))
         if self._ss_late:
             out.append(("HIGH", f"superstep retrace storm: "
                                 f"{self._ss_late:.0f} "

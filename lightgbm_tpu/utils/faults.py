@@ -85,6 +85,30 @@ point                  modes its call site interprets
                        reports exhaustion for this request (a
                        structured 429 + Retry-After without having to
                        actually flood the token bucket)
+``stream.chunk_read``  fired once per raw-chunk read of the streamed
+                       ingest (``io/stream.py``, sample AND bin
+                       passes): ``error`` — a TRANSIENT ``OSError``
+                       (bounded exponential backoff + retry, then
+                       quarantine); ``corrupt`` / ``truncate`` — a
+                       deterministic parse failure (immediate
+                       quarantine); ``hang`` — the read blocks;
+                       ``sleep_<ms>`` — added latency
+``stream.cache_write`` fired once per cache commit (prelude, each
+                       chunk, manifest — ``io/cache.py``): ``error``
+                       — the write raises ``OSError``; ``crash`` —
+                       die mid-write with torn bytes on disk (the
+                       SIGKILL shape: resume must reuse everything
+                       already attested); ``truncate`` — publish
+                       normally then tear bytes off the final range
+                       (lost pages; sha256 verify-on-load must
+                       catch); ``hang`` / ``sleep_<ms>``
+``stream.prefetch``    fired once per host->device upload window
+                       (``BlockFetcher``): ``error`` — window prep
+                       raises (bounded retry, then fail loudly);
+                       ``hang`` — the prefetch thread blocks (an
+                       upload that never finishes); ``sleep_<ms>`` —
+                       added latency (widens the overlap window the
+                       telemetry measures)
 =====================  =================================================
 
 A spec naming a point outside this table arms nothing — a typo'd
@@ -134,7 +158,8 @@ KNOWN_POINTS = frozenset({
     "http.request", "fleet.spawn", "ingest.read", "ingest.validate",
     "trainer.step", "trainer.refit", "mesh.collective",
     "mesh.heartbeat", "elastic.remesh", "router.backend",
-    "router.admit",
+    "router.admit", "stream.chunk_read", "stream.cache_write",
+    "stream.prefetch",
 })
 
 

@@ -75,7 +75,7 @@ SCHEMA_VERSION = 1
 
 RECORD_TYPES = ("run_start", "iteration", "superstep", "eval", "predict",
                 "serve", "checkpoint", "fleet", "continual", "recovery",
-                "router", "span", "capture", "run_end")
+                "router", "ingest", "span", "capture", "run_end")
 
 # per-type required fields on top of the common envelope; values are
 # (field, type-or-types) pairs the lint enforces
@@ -176,6 +176,27 @@ _TYPE_FIELDS: Dict[str, Tuple[Tuple[str, Any], ...]] = {
     # rate > 20% (MED), budget-shed rate > 5% (HIGH) and breaker
     # opens (HIGH).
     "router": (("event", str),),
+    # one record per streamed-ingest event (io/stream.py + io/cache.py,
+    # docs/Streaming.md): ``event`` is chunk_read (one raw chunk off
+    # the source: chunk/rows/attempt) | cache_write (one binned chunk
+    # published: chunk/bytes/bin_ms/write_ms, rebin=true when it
+    # REPLACED a corrupt cached chunk) | verify_fail (a cached chunk
+    # failed its sha256 verify-on-load and will be re-binned alone) |
+    # prelude_hit (the fit-once mappers + metadata were reused —
+    # resume never fits a mapper twice) | fit_mappers (the streamed
+    # sample pass ran: rows_sampled/duration_ms) | backoff (a
+    # transient chunk read or prefetch window retried:
+    # chunk|window/attempt/sleep_s) | quarantine (retries exhausted or
+    # deterministic parse failure: chunk/reason — a HIGH anomaly,
+    # obs/rules.py) | clamp (stream_chunk_rows degraded to fit
+    # stream_host_budget_mb) | prefetch (one host->device upload:
+    # windows/bytes/overlap_s — the host prep hidden under async
+    # device copies; ~zero overlap with streaming enabled is a MED
+    # anomaly) | ingest_done (rollup: chunks/cache_hits/rebinned/
+    # from_cache) | resume (checkpoint restore compared the manifest's
+    # recorded cache identity with the live dataset's: cache_hit=false
+    # means a re-bin the manifest should have prevented — MED).
+    "ingest": (("event", str),),
     # one record per closed trace span (obs/spans.py): ``trace_id``
     # joins spans (and trace-tagged records of every other type)
     # emitted by ANY process into one timeline — the continual
@@ -600,6 +621,48 @@ class RunRecorder:
             else:
                 self._router_lat[self._router_lat_n % 65536] = v
             self._router_lat_n += 1
+        elif t == "ingest":
+            event = rec.get("event")
+            key = {
+                "chunk_read": "ingest_chunk_reads",
+                "cache_write": "ingest_cache_writes",
+                "verify_fail": "ingest_verify_fails",
+                "prelude_hit": "ingest_prelude_hits",
+                "fit_mappers": "ingest_mapper_fits",
+                "backoff": "ingest_backoffs",
+                "quarantine": "ingest_quarantines",
+                "clamp": "ingest_clamps",
+                "resume": "ingest_resumes",
+            }.get(event)
+            if key:
+                self._agg[key] = self._agg.get(key, 0) + 1
+            if event == "cache_write":
+                self._agg["ingest_cached_bytes"] = \
+                    self._agg.get("ingest_cached_bytes", 0) + \
+                    int(rec.get("bytes", 0))
+                if rec.get("rebin"):
+                    self._agg["ingest_rebins"] = \
+                        self._agg.get("ingest_rebins", 0) + 1
+            elif event == "chunk_read":
+                self._agg["ingest_rows"] = \
+                    self._agg.get("ingest_rows", 0) + \
+                    int(rec.get("rows", 0))
+            elif event == "prefetch":
+                self._agg["ingest_prefetch_windows"] = \
+                    self._agg.get("ingest_prefetch_windows", 0) + \
+                    int(rec.get("windows", 0))
+                self._agg["ingest_prefetch_overlap_s"] = round(
+                    self._agg.get("ingest_prefetch_overlap_s", 0.0) +
+                    float(rec.get("overlap_s", 0.0)), 6)
+            elif event == "ingest_done":
+                self._agg["ingest_runs"] = \
+                    self._agg.get("ingest_runs", 0) + 1
+                self._agg["ingest_cache_hits"] = \
+                    self._agg.get("ingest_cache_hits", 0) + \
+                    int(rec.get("cache_hits", 0))
+            elif event == "resume" and not rec.get("cache_hit", True):
+                self._agg["ingest_resume_misses"] = \
+                    self._agg.get("ingest_resume_misses", 0) + 1
         elif t == "recovery":
             key = {
                 "detect": "recovery_detects",

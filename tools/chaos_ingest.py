@@ -1,0 +1,422 @@
+"""Streamed-ingest chaos e2e: the acceptance harness for the
+out-of-core data plane (``io/stream.py`` + ``io/cache.py``,
+``docs/Streaming.md``).
+
+Phases (exit nonzero on any failed check):
+
+1. **SIGKILL mid-binning** — a subprocess ingests with an injected
+   slow chunk write and is SIGKILLed once two chunks are published.
+   The resume run must fit NO mapper twice (zero ``fit_mappers``
+   records), reuse every published chunk, seal the manifest, and
+   train to a model byte-identical to the in-memory oracle.
+2. **Corrupt chunk** — bytes flipped inside one published chunk of
+   the SEALED cache: the reopen must sha256-verify, re-bin exactly
+   that one chunk (``verify_fail`` + ``rebin`` telemetry), and train
+   byte-identical.
+3. **Truncated cache** — the tail of ``binned.dat`` torn off: the
+   file is re-extended, only the chunks past the cut re-bin, model
+   byte-identical.
+4. **Transient read faults** — ``stream.chunk_read:error@2`` retried
+   under bounded backoff (one ``backoff`` record), model
+   byte-identical.
+5. **SIGKILL mid-TRAINING, dataset larger than the host/device
+   staging budget** — a subprocess trains a streamed dataset whose
+   binned matrix EXCEEDS ``stream_host_budget_mb`` (multi-window
+   double-buffered upload), checkpointing as it goes; SIGKILLed after
+   the first snapshot, restarted with ``resume_from=auto``.  The
+   restart must reuse the cache (``resume`` record with
+   ``cache_hit=true``, zero mapper fits) and finish byte-identical to
+   the uninterrupted in-memory oracle.
+
+Every telemetry JSONL is schema-linted, and the shared anomaly
+scanner (``obs/rules.py``) must show the expected ingest anomalies
+and ONLY those.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/chaos_ingest.py \
+        --workdir chaos_ingest_work --out chaos_ingest.json
+"""
+import argparse
+import glob
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+CHECKS = []
+
+SMALL = dict(rows=601, feats=12, chunk=97, rounds=8)
+BIG = dict(rows=40000, feats=28, chunk=7000, rounds=8)
+
+
+def check(name, ok, detail=""):
+    CHECKS.append({"name": name, "ok": bool(ok), "detail": str(detail)})
+    print(f"[{'OK' if ok else 'FAIL'}] {name}"
+          + (f" — {detail}" if detail and not ok else ""), flush=True)
+    return bool(ok)
+
+
+def make_data(shape, seed=11):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(shape["rows"], shape["feats"])
+    w = rng.randn(shape["feats"])
+    y = (1.0 / (1.0 + np.exp(-(X @ w) * 0.5)) >
+         rng.random_sample(shape["rows"])).astype(np.float32)
+    return X, y
+
+
+def base_params(shape, cache_dir, **extra):
+    p = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+         "metric": "None", "num_iterations": shape["rounds"],
+         "fused_iters": 4, "stream_ingest": True,
+         "stream_cache_dir": cache_dir,
+         "stream_chunk_rows": shape["chunk"],
+         "stream_backoff_base_s": 0.02}
+    p.update(extra)
+    return p
+
+
+def train_text(params, data, label=None):
+    import lightgbm_tpu as lgb
+    d = lgb.Dataset(data, label=label, params=dict(params))
+    return lgb.train(dict(params), d, verbose_eval=False
+                     ).model_to_string(), d
+
+
+def read_events(path):
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        pass
+    except OSError:
+        pass
+    return out
+
+
+def ingest_events(records, event):
+    return [r for r in records if r.get("type") == "ingest"
+            and r.get("event") == event]
+
+
+def lint(path, name):
+    from lightgbm_tpu.utils import telemetry as tele
+    n, errs = tele.lint_file(path)
+    check(f"{name}: telemetry schema-clean ({n} records)",
+          n > 0 and not errs, "; ".join(errs[:3]))
+
+
+def spawn_child(mode, workdir, stem, shape, telemetry, faults="",
+                resume=False, budget_mb=None, window_rows=0):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep +
+               os.environ.get("PYTHONPATH", ""))
+    if faults:
+        env["LTPU_FAULTS"] = faults
+    else:
+        env.pop("LTPU_FAULTS", None)
+    cmd = [sys.executable, os.path.abspath(__file__), "--child", mode,
+           "--workdir", workdir, "--stem", stem,
+           "--shape", json.dumps(shape), "--telemetry", telemetry]
+    if resume:
+        cmd.append("--resume")
+    if budget_mb is not None:
+        cmd += ["--budget-mb", str(budget_mb)]
+    if window_rows:
+        cmd += ["--window-rows", str(window_rows)]
+    return subprocess.Popen(cmd, env=env)
+
+
+def wait_for(pred, timeout, what):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    print(f"TIMEOUT waiting for {what}", flush=True)
+    return False
+
+
+# ----------------------------------------------------------------------
+# child modes (run in a subprocess so SIGKILL is a real SIGKILL)
+# ----------------------------------------------------------------------
+def child_main(args):
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils import telemetry as tele
+    shape = json.loads(args.shape)
+    cache = os.path.join(args.workdir, "cache")
+    rec = tele.RunRecorder(args.telemetry)
+    tele.set_recorder(rec)
+    if args.child == "ingest":
+        p = base_params(shape, cache)
+        lgb.Dataset(args.stem + ".X.npy", params=p).construct()
+        print("CHILD_INGEST_DONE", flush=True)
+        return 0
+    if args.child == "train":
+        ck = os.path.join(args.workdir, "ck")
+        p = base_params(shape, cache, checkpoint_dir=ck,
+                        snapshot_freq=2,
+                        stream_host_budget_mb=args.budget_mb or 256)
+        if args.window_rows:
+            p["stream_window_rows"] = args.window_rows
+        d = lgb.Dataset(args.stem + ".X.npy", params=p)
+        bst = lgb.train(dict(p), d, verbose_eval=False,
+                        resume_from="auto" if args.resume else None)
+        with open(os.path.join(args.workdir, "final_model.txt"),
+                  "w") as f:
+            f.write(bst.model_to_string())
+        info = d._constructed.stream
+        with open(os.path.join(args.workdir, "stream_info.json"),
+                  "w") as f:
+            json.dump({"from_cache": info.from_cache,
+                       "mappers_reused": info.mappers_reused,
+                       "rebinned": info.rebinned,
+                       "cache_hits": info.cache_hits,
+                       "windows": (bst._gbdt._stream_upload or
+                                   {}).get("windows", 0),
+                       "binned_bytes": int(
+                           np.asarray(d._constructed.binned).nbytes)},
+                      f)
+        rec.close(log=False)
+        print("CHILD_TRAIN_DONE", flush=True)
+        return 0
+    raise SystemExit(f"unknown child mode {args.child!r}")
+
+
+# ----------------------------------------------------------------------
+# phases
+# ----------------------------------------------------------------------
+def phase_sigkill_mid_binning(workdir, X, y, oracle):
+    from lightgbm_tpu.utils import telemetry as tele
+    import lightgbm_tpu as lgb
+    wd = os.path.join(workdir, "p1")
+    os.makedirs(wd)
+    stem = os.path.join(wd, "raw")
+    np.save(stem + ".X.npy", X)
+    np.save(stem + ".y.npy", y)
+    cache = os.path.join(wd, "cache")
+    # slow every chunk write from the 4th cache commit on (prelude,
+    # c0, c1 fast; c2+ slow) so the kill lands mid-binning
+    child = spawn_child("ingest", wd, stem, SMALL,
+                        os.path.join(wd, "tele_child.jsonl"),
+                        faults="stream.cache_write:sleep_2000@4+")
+    ok = wait_for(lambda: len(glob.glob(os.path.join(
+        cache, "*", "chunk_*.json"))) >= 2, 90,
+        "two published chunks")
+    child.send_signal(signal.SIGKILL)
+    child.wait()
+    check("p1: child SIGKILLed mid-binning with >=2 chunks published",
+          ok)
+    cdirs = glob.glob(os.path.join(cache, "*"))
+    check("p1: no manifest sealed before the kill",
+          cdirs and not os.path.exists(
+              os.path.join(cdirs[0], "manifest.json")))
+    published = len(glob.glob(os.path.join(cache, "*",
+                                           "chunk_*.json")))
+    # resume in-process with a recorder: no mapper re-fit, published
+    # chunks reused, final model byte-identical to the oracle
+    tpath = os.path.join(wd, "tele_resume.jsonl")
+    rec = tele.RunRecorder(tpath)
+    tele.set_recorder(rec)
+    p = base_params(SMALL, cache)
+    m, d = train_text(p, stem + ".X.npy")
+    tele.set_recorder(None)
+    rec.close(log=False)
+    records = read_events(tpath)
+    check("p1: resume fit NO mapper twice",
+          not ingest_events(records, "fit_mappers") and
+          len(ingest_events(records, "prelude_hit")) == 1)
+    info = d._constructed.stream
+    check(f"p1: resume reused every published chunk "
+          f"({info.cache_hits}/{published})",
+          info.cache_hits == published and published >= 2)
+    check("p1: resumed ingest trains byte-identical to the in-memory "
+          "oracle", m == oracle)
+    lint(tpath, "p1")
+    return cache, stem
+
+
+def phase_corrupt_chunk(wd, cache, stem, oracle):
+    from lightgbm_tpu.utils import telemetry as tele
+    cdir = glob.glob(os.path.join(cache, "*"))[0]
+    dat = os.path.join(cdir, "binned.dat")
+    with open(dat, "r+b") as f:
+        f.seek(SMALL["chunk"] * SMALL["feats"] + 7)   # inside chunk 1
+        f.write(b"\xde\xad\xbe\xef")
+    tpath = os.path.join(wd, "tele_corrupt.jsonl")
+    rec = tele.RunRecorder(tpath)
+    tele.set_recorder(rec)
+    m, d = train_text(base_params(SMALL, cache), stem + ".X.npy")
+    tele.set_recorder(None)
+    rec.close(log=False)
+    records = read_events(tpath)
+    fails = ingest_events(records, "verify_fail")
+    info = d._constructed.stream
+    check("p2: corrupt chunk detected by sha256 verify-on-load",
+          [r.get("chunk") for r in fails] == [1])
+    check("p2: exactly ONE chunk re-binned, the rest reused",
+          info.rebinned == 1 and info.cache_hits == 6)
+    check("p2: repaired cache trains byte-identical", m == oracle)
+    lint(tpath, "p2")
+
+
+def phase_truncated_cache(wd, cache, stem, oracle):
+    cdir = glob.glob(os.path.join(cache, "*"))[0]
+    dat = os.path.join(cdir, "binned.dat")
+    size = os.path.getsize(dat)
+    with open(dat, "r+b") as f:
+        f.truncate(size - SMALL["feats"] * 25)
+    m, d = train_text(base_params(SMALL, cache), stem + ".X.npy")
+    info = d._constructed.stream
+    check("p3: truncated cache re-extended; prefix chunks reused",
+          info.mappers_reused and info.cache_hits >= 5)
+    check("p3: post-truncation model byte-identical", m == oracle)
+
+
+def phase_transient_reads(workdir, X, y, oracle):
+    from lightgbm_tpu.utils import faults, telemetry as tele
+    wd = os.path.join(workdir, "p4")
+    os.makedirs(wd)
+    tpath = os.path.join(wd, "tele.jsonl")
+    faults.reset()      # earlier in-process phases advanced the
+    faults.configure("stream.chunk_read:error@2")  # hit ordinals
+    rec = tele.RunRecorder(tpath)
+    tele.set_recorder(rec)
+    m, _ = train_text(base_params(SMALL, os.path.join(wd, "cache")),
+                      X, label=y)
+    tele.set_recorder(None)
+    faults.configure("")
+    faults.reset()
+    rec.close(log=False)
+    records = read_events(tpath)
+    check("p4: transient read retried under backoff",
+          len(ingest_events(records, "backoff")) == 1)
+    check("p4: model byte-identical after retries", m == oracle)
+    lint(tpath, "p4")
+
+
+def phase_sigkill_mid_training(workdir, X, y):
+    import lightgbm_tpu as lgb
+    wd = os.path.join(workdir, "p5")
+    os.makedirs(wd)
+    stem = os.path.join(wd, "raw")
+    np.save(stem + ".X.npy", X)
+    np.save(stem + ".y.npy", y)
+    # the in-memory oracle (uninterrupted)
+    p_mem = {k: v for k, v in base_params(BIG, "").items()
+             if not k.startswith("stream")}
+    oracle, _ = train_text(p_mem, X, label=y)
+    ck = os.path.join(wd, "ck")
+    budget_mb = 1
+    # run 1: SIGKILL once the first periodic snapshot lands
+    child = spawn_child("train", wd, stem, BIG,
+                        os.path.join(wd, "tele_run1.jsonl"),
+                        budget_mb=budget_mb, window_rows=3000)
+    ok = wait_for(lambda: bool(glob.glob(os.path.join(
+        ck, "ckpt_*", "manifest.json"))), 180, "first checkpoint")
+    child.send_signal(signal.SIGKILL)
+    child.wait()
+    check("p5: child SIGKILLed after its first streamed checkpoint",
+          ok)
+    # run 2: restart, resume_from=auto
+    t2 = os.path.join(wd, "tele_run2.jsonl")
+    child = spawn_child("train", wd, stem, BIG, t2, resume=True,
+                        budget_mb=budget_mb, window_rows=3000)
+    rc = child.wait(timeout=600)
+    check("p5: restarted child finished (rc=0)", rc == 0, f"rc={rc}")
+    try:
+        with open(os.path.join(wd, "final_model.txt")) as f:
+            final = f.read()
+        with open(os.path.join(wd, "stream_info.json")) as f:
+            sinfo = json.load(f)
+    except OSError as exc:
+        check("p5: child artifacts written", False, str(exc))
+        return
+    check("p5: resumed streamed model byte-identical to the "
+          "in-memory oracle", final == oracle)
+    check("p5: restart reused the cache (sealed open, zero re-bins)",
+          sinfo["from_cache"] and sinfo["rebinned"] == 0)
+    check(f"p5: binned matrix ({sinfo['binned_bytes']} B) EXCEEDS the "
+          f"{budget_mb} MB staging budget and streamed in "
+          f"{sinfo['windows']} windows",
+          sinfo["binned_bytes"] > budget_mb * (1 << 20) and
+          sinfo["windows"] > 1)
+    records = read_events(t2)
+    resume = ingest_events(records, "resume")
+    check("p5: checkpoint resume verified the cache identity "
+          "(cache_hit=true)",
+          [r.get("cache_hit") for r in resume] == [True])
+    check("p5: restart fit no mapper",
+          not ingest_events(records, "fit_mappers"))
+    lint(t2, "p5")
+    # the shared anomaly scanner must be silent on the CLEAN restart
+    from lightgbm_tpu.obs import rules
+    scanner = rules.OnlineScanner()
+    fired = [a for r in records for a in scanner.feed(r)]
+    bad = [c for _, c, _ in fired
+           if c in ("ingest_cache_miss", "ingest_quarantine")]
+    check("p5: no cache-miss/quarantine anomalies on the clean "
+          "restart", not bad, str(bad))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workdir", default="chaos_ingest_work")
+    ap.add_argument("--out", default="")
+    ap.add_argument("--child", default="")
+    ap.add_argument("--stem", default="")
+    ap.add_argument("--shape", default="{}")
+    ap.add_argument("--telemetry", default="")
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--budget-mb", type=int, default=0)
+    ap.add_argument("--window-rows", type=int, default=0)
+    args = ap.parse_args()
+    if args.child:
+        return child_main(args)
+
+    workdir = os.path.abspath(args.workdir)
+    if os.path.isdir(workdir):
+        shutil.rmtree(workdir)
+    os.makedirs(workdir)
+
+    X, y = make_data(SMALL)
+    p_mem = {k: v for k, v in base_params(SMALL, "").items()
+             if not k.startswith("stream")}
+    oracle, _ = train_text(p_mem, X, label=y)
+
+    cache, stem = phase_sigkill_mid_binning(workdir, X, y, oracle)
+    phase_corrupt_chunk(os.path.join(workdir, "p1"), cache, stem,
+                        oracle)
+    phase_truncated_cache(os.path.join(workdir, "p1"), cache, stem,
+                          oracle)
+    phase_transient_reads(workdir, X, y, oracle)
+    Xb, yb = make_data(BIG, seed=23)
+    phase_sigkill_mid_training(workdir, Xb, yb)
+
+    n_ok = sum(1 for c in CHECKS if c["ok"])
+    result = {"checks": CHECKS, "passed": n_ok, "total": len(CHECKS)}
+    print(f"\nchaos_ingest: {n_ok}/{len(CHECKS)} checks passed",
+          flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+    return 0 if n_ok == len(CHECKS) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -484,6 +484,23 @@ def triage(records, baseline=None):
                 f"({s.get('fleet_publish_verified', 0):.0f} verified), "
                 f"{s.get('fleet_skips', 0):.0f} skips, "
                 f"{s.get('fleet_rollbacks', 0):.0f} rollbacks")
+        if s.get("ingest_runs") or s.get("ingest_chunk_reads") or \
+                s.get("ingest_quarantines"):
+            lines.append(
+                f"ingest      : "
+                f"{s.get('ingest_chunk_reads', 0):.0f} chunk reads "
+                f"({s.get('ingest_rows', 0):.0f} rows), "
+                f"{s.get('ingest_cache_writes', 0):.0f} cache writes "
+                f"({s.get('ingest_cached_bytes', 0) / 1e6:.2f} MB), "
+                f"{s.get('ingest_cache_hits', 0):.0f} chunk cache "
+                f"hits, {s.get('ingest_rebins', 0):.0f} re-bins, "
+                f"{s.get('ingest_mapper_fits', 0):.0f} mapper fits "
+                f"({s.get('ingest_prelude_hits', 0):.0f} prelude "
+                f"hits), {s.get('ingest_quarantines', 0):.0f} "
+                f"quarantined, {s.get('ingest_backoffs', 0):.0f} "
+                f"backoffs, prefetch overlap "
+                f"{s.get('ingest_prefetch_overlap_s', 0.0):.3f}s over "
+                f"{s.get('ingest_prefetch_windows', 0):.0f} windows")
         if s.get("continual_batches") or s.get("continual_quarantines"):
             mean_ms = (s.get("continual_batch_ms", 0.0) /
                        max(s.get("continual_batches", 0), 1))
