@@ -2050,6 +2050,139 @@ def ingest_only():
     return 0
 
 
+_SWEEP_SOLO_DRIVER = """\
+import json, sys
+import numpy as np
+import lightgbm_tpu as lgb
+z = np.load(sys.argv[1])
+params = json.loads(sys.argv[2])
+d = lgb.Dataset(z["X"], label=z["y"], free_raw_data=False)
+lgb.train(params, d, verbose_eval=False)
+"""
+
+
+def sweep_only():
+    """Fast path (``python bench.py --sweep-only``): measure the
+    vmapped booster battery (models/battery.py) against B sequential
+    solo trainings and write BENCH_sweep_cpu.json — one cell per
+    battery width B, with a models/s column for both lanes.  Every
+    member varies only traced per-model params (learning rate +
+    bagging seed), so each battery is ONE compiled program however
+    wide it is.
+
+    Two baselines, both reported:
+
+    - ``solo_proc``: one training per process — how sequential sweep
+      drivers actually run trainings, each paying JAX init + its own
+      compiles.  The battery amortizes exactly those costs, so this is
+      the headline ``speedup`` (the acceptance bar: B=16 battery wall
+      < 0.5x of 16 sequential solo trainings).
+    - ``solo_warm``: an in-process loop sharing one warm compile
+      cache — the floor a perfectly-cached sequential driver could
+      hit.  On a 1-core CPU the device compute is the same work
+      either way, so ``speedup_warm`` hovers near 1 there and the
+      battery's device-side win only appears with real accelerators
+      (dispatch amortization + the model axis on spare devices)."""
+    import datetime
+    import tempfile
+
+    if ensure_backend(variant="sweep") is None:
+        return 0
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.models import battery as battery_mod
+    from lightgbm_tpu.utils import telemetry as _telemetry
+    _telemetry.install_jax_hooks()
+
+    n_rows = int(os.environ.get("BENCH_SWEEP_ROWS", "2000"))
+    n_features = 28
+    rounds = int(os.environ.get("BENCH_SWEEP_ROUNDS", "30"))
+    widths = [int(b) for b in
+              os.environ.get("BENCH_SWEEP_B", "1,4,16").split(",")]
+    run_proc = os.environ.get("BENCH_SWEEP_PROC", "1") != "0"
+    X, y = make_higgs_shaped(n_rows, n_features, seed=3)
+
+    base = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+            "metric": "None", "num_iterations": rounds,
+            "bagging_fraction": 0.8, "bagging_freq": 1,
+            "deterministic": True, "seed": 11}
+
+    def member_params(i):
+        # traced-only variation: one static group, one compile
+        return dict(base, learning_rate=0.05 + 0.005 * i,
+                    bagging_seed=100 + i)
+
+    with tempfile.TemporaryDirectory() as td:
+        npz = os.path.join(td, "data.npz")
+        np.savez(npz, X=X, y=y)
+        cells = []
+        for B in widths:
+            ds = lgb.Dataset(X, label=y, free_raw_data=False)
+            specs = [battery_mod.MemberSpec(params=member_params(i),
+                                            tag=f"m{i}")
+                     for i in range(B)]
+            t0 = time.time()
+            report = battery_mod.train_battery(ds, specs)
+            battery_wall = time.time() - t0
+            assert all(not r.failed for r in report.results)
+
+            t0 = time.time()
+            for i in range(B):
+                d = lgb.Dataset(X, label=y, free_raw_data=False)
+                lgb.train(member_params(i), d, verbose_eval=False)
+            warm_wall = time.time() - t0
+
+            cell = {
+                "B": B,
+                "battery_wall_s": round(battery_wall, 3),
+                "battery_models_per_s": round(B / battery_wall, 3),
+                "solo_warm_wall_s": round(warm_wall, 3),
+                "solo_warm_models_per_s": round(B / warm_wall, 3),
+                "speedup_warm": round(warm_wall / battery_wall, 2),
+                "groups": report.groups,
+                "xla_compiles": report.xla_compiles,
+                "retraces_per_model": round(
+                    report.retraces_per_model, 3),
+            }
+            if run_proc:
+                t0 = time.time()
+                for i in range(B):
+                    subprocess.run(
+                        [sys.executable, "-c", _SWEEP_SOLO_DRIVER,
+                         npz, json.dumps(member_params(i))],
+                        check=True, env=dict(os.environ,
+                                             JAX_PLATFORMS="cpu"))
+                proc_wall = time.time() - t0
+                cell.update({
+                    "solo_proc_wall_s": round(proc_wall, 3),
+                    "solo_proc_models_per_s": round(B / proc_wall, 3),
+                    "speedup": round(proc_wall / battery_wall, 2),
+                })
+            cells.append(cell)
+            print(json.dumps({"sweep_cell": B, **cell}), flush=True)
+
+    out = {
+        "metric": "sweep_battery_cpu",
+        "unit": "models/s",
+        "backend": "cpu",
+        "date": datetime.date.today().isoformat(),
+        "source": "JAX_PLATFORMS=cpu python bench.py --sweep-only",
+        "env": "1-core CPU container",
+        "forest": (f"15-leaf binary forest, {n_rows} x {n_features} "
+                   f"Higgs-shaped train matrix, {rounds} iterations, "
+                   f"bagging 0.8/1"),
+        "config": {"rows": n_rows, "features": n_features,
+                   "rounds": rounds, "widths": widths},
+        "cells": cells,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_sweep_cpu.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    print(json.dumps({"wrote": os.path.basename(path)}), flush=True)
+    return 0
+
+
 if __name__ == "__main__":
     if "--serve-only" in sys.argv:
         sys.exit(serve_only())
@@ -2065,4 +2198,6 @@ if __name__ == "__main__":
         sys.exit(ingest_only())
     if "--weakscale-only" in sys.argv:
         sys.exit(weakscale_only())
+    if "--sweep-only" in sys.argv:
+        sys.exit(sweep_only())
     sys.exit(main())

@@ -239,10 +239,11 @@ def _task_serve(params: Dict[str, str], config: Config) -> None:
             # a ROOT is a live deploy pipeline: watch it (validated
             # auto-publish + rollback); an explicit ckpt_* dir is a
             # one-shot serve
+            fcfg = FleetConfig.from_params(config)
             watcher = CheckpointWatcher(
-                config.input_model, RegistryTarget(server),
-                config=FleetConfig.from_params(config),
-                recorder=server._recorder).start()
+                config.input_model,
+                RegistryTarget(server, model=fcfg.tenant),
+                config=fcfg, recorder=server._recorder).start()
     else:
         server.registry.publish(Booster(model_file=config.input_model))
     try:
@@ -323,6 +324,49 @@ def _task_continual(params: Dict[str, str], config: Config) -> None:
     Log.info("continual: exit (%s)", stats.get("status", "?"))
 
 
+def _task_sweep(params: Dict[str, str], config: Config) -> None:
+    """Hyperparameter sweep + k-fold CV as one compiled booster
+    battery (``engine.sweep``, ``docs/Sweep.md``): candidates from
+    ``sweep_grid`` (x ``sweep_random``) score on ``sweep_folds``-fold
+    CV over the ONE shared dataset; the winner's full-data model is
+    saved to ``output_model``."""
+    from .basic import Dataset
+    from .engine import sweep
+    from .utils import telemetry as _telemetry
+
+    if not config.data:
+        Log.fatal("No training data: set data=<file>")
+    if not config.sweep_grid and not config.sweep_random:
+        Log.warning("task=sweep without sweep_grid: scoring the base "
+                    "params on %d-fold CV only", config.sweep_folds)
+    recorder = None
+    if config.telemetry_file:
+        recorder = _telemetry.RunRecorder(
+            config.telemetry_file, run_info={"task": "sweep",
+                                             "backend": "none"})
+        _telemetry.set_recorder(recorder)
+    train_set = Dataset(config.data, params=params)
+    try:
+        res = sweep(params, train_set,
+                    num_boost_round=config.num_iterations)
+        if res.best_index < 0:
+            Log.fatal("sweep: every candidate failed")
+        Log.info("sweep: winner c%d %s=%.6g at iteration %d (%s)",
+                 res.best_index, res.metric_name, res.best_score,
+                 res.best_iteration,
+                 ";".join(f"{k}={v}" for k, v in
+                          res.candidates[res.best_index].items())
+                 or "base params")
+        with open(config.output_model, "w") as f:
+            f.write(res.model_text)
+        Log.info("Finished sweep; winner saved to %s",
+                 config.output_model)
+    finally:
+        if recorder is not None:
+            _telemetry.set_recorder(None)
+            recorder.close()
+
+
 def _task_refit(params: Dict[str, str], config: Config) -> None:
     from .basic import Booster
     from .io.parser import parse_file
@@ -351,7 +395,7 @@ def main(argv: List[str] = None) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
         print("tasks: train | predict | convert_model | refit | serve "
-              "| route | continual")
+              "| route | continual | sweep")
         return 0
     params = _parse_args(argv)
     config = Config(params)
@@ -370,6 +414,8 @@ def main(argv: List[str] = None) -> int:
         _task_route(params, config)
     elif task in ("continual", "continual_train"):
         _task_continual(params, config)
+    elif task == "sweep":
+        _task_sweep(params, config)
     else:
         Log.fatal("unknown task %r", task)
     return 0

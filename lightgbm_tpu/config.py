@@ -42,7 +42,7 @@ PARAMS: List[Param] = [
     # ---- core ----
     _p("config", "", str, ("config_file",), "path to config file"),
     _p("task", "train", str, ("task_type",),
-       "train, predict, convert_model, refit, serve, continual"),
+       "train, predict, convert_model, refit, serve, continual, sweep"),
     _p("objective", "regression", str,
        ("objective_type", "app", "application", "loss"),
        "regression, regression_l1, huber, fair, poisson, quantile, mape, "
@@ -766,6 +766,11 @@ PARAMS: List[Param] = [
        "snapshots are validated (manifest hashes + canary scoring) "
        "and auto-published; corrupt or mis-scoring snapshots are "
        "skipped with a telemetry anomaly", group="fleet", check=">0"),
+    _p("watch_tenant", "default", str, (),
+       "named tenant the continual watcher (and task=sweep) publishes "
+       "models under: replicas load it via the routing front's "
+       "/v1/<tenant>/... endpoints while 'default' keeps the unnamed "
+       "routes working", group="fleet"),
     _p("canary_file", "", str, (),
        "npz of pinned reference rows the watcher scores every "
        "candidate snapshot on before publishing: array 'X' (rows), "
@@ -806,6 +811,40 @@ PARAMS: List[Param] = [
        "fingerprint are skipped (reason=holddown) for this long — a "
        "regressing deploy cannot flap back in", group="fleet",
        check=">=0"),
+    # ---- sweep (many-model battery training: models/battery.py) ----
+    _p("sweep_grid", "", str, (),
+       "hyperparameter grid for task=sweep as "
+       "'param=v1,v2;param2=v3,v4' — the cartesian product defines "
+       "the candidate set.  Candidates varying only traced per-model "
+       "params (learning_rate, seeds, feature_fraction) share ONE "
+       "compiled program (docs/Sweep.md)", group="sweep"),
+    _p("sweep_random", 0, int, (),
+       "instead of the full cartesian product, sample this many "
+       "candidates uniformly from the grid's choices (0 = full grid)",
+       group="sweep", check=">=0"),
+    _p("sweep_seed", 0, int, (),
+       "seed of the random-candidate sampler", group="sweep"),
+    _p("sweep_folds", 3, int, ("sweep_nfold",),
+       "k-fold CV folds scored per candidate; fold masks ride as "
+       "per-model weight vectors over the ONE shared dataset (no "
+       "data replication).  1 = no CV (requires sweep_train_full for "
+       "winner selection by training metric)", group="sweep",
+       check=">=1"),
+    _p("sweep_fold_seed", 0, int, (),
+       "seed of the CV fold shuffle", group="sweep"),
+    _p("sweep_metric", "", str, (),
+       "metric scoring each candidate's held-out fold rows per "
+       "iteration (l2, rmse, l1, binary_logloss, binary_error, auc); "
+       "'' picks the objective's default.  Winner = best mean CV "
+       "score at its best iteration", group="sweep"),
+    _p("sweep_train_full", True, bool, (),
+       "also train every candidate on ALL rows inside the same "
+       "compiled battery, so the winner's full-data model exports "
+       "without a refit pass", group="sweep"),
+    _p("sweep_shard_models", False, bool, (),
+       "lay the battery's model axis onto the device mesh when it "
+       "tiles evenly (spare devices train disjoint members; no "
+       "collectives, bit-identical results)", group="sweep"),
     # ---- continual (long-running trainer daemon, lightgbm_tpu/cont/) ----
     _p("continual_ingest_dir", "", str, ("ingest_dir",),
        "batch source directory of the continual training daemon "

@@ -16,10 +16,12 @@ import numpy as np
 from . import callback as callback_mod
 from .basic import Booster, Dataset
 from .callback import CallbackEnv, EarlyStopException
+from .metrics import default_metric_for
 from .utils.log import Log
 
-__all__ = ["train", "cv", "CVBooster", "request_preempt",
-           "preempt_requested", "clear_preempt", "install_preempt_guard"]
+__all__ = ["train", "cv", "CVBooster", "sweep", "SweepResult",
+           "request_preempt", "preempt_requested", "clear_preempt",
+           "install_preempt_guard"]
 
 
 # ----------------------------------------------------------------------
@@ -673,3 +675,286 @@ def _agg_cv_result(boosters, feval, fold_data):
     return [(name if name != "valid" else "valid", metric,
              float(np.mean(vals)), hb, float(np.std(vals)))
             for (name, metric, hb), vals in by_key.items()]
+
+
+# ----------------------------------------------------------------------
+# task=sweep: hyperparameter search + k-fold CV as ONE compiled battery
+# ----------------------------------------------------------------------
+# Candidates x folds stack on the model axis of a vmapped booster
+# battery (models/battery.py): the shared binned matrix is resident
+# once, fold masks ride as per-model weight vectors, and candidates
+# that vary only traced per-model params (learning rate, seeds,
+# feature_fraction) share ONE XLA compile.
+
+_SWEEP_METRIC_GREATER = {"auc"}
+
+
+def _parse_sweep_grid(text: str) -> "collections.OrderedDict":
+    """``'learning_rate=0.05,0.1;bagging_seed=1,2'`` -> ordered
+    ``{param: [values]}`` with numeric coercion (int before float
+    before raw string)."""
+    grid: "collections.OrderedDict" = collections.OrderedDict()
+    for clause in str(text or "").split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if "=" not in clause:
+            Log.fatal("sweep_grid clause %r has no '='", clause)
+        name, _, vals = clause.partition("=")
+        parsed = []
+        for tok in vals.split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            for cast in (int, float):
+                try:
+                    parsed.append(cast(tok))
+                    break
+                except ValueError:
+                    continue
+            else:
+                parsed.append(tok)
+        if parsed:
+            grid[name.strip()] = parsed
+    return grid
+
+
+def _expand_candidates(grid, num_random: int,
+                       seed: int) -> List[Dict[str, Any]]:
+    """Candidate override dicts: the grid's cartesian product, or
+    ``num_random`` uniform samples from its per-param choices."""
+    if not grid:
+        return [{}]
+    names = list(grid)
+    if num_random and num_random > 0:
+        rng = np.random.RandomState(seed & 0x7FFFFFFF)
+        out = []
+        for _ in range(int(num_random)):
+            out.append({k: grid[k][rng.randint(len(grid[k]))]
+                        for k in names})
+        return out
+    out = [{}]
+    for k in names:
+        out = [{**c, k: v} for c in out for v in grid[k]]
+    return out
+
+
+def _sweep_metric(name: str, objective: str, label, weight, sigmoid):
+    """``(metric_name, fn(raw_scores, row_indices) -> float,
+    greater_is_better)`` — the per-iteration fold scorer, computed in
+    f64 on host (the curve itself replays the device f32 scores
+    bit-exactly; only the metric reduction is f64)."""
+    name = (name or "").strip() or default_metric_for(objective)
+    alias = {"mse": "l2", "regression": "l2", "regression_l2": "l2",
+             "mae": "l1", "regression_l1": "l1", "l2_root": "rmse"}
+    name = alias.get(name, name)
+    y = np.asarray(label, np.float64)
+    w = None if weight is None else np.asarray(weight, np.float64)
+    sig = float(sigmoid or 1.0)
+
+    def wmean(v, rows):
+        if w is None:
+            return float(np.mean(v))
+        return float(np.sum(v * w[rows]) / np.sum(w[rows]))
+
+    if name == "l2":
+        fn = lambda s, rows: wmean(  # noqa: E731
+            (np.asarray(s, np.float64) - y[rows]) ** 2, rows)
+    elif name == "rmse":
+        fn = lambda s, rows: float(np.sqrt(wmean(  # noqa: E731
+            (np.asarray(s, np.float64) - y[rows]) ** 2, rows)))
+    elif name == "l1":
+        fn = lambda s, rows: wmean(  # noqa: E731
+            np.abs(np.asarray(s, np.float64) - y[rows]), rows)
+    elif name == "binary_logloss":
+        def fn(s, rows):
+            p = 1.0 / (1.0 + np.exp(-sig * np.asarray(s, np.float64)))
+            p = np.clip(p, 1e-15, 1.0 - 1e-15)
+            yy = y[rows]
+            return wmean(-(yy * np.log(p) + (1 - yy) * np.log(1 - p)),
+                         rows)
+    elif name == "binary_error":
+        fn = lambda s, rows: wmean(  # noqa: E731
+            (np.asarray(s, np.float64) > 0) != (y[rows] > 0), rows)
+    elif name == "auc":
+        from .serve.watcher import auc_score
+        fn = lambda s, rows: auc_score(y[rows], s)  # noqa: E731
+    else:
+        Log.warning("sweep_metric %s unsupported for fold scoring; "
+                    "falling back to l2", name)
+        return _sweep_metric("l2", objective, label, weight, sigmoid)
+    return name, fn, name in _SWEEP_METRIC_GREATER
+
+
+class SweepResult:
+    """Outcome of one :func:`sweep` call."""
+
+    def __init__(self, candidates, metric_name, greater_better):
+        self.candidates: List[Dict[str, Any]] = candidates
+        self.metric_name = metric_name
+        self.greater_better = greater_better
+        self.cv_curves: List[List[List[float]]] = []  # [cand][fold][it]
+        self.scores: List[float] = []        # best mean CV score / cand
+        self.best_iters: List[int] = []      # 1-based best iter / cand
+        self.best_index: int = -1
+        self.best_iteration: int = -1
+        self.best_score: float = float("nan")
+        self.best_params: Dict[str, Any] = {}
+        self.model_text: str = ""
+        self.booster: Optional[Booster] = None
+        self.report = None                   # battery.BatteryReport
+
+    def _worst(self) -> float:
+        return -np.inf if self.greater_better else np.inf
+
+
+def sweep(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: Optional[int] = None, *,
+          grid: Optional[Dict[str, Sequence[Any]]] = None,
+          folds=None, metric: Optional[str] = None,
+          supervisor=None, tenant: Optional[str] = None) -> SweepResult:
+    """Hyperparameter sweep + k-fold CV as one compiled battery.
+
+    Builds candidates from ``grid`` (or ``params['sweep_grid']``),
+    scores each on ``sweep_folds``-fold CV — fold masks are per-model
+    weight vectors over the ONE shared ``train_set``, never data
+    copies — and trains every candidate's full-data model in the same
+    battery (``sweep_train_full``).  The winner (best mean CV score at
+    its best iteration) is exported as a model string byte-equal to
+    solo training, loaded into ``result.booster``, and — when a
+    ``supervisor`` (serve.fleet.FleetSupervisor) is passed — published
+    under ``tenant`` (default ``watch_tenant``).  Emits one ``sweep``
+    telemetry record; steady-state XLA compiles per static group is 1
+    (``retraces_per_model`` flags violations, obs/rules.py)."""
+    from .config import Config
+    from .models import battery as battery_mod
+    from .utils import telemetry as _telemetry
+
+    params = dict(params)
+    cfg = Config(params)
+    T = int(num_boost_round if num_boost_round is not None
+            else cfg.num_iterations)
+    if grid is None:
+        grid = _parse_sweep_grid(cfg.sweep_grid)
+    candidates = _expand_candidates(grid, cfg.sweep_random,
+                                    cfg.sweep_seed)
+    train_set.construct()
+    n = train_set.num_data()
+    label = train_set.get_label()
+    base_w = train_set.get_weight()
+
+    metric_name, metric_fn, greater = _sweep_metric(
+        metric if metric is not None else cfg.sweep_metric,
+        cfg.objective, label, base_w, getattr(cfg, "sigmoid", 1.0))
+
+    # ---- fold masks over the shared dataset --------------------------
+    nfold = max(1, int(cfg.sweep_folds))
+    if nfold > 1 or folds is not None:
+        stratified = str(cfg.objective).startswith("binary")
+        folds_idx = _make_folds(train_set, nfold, stratified, True,
+                                cfg.sweep_fold_seed, folds)
+    else:
+        # nfold=1: one "fold" trains on every row and scores the
+        # training metric — the fold member IS the full-data model
+        all_idx = np.arange(n)
+        folds_idx = [(all_idx, all_idx)]
+    nfold = len(folds_idx)
+    fold_w, fold_m = [], []
+    for tr_idx, te_idx in folds_idx:
+        w = np.zeros(n, np.float32)
+        w[tr_idx] = 1.0 if base_w is None else \
+            np.asarray(base_w, np.float32)[tr_idx]
+        m = np.zeros(n, bool)
+        m[te_idx] = True
+        fold_w.append(w)
+        fold_m.append(m)
+
+    # ---- member specs: candidates x (folds [+ full]) -----------------
+    want_full = bool(cfg.sweep_train_full) and not \
+        (nfold == 1 and folds is None)
+    specs: List[battery_mod.MemberSpec] = []
+    full_of: Dict[int, int] = {}     # candidate -> full-member index
+    fold_of: Dict[int, List[int]] = {}
+    for ci, cand in enumerate(candidates):
+        merged = {**params, **cand, "num_iterations": T}
+        fold_of[ci] = []
+        for k in range(nfold):
+            fold_of[ci].append(len(specs))
+            specs.append(battery_mod.MemberSpec(
+                params=merged, weight=fold_w[k], eval_mask=fold_m[k],
+                tag=f"c{ci}/fold{k}"))
+        if want_full:
+            full_of[ci] = len(specs)
+            specs.append(battery_mod.MemberSpec(
+                params=merged, tag=f"c{ci}/full"))
+        else:
+            full_of[ci] = fold_of[ci][0]
+    Log.info("sweep: %d candidates x %d folds%s = %d battery members",
+             len(candidates), nfold, " (+full)" if want_full else "",
+             len(specs))
+
+    report = battery_mod.train_battery(
+        train_set, specs, metric=metric_fn,
+        shard_models=bool(cfg.sweep_shard_models))
+
+    # ---- per-candidate CV aggregation and winner selection -----------
+    res = SweepResult(candidates, metric_name, greater)
+    res.report = report
+    for ci in range(len(candidates)):
+        members = [report.results[i] for i in fold_of[ci]]
+        curves = [m.curve or [] for m in members]
+        res.cv_curves.append(curves)
+        depth = min((len(c) for c in curves), default=0)
+        if any(m.failed for m in members) or depth == 0:
+            res.scores.append(res._worst())
+            res.best_iters.append(-1)
+            continue
+        mean = np.mean([c[:depth] for c in curves], axis=0)
+        bi = int(np.argmax(mean) if greater else np.argmin(mean))
+        res.scores.append(float(mean[bi]))
+        res.best_iters.append(bi + 1)
+    order = np.argsort(res.scores)
+    best = int(order[-1] if greater else order[0])
+    if np.isfinite(res.scores[best]):
+        res.best_index = best
+        res.best_iteration = res.best_iters[best]
+        res.best_score = res.scores[best]
+        res.best_params = {**params, **candidates[best],
+                           "num_iterations": T}
+
+    # ---- winner export (byte-equal to solo training) -----------------
+    if res.best_index >= 0:
+        win = report.results[full_of[res.best_index]]
+        if not win.failed and win.trees:
+            ni = min(res.best_iteration, len(win.trees))
+            res.model_text = battery_mod.member_model_string(
+                win, Config(dict(win.spec.params)),
+                train_set._constructed, num_iteration=ni)
+            res.booster = Booster(model_str=res.model_text)
+            res.booster.best_iteration = ni
+
+    rec = _telemetry.get_recorder()
+    if rec is not None:
+        dur = max(report.duration_s, 1e-9)
+        rec.emit("sweep", models=len(specs), groups=report.groups,
+                 xla_compiles=report.xla_compiles,
+                 retraces_per_model=float(report.retraces_per_model),
+                 models_per_s=float(len(specs) / dur),
+                 vmap_members=report.vmap_members,
+                 solo_members=report.solo_members,
+                 candidates=len(candidates), folds=nfold,
+                 metric=metric_name,
+                 best_index=res.best_index,
+                 best_iteration=res.best_iteration,
+                 best_score=(float(res.best_score)
+                             if np.isfinite(res.best_score) else None),
+                 best_iters=list(res.best_iters))
+
+    if supervisor is not None and res.model_text:
+        name = tenant if tenant is not None else \
+            (cfg.watch_tenant or "default")
+        supervisor.publish_model(res.model_text, source="sweep",
+                                 model=name)
+        Log.info("sweep: published winner c%d (score=%.6g) under "
+                 "tenant %r", res.best_index, res.best_score, name)
+    return res

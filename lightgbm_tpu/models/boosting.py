@@ -66,6 +66,11 @@ class GOSS(GBDT):
         jitted program serves the sequential and scan-inlined call
         sites (fused-path bit-parity)."""
         import jax
+        if getattr(self, "_trace_raw", False):
+            # battery trace: ``self._bag_key`` is a per-model tracer —
+            # inline the raw impl (jit under a trace compiles to the
+            # same program, so solo/battery stay bit-identical)
+            return self._goss_mask_impl(it, grad, hess)
         if getattr(self, "_goss_mask_jit", None) is None:
             self._goss_mask_jit = jax.jit(self._goss_mask_impl)
         return self._goss_mask_jit(it, grad, hess)
@@ -147,6 +152,9 @@ class MVS(GBDT):
         """One jitted program from both call sites — see
         :meth:`GOSS._goss_mask`."""
         import jax
+        if getattr(self, "_trace_raw", False):
+            # battery trace: see GOSS._goss_mask
+            return self._mvs_mask_impl(it, grad, hess)
         if getattr(self, "_mvs_mask_jit", None) is None:
             self._mvs_mask_jit = jax.jit(self._mvs_mask_impl)
         return self._mvs_mask_jit(it, grad, hess)

@@ -35,6 +35,96 @@ def _threshold_l1(s, l1):
     return np.sign(s) * np.maximum(np.abs(s) - l1, 0.0)
 
 
+def records_to_tree(rec, config, train_set, counts_proxy=False) -> Tree:
+    """Materialize ONE host :class:`Tree` from a fetched split-record
+    dict.  Module-level (not a GBDT method) so the battery trainer
+    (``models/battery.py``) can assemble per-member trees from stacked
+    (B, K, ...) records with per-member configs without instantiating
+    B GBDT drivers — the shared TpuDataset supplies the bin mappers."""
+    cfg = config
+    ds = train_set
+    tree = Tree(cfg.num_leaves)
+
+    def out(g, h):
+        o = -np.sign(_thl1(g, cfg.lambda_l1)) * abs(
+            _thl1(g, cfg.lambda_l1)) / (h + cfg.lambda_l2 + _KEPS)
+        if cfg.max_delta_step > 0:
+            o = np.clip(o, -cfg.max_delta_step, cfg.max_delta_step)
+        return float(o)
+
+    def _thl1(s, l1):
+        return np.sign(s) * max(abs(s) - l1, 0.0) if l1 > 0 else s
+
+    L1 = cfg.num_leaves - 1
+    for i in range(L1):
+        if not bool(rec["valid"][i]):
+            break
+        leaf = int(rec["leaf"][i])
+        inner_f = int(rec["feature"][i])
+        real_f = ds.real_feature_index(inner_f)
+        mapper = ds.mappers[real_f]
+        ls = rec["left_stats"][i]
+        rs = rec["right_stats"][i]
+        lv, rv = out(ls[0], ls[1]), out(rs[0], rs[1])
+        if "rec_left_min" in rec:
+            # monotone value constraints (the device loop clamped
+            # identically; redo in f64 on the host-side outputs)
+            lv = float(np.clip(lv, rec["rec_left_min"][i],
+                               rec["rec_left_max"][i]))
+            rv = float(np.clip(rv, rec["rec_right_min"][i],
+                               rec["rec_right_max"][i]))
+        gain = float(rec["gain"][i])
+        if bool(rec["is_cat"][i]):
+            bins = np.nonzero(rec["left_mask"][i])[0]
+            cats = [mapper.bin_2_categorical[b] for b in bins
+                    if 0 < b < len(mapper.bin_2_categorical)]
+            if not cats:
+                cats = [0]
+            tree.split_categorical(
+                leaf, real_f, cat_bitset(cats), lv, rv,
+                float(ls[1]), float(rs[1]), int(round(ls[2])),
+                int(round(rs[2])), gain, mapper.missing_type)
+        else:
+            thr_bin = int(rec["threshold"][i])
+            tree.split(leaf, real_f, thr_bin,
+                       mapper.bin_to_value(thr_bin), lv, rv,
+                       float(ls[1]), float(rs[1])
+                       , int(round(ls[2])), int(round(rs[2])), gain,
+                       mapper.missing_type,
+                       bool(rec["default_left"][i]))
+        node = tree.num_leaves - 2
+        pg, ph = ls[0] + rs[0], ls[1] + rs[1]
+        tree.internal_value[node] = out(pg, ph)
+    if "leaf_stats_exact" in rec:
+        # quantized training: renew leaf outputs from the
+        # full-precision per-leaf sums (RenewIntGradTreeOutput) so
+        # leaf values carry no stochastic-rounding noise
+        ex = np.asarray(rec["leaf_stats_exact"], np.float64)
+        for leaf in range(tree.num_leaves):
+            if leaf < len(ex) and ex[leaf, 2] > 0:
+                tree.leaf_value[leaf] = out(ex[leaf, 0], ex[leaf, 1])
+        if counts_proxy:
+            # two-column passes record hess sums in the count slots;
+            # restore REAL counts: leaves from the exact renewal
+            # sums, internal nodes by one REVERSE-id sweep (a
+            # child's node id always exceeds its parent's, so its
+            # count is ready first; no recursion — chain-shaped
+            # trees can exceed Python's recursion limit)
+            for leaf in range(tree.num_leaves):
+                if leaf < len(ex):
+                    tree.leaf_count[leaf] = int(round(ex[leaf, 2]))
+
+            def child_count(c):
+                return tree.leaf_count[~c] if c < 0 else \
+                    tree.internal_count[c]
+
+            for node in range(tree.num_leaves - 2, -1, -1):
+                tree.internal_count[node] = \
+                    child_count(tree.left_child[node]) + \
+                    child_count(tree.right_child[node])
+    return tree
+
+
 @dataclasses.dataclass
 class ValidSet:
     name: str
@@ -828,6 +918,14 @@ class GBDT:
         call sites — makes the fused and sequential paths
         bit-identical."""
         import jax
+        if getattr(self, "_trace_raw", False):
+            # battery trace: ``self._bag_key`` is a per-model tracer,
+            # so the draw must inline into the enclosing trace instead
+            # of caching a jitted wrapper around it.  jit called under
+            # a trace inlines to the same program as the raw call, so
+            # this is program-identical to the solo path.
+            self._ensure_label_pos()
+            return self._draw_bag_mask_impl(it)
         if getattr(self, "_bag_draw_jit", None) is None:
             self._ensure_label_pos()
             self._bag_draw_jit = jax.jit(self._draw_bag_mask_impl)
@@ -962,27 +1060,25 @@ class GBDT:
                 not self._models and self._pending is None and
                 self.train_set.metadata.init_score is None)
 
-    def _build_superstep_fn(self):
-        """Build the jitted K-iteration scan.  K is carried by the xs
-        shapes, so one jitted callable serves every block size (the
-        shorter tail block recompiles once).  Big device residents
-        (the binned matrix, masks, descriptors) ride as ARGUMENTS —
-        closure capture would embed them in the remote-compile
-        payload; the objective's label tensors stay closure-captured
-        because ``gradient_fn`` owns them.
+    def _superstep_core(self, batched: bool = False):
+        """The raw (unjitted, unsharded) K-iteration scan body, shared
+        by the solo fused path (:meth:`_build_superstep_fn`) and the
+        many-model battery trainer (``models/battery.py``).
 
-        With a distributed learner the SAME scan body runs SPMD: the
-        whole K-iteration program is wrapped in ``shard_map`` over the
-        learner's 1-D mesh, the binned matrix arrives as the local
-        shard (rows for data/voting, features for feature-parallel),
-        and the per-strategy histogram/merge collectives inside
-        ``build_tree_impl`` ride within the one compiled program — K
-        iterations of sharded build+update cost ONE dispatch, not 5K
-        per-shard dispatches.  Gradients, mask draws and the score
-        update run replicated (identical math on every shard — the
-        bit-exactness anchor against the serial scan), and the
-        row-sharded learners all-gather the (N,) leaf assignment once
-        per iteration for the replicated score update."""
+        With ``batched=True`` the returned callable grows two trailing
+        per-model arguments — ``wvec``, a per-row gradient/hessian
+        weight (the battery's CV fold masks ride here, multiplying
+        exactly where solo weighted training multiplies metadata
+        weights), and ``bag_key``, the bagging/GOSS/MVS PRNG key that
+        replaces the closure-captured ``self._bag_key`` — so the whole
+        scan can be lifted over a leading model axis with ``jax.vmap``.
+        Per-model values arrive TRACED while every structural knob
+        stays static (the bit-exactness anchor: a traced operand of
+        equal value yields the same elementwise ops as a constant, but
+        a static knob becoming traced would change the expression
+        tree).  The tracer swap happens at trace time only, and
+        ``_trace_raw`` routes the mask draws to their raw impls so no
+        jitted wrapper captures a tracer in its closure."""
         import jax
         import jax.numpy as jnp
         from ..ops.grow import build_tree_impl
@@ -991,6 +1087,7 @@ class GBDT:
         dist = self._dist
         p = self.grow_params if dist is None else dist.params
         n, n_pad = self.num_data, self._n_pad
+        obj = self.objective
         grad_fn = self.objective.gradient_fn()
         mask_fn = self._fused_mask_fn()
         self._fused_has_bagging = mask_fn is not None
@@ -1009,11 +1106,27 @@ class GBDT:
 
         def superstep(score, bag0, lr, quant_key, xt, base_mask,
                       num_bins, missing_type, is_cat, iters, fmasks,
-                      tree_ids):
+                      tree_ids, *extras):
+            if batched:
+                wvec, bag_key = extras
+                saved_key = self._bag_key
+                saved_raw = getattr(self, "_trace_raw", False)
+                self._bag_key = bag_key
+                self._trace_raw = True
+
             def step(carry, xs):
                 sc, bag_prev = carry
                 it, fmask, tid = xs
-                grad, hess = grad_fn(sc)
+                if batched:
+                    # per-model fold/sample weights multiply inside
+                    # the objective exactly where solo weighted
+                    # training multiplies metadata weights
+                    # (objectives.py ``_w``/``_jitted_gradients``) —
+                    # the loop-of-solo CV reference's op order
+                    with obj.weight_override(wvec):
+                        grad, hess = obj.get_gradients(sc)
+                else:
+                    grad, hess = grad_fn(sc)
                 grad = jnp.atleast_2d(grad)
                 hess = jnp.atleast_2d(hess)
                 bag = mask_fn(it, bag_prev, grad, hess) \
@@ -1086,9 +1199,17 @@ class GBDT:
                 return (new_sc, new_bag), \
                     (host_rec, li.astype(li_dt), vals)
 
-            (final_sc, final_bag), (recs, leaf_idx_k, vals_k) = \
-                jax.lax.scan(step, (score, bag0),
-                             (iters, fmasks, tree_ids))
+            try:
+                (final_sc, final_bag), (recs, leaf_idx_k, vals_k) = \
+                    jax.lax.scan(step, (score, bag0),
+                                 (iters, fmasks, tree_ids))
+            finally:
+                if batched:
+                    # the key/raw swap is trace-time state only —
+                    # restore it even when the trace aborts (e.g. a
+                    # kernel without a batching rule under vmap)
+                    self._bag_key = saved_key
+                    self._trace_raw = saved_raw
             # returning the donated inputs forces XLA to copy the
             # block-start score AND bagging mask out — the
             # rewind/rollback anchor at no extra dispatch, and (under
@@ -1098,6 +1219,35 @@ class GBDT:
             return (score, bag0, final_sc, final_bag, recs, leaf_idx_k,
                     vals_k)
 
+        return superstep
+
+    def _build_superstep_fn(self):
+        """Build the jitted K-iteration scan.  K is carried by the xs
+        shapes, so one jitted callable serves every block size (the
+        shorter tail block recompiles once).  Big device residents
+        (the binned matrix, masks, descriptors) ride as ARGUMENTS —
+        closure capture would embed them in the remote-compile
+        payload; the objective's label tensors stay closure-captured
+        because ``gradient_fn`` owns them.
+
+        With a distributed learner the SAME scan body runs SPMD: the
+        whole K-iteration program is wrapped in ``shard_map`` over the
+        learner's 1-D mesh, the binned matrix arrives as the local
+        shard (rows for data/voting, features for feature-parallel),
+        and the per-strategy histogram/merge collectives inside
+        ``build_tree_impl`` ride within the one compiled program — K
+        iterations of sharded build+update cost ONE dispatch, not 5K
+        per-shard dispatches.  Gradients, mask draws and the score
+        update run replicated (identical math on every shard — the
+        bit-exactness anchor against the serial scan), and the
+        row-sharded learners all-gather the (N,) leaf assignment once
+        per iteration for the replicated score update."""
+        import jax
+
+        superstep = self._superstep_core()
+        dist = self._dist
+        rows_sharded = dist is not None and dist.kind in ("data",
+                                                          "voting")
         if dist is not None:
             from jax.sharding import PartitionSpec as P
             from ..parallel.learners import shard_map_compat
@@ -2264,88 +2414,9 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def _records_to_tree(self, rec) -> Tree:
-        cfg = self.config
-        ds = self.train_set
-        tree = Tree(cfg.num_leaves)
-
-        def out(g, h):
-            o = -np.sign(_thl1(g, cfg.lambda_l1)) * abs(
-                _thl1(g, cfg.lambda_l1)) / (h + cfg.lambda_l2 + _KEPS)
-            if cfg.max_delta_step > 0:
-                o = np.clip(o, -cfg.max_delta_step, cfg.max_delta_step)
-            return float(o)
-
-        def _thl1(s, l1):
-            return np.sign(s) * max(abs(s) - l1, 0.0) if l1 > 0 else s
-
-        L1 = cfg.num_leaves - 1
-        for i in range(L1):
-            if not bool(rec["valid"][i]):
-                break
-            leaf = int(rec["leaf"][i])
-            inner_f = int(rec["feature"][i])
-            real_f = ds.real_feature_index(inner_f)
-            mapper = ds.mappers[real_f]
-            ls = rec["left_stats"][i]
-            rs = rec["right_stats"][i]
-            lv, rv = out(ls[0], ls[1]), out(rs[0], rs[1])
-            if "rec_left_min" in rec:
-                # monotone value constraints (the device loop clamped
-                # identically; redo in f64 on the host-side outputs)
-                lv = float(np.clip(lv, rec["rec_left_min"][i],
-                                   rec["rec_left_max"][i]))
-                rv = float(np.clip(rv, rec["rec_right_min"][i],
-                                   rec["rec_right_max"][i]))
-            gain = float(rec["gain"][i])
-            if bool(rec["is_cat"][i]):
-                bins = np.nonzero(rec["left_mask"][i])[0]
-                cats = [mapper.bin_2_categorical[b] for b in bins
-                        if 0 < b < len(mapper.bin_2_categorical)]
-                if not cats:
-                    cats = [0]
-                tree.split_categorical(
-                    leaf, real_f, cat_bitset(cats), lv, rv,
-                    float(ls[1]), float(rs[1]), int(round(ls[2])),
-                    int(round(rs[2])), gain, mapper.missing_type)
-            else:
-                thr_bin = int(rec["threshold"][i])
-                tree.split(leaf, real_f, thr_bin,
-                           mapper.bin_to_value(thr_bin), lv, rv,
-                           float(ls[1]), float(rs[1])
-                           , int(round(ls[2])), int(round(rs[2])), gain,
-                           mapper.missing_type,
-                           bool(rec["default_left"][i]))
-            node = tree.num_leaves - 2
-            pg, ph = ls[0] + rs[0], ls[1] + rs[1]
-            tree.internal_value[node] = out(pg, ph)
-        if "leaf_stats_exact" in rec:
-            # quantized training: renew leaf outputs from the
-            # full-precision per-leaf sums (RenewIntGradTreeOutput) so
-            # leaf values carry no stochastic-rounding noise
-            ex = np.asarray(rec["leaf_stats_exact"], np.float64)
-            for leaf in range(tree.num_leaves):
-                if leaf < len(ex) and ex[leaf, 2] > 0:
-                    tree.leaf_value[leaf] = out(ex[leaf, 0], ex[leaf, 1])
-            if getattr(self, "_counts_proxy", False):
-                # two-column passes record hess sums in the count slots;
-                # restore REAL counts: leaves from the exact renewal
-                # sums, internal nodes by one REVERSE-id sweep (a
-                # child's node id always exceeds its parent's, so its
-                # count is ready first; no recursion — chain-shaped
-                # trees can exceed Python's recursion limit)
-                for leaf in range(tree.num_leaves):
-                    if leaf < len(ex):
-                        tree.leaf_count[leaf] = int(round(ex[leaf, 2]))
-
-                def child_count(c):
-                    return tree.leaf_count[~c] if c < 0 else \
-                        tree.internal_count[c]
-
-                for node in range(tree.num_leaves - 2, -1, -1):
-                    tree.internal_count[node] = \
-                        child_count(tree.left_child[node]) + \
-                        child_count(tree.right_child[node])
-        return tree
+        return records_to_tree(rec, self.config, self.train_set,
+                               counts_proxy=getattr(self, "_counts_proxy",
+                                                    False))
 
     # ---- checkpoint/resume (lightgbm_tpu/ckpt/) ----------------------
     def completed_iterations(self) -> int:

@@ -15,6 +15,7 @@ segment-id masked ops.
 """
 from __future__ import annotations
 
+import contextlib
 from typing import Dict, Optional, Tuple, Type
 
 import jax
@@ -78,6 +79,27 @@ class Objective:
         if self.weight is not None:
             return grad * self.weight, hess * self.weight
         return grad, hess
+
+    # Battery training (models/battery.py): objectives whose weight
+    # handling is a pure gradient-time multiply can accept a per-trace
+    # weight override (per-model CV fold masks riding as a traced
+    # vector).  MAPE opts out — it bakes weights into its label
+    # weighting at init, so an override would be silently ignored.
+    supports_weight_override = True
+
+    @contextlib.contextmanager
+    def weight_override(self, weight):
+        """Swap ``self.weight`` for the duration of a trace.  The
+        override multiplies gradients/hessians at exactly the point
+        solo weighted training multiplies metadata weights, so a fold
+        mask entering here reproduces the solo weighted op order
+        bit-for-bit."""
+        saved = self.weight
+        self.weight = weight
+        try:
+            yield
+        finally:
+            self.weight = saved
 
     def _jitted_gradients(self, impl, args, **statics):
         """Dispatch ``impl(*args, weight, *, weighted=..., **statics)``
@@ -320,6 +342,7 @@ class MAPE(_RenewableRegression):
     """Mean absolute percentage error: L1 with 1/|label| row weights and
     weighted-median leaf refit."""
     is_constant_hessian = True
+    supports_weight_override = False  # weights baked into _label_weight
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)

@@ -35,7 +35,8 @@ WARMUP_ITERS = 3
 # ISSUE 13 names: retrace storm, pipelining-disabled,
 # XLA-fallback-on-TPU, stall, rollback, nonfinite)
 FLIGHT_TRIGGERS = ("retrace_storm", "pipelining_disabled",
-                   "xla_fallback", "stall", "rollback", "nonfinite")
+                   "xla_fallback", "stall", "rollback", "nonfinite",
+                   "sweep_retrace")
 
 # (severity, code, message)
 Anomaly = Tuple[str, str, str]
@@ -237,6 +238,20 @@ class OnlineScanner:
                         "MED", "xla_fallback",
                         f"split kernel fell back to XLA on a "
                         f"{backend} backend: {reason}"))
+        elif rtype == "sweep":
+            # battery contract: members of one static group share ONE
+            # compiled program — any compiles beyond groups mean the
+            # vmap lane silently retraced per model (the exact cost
+            # the battery exists to amortize)
+            rpm = float(r.get("retraces_per_model", 0.0) or 0.0)
+            if rpm > 0:
+                out.append((
+                    "MED", "sweep_retrace",
+                    f"sweep battery retraced after warmup: "
+                    f"{rpm:.2f} extra XLA compile(s) per model "
+                    f"({r.get('xla_compiles', '?')} compiles for "
+                    f"{r.get('groups', '?')} static group(s), "
+                    f"{r.get('models', '?')} models)"))
         elif rtype == "continual":
             event = r.get("event")
             if event == "stall_restart":

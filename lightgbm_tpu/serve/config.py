@@ -234,6 +234,9 @@ class FleetConfig:
     seed: int = 0
     # checkpoint watcher
     watch_poll_s: float = 2.0
+    # named tenant the watcher (and task=sweep) publishes under;
+    # "default" keeps the unnamed /predict-/swap routes working
+    tenant: str = "default"
     canary_file: str = ""
     canary_min_auc: float = 0.0
     canary_tolerance: float = 1e-6
@@ -267,6 +270,7 @@ class FleetConfig:
             circuit_cooldown_s=float(cfg.fleet_circuit_cooldown_s),
             seed=int(cfg.seed) if cfg.seed is not None else 0,
             watch_poll_s=float(cfg.watch_poll_s),
+            tenant=str(cfg.watch_tenant or "default"),
             canary_file=str(cfg.canary_file or ""),
             canary_min_auc=float(cfg.canary_min_auc),
             canary_tolerance=float(cfg.canary_tolerance),

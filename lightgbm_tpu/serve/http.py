@@ -234,6 +234,25 @@ def _json_handler_for(server: Server):
                 else:
                     self._send(200, _faults.snapshot())
             else:
+                # tenancy route: /v1/<model>/model reads the named
+                # registry (the fleet target's named-tenant
+                # active_model probe)
+                model, verb = split_model_route(self.path)
+                if model is not None and verb == "/model":
+                    try:
+                        ver = server.registry_for(model).current()
+                    except UnknownModel as exc:
+                        self._send(404, {"error": str(exc),
+                                         "code": "unknown_model"})
+                        return
+                    if ver is None:
+                        self._send(404, {"error": "no model published",
+                                         "code": "no_model"})
+                    else:
+                        self._send(200, {"version": ver.version,
+                                         "model_id": ver.model_id,
+                                         "model_str": ver.model_text})
+                    return
                 self._send(404, {"error": f"no route {self.path}",
                                  "code": "no_route"})
 

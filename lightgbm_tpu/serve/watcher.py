@@ -164,21 +164,35 @@ class CanarySet:
 # publish targets
 # ----------------------------------------------------------------------
 class RegistryTarget:
-    """Publish target over one in-process :class:`~.server.Server`."""
+    """Publish target over one in-process :class:`~.server.Server`.
 
-    def __init__(self, server):
+    ``model`` names the tenant registry published into (None/"default"
+    = the unnamed single-model routes) — the watcher's end-to-end
+    named-tenant path: daemon checkpoint (or sweep winner) -> named
+    registry -> ``/v1/<model>/predict``."""
+
+    def __init__(self, server, model: Optional[str] = None):
         self.server = server
+        self.model = model if model not in (None, "") else None
+
+    def _registry(self):
+        try:
+            return self.server.registry_for(self.model)
+        except Exception:          # noqa: BLE001 - tenant not yet born
+            return None
 
     def active_model(self) -> Optional[Tuple[str, str]]:
-        ver = self.server.registry.current()
+        reg = self._registry()
+        ver = reg.current() if reg is not None else None
         return None if ver is None else (ver.model_id, ver.model_text)
 
     def publish_model(self, model_text: str, source: str = "") -> str:
-        self.server.swap(model_str=model_text)
-        return self.server.registry.current().model_id
+        self.server.swap(model_str=model_text, model=self.model)
+        return self.server.registry_for(self.model).current().model_id
 
     def active_ids(self) -> List[str]:
-        ver = self.server.registry.current()
+        reg = self._registry()
+        ver = reg.current() if reg is not None else None
         return [] if ver is None else [ver.model_id]
 
     def stats_probe(self) -> Dict[str, float]:
@@ -198,15 +212,18 @@ class FleetTarget:
     swaps every healthy replica (the supervisor reconciles restarts),
     probes aggregate across the fleet."""
 
-    def __init__(self, supervisor):
+    def __init__(self, supervisor, model: Optional[str] = None):
         self.supervisor = supervisor
+        self.model = model if model not in (None, "") else "default"
 
     def active_model(self) -> Optional[Tuple[str, str]]:
         import json as _json
         import urllib.request
+        route = "/model" if self.model == "default" else \
+            f"/v1/{self.model}/model"
         for url in self.supervisor.endpoints():
             try:
-                with urllib.request.urlopen(url + "/model",
+                with urllib.request.urlopen(url + route,
                                             timeout=10) as r:
                     obj = _json.loads(r.read())
                 return obj["model_id"], obj["model_str"]
@@ -215,11 +232,12 @@ class FleetTarget:
         return None
 
     def publish_model(self, model_text: str, source: str = "") -> str:
-        return self.supervisor.publish_model(model_text, source)
+        return self.supervisor.publish_model(model_text, source,
+                                             model=self.model)
 
     def active_ids(self) -> List[str]:
         return [mid for mid in
-                self.supervisor.active_models().values()
+                self.supervisor.active_models(self.model).values()
                 if mid is not None]
 
     def stats_probe(self) -> Dict[str, float]:

@@ -75,7 +75,7 @@ SCHEMA_VERSION = 1
 
 RECORD_TYPES = ("run_start", "iteration", "superstep", "eval", "predict",
                 "serve", "checkpoint", "fleet", "continual", "recovery",
-                "router", "ingest", "span", "capture", "run_end")
+                "router", "ingest", "span", "capture", "sweep", "run_end")
 
 # per-type required fields on top of the common envelope; values are
 # (field, type-or-types) pairs the lint enforces
@@ -214,6 +214,21 @@ _TYPE_FIELDS: Dict[str, Tuple[Tuple[str, Any], ...]] = {
     # nonfinite), ``path`` the capture directory holding
     # anomaly.json + ring.jsonl (+ profile/ on device backends).
     "capture": (("trigger", str), ("path", str)),
+    # one record per battery sweep (models/battery.py + engine.sweep,
+    # docs/Sweep.md): ``models`` is the battery width B, ``groups``
+    # the number of distinct compiled programs (static-signature
+    # groups — every member whose program-shaping params agree shares
+    # ONE vmapped compile), ``xla_compiles`` the compile-counter delta
+    # across the batched dispatches and ``retraces_per_model`` the
+    # per-model compile count BEYOND the one expected warmup compile
+    # per group — steady-state must be 0 (one compiled program serves
+    # the whole battery); a positive value is the battery silently
+    # degrading toward per-model compilation (MED anomaly,
+    # obs/rules.py, surfaced by triage_run.py).  Also carries the
+    # models/s rollup plus per-model best iterations and CV scores.
+    "sweep": (("models", int), ("groups", int), ("xla_compiles", int),
+              ("retraces_per_model", (int, float)),
+              ("models_per_s", (int, float))),
     "run_end": (("summary", dict),),
 }
 

@@ -1,0 +1,380 @@
+"""Many-model battery training + task=sweep (models/battery.py,
+engine.sweep).
+
+The load-bearing contract is BIT-exactness: every battery member's
+exported model string must be byte-equal to the same params trained
+solo, because the battery is the solo fused scan lifted over a model
+axis — not a reimplementation.  Pins cover:
+
+- solo-vs-battery byte equality at B=8 across sampling modes (GOSS
+  and quantized fast; plain/bagging/feature-fraction/MVS/regularized
+  ride the sharded-mesh + PRNG cases and the @slow matrix), the
+  solo-fallback modes (DART, RF, monotone constraints), solo fused
+  blocks (fused_iters 1 vs 4) and the model-axis sharded mesh,
+- k-fold CV curves vs a loop-of-solo reference (fold masks as dataset
+  weights),
+- PRNG-fold independence: member i's streams are unchanged by B,
+- the single-compile contract + sweep telemetry + the
+  ``sweep_retrace`` triage anomaly,
+- winner export round-tripping through the serve registry under a
+  named tenant.
+
+Fast lane: one representative per property; the heavy matrix is @slow.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.engine import sweep
+from lightgbm_tpu.models.battery import (MemberSpec, member_model_string,
+                                         train_battery)
+
+N_ROWS = 240
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(7)
+    X = rng.random_sample((N_ROWS, 8))
+    y = (X[:, 0] + 0.5 * (X[:, 1] > 0.4) + 0.3 * X[:, 2] ** 2 +
+         0.1 * rng.randn(N_ROWS) > 0.8).astype(float)
+    return X, y
+
+
+BASE = {"objective": "binary", "num_leaves": 8, "verbose": -1,
+        "metric": "None", "num_iterations": 4, "min_data_in_leaf": 5,
+        "deterministic": True, "seed": 3}
+
+
+def _member_params(i, extra=None):
+    p = dict(BASE, learning_rate=0.08 + 0.01 * i, bagging_seed=50 + i,
+             feature_fraction_seed=90 + i, data_random_seed=20 + i)
+    p.update(extra or {})
+    return p
+
+
+def _solo_text(X, y, params, weight=None, fused=1):
+    p = dict(params, fused_iters=fused)
+    d = lgb.Dataset(X, label=y, weight=weight, free_raw_data=False)
+    bst = lgb.train(p, d, verbose_eval=False)
+    return bst.model_to_string()
+
+
+def _battery_texts(X, y, extra=None, B=8, shard_models=False,
+                   weight=None):
+    ds = lgb.Dataset(X, label=y, weight=weight, free_raw_data=False)
+    specs = [MemberSpec(params=_member_params(i, extra), tag=f"m{i}")
+             for i in range(B)]
+    rep = train_battery(ds, specs, shard_models=shard_models)
+    texts = []
+    for r in rep.results:
+        assert not r.failed, r.error
+        texts.append(member_model_string(
+            r, Config(dict(r.spec.params)), ds._constructed))
+    return rep, texts
+
+
+# ----------------------------------------------------------------------
+# byte-equality parity pins (the acceptance bar)
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("mode,extra", [
+    # plain / bagging / feature-fraction parity rides the sharded-mesh,
+    # PRNG-independence and @slow matrix cases below — the fast lane
+    # keeps the two modes with their own traced sampling machinery
+    ("goss", {"boosting": "goss"}),
+    ("quantized", {"use_quantized_grad": True}),
+])
+def test_parity_vmap_lane(data, mode, extra):
+    X, y = data
+    rep, texts = _battery_texts(X, y, extra)
+    assert rep.vmap_members == 8 and rep.solo_members == 0
+    assert rep.groups == 1
+    assert rep.xla_compiles == 1, \
+        f"{mode}: one static group must compile exactly once"
+    assert rep.retraces_per_model == 0.0
+    for i, txt in enumerate(texts):
+        solo = _solo_text(X, y, _member_params(i, extra))
+        assert txt == solo, f"{mode}: member {i} not byte-equal to solo"
+
+
+@pytest.mark.parametrize("mode,extra", [
+    ("dart", {"boosting": "dart"}),
+    ("rf", {"boosting": "rf", "bagging_fraction": 0.7,
+            "bagging_freq": 1}),
+    ("monotone", {"monotone_constraints": [1, -1, 0, 0, 0, 0, 0, 0]}),
+])
+def test_parity_solo_fallback(data, mode, extra):
+    """Modes the fused scan cannot express (or cannot express
+    bit-stably under a batch axis) take the solo lane — same bytes,
+    no shared compile."""
+    X, y = data
+    rep, texts = _battery_texts(X, y, extra, B=2)
+    assert rep.vmap_members == 0 and rep.solo_members == 2
+    for r in rep.results:
+        assert r.lane == "solo" and r.error
+    for i, txt in enumerate(texts):
+        solo = _solo_text(X, y, _member_params(i, extra))
+        assert txt == solo, f"{mode}: member {i} not byte-equal to solo"
+
+
+def test_parity_fused_blocks(data):
+    """Battery members equal the solo reference whatever fused block
+    size the solo run used (fused and unfused solo are already pinned
+    equal; the battery joins that equivalence class)."""
+    X, y = data
+    _, texts = _battery_texts(X, y, B=2)
+    for i in range(2):
+        assert texts[i] == _solo_text(X, y, _member_params(i), fused=1)
+        assert texts[i] == _solo_text(X, y, _member_params(i), fused=4)
+
+
+def test_parity_sharded_mesh(data):
+    """shard_models=True lays the model axis over the forced 8-device
+    CPU mesh (B % D == 0): no collectives, so results are
+    byte-identical and the group still compiles once."""
+    X, y = data
+    rep, texts = _battery_texts(
+        X, y, {"bagging_fraction": 0.7, "bagging_freq": 1},
+        shard_models=True)
+    assert rep.groups == 1 and rep.xla_compiles == 1
+    for i, txt in enumerate(texts):
+        solo = _solo_text(X, y, _member_params(
+            i, {"bagging_fraction": 0.7, "bagging_freq": 1}))
+        assert txt == solo, f"sharded member {i} not byte-equal"
+
+
+def test_prng_fold_independence(data):
+    """Member i's sampling/quantization streams are functions of ITS
+    seeds and the global counters only — training it alone (B=1) or
+    inside a B=8 battery yields identical bytes."""
+    X, y = data
+    extra = {"bagging_fraction": 0.7, "bagging_freq": 1,
+             "feature_fraction": 0.6}
+    _, wide = _battery_texts(X, y, extra, B=8)
+    for i in (0, 3, 7):
+        ds = lgb.Dataset(X, label=y, free_raw_data=False)
+        rep1 = train_battery(
+            ds, [MemberSpec(params=_member_params(i, extra))])
+        txt1 = member_model_string(
+            rep1.results[0],
+            Config(dict(_member_params(i, extra))), ds._constructed)
+        assert txt1 == wide[i], \
+            f"member {i} changed bytes when B went 1 -> 8"
+
+
+@pytest.mark.slow
+def test_static_param_split_groups(data):
+    """Members differing in a program-shaping param split into static
+    groups: each group compiles once (2 groups = 2 compiles)."""
+    X, y = data
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    specs = [MemberSpec(params=_member_params(0)),
+             MemberSpec(params=_member_params(1)),
+             MemberSpec(params=_member_params(2, {"num_leaves": 4})),
+             MemberSpec(params=_member_params(3, {"num_leaves": 4}))]
+    rep = train_battery(ds, specs)
+    assert rep.groups == 2 and rep.xla_compiles == 2
+    assert rep.retraces_per_model == 0.0
+
+
+# ----------------------------------------------------------------------
+# k-fold CV as fold weights
+# ----------------------------------------------------------------------
+def test_cv_scores_match_loop_of_solo(data):
+    """CV fold members (fold mask as per-model weight) train the SAME
+    model a solo run with dataset weight=fold mask trains — and the
+    host score-curve replay scores exactly that model, so the whole
+    curve matches a loop-of-solo reference computed from solo score
+    state."""
+    X, y = data
+    n = len(y)
+    rng = np.random.RandomState(5)
+    perm = rng.permutation(n)
+    folds = [perm[k::3] for k in range(3)]
+    params = dict(BASE, learning_rate=0.1)
+
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    specs = []
+    for te in folds:
+        w = np.ones(n, np.float32)
+        w[te] = 0.0
+        m = np.zeros(n, bool)
+        m[te] = True
+        specs.append(MemberSpec(params=params, weight=w, eval_mask=m))
+
+    def metric(scores, rows):
+        p = 1.0 / (1.0 + np.exp(-np.asarray(scores, np.float64)))
+        p = np.clip(p, 1e-15, 1 - 1e-15)
+        yy = np.asarray(y, np.float64)[rows]
+        return float(np.mean(-(yy * np.log(p) +
+                               (1 - yy) * np.log(1 - p))))
+
+    rep = train_battery(ds, specs, metric=metric)
+    assert rep.groups == 1 and rep.xla_compiles == 1
+    for k, te in enumerate(folds):
+        w = np.ones(n)
+        w[te] = 0.0
+        d = lgb.Dataset(X, label=y, weight=w, free_raw_data=False)
+        bst = lgb.train(params, d, verbose_eval=False)
+        # solo reference curve from the booster's own score state
+        g = bst._gbdt
+        sc = np.asarray(g._score)[0, np.sort(te)]
+        ref_final = metric(sc, np.sort(te))
+        curve = rep.results[k].curve
+        assert len(curve) == BASE["num_iterations"]
+        assert curve[-1] == ref_final, \
+            f"fold {k}: battery CV score != loop-of-solo reference"
+        # and the fold member IS the solo weighted model, byte-equal
+        txt = member_model_string(rep.results[k], Config(dict(params)),
+                                  ds._constructed)
+        assert txt == bst.model_to_string()
+
+
+# ----------------------------------------------------------------------
+# engine.sweep: selection, telemetry, publish
+# ----------------------------------------------------------------------
+def _run_sweep(data, tmp_path, supervisor=None, **kw):
+    X, y = data
+    from lightgbm_tpu.utils import telemetry
+    rec = telemetry.RunRecorder(str(tmp_path / "run.jsonl"))
+    telemetry.set_recorder(rec)
+    try:
+        ds = lgb.Dataset(X, label=y, free_raw_data=False)
+        res = sweep(dict(BASE, sweep_folds=3, sweep_fold_seed=1), ds,
+                    num_boost_round=4,
+                    grid={"learning_rate": [0.05, 0.1],
+                          "bagging_seed": [1, 2]},
+                    supervisor=supervisor, **kw)
+    finally:
+        telemetry.set_recorder(None)
+        rec.close()
+    return res, str(tmp_path / "run.jsonl")
+
+
+@pytest.fixture(scope="module")
+def swept(data, tmp_path_factory):
+    """One shared sweep run: the selection / telemetry / winner-parity
+    tests all read the same result instead of re-sweeping."""
+    return _run_sweep(data, tmp_path_factory.mktemp("sweep"))
+
+
+def test_sweep_end_to_end(data, swept):
+    from lightgbm_tpu.utils import telemetry
+    X, y = data
+    res, tele = swept
+    # 4 candidates x (3 folds + full) = 16 members, ONE compile
+    assert len(res.candidates) == 4
+    assert res.report.groups == 1 and res.report.xla_compiles == 1
+    assert res.best_index >= 0 and res.best_iteration >= 1
+    assert np.isfinite(res.best_score)
+    assert res.booster is not None
+    # the exported winner predicts, truncated at its best iteration
+    pred = res.booster.predict(X[:8])
+    assert pred.shape == (8,) and np.all(np.isfinite(pred))
+    assert res.booster.num_trees() == res.best_iteration
+    # one valid sweep record with the single-compile accounting
+    cnt, errs = telemetry.lint_file(tele)
+    assert not errs, errs
+    sw = [r for r in telemetry.read_records(tele)
+          if r["type"] == "sweep"]
+    assert len(sw) == 1
+    assert sw[0]["models"] == 16 and sw[0]["groups"] == 1
+    assert sw[0]["xla_compiles"] == 1
+    assert sw[0]["retraces_per_model"] == 0.0
+    assert sw[0]["models_per_s"] > 0
+
+
+def test_sweep_winner_matches_solo(data, swept):
+    """The exported winner is byte-equal to solo-training the winning
+    params on the full data and truncating at the best iteration."""
+    X, y = data
+    res, _ = swept
+    d = lgb.Dataset(X, label=y, free_raw_data=False)
+    solo = lgb.train(res.best_params, d, verbose_eval=False)
+    assert res.model_text == solo.model_to_string(
+        num_iteration=res.best_iteration)
+
+
+def test_sweep_publish_registry_roundtrip(data, tmp_path):
+    """A sweep winner publishes into the serve registry under a named
+    tenant and round-trips: the registry's model text is the export,
+    and a booster loaded from it predicts identically."""
+    from lightgbm_tpu.serve import Server, ServeConfig
+
+    class _Supervisor:
+        def __init__(self, server):
+            self.server = server
+            self.calls = []
+
+        def publish_model(self, model_text, source="",
+                          model="default"):
+            self.calls.append((source, model))
+            self.server.swap(model_str=model_text, model=model)
+            return "fp"
+
+    server = Server(config=ServeConfig.from_params(
+        {"serve_warmup": False}))
+    try:
+        sup = _Supervisor(server)
+        res, _ = _run_sweep(data, tmp_path, supervisor=sup,
+                            tenant="sweepwin")
+        assert sup.calls == [("sweep", "sweepwin")]
+        ver = server.registry_for("sweepwin").current()
+        assert ver is not None
+        assert ver.model_text == res.model_text
+        X, _y = data
+        from lightgbm_tpu.basic import Booster
+        again = Booster(model_str=ver.model_text)
+        np.testing.assert_array_equal(again.predict(X[:16]),
+                                      res.booster.predict(X[:16]))
+    finally:
+        server.stop()
+
+
+def test_sweep_retrace_anomaly_rule():
+    """retraces past the per-group compile budget fire the MED
+    ``sweep_retrace`` triage anomaly; a clean battery does not."""
+    from lightgbm_tpu.obs import rules
+
+    clean = {"type": "sweep", "models": 8,
+             "groups": 1, "xla_compiles": 1,
+             "retraces_per_model": 0.0, "models_per_s": 2.0}
+    scanner = rules.OnlineScanner()
+    assert scanner.feed(dict(clean)) == []
+    bad = dict(clean, xla_compiles=9, retraces_per_model=1.0)
+    out = scanner.feed(bad)
+    assert len(out) == 1
+    sev, code, msg = out[0]
+    assert sev == "MED" and code == "sweep_retrace"
+    assert "sweep_retrace" in rules.FLIGHT_TRIGGERS
+
+
+def test_tenant_model_route_parsing():
+    from lightgbm_tpu.serve.http import split_model_route
+    assert split_model_route("/v1/alpha/model") == ("alpha", "/model")
+    assert split_model_route("/model") == (None, "/model")
+
+
+# ----------------------------------------------------------------------
+# heavy matrix
+# ----------------------------------------------------------------------
+@pytest.mark.slow
+@pytest.mark.parametrize("extra", [
+    {},
+    {"boosting": "mvs", "bagging_fraction": 0.6},
+    {"bagging_fraction": 0.7, "bagging_freq": 1,
+     "feature_fraction": 0.6},
+    {"boosting": "goss", "use_quantized_grad": True},
+    {"objective": "regression", "metric": "None"},
+    {"lambda_l1": 0.5, "min_gain_to_split": 0.1},
+])
+def test_parity_matrix_slow(data, extra):
+    X, y = data
+    yy = y if extra.get("objective", "binary") == "binary" else \
+        np.asarray(y) + 0.1 * X[:, 0]
+    rep, texts = _battery_texts(X, yy, extra)
+    assert rep.xla_compiles == rep.groups
+    for i, txt in enumerate(texts):
+        assert txt == _solo_text(X, yy, _member_params(i, extra)), \
+            f"member {i} not byte-equal ({extra})"
