@@ -261,8 +261,17 @@ def _task_route(params: Dict[str, str], config: Config) -> None:
     awareness, bounded retries + hedging, per-backend circuit
     breakers and per-model admission budgets.  Runs until a
     SIGTERM/SIGINT drains it.  Programmatic deployments attach
-    FleetSupervisors instead (``Router.add_model``)."""
-    from .serve.config import RouterConfig
+    FleetSupervisors instead (``Router.add_model``).
+
+    ``slo_enable=true`` runs the SLO engine next to the router
+    (burn-rate evaluation over the standard router objectives,
+    ``obs/slo.py``); ``autoscale=true`` additionally runs the
+    closed-loop controller — with a static backend table its only
+    lever is the admission retune (no supervisor to scale), which is
+    exactly the degraded-capacity posture the controller is built
+    for.  ``docs/Serving.md`` has the full control-policy table."""
+    from .serve.config import (AutoscaleConfig, RouterConfig,
+                               SloConfig)
     from .serve.router import Router, parse_backends_spec, route_http
 
     rcfg = RouterConfig.from_params(config)
@@ -282,9 +291,25 @@ def _task_route(params: Dict[str, str], config: Config) -> None:
         router.add_model(name, urls=urls,
                          replica_model="default" if name == "default"
                          else name)
+    slo_engine = None
+    scaler = None
+    scfg = SloConfig.from_params(config)
+    acfg = AutoscaleConfig.from_params(config)
+    if scfg.enable or acfg.enable:
+        from .obs.slo import SloEngine, router_objectives
+        slo_engine = SloEngine(router_objectives(router, scfg),
+                               config=scfg, recorder=recorder).start()
+    if acfg.enable:
+        from .serve.autoscaler import Autoscaler
+        scaler = Autoscaler(router=router, slo=slo_engine, config=acfg,
+                            recorder=recorder).start()
     try:
         route_http(router)
     finally:
+        if scaler is not None:
+            scaler.stop()
+        if slo_engine is not None:
+            slo_engine.stop()
         router.stop()
         if recorder is not None:
             recorder.close()

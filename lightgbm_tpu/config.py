@@ -954,6 +954,115 @@ PARAMS: List[Param] = [
     _p("obs_max_captures", 4, int, (),
        "capture budget per process; further anomalies only log",
        group="obs", check=">=1"),
+    # ---- slo (SLO engine: lightgbm_tpu/obs/slo.py) ----
+    _p("slo_enable", False, bool, (),
+       "run the SLO engine next to the routing front (task=route): "
+       "declarative objectives (availability, latency-vs-target, "
+       "queue saturation, per-model shed rate) evaluated with multi-"
+       "window multi-burn-rate alerting; every tick emits slo "
+       "telemetry records, sets ltpu_slo_* gauges, and feeds the "
+       "shared anomaly rules (obs/rules.py)", group="slo"),
+    _p("slo_interval_s", 5.0, float, (),
+       "SLO evaluation cadence (one tick scrapes every objective "
+       "source and re-judges every window)", group="slo", check=">0"),
+    _p("slo_window_fast_s", 60.0, float, (),
+       "fast burn window: the page-grade alert fires only when the "
+       "burn exceeds slo_fast_burn on BOTH this and the mid window "
+       "(fast to fire, hard to blip)", group="slo", check=">0"),
+    _p("slo_window_mid_s", 300.0, float, (),
+       "mid burn window confirming the fast alert", group="slo",
+       check=">0"),
+    _p("slo_window_slow_s", 1800.0, float, (),
+       "slow burn window: the ticket-grade alert fires on this "
+       "window alone at slo_slow_burn", group="slo", check=">0"),
+    _p("slo_fast_burn", 14.4, float, (),
+       "page-grade burn-rate threshold (multiples of 'exactly on "
+       "target' budget spend; 14.4 spends a 30-day budget in ~2 "
+       "days)", group="slo", check=">0"),
+    _p("slo_slow_burn", 3.0, float, (),
+       "ticket-grade burn-rate threshold on the slow window alone",
+       group="slo", check=">0"),
+    _p("slo_budget_window_s", 86400.0, float, (),
+       "wall-clock error-budget accounting period; budget consumed "
+       "and remaining are tracked over this window and persisted "
+       "across restarts via slo_state_file", group="slo", check=">0"),
+    _p("slo_state_file", "", str, (),
+       "error-budget persistence path (atomic tmp+rename each tick); "
+       "a restarting serve tier re-adopts its burned budget instead "
+       "of laundering it.  '' = in-memory only", group="slo"),
+    _p("slo_availability_target", 0.999, float, (),
+       "availability objective: fraction of terminal responses that "
+       "must be ok (non-error, non-shed)", group="slo"),
+    _p("slo_latency_p99_ms", 250.0, float, (),
+       "latency objective: the rolling p99 each tick must be at or "
+       "under this many milliseconds to count as a good sample",
+       group="slo", check=">0"),
+    _p("slo_latency_target", 0.99, float, (),
+       "latency objective target: fraction of ticks whose rolling "
+       "p99 met slo_latency_p99_ms", group="slo"),
+    _p("slo_queue_saturation", 0.8, float, (),
+       "queue objective: in-flight occupancy (in-flight requests / "
+       "total max_inflight capacity) at or above this fraction makes "
+       "the tick a bad sample", group="slo"),
+    _p("slo_queue_target", 0.99, float, (),
+       "queue objective target: fraction of ticks below "
+       "slo_queue_saturation occupancy", group="slo"),
+    _p("slo_shed_target", 0.99, float, (),
+       "per-model shed objective target: fraction of requests NOT "
+       "turned away by the admission budgets (one objective per "
+       "registered model, named shed:<model>)", group="slo"),
+    # ---- autoscale (closed-loop controller: serve/autoscaler.py) ----
+    _p("autoscale", False, bool, ("autoscale_enable",),
+       "run the closed-loop autoscaler next to the routing front "
+       "(task=route with a fleet): consumes the SLO burn rates + "
+       "live router gauges and grows/drains FleetSupervisor replicas "
+       "and retunes per-model admission budgets; every decision is a "
+       "traced autoscale telemetry record with its evidence inline",
+       group="autoscale"),
+    _p("autoscale_dry_run", False, bool, (),
+       "compute and emit identical decisions (mode=dry_run) without "
+       "touching the fleet or the buckets — the rehearsal mode for "
+       "tuning thresholds against live traffic", group="autoscale"),
+    _p("autoscale_interval_s", 2.0, float, (),
+       "control-loop cadence", group="autoscale", check=">0"),
+    _p("autoscale_min_replicas", 1, int, (),
+       "the controller never drains below this replica count",
+       group="autoscale", check=">=1"),
+    _p("autoscale_max_replicas", 4, int, (),
+       "the controller never grows above this replica count; at max "
+       "it falls back to the admission lever (shed cheap traffic "
+       "first)", group="autoscale", check=">=1"),
+    _p("autoscale_grow_burn", 2.0, float, (),
+       "grow trigger: SLO fast burn above this on BOTH fast windows "
+       "(page-grade evidence, not a blip)", group="autoscale",
+       check=">0"),
+    _p("autoscale_grow_queue", 0.8, float, (),
+       "grow trigger: in-flight occupancy at/above this fraction of "
+       "total routing capacity", group="autoscale", check=">0"),
+    _p("autoscale_drain_idle_s", 60.0, float, (),
+       "drain hysteresis: quiet (low occupancy AND no burn) must be "
+       "sustained this long before one replica drains",
+       group="autoscale", check=">=0"),
+    _p("autoscale_drain_util", 0.2, float, (),
+       "quiet means in-flight occupancy below this fraction (must be "
+       "< autoscale_grow_queue — the gap is the anti-flap deadband)",
+       group="autoscale", check=">=0"),
+    _p("autoscale_cooldown_s", 30.0, float, (),
+       "minimum spacing between grow actions", group="autoscale",
+       check=">=0"),
+    _p("autoscale_drain_cooldown_s", 60.0, float, (),
+       "minimum spacing between drain actions (slower than grow: "
+       "adding capacity is cheap, removing it under load is not)",
+       group="autoscale", check=">=0"),
+    _p("autoscale_shed_rows_per_s", 256.0, float, (),
+       "per-model token-bucket rate while a shed retune is active "
+       "(priority > 0 requests keep their overdraw reserve, so cheap "
+       "traffic sheds first); originals are restored once the burn "
+       "clears", group="autoscale", check=">0"),
+    _p("autoscale_budget_floor", 0.25, float, (),
+       "retune admission down once SLO budget remaining falls below "
+       "this fraction even without an active burn — spend the last "
+       "quarter of the budget slowly", group="autoscale", check=">=0"),
 ]
 
 _PARAM_BY_NAME: Dict[str, Param] = {p.name: p for p in PARAMS}

@@ -33,10 +33,13 @@ WARMUP_ITERS = 3
 
 # rule codes that trip the flight recorder by default (the anomaly set
 # ISSUE 13 names: retrace storm, pipelining-disabled,
-# XLA-fallback-on-TPU, stall, rollback, nonfinite)
+# XLA-fallback-on-TPU, stall, rollback, nonfinite; ISSUE 17 adds the
+# page-grade SLO states — a burning error budget is exactly the moment
+# a ring dump is worth having)
 FLIGHT_TRIGGERS = ("retrace_storm", "pipelining_disabled",
                    "xla_fallback", "stall", "rollback", "nonfinite",
-                   "sweep_retrace")
+                   "sweep_retrace", "slo_fast_burn",
+                   "slo_budget_exhausted")
 
 # (severity, code, message)
 Anomaly = Tuple[str, str, str]
@@ -158,6 +161,12 @@ class OnlineScanner:
         self._ing_overlap_s = 0.0
         self._ing_quarantines = 0
         self._ing_resume_miss: Optional[Dict[str, Any]] = None
+        # SLO rollups (obs/slo.py): worst observed state per objective
+        # plus the autoscaler's response, so the triage summary can say
+        # "the budget burned AND the controller did/didn't react"
+        self._slo_worst: Dict[str, Dict[str, Any]] = {}
+        self._as_actions = 0
+        self._as_degraded = 0
         self._segs: "deque[Dict[str, Any]]" = \
             deque(maxlen=self.MAX_SEGMENTS)
         self._cur_seg: Optional[Dict[str, Any]] = None
@@ -317,6 +326,61 @@ class OnlineScanner:
                             f"admission budgets are turning real "
                             f"traffic away; raise route_rows_per_s "
                             f"or add replicas"))
+        elif rtype == "slo":
+            status = r.get("status", "")
+            obj = str(r.get("objective", "?"))
+            prev = self._slo_worst.get(obj)
+            rank = {"ok": 0, "scrape_error": 1, "slow_burn": 2,
+                    "fast_burn": 3, "budget_exhausted": 4}
+            if prev is None or rank.get(status, 0) >= \
+                    rank.get(prev.get("status", ""), 0):
+                self._slo_worst[obj] = r
+            # multi-window multi-burn-rate alerting: the SLO engine
+            # already did the window math — the scanner just maps its
+            # verdicts to anomalies, debounced per (code, objective) so
+            # a sustained burn pages once, not once per scrape
+            if status == "budget_exhausted" and \
+                    ("slo_budget_exhausted", obj) not in self._fired:
+                self._fired.add(("slo_budget_exhausted", obj))
+                out.append((
+                    "HIGH", "slo_budget_exhausted",
+                    f"SLO error budget EXHAUSTED for objective "
+                    f"{obj} (target {r.get('target', '?')}) — every "
+                    f"further bad event is an SLO violation with no "
+                    f"budget left to absorb it"))
+            elif status == "fast_burn" and \
+                    ("slo_fast_burn", obj) not in self._fired:
+                self._fired.add(("slo_fast_burn", obj))
+                eta = float(r.get("exhaustion_eta_s", -1.0) or -1.0)
+                eta_txt = (f"; budget exhausts in ~{eta / 60:.0f} min "
+                           f"at this rate" if eta > 0 else "")
+                out.append((
+                    "HIGH", "slo_fast_burn",
+                    f"SLO fast burn on objective {obj}: burn rate "
+                    f"{float(r.get('burn_fast', 0.0)):.1f}x on the "
+                    f"fast window (confirmed on the mid window) — "
+                    f"page-grade{eta_txt}"))
+            elif status == "slow_burn" and \
+                    ("slo_slow_burn", obj) not in self._fired:
+                self._fired.add(("slo_slow_burn", obj))
+                out.append((
+                    "MED", "slo_slow_burn",
+                    f"SLO slow burn on objective {obj}: burn rate "
+                    f"{float(r.get('burn_slow', 0.0)):.1f}x on the "
+                    f"slow window — ticket-grade budget leak"))
+        elif rtype == "autoscale":
+            if r.get("mode") == "degraded":
+                self._as_degraded += 1
+                if "autoscale_degraded" not in self._fired:
+                    self._fired.add("autoscale_degraded")
+                    out.append((
+                        "MED", "autoscale_degraded",
+                        f"autoscaler control step failed and degraded "
+                        f"to no-op ({str(r.get('error', '?'))[:120]}) "
+                        f"— the fleet keeps serving at its current "
+                        f"size, but nobody is steering"))
+            elif r.get("action") not in (None, "none"):
+                self._as_actions += 1
         elif rtype == "ingest":
             event = r.get("event")
             if event == "quarantine":
@@ -387,6 +451,26 @@ class OnlineScanner:
                                     f"turning real traffic away; "
                                     f"raise route_rows_per_s or add "
                                     f"replicas"))
+        for obj in sorted(self._slo_worst):
+            r = self._slo_worst[obj]
+            status = r.get("status", "")
+            if status in ("", "ok", "scrape_error"):
+                continue
+            sev = "MED" if status == "slow_burn" else "HIGH"
+            reacted = (f"; autoscaler took {self._as_actions} "
+                       f"action(s)" if self._as_actions else
+                       "; autoscaler took no action")
+            out.append((sev, f"SLO objective {obj} worst state "
+                             f"{status.upper()} (burn fast/slow "
+                             f"{float(r.get('burn_fast', 0.0)):.1f}x/"
+                             f"{float(r.get('burn_slow', 0.0)):.1f}x, "
+                             f"budget remaining "
+                             f"{float(r.get('budget_remaining', 0.0)):.0%})"
+                             f"{reacted}"))
+        if self._as_degraded:
+            out.append(("MED", f"autoscaler degraded to no-op on "
+                               f"{self._as_degraded} control step(s) — "
+                               f"the fleet kept serving, unsteered"))
         if self._ing_quarantines:
             out.append(("HIGH", f"streamed ingest quarantined "
                                 f"{self._ing_quarantines} chunk(s) — "

@@ -33,11 +33,18 @@ Large-scale Tree Boosting" for the low-latency inference focus):
   tail-latency hedging, per-backend circuit breakers, per-model
   admission budgets, and the multi-model tenancy table
   (``POST /v1/<model>/predict``, ``docs/Routing.md``).
+- :mod:`.autoscaler` — the closed-loop controller above all of it:
+  consumes the SLO engine's burn rates (``obs/slo.py``) plus the live
+  router gauges and grows/drains fleet replicas and retunes per-model
+  admission budgets, every decision a traced ``autoscale`` telemetry
+  record (``docs/Serving.md``).
 """
 from .admission import (AdmissionQueue, QueueSaturated, Request,
                         RequestShed, RequestTimeout, ServeError,
                         ServerClosed, UnknownModel)
-from .config import FleetConfig, RouterConfig, ServeConfig
+from .autoscaler import Autoscaler
+from .config import (AutoscaleConfig, FleetConfig, RouterConfig,
+                     ServeConfig, SloConfig)
 from .fleet import FleetSupervisor, InprocReplica, ProcessReplica
 from .registry import ModelRegistry, ModelVersion, model_fingerprint
 from .router import Router, route_http
@@ -47,6 +54,7 @@ from .watcher import (CanarySet, CheckpointWatcher, FleetTarget,
 
 __all__ = [
     "Server", "ServeConfig", "FleetConfig", "RouterConfig",
+    "SloConfig", "AutoscaleConfig", "Autoscaler",
     "ModelRegistry", "ModelVersion", "model_fingerprint",
     "AdmissionQueue", "Request", "ServeError", "QueueSaturated",
     "RequestShed", "RequestTimeout", "ServerClosed", "UnknownModel",

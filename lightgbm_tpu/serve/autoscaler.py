@@ -1,0 +1,304 @@
+"""Closed-loop fleet autoscaler: the controller the observability
+plane was built for.
+
+The control loop consumes the SLO engine's burn rates
+(``obs/slo.py``) plus the live PR 13/14 gauges — in-flight occupancy,
+routable backends, open circuit breakers, replica count — and acts on
+two levers:
+
+- **capacity**: grow/drain :class:`~.fleet.FleetSupervisor` replicas
+  via :meth:`~.fleet.FleetSupervisor.scale_to`, bounded by
+  ``autoscale_min_replicas`` / ``autoscale_max_replicas``;
+- **admission**: when capacity cannot come up (already at max, or no
+  supervisor attached), retune the router's per-model token buckets
+  down to ``autoscale_shed_rows_per_s`` so cheap traffic sheds first
+  (priority > 0 requests keep their overdraw reserve), restoring the
+  original budgets once the burn clears.
+
+It can never flap by construction: growing needs a page-grade signal
+(fast burn above ``autoscale_grow_burn`` on BOTH fast windows, or
+in-flight occupancy above ``autoscale_grow_queue``), draining needs
+quiet — occupancy below ``autoscale_drain_util`` AND no burn —
+**sustained** for ``autoscale_drain_idle_s``, and both directions hold
+separate cooldowns (``autoscale_cooldown_s`` /
+``autoscale_drain_cooldown_s``).
+
+Every decision is a traced ``autoscale`` telemetry record carrying its
+evidence inline (the inputs snapshot → the rule that fired → the
+action taken), wrapped in an ``autoscale_decide`` span so
+``trace_view.py`` joins controller decisions into the same timelines
+as the requests they protect.  ``autoscale_dry_run`` computes and
+emits identical decisions (``mode="dry_run"``) without touching the
+fleet or the buckets.
+
+Fault point ``autoscale.decide`` (``error`` | ``hang``) wedges the
+controller deterministically; the chaos harness pins that a wedged
+controller leaves the fleet serving at its current size.
+
+Stdlib-only; importable without jax.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..obs import slo as _slo
+from ..obs import spans as _spans
+from ..utils import faults as _faults
+from ..utils.log import Log
+
+__all__ = ["Autoscaler"]
+
+
+class Autoscaler:
+    """The control loop; see the module docstring.  ``supervisor``
+    and/or ``router`` may be None — without a supervisor only the
+    admission lever is available, without a router only capacity."""
+
+    def __init__(self, supervisor=None, router=None, slo=None,
+                 config=None, recorder=None,
+                 clock=time.monotonic):
+        from .config import AutoscaleConfig
+        if supervisor is None and router is None:
+            raise ValueError("autoscaler needs a supervisor or a "
+                             "router (it has no levers otherwise)")
+        self.supervisor = supervisor
+        self.router = router
+        self.slo = slo
+        self.config = config or AutoscaleConfig()
+        self.config.validate()
+        self.recorder = recorder
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # hysteresis state (identical trajectory in dry-run, so
+        # dry-run and active decisions match on the same inputs)
+        self._last_grow_at = -float("inf")
+        self._last_drain_at = -float("inf")
+        self._idle_since: Optional[float] = None
+        # model -> (original rows_per_s, original burst) while a shed
+        # retune is active
+        self._shed_saved: Dict[str, Any] = {}
+        self.decisions = 0
+        self.actions = 0
+
+    # -- inputs --------------------------------------------------------
+    def inputs(self) -> Dict[str, Any]:
+        """One evidence snapshot: everything :meth:`decide` reads."""
+        inp: Dict[str, Any] = {
+            "replicas": 0, "routable": 0, "breakers_open": 0,
+            "queue_frac": 0.0, "inflight": 0,
+            "burn_fast": 0.0, "burn_mid": 0.0, "burn_slow": 0.0,
+            "budget_remaining": 1.0, "shed_active":
+                bool(self._shed_saved),
+        }
+        if self.supervisor is not None:
+            slots = self.supervisor.slots()
+            inp["replicas"] = len(slots)
+            inp["routable"] = sum(1 for s in slots if s["in_rotation"])
+        if self.router is not None:
+            with self.router._lock:
+                backends = list(self.router._backends.values())
+                routes = list(self.router._routes.values())
+            inp["breakers_open"] = sum(
+                1 for b in backends if b.breaker.state == "open")
+            inp["inflight"] = int(sum(r.inflight for r in routes))
+            inp["queue_frac"] = round(
+                _slo.router_queue_fraction(self.router), 4)
+            if self.supervisor is None:
+                inp["routable"] = sum(
+                    1 for b in backends
+                    if b.healthy and not b.draining)
+        if self.slo is not None:
+            for res in self.slo.snapshot().values():
+                inp["burn_fast"] = max(inp["burn_fast"],
+                                       res.get("burn_fast", 0.0))
+                inp["burn_mid"] = max(inp["burn_mid"],
+                                      res.get("burn_mid", 0.0))
+                inp["burn_slow"] = max(inp["burn_slow"],
+                                       res.get("burn_slow", 0.0))
+                inp["budget_remaining"] = min(
+                    inp["budget_remaining"],
+                    res.get("budget_remaining", 1.0))
+        return inp
+
+    # -- the policy ----------------------------------------------------
+    def decide(self, inp: Dict[str, Any], now: float
+               ) -> List[Dict[str, Any]]:
+        """Pure-policy step: inputs → decisions.  Mutates only the
+        hysteresis clocks (cooldowns, idle timer) — never the fleet —
+        so dry-run and active mode walk identical trajectories on
+        identical inputs."""
+        cfg = self.config
+        out: List[Dict[str, Any]] = []
+        replicas = int(inp.get("replicas", 0))
+        burning = (inp["burn_fast"] > cfg.grow_burn and
+                   inp["burn_mid"] > cfg.grow_burn)
+        saturated = inp["queue_frac"] >= cfg.grow_queue
+        grow_signal = burning or saturated
+        rule = ("fast_burn" if burning else "queue_saturation") \
+            if grow_signal else ""
+        can_scale = self.supervisor is not None
+        can_retune = self.router is not None
+
+        if grow_signal:
+            self._idle_since = None
+            if can_scale and replicas < cfg.max_replicas and \
+                    now - self._last_grow_at >= cfg.cooldown_s:
+                self._last_grow_at = now
+                out.append({"action": "grow", "rule": rule,
+                            "from_replicas": replicas,
+                            "to_replicas": replicas + 1})
+            elif can_retune and not inp.get("shed_active"):
+                # capacity can't come up (at max / cooling / no
+                # supervisor): shed cheap traffic first
+                out.append({"action": "retune_shed",
+                            "rule": rule if (not can_scale or
+                                             replicas >= cfg.max_replicas)
+                            else f"{rule}_cooldown",
+                            "rows_per_s": cfg.shed_rows_per_s})
+        elif can_retune and \
+                inp.get("budget_remaining", 1.0) < cfg.budget_floor and \
+                not inp.get("shed_active"):
+            self._idle_since = None
+            out.append({"action": "retune_shed", "rule": "budget_floor",
+                        "rows_per_s": cfg.shed_rows_per_s})
+        else:
+            if inp.get("shed_active") and \
+                    inp["burn_fast"] <= cfg.grow_burn / 2 and \
+                    not saturated and \
+                    inp.get("budget_remaining", 1.0) >= \
+                    cfg.budget_floor:
+                # budget must ALSO be back above the floor, or restore
+                # and the budget_floor retune would alternate forever
+                out.append({"action": "retune_restore",
+                            "rule": "burn_cleared"})
+            quiet = (inp["queue_frac"] < cfg.drain_util and
+                     inp["burn_fast"] <= cfg.grow_burn / 2)
+            if quiet and can_scale and replicas > cfg.min_replicas:
+                if self._idle_since is None:
+                    self._idle_since = now
+                elif (now - self._idle_since >= cfg.drain_idle_s and
+                      now - self._last_drain_at >=
+                      cfg.drain_cooldown_s):
+                    self._last_drain_at = now
+                    self._idle_since = now
+                    out.append({"action": "drain", "rule": "idle",
+                                "from_replicas": replicas,
+                                "to_replicas": replicas - 1})
+            elif not quiet:
+                self._idle_since = None
+        return out
+
+    # -- actuation -----------------------------------------------------
+    def _apply(self, d: Dict[str, Any]) -> None:
+        action = d["action"]
+        if action in ("grow", "drain"):
+            self.supervisor.scale_to(d["to_replicas"],
+                                     reason=f"autoscale:{d['rule']}")
+        elif action == "retune_shed":
+            for name in self.router.models():
+                route = self.router.model_route(name)
+                if route is None or name in self._shed_saved:
+                    continue
+                self._shed_saved[name] = (route.bucket.rate,
+                                          route.bucket.burst)
+                route.bucket.set_rate(d["rows_per_s"])
+        elif action == "retune_restore":
+            for name, (rate, burst) in list(self._shed_saved.items()):
+                route = self.router.model_route(name)
+                if route is not None:
+                    route.bucket.set_rate(rate, burst_rows=burst)
+                del self._shed_saved[name]
+
+    def evaluate(self, now: Optional[float] = None
+                 ) -> List[Dict[str, Any]]:
+        """One control step: snapshot inputs, decide, act (unless
+        dry-run), emit one traced ``autoscale`` record per decision
+        with the evidence inline."""
+        cfg = self.config
+        mode = _faults.fire("autoscale.decide")
+        if mode == "hang":
+            # a wedged controller: block (until stop) WITHOUT touching
+            # the fleet — it keeps serving at its current size
+            Log.warning("autoscale: decide wedged (injected hang)")
+            self._stop.wait()
+            return []
+        now = self._clock() if now is None else float(now)
+        try:
+            if mode == "error":
+                raise RuntimeError(
+                    "injected fault (autoscale.decide:error)")
+            with self._lock:
+                inp = self.inputs()
+                decisions = self.decide(inp, now)
+                self.decisions += 1
+                mode_str = "dry_run" if cfg.dry_run else "active"
+                for d in decisions:
+                    with _spans.span("autoscale_decide",
+                                     recorder=self.recorder, root=True,
+                                     action=d["action"]) as sp:
+                        if not cfg.dry_run:
+                            self._apply(d)
+                            self.actions += 1
+                        sp.set(rule=d["rule"], mode=mode_str)
+                        self._emit(d, inp, mode_str)
+                    Log.info("autoscale[%s]: %s (%s) — burn_fast="
+                             "%.2f queue=%.2f replicas=%d",
+                             mode_str, d["action"], d["rule"],
+                             inp["burn_fast"], inp["queue_frac"],
+                             inp["replicas"])
+            return decisions
+        except Exception as exc:           # noqa: BLE001 - degrade
+            # the controller never takes the fleet down with it: an
+            # erroring decide leaves everything at current size
+            Log.warning("autoscale: decide failed (%s) — fleet left "
+                        "at current size", exc)
+            if self.recorder is not None:
+                self.recorder.emit(
+                    "autoscale", action="none", mode="degraded",
+                    rule="decide_error", error=str(exc)[:200])
+            return []
+
+    def _emit(self, d: Dict[str, Any], inp: Dict[str, Any],
+              mode_str: str) -> None:
+        if self.recorder is None:
+            return
+        fields = dict(d)
+        fields.pop("action", None)
+        fields.pop("rule", None)
+        self.recorder.emit(
+            "autoscale", action=d["action"], mode=mode_str,
+            rule=d["rule"],
+            evidence={k: v for k, v in inp.items()
+                      if not isinstance(v, bool)},
+            **fields)
+
+    def shed_active(self) -> bool:
+        return bool(self._shed_saved)
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "Autoscaler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="ltpu-autoscale",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.interval_s):
+            try:
+                self.evaluate()
+            except Exception as exc:       # noqa: BLE001 - keep going
+                Log.warning("autoscale: loop tick failed: %s", exc)

@@ -298,3 +298,162 @@ class FleetConfig:
             raise ValueError("rollback_min_requests must be >= 1")
         if self.rollback_error_rate < 0 or self.rollback_p99_factor <= 0:
             raise ValueError("rollback thresholds must be positive")
+
+
+@dataclasses.dataclass
+class SloConfig:
+    """Resolved knobs of the SLO engine (``obs/slo.py``): declarative
+    objectives + multi-window multi-burn-rate evaluation.  Canonical
+    definitions live in the ``slo`` group of the
+    ``lightgbm_tpu/config.py`` registry."""
+
+    enable: bool = False
+    # evaluation cadence; windows are trailing from each tick
+    interval_s: float = 5.0
+    window_fast_s: float = 60.0
+    window_mid_s: float = 300.0
+    window_slow_s: float = 1800.0
+    # burn-rate alert thresholds: fast is page-grade (must exceed on
+    # BOTH fast and mid windows), slow is ticket-grade (slow window
+    # alone).  14.4 is the classic "30-day budget in 2 days" pace.
+    fast_burn: float = 14.4
+    slow_burn: float = 3.0
+    # wall-clock error-budget accounting period
+    budget_window_s: float = 86400.0
+    # budget persistence across replica restarts ("" = in-memory only)
+    state_file: str = ""
+    # objective targets (router_objectives standard set)
+    availability_target: float = 0.999
+    latency_p99_ms: float = 250.0
+    latency_target: float = 0.99
+    queue_saturation: float = 0.8
+    queue_target: float = 0.99
+    shed_target: float = 0.99
+
+    @classmethod
+    def from_params(cls, params: Union[None, Dict[str, Any], Any] = None
+                    ) -> "SloConfig":
+        from ..config import Config
+        if params is None:
+            cfg = Config()
+        elif isinstance(params, Config):
+            cfg = params
+        else:
+            cfg = Config(dict(params))
+        return cls(
+            enable=bool(cfg.slo_enable),
+            interval_s=float(cfg.slo_interval_s),
+            window_fast_s=float(cfg.slo_window_fast_s),
+            window_mid_s=float(cfg.slo_window_mid_s),
+            window_slow_s=float(cfg.slo_window_slow_s),
+            fast_burn=float(cfg.slo_fast_burn),
+            slow_burn=float(cfg.slo_slow_burn),
+            budget_window_s=float(cfg.slo_budget_window_s),
+            state_file=str(cfg.slo_state_file or ""),
+            availability_target=float(cfg.slo_availability_target),
+            latency_p99_ms=float(cfg.slo_latency_p99_ms),
+            latency_target=float(cfg.slo_latency_target),
+            queue_saturation=float(cfg.slo_queue_saturation),
+            queue_target=float(cfg.slo_queue_target),
+            shed_target=float(cfg.slo_shed_target))
+
+    def validate(self) -> None:
+        if self.interval_s <= 0:
+            raise ValueError("slo_interval_s must be > 0")
+        if not (0 < self.window_fast_s <= self.window_mid_s
+                <= self.window_slow_s):
+            raise ValueError("slo windows must satisfy 0 < fast <= "
+                             "mid <= slow")
+        if self.budget_window_s < self.window_slow_s:
+            raise ValueError("slo_budget_window_s must be >= "
+                             "slo_window_slow_s")
+        if self.fast_burn <= 0 or self.slow_burn <= 0:
+            raise ValueError("slo burn thresholds must be > 0")
+        for name, v in (("slo_availability_target",
+                         self.availability_target),
+                        ("slo_latency_target", self.latency_target),
+                        ("slo_queue_target", self.queue_target),
+                        ("slo_shed_target", self.shed_target)):
+            if not 0.0 < v < 1.0:
+                raise ValueError(f"{name} must be in (0, 1)")
+        if self.latency_p99_ms <= 0:
+            raise ValueError("slo_latency_p99_ms must be > 0")
+        if not 0.0 < self.queue_saturation <= 1.0:
+            raise ValueError("slo_queue_saturation must be in (0, 1]")
+
+
+@dataclasses.dataclass
+class AutoscaleConfig:
+    """Resolved knobs of the closed-loop autoscaler
+    (``serve/autoscaler.py``).  Canonical definitions live in the
+    ``autoscale`` group of the ``lightgbm_tpu/config.py`` registry."""
+
+    enable: bool = False
+    # compute + emit decisions without touching the fleet/buckets
+    dry_run: bool = False
+    interval_s: float = 2.0
+    # replica bounds the controller may never cross
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # grow triggers: page-grade burn (both fast windows) OR in-flight
+    # occupancy at/above this fraction of routing capacity
+    grow_burn: float = 2.0
+    grow_queue: float = 0.8
+    # drain hysteresis: occupancy below drain_util AND burn cleared,
+    # sustained for drain_idle_s, before one replica drains
+    drain_idle_s: float = 60.0
+    drain_util: float = 0.2
+    # per-direction cooldowns (anti-flap)
+    cooldown_s: float = 30.0
+    drain_cooldown_s: float = 60.0
+    # admission retune: per-model token-bucket rate while shedding
+    shed_rows_per_s: float = 256.0
+    # retune admission down once budget remaining falls below this
+    budget_floor: float = 0.25
+
+    @classmethod
+    def from_params(cls, params: Union[None, Dict[str, Any], Any] = None
+                    ) -> "AutoscaleConfig":
+        from ..config import Config
+        if params is None:
+            cfg = Config()
+        elif isinstance(params, Config):
+            cfg = params
+        else:
+            cfg = Config(dict(params))
+        return cls(
+            enable=bool(cfg.autoscale),
+            dry_run=bool(cfg.autoscale_dry_run),
+            interval_s=float(cfg.autoscale_interval_s),
+            min_replicas=int(cfg.autoscale_min_replicas),
+            max_replicas=int(cfg.autoscale_max_replicas),
+            grow_burn=float(cfg.autoscale_grow_burn),
+            grow_queue=float(cfg.autoscale_grow_queue),
+            drain_idle_s=float(cfg.autoscale_drain_idle_s),
+            drain_util=float(cfg.autoscale_drain_util),
+            cooldown_s=float(cfg.autoscale_cooldown_s),
+            drain_cooldown_s=float(cfg.autoscale_drain_cooldown_s),
+            shed_rows_per_s=float(cfg.autoscale_shed_rows_per_s),
+            budget_floor=float(cfg.autoscale_budget_floor))
+
+    def validate(self) -> None:
+        if self.interval_s <= 0:
+            raise ValueError("autoscale_interval_s must be > 0")
+        if self.min_replicas < 1 or \
+                self.max_replicas < self.min_replicas:
+            raise ValueError("autoscale replicas must satisfy 1 <= "
+                             "min <= max")
+        if self.grow_burn <= 0:
+            raise ValueError("autoscale_grow_burn must be > 0")
+        if not 0.0 < self.grow_queue <= 1.0:
+            raise ValueError("autoscale_grow_queue must be in (0, 1]")
+        if self.drain_idle_s < 0 or self.cooldown_s < 0 or \
+                self.drain_cooldown_s < 0:
+            raise ValueError("autoscale cooldowns must be >= 0")
+        if not 0.0 <= self.drain_util < self.grow_queue:
+            raise ValueError("autoscale_drain_util must be in "
+                             "[0, autoscale_grow_queue)")
+        if self.shed_rows_per_s <= 0:
+            raise ValueError("autoscale_shed_rows_per_s must be > 0")
+        if not 0.0 <= self.budget_floor < 1.0:
+            raise ValueError("autoscale_budget_floor must be in [0, 1)")

@@ -258,6 +258,9 @@ class FleetSupervisor:
         # model_text)}.  The single-model API (publish_model with no
         # name) lives under the "default" tenant.
         self._desired: Dict[str, tuple] = {}
+        # a router fronting this fleet (set_router): its ltpu_router_*
+        # registry series join the aggregate scrape
+        self._router = None
 
     # -- telemetry -----------------------------------------------------
     def _emit(self, event: str, **fields) -> None:
@@ -329,6 +332,57 @@ class FleetSupervisor:
 
     def handle(self, index: int):
         return self._slots[index].handle
+
+    def replica_count(self) -> int:
+        with self._lock:
+            return len(self._slots)
+
+    # -- elastic capacity (serve/autoscaler.py) ------------------------
+    def scale_to(self, n: int, reason: str = "") -> int:
+        """Resize the fleet to ``n`` slots.  Growing appends fresh
+        slots and spawns them immediately; draining retires the
+        highest-index slots with a graceful ``terminate`` (admitted
+        work finishes — the drain semantics clients never notice) in a
+        background thread so the caller's control loop is not blocked
+        on the drain grace.  Returns the new slot count."""
+        n = int(n)
+        if n < 1:
+            raise ValueError("scale_to needs n >= 1")
+        now = time.monotonic()
+        with self._lock:
+            cur = len(self._slots)
+            if n == cur:
+                return cur
+            if n > cur:
+                added = [_Slot(i) for i in range(cur, n)]
+                self._slots.extend(added)
+                removed = []
+            else:
+                added = []
+                removed = self._slots[n:]
+                del self._slots[n:]
+                for slot in removed:
+                    slot.in_rotation = False
+        self._emit("scale", direction="grow" if added else "drain",
+                   from_replicas=cur, to_replicas=n,
+                   reason=str(reason)[:120])
+        Log.info("fleet: scale %d -> %d replicas (%s)", cur, n,
+                 reason or "operator")
+        for slot in added:
+            self._spawn(slot, now)
+        if removed:
+            def _retire(slots=removed):
+                for slot in slots:
+                    handle = slot.handle
+                    slot.handle = None
+                    if handle is not None:
+                        try:
+                            handle.terminate(10.0)
+                        except Exception:  # noqa: BLE001
+                            pass
+            threading.Thread(target=_retire, name="ltpu-fleet-drain",
+                             daemon=True).start()
+        return n
 
     def active_models(self, model: str = "default"
                       ) -> Dict[int, Optional[str]]:
@@ -428,20 +482,36 @@ class FleetSupervisor:
                 "p99_ms": p99}
 
     # -- fleet-level metrics aggregation -------------------------------
+    def set_router(self, router) -> None:
+        """Attach the router fronting this fleet: its own
+        ``ltpu_router_*`` (and ``ltpu_slo_*``) series join
+        :meth:`metrics_text` as a ``replica="router"`` scrape — one
+        pane of glass for the whole serve tier."""
+        self._router = router
+
     def metrics_text(self) -> str:
         """One Prometheus exposition for the whole fleet: every
         reachable replica's ``GET /metrics`` scrape re-labeled with
         ``replica="<slot>"`` plus supervisor-level gauges (slot
         states, desired model) — the scrape surface a router tier in
         front of :meth:`endpoints` consumes
-        (``docs/Observability.md``)."""
+        (``docs/Observability.md``).  With :meth:`set_router`, the
+        router's own series ride along as ``replica="router"``."""
         with self._lock:
             targets = [(s.index, s.url) for s in self._slots
                        if s.state == "healthy" and s.url]
             states = [(s.index, s.state, s.in_rotation)
                       for s in self._slots]
             desired = dict(self._desired)
+            router = self._router
         scrapes = []
+        if router is not None:
+            try:
+                scrapes.append(("router", _filter_families(
+                    router.metrics_text(),
+                    ("ltpu_router_", "ltpu_slo_"))))
+            except Exception:              # noqa: BLE001 - probe only
+                pass
         for index, url in targets:
             try:
                 with urllib.request.urlopen(
@@ -569,7 +639,10 @@ class FleetSupervisor:
 
     def _tick(self) -> None:
         now = time.monotonic()
-        for slot in self._slots:
+        with self._lock:
+            # scale_to may resize the slot list concurrently
+            slots = list(self._slots)
+        for slot in slots:
             state = slot.state
             if state == "circuit_open":
                 cd = self.config.circuit_cooldown_s
@@ -650,6 +723,32 @@ class FleetSupervisor:
                 self._tick()
             except Exception as exc:       # noqa: BLE001 - keep going
                 Log.warning("fleet: monitor tick failed: %s", exc)
+
+
+def _filter_families(text: str, prefixes) -> str:
+    """Keep only the metric families whose name starts with one of
+    ``prefixes`` from a Prometheus exposition — the router process's
+    registry also carries fleet-irrelevant series the aggregate must
+    not duplicate."""
+    out: List[str] = []
+    for line in text.splitlines():
+        s = line.strip()
+        if not s:
+            continue
+        if s.startswith("# "):
+            parts = s.split(None, 3)
+            name = parts[2] if len(parts) >= 3 else ""
+        else:
+            name = s.split("{", 1)[0].split(None, 1)[0]
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if base.endswith(suffix):
+                base = base[: -len(suffix)]
+                break
+        if any(base.startswith(p) or name.startswith(p)
+               for p in prefixes):
+            out.append(s)
+    return "\n".join(out) + "\n"
 
 
 def _post_json(url: str, path: str, obj: Dict[str, Any],

@@ -109,6 +109,19 @@ point                  modes its call site interprets
                        upload that never finishes); ``sleep_<ms>`` —
                        added latency (widens the overlap window the
                        telemetry measures)
+``slo.scrape``         fired once per SLO engine tick
+                       (``obs/slo.py``): ``error`` — every objective
+                       source scrape raises; the tick degrades to
+                       ``status=scrape_error`` records on last-known
+                       state (the engine never crashes its host)
+``autoscale.decide``   fired once per autoscaler control step
+                       (``serve/autoscaler.py``): ``error`` — the
+                       step raises and degrades to a no-op
+                       (``mode=degraded`` record; the fleet stays at
+                       its current size); ``hang`` — the controller
+                       wedges until stopped WITHOUT touching the
+                       fleet (the chaos harness pins that serving
+                       continues unsteered)
 =====================  =================================================
 
 A spec naming a point outside this table arms nothing — a typo'd
@@ -159,7 +172,7 @@ KNOWN_POINTS = frozenset({
     "trainer.step", "trainer.refit", "mesh.collective",
     "mesh.heartbeat", "elastic.remesh", "router.backend",
     "router.admit", "stream.chunk_read", "stream.cache_write",
-    "stream.prefetch",
+    "stream.prefetch", "slo.scrape", "autoscale.decide",
 })
 
 

@@ -75,7 +75,8 @@ SCHEMA_VERSION = 1
 
 RECORD_TYPES = ("run_start", "iteration", "superstep", "eval", "predict",
                 "serve", "checkpoint", "fleet", "continual", "recovery",
-                "router", "ingest", "span", "capture", "sweep", "run_end")
+                "router", "ingest", "span", "capture", "sweep", "slo",
+                "autoscale", "run_end")
 
 # per-type required fields on top of the common envelope; values are
 # (field, type-or-types) pairs the lint enforces
@@ -229,6 +230,30 @@ _TYPE_FIELDS: Dict[str, Tuple[Tuple[str, Any], ...]] = {
     "sweep": (("models", int), ("groups", int), ("xla_compiles", int),
               ("retraces_per_model", (int, float)),
               ("models_per_s", (int, float))),
+    # one record per SLO objective per evaluation tick (obs/slo.py):
+    # ``objective`` names the declared objective (availability |
+    # latency_p99 | queue_saturation | shed:<model> | custom),
+    # ``status`` is ok | slow_burn | fast_burn | budget_exhausted |
+    # scrape_error (the source raised; the tick degraded to last-known
+    # state).  Carries the multi-window burn rates
+    # (burn_fast/burn_mid/burn_slow), budget_remaining (fraction of
+    # the error budget left this period — persisted across restarts),
+    # exhaustion_eta_s (-1 = not burning) and the window/period
+    # good/bad totals.  obs/rules.py turns the statuses into anomalies
+    # (budget-exhaustion HIGH, fast-burn HIGH, slow-burn MED) so
+    # --follow, triage and the flight recorder all see SLO state.
+    "slo": (("objective", str), ("status", str)),
+    # one record per autoscaler decision (serve/autoscaler.py):
+    # ``action`` is grow | drain | retune_shed | retune_restore | none
+    # (a degraded decide), ``mode`` is active | dry_run | degraded,
+    # ``rule`` the policy clause that fired (fast_burn |
+    # queue_saturation | budget_floor | burn_cleared | idle |
+    # decide_error), and ``evidence`` the full inputs snapshot the
+    # decision was made from (burn rates, queue fraction, replica and
+    # breaker counts) — the reconciliation surface the chaos e2e
+    # diffs against actual fleet/router state changes.  grow/drain
+    # carry from_replicas/to_replicas; retunes carry rows_per_s.
+    "autoscale": (("action", str), ("mode", str)),
     "run_end": (("summary", dict),),
 }
 
@@ -688,6 +713,22 @@ class RunRecorder:
             }.get(rec.get("event"))
             if key:
                 self._agg[key] = self._agg.get(key, 0) + 1
+        elif t == "slo":
+            self._agg["slo_evals"] = self._agg.get("slo_evals", 0) + 1
+            status = rec.get("status")
+            if status and status != "ok":
+                self._agg[f"slo_{status}"] = \
+                    self._agg.get(f"slo_{status}", 0) + 1
+        elif t == "autoscale":
+            action = rec.get("action")
+            if action and action != "none":
+                self._agg["autoscale_actions"] = \
+                    self._agg.get("autoscale_actions", 0) + 1
+                self._agg[f"autoscale_{action}"] = \
+                    self._agg.get(f"autoscale_{action}", 0) + 1
+            if rec.get("mode") == "degraded":
+                self._agg["autoscale_degraded"] = \
+                    self._agg.get("autoscale_degraded", 0) + 1
         elif t == "span":
             self._agg["spans"] = self._agg.get("spans", 0) + 1
         elif t == "capture":
@@ -806,6 +847,19 @@ class RunRecorder:
                     f"{s.get('serve_shed', 0):.0f} shed, "
                     f"{s.get('serve_timeout', 0):.0f} timeout, "
                     f"{s.get('serve_rejected', 0):.0f} rejected)")
+            if s.get("slo_evals"):
+                parts.append(
+                    f"slo: {s['slo_evals']:.0f} evals "
+                    f"({s.get('slo_fast_burn', 0):.0f} fast-burn, "
+                    f"{s.get('slo_slow_burn', 0):.0f} slow-burn, "
+                    f"{s.get('slo_budget_exhausted', 0):.0f} "
+                    f"budget-exhausted)")
+            if s.get("autoscale_actions"):
+                parts.append(
+                    f"autoscale: {s['autoscale_actions']:.0f} actions "
+                    f"({s.get('autoscale_grow', 0):.0f} grow, "
+                    f"{s.get('autoscale_drain', 0):.0f} drain, "
+                    f"{s.get('autoscale_retune_shed', 0):.0f} retune)")
             if s.get("captures"):
                 parts.append(f"{s['captures']:.0f} flight-recorder "
                              f"capture(s)")

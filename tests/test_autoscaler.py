@@ -1,0 +1,356 @@
+"""Closed-loop autoscaler (serve/autoscaler.py): policy decisions
+against fake levers — grow/drain hysteresis and cooldowns, admission
+retunes, dry-run parity, and fault-injected controller failure.
+
+``decide`` is pure policy driven by an injected clock; no sleeping.
+"""
+import threading
+import time
+
+import pytest
+
+import lightgbm_tpu.utils.telemetry as tele
+from lightgbm_tpu.serve.autoscaler import Autoscaler
+from lightgbm_tpu.serve.config import AutoscaleConfig
+from lightgbm_tpu.serve.router import TokenBucket
+from lightgbm_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    faults.reset()
+    yield
+    faults.clear()
+    faults.reset()
+
+
+# ----------------------------------------------------------------------
+# fakes: the two levers and the instrument
+# ----------------------------------------------------------------------
+class FakeSupervisor:
+    def __init__(self, replicas=1):
+        self.n = replicas
+        self.calls = []
+
+    def slots(self):
+        return [{"in_rotation": True} for _ in range(self.n)]
+
+    def replica_count(self):
+        return self.n
+
+    def scale_to(self, n, reason=""):
+        self.calls.append((self.n, n, reason))
+        self.n = n
+        return n
+
+
+class FakeRoute:
+    def __init__(self, rate=128.0, burst=4096, max_inflight=8,
+                 inflight=0):
+        self.bucket = TokenBucket(rate, burst)
+        self.inflight = inflight
+        self.max_inflight = max_inflight
+
+
+class FakeRouter:
+    def __init__(self, routes):
+        self._lock = threading.Lock()
+        self._routes = dict(routes)
+        self._backends = {}
+        self._counts = {}
+        self._metrics = None
+
+    def models(self):
+        return list(self._routes)
+
+    def model_route(self, name):
+        return self._routes.get(name)
+
+
+class FakeSlo:
+    def __init__(self):
+        self.snap = {}
+
+    def snapshot(self):
+        return self.snap
+
+
+def _cfg(**kw):
+    base = dict(enable=True, interval_s=1.0, min_replicas=1,
+                max_replicas=3, grow_burn=2.0, grow_queue=0.8,
+                drain_idle_s=30.0, drain_util=0.2, cooldown_s=10.0,
+                drain_cooldown_s=20.0, shed_rows_per_s=64.0,
+                budget_floor=0.25)
+    base.update(kw)
+    return AutoscaleConfig(**base)
+
+
+def _inp(**kw):
+    base = dict(replicas=1, routable=1, breakers_open=0,
+                queue_frac=0.0, inflight=0, burn_fast=0.0,
+                burn_mid=0.0, burn_slow=0.0, budget_remaining=1.0,
+                shed_active=False)
+    base.update(kw)
+    return base
+
+
+def _scaler(**kw):
+    sup = FakeSupervisor(kw.pop("replicas", 1))
+    router = kw.pop("router", None)
+    a = Autoscaler(supervisor=sup, router=router,
+                   slo=kw.pop("slo", None),
+                   config=kw.pop("cfg", None) or _cfg(),
+                   recorder=kw.pop("recorder", None), **kw)
+    return a, sup
+
+
+# ----------------------------------------------------------------------
+# the policy
+# ----------------------------------------------------------------------
+def test_grows_on_fast_burn_both_windows_only():
+    a, _ = _scaler()
+    # burn above threshold on the fast window alone: no page, no grow
+    assert a.decide(_inp(burn_fast=5.0, burn_mid=0.5), now=0.0) == []
+    d = a.decide(_inp(burn_fast=5.0, burn_mid=5.0), now=20.0)
+    assert d == [{"action": "grow", "rule": "fast_burn",
+                  "from_replicas": 1, "to_replicas": 2}]
+
+
+def test_grow_cooldown_and_max_bound():
+    router = FakeRouter({"default": FakeRoute()})
+    a, _ = _scaler(router=router, replicas=1)
+    hot = _inp(burn_fast=5.0, burn_mid=5.0)
+    assert a.decide(hot, now=0.0)[0]["action"] == "grow"
+    # still burning inside the cooldown: the admission lever steps in
+    d = a.decide(dict(hot, replicas=2, shed_active=False), now=1.0)
+    assert d[0]["action"] == "retune_shed"
+    assert d[0]["rule"] == "fast_burn_cooldown"
+    # cooldown over, below max: grow again
+    d = a.decide(dict(hot, replicas=2), now=11.0)
+    assert d[0]["action"] == "grow"
+    # at max_replicas the only lever left is shedding
+    d = a.decide(dict(hot, replicas=3), now=30.0)
+    assert d[0] == {"action": "retune_shed", "rule": "fast_burn",
+                    "rows_per_s": 64.0}
+    # and once the shed is active there is nothing more to do
+    assert a.decide(dict(hot, replicas=3, shed_active=True),
+                    now=40.0) == []
+
+
+def test_grows_on_queue_saturation():
+    a, _ = _scaler()
+    d = a.decide(_inp(queue_frac=0.9), now=0.0)
+    assert d[0]["action"] == "grow"
+    assert d[0]["rule"] == "queue_saturation"
+
+
+def test_drain_needs_sustained_idle_and_cooldown():
+    a, _ = _scaler(replicas=3)
+    quiet = _inp(replicas=3, queue_frac=0.05)
+    # first quiet look only starts the idle timer
+    assert a.decide(quiet, now=0.0) == []
+    # idle but not yet sustained for drain_idle_s
+    assert a.decide(quiet, now=15.0) == []
+    d = a.decide(quiet, now=31.0)
+    assert d == [{"action": "drain", "rule": "idle",
+                  "from_replicas": 3, "to_replicas": 2}]
+    # a burst of load resets the idle clock entirely
+    assert a.decide(_inp(replicas=2, queue_frac=0.9, burn_fast=0.0),
+                    now=40.0)[0]["action"] == "grow"
+    assert a.decide(_inp(replicas=3, queue_frac=0.05), now=45.0) == []
+    # sustained idle again, but the drain cooldown (20 s) gates it
+    assert a.decide(_inp(replicas=3, queue_frac=0.05), now=50.9) == []
+    d = a.decide(_inp(replicas=3, queue_frac=0.05), now=76.0)
+    assert d[0]["action"] == "drain"
+
+
+def test_never_drains_below_min_and_deadband_holds():
+    a, _ = _scaler(replicas=1)
+    # at min_replicas quiet does nothing, forever
+    for t in (0.0, 40.0, 80.0, 120.0):
+        assert a.decide(_inp(replicas=1, queue_frac=0.0), now=t) == []
+    # the deadband between drain_util and grow_queue: no action either
+    a2, _ = _scaler(replicas=2)
+    for t in (0.0, 40.0, 80.0):
+        assert a2.decide(_inp(replicas=2, queue_frac=0.5), now=t) == []
+
+
+def test_budget_floor_retunes_and_restore_waits_for_budget():
+    router = FakeRouter({"default": FakeRoute()})
+    a, _ = _scaler(router=router)
+    # budget nearly gone without an acute burn: shed cheap traffic
+    d = a.decide(_inp(budget_remaining=0.1), now=0.0)
+    assert d == [{"action": "retune_shed", "rule": "budget_floor",
+                  "rows_per_s": 64.0}]
+    # burn clear but budget still below the floor: restoring now would
+    # alternate with the budget_floor retune forever — hold the shed
+    assert a.decide(_inp(budget_remaining=0.1, shed_active=True),
+                    now=10.0) == []
+    # budget recovered: restore the saved admission budgets
+    d = a.decide(_inp(budget_remaining=0.5, shed_active=True),
+                 now=20.0)
+    assert d == [{"action": "retune_restore", "rule": "burn_cleared"}]
+
+
+def test_restore_waits_for_burn_to_clear():
+    router = FakeRouter({"default": FakeRoute()})
+    a, _ = _scaler(router=router, replicas=3)
+    hot = _inp(replicas=3, burn_fast=5.0, burn_mid=5.0)
+    assert a.decide(hot, now=0.0)[0]["action"] == "retune_shed"
+    # burn_fast must fall below grow_burn/2 before restore fires
+    assert a.decide(_inp(replicas=3, burn_fast=1.5, shed_active=True),
+                    now=10.0) == []
+    d = a.decide(_inp(replicas=3, burn_fast=0.5, shed_active=True),
+                 now=20.0)
+    assert d[0]["action"] == "retune_restore"
+
+
+# ----------------------------------------------------------------------
+# actuation: evaluate() drives the real levers
+# ----------------------------------------------------------------------
+def test_evaluate_applies_grow_and_emits_traced_record():
+    rec = tele.RunRecorder()
+    slo = FakeSlo()
+    slo.snap = {"availability": {"burn_fast": 5.0, "burn_mid": 5.0,
+                                 "burn_slow": 1.0,
+                                 "budget_remaining": 0.9}}
+    a, sup = _scaler(slo=slo, recorder=rec)
+    decisions = a.evaluate(now=0.0)
+    assert decisions[0]["action"] == "grow"
+    assert sup.calls == [(1, 2, "autoscale:fast_burn")]
+    recs = [r for r in rec.records if r["type"] == "autoscale"]
+    assert len(recs) == 1
+    r = recs[0]
+    assert tele.validate_record(r) == []
+    assert r["action"] == "grow" and r["mode"] == "active"
+    assert r["rule"] == "fast_burn"
+    # the evidence rides inline and carries the burn that justified it
+    assert r["evidence"]["burn_fast"] == 5.0
+    assert r["evidence"]["replicas"] == 1
+    # the decision is a traced span joined to the record
+    assert r.get("trace_id")
+    spans = [s for s in rec.records if s["type"] == "span" and
+             s.get("name") == "autoscale_decide"]
+    assert spans and spans[0]["trace_id"] == r["trace_id"]
+    assert rec.summary()["autoscale_grow"] == 1
+
+
+def test_evaluate_retune_shed_and_restore_roundtrip():
+    rec = tele.RunRecorder()
+    routes = {"a": FakeRoute(rate=128.0, burst=4096),
+              "b": FakeRoute(rate=0.0, burst=8192)}
+    router = FakeRouter(routes)
+    slo = FakeSlo()
+    slo.snap = {"o": {"burn_fast": 5.0, "burn_mid": 5.0,
+                      "budget_remaining": 0.9}}
+    a, sup = _scaler(router=router, replicas=3,
+                     cfg=_cfg(max_replicas=3), slo=slo, recorder=rec)
+    sup.n = 3
+    a.evaluate(now=0.0)
+    assert a.shed_active()
+    assert routes["a"].bucket.rate == 64.0
+    assert routes["b"].bucket.rate == 64.0
+    assert sup.calls == []                     # capacity untouched
+    # burn clears: the original budgets come back exactly
+    slo.snap = {"o": {"burn_fast": 0.0, "burn_mid": 0.0,
+                      "budget_remaining": 0.9}}
+    a.evaluate(now=20.0)
+    assert not a.shed_active()
+    assert routes["a"].bucket.rate == 128.0
+    assert routes["a"].bucket.burst == 4096
+    assert routes["b"].bucket.rate == 0.0      # disabled stays disabled
+    actions = [r["action"] for r in rec.records
+               if r["type"] == "autoscale"]
+    assert actions == ["retune_shed", "retune_restore"]
+
+
+def test_dry_run_emits_identical_decisions_without_acting():
+    feed = [
+        _inp(burn_fast=5.0, burn_mid=5.0),
+        _inp(replicas=2, burn_fast=5.0, burn_mid=5.0),
+        _inp(replicas=2, queue_frac=0.05),
+        _inp(replicas=2, queue_frac=0.05),
+        _inp(replicas=2, queue_frac=0.05),
+    ]
+    times = [0.0, 11.0, 20.0, 45.0, 76.0]
+
+    def run(dry_run):
+        rec = tele.RunRecorder()
+        a, sup = _scaler(cfg=_cfg(dry_run=dry_run), recorder=rec)
+        for inp, t in zip(feed, times):
+            inp = dict(inp)
+            a.inputs = lambda _i=inp: _i       # scripted evidence
+            a.evaluate(now=t)
+        recs = [r for r in rec.records if r["type"] == "autoscale"]
+        return sup, [(r["action"], r["rule"]) for r in recs], \
+            [r["mode"] for r in recs]
+
+    sup_a, dec_a, modes_a = run(dry_run=False)
+    sup_d, dec_d, modes_d = run(dry_run=True)
+    assert dec_a == dec_d                      # identical decisions...
+    assert dec_a == [("grow", "fast_burn"), ("grow", "fast_burn"),
+                     ("drain", "idle")]
+    assert set(modes_a) == {"active"}
+    assert set(modes_d) == {"dry_run"}
+    assert len(sup_a.calls) == 3
+    assert sup_d.calls == []                   # ...but no actuation
+
+
+def test_decide_error_fault_degrades_without_touching_fleet():
+    rec = tele.RunRecorder()
+    a, sup = _scaler(replicas=2, recorder=rec)
+    faults.configure("autoscale.decide:error@1")
+    assert a.evaluate(now=0.0) == []
+    assert sup.calls == []
+    recs = [r for r in rec.records if r["type"] == "autoscale"]
+    assert len(recs) == 1
+    assert recs[0]["mode"] == "degraded"
+    assert recs[0]["action"] == "none"
+    assert recs[0]["rule"] == "decide_error"
+    assert tele.validate_record(recs[0]) == []
+    assert rec.summary().get("autoscale_degraded") == 1
+
+
+def test_decide_hang_fault_wedges_until_stop_fleet_untouched():
+    a, sup = _scaler(replicas=2)
+    faults.configure("autoscale.decide:hang@*")
+    done = threading.Event()
+
+    def run():
+        a.evaluate(now=0.0)
+        done.set()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert not done.wait(0.3)                  # wedged, not crashed
+    assert sup.calls == []                     # fleet left serving
+    a.stop()
+    assert done.wait(5.0)
+    t.join(5.0)
+
+
+def test_needs_at_least_one_lever():
+    with pytest.raises(ValueError):
+        Autoscaler(supervisor=None, router=None)
+
+
+def test_inputs_snapshot_reads_slo_and_router():
+    routes = {"a": FakeRoute(max_inflight=8, inflight=4),
+              "b": FakeRoute(max_inflight=8, inflight=2)}
+    slo = FakeSlo()
+    slo.snap = {
+        "x": {"burn_fast": 1.0, "burn_mid": 0.5, "burn_slow": 0.2,
+              "budget_remaining": 0.9},
+        "y": {"burn_fast": 3.0, "burn_mid": 2.0, "burn_slow": 0.1,
+              "budget_remaining": 0.4},
+    }
+    a, sup = _scaler(router=FakeRouter(routes), slo=slo, replicas=2)
+    inp = a.inputs()
+    assert inp["replicas"] == 2
+    assert inp["burn_fast"] == 3.0             # worst across objectives
+    assert inp["burn_mid"] == 2.0
+    assert inp["budget_remaining"] == 0.4      # min across objectives
+    assert inp["inflight"] == 6
+    assert inp["queue_frac"] == pytest.approx(6 / 16)
+    assert inp["shed_active"] is False

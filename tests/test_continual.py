@@ -405,6 +405,7 @@ def test_trainer_quarantines_nan_batch_at_validation(tmp_path):
                                        "b1.npz"))
 
 
+@pytest.mark.slow
 def test_trainer_nonfinite_rewind_surviving_batch_parity(tmp_path):
     # validator off -> the NaN batch reaches training; the guard must
     # rewind so the final model EQUALS a run over the surviving
@@ -486,6 +487,7 @@ def test_trainer_step_error_exhausts_retries_and_reverts(tmp_path):
     assert tr._model_iter == 4
 
 
+@pytest.mark.slow
 def test_trainer_preempt_drain_and_bitexact_resume(tmp_path):
     oracle_dir = tmp_path / "oracle"
     for td in (tmp_path, oracle_dir):

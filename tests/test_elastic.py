@@ -11,8 +11,13 @@ feature-parallel reduces no float histograms and is byte-identical to
 serial at EVERY width, prefix included.
 
 Fast lane: one representative per property on the forced 8-device CPU
-mesh.  The full cross-width resume matrix ({data, feature, voting} x
-fused_iters {1, 4} x resume width {4, 1}) is @slow.
+mesh (feature-parallel cross-width resume, the healthy-path
+supervisor, remesh-to-serial fallback).  The full cross-width resume
+matrix ({data, feature, voting} x fused_iters {1, 4} x resume width
+{4, 1}) and the heaviest ~20 s bit-exact recovery pins (same-width
+roundtrip, supervisor error recovery with/without an outstanding
+block, data-parallel cross-width resume) are @slow — the quick gate
+must fit a 1-core container's tier-1 budget.
 """
 import glob
 import json
@@ -89,6 +94,7 @@ def _oracle_remesh_at(X, y, boundary, to_shards, learner="data",
 # ----------------------------------------------------------------------
 # remesh entry point
 # ----------------------------------------------------------------------
+@pytest.mark.slow
 def test_remesh_same_width_roundtrip_identity(data601):
     """remesh is lossless: snapshot -> reconstruct -> restore at the
     SAME width mid-run (under bagging: host RNG stream + bagging-cycle
@@ -137,6 +143,7 @@ def test_mesh_fault_points_registered():
 # ----------------------------------------------------------------------
 # elastic supervisor
 # ----------------------------------------------------------------------
+@pytest.mark.slow
 def test_supervisor_error_recovery_bit_exact(data601, tmp_path):
     """An injected collective failure (a shard dying mid-fused-block)
     is detected, the mesh rebuilds over the survivors, and the final
@@ -173,6 +180,7 @@ def test_supervisor_error_recovery_bit_exact(data601, tmp_path):
     assert bst.model_to_string() == _oracle_remesh_at(X, y, boundary, 7)
 
 
+@pytest.mark.slow
 def test_supervisor_recovery_with_outstanding_block(data601, tmp_path):
     """A shard failure on block K+2's dispatch while block K+1 is
     still IN FLIGHT (superstep_pipeline_depth=1: dispatched, records
@@ -389,6 +397,7 @@ def test_manifest_records_mesh_topology(data601, tmp_path):
                         "mesh_shape": [8]}
 
 
+@pytest.mark.slow
 def test_cross_width_resume_data_bit_exact(data601, tmp_path):
     """Save at 8 shards (mid-fused-block boundary), resume at 4: the
     final model is byte-identical to the in-process remesh

@@ -780,3 +780,81 @@ def test_injected_dispatch_fault_fails_requests_loudly(models):
         srv.predict(X[:4])                 # hit 3: clean again
     finally:
         srv.stop()
+
+
+# ----------------------------------------------------------------------
+# elastic capacity (scale_to) + fleet/router metrics merge (PR 17)
+# ----------------------------------------------------------------------
+def test_scale_to_grows_and_drains_with_reconciled_events(models):
+    b1, X = models["b1"], models["X"]
+    cfg = FleetConfig(replicas=1, probe_interval_s=0.05,
+                      probe_timeout_s=2.0, fail_threshold=3,
+                      backoff_base_s=0.05, backoff_max_s=0.2,
+                      circuit_failures=10, seed=1)
+    rec = RunRecorder(None, run_info={"task": "fleet"})
+    sup = FleetSupervisor(_inproc_factory(b1), cfg, rec)
+    try:
+        sup.start(wait_healthy_s=30)
+        assert sup.replica_count() == 1
+        with pytest.raises(ValueError):
+            sup.scale_to(0)
+        # grow: the new slot spawns, converges, and joins the rotation
+        assert sup.scale_to(2, reason="autoscale:fast_burn") == 2
+        assert sup.replica_count() == 2
+        _wait(lambda: len(sup.endpoints()) == 2, 30, "grown routable")
+        fp = model_fingerprint(b1.model_to_string(num_iteration=-1))
+        ids = {_http_predict(u, X[:2].tolist())["model_id"]
+               for u in sup.endpoints()}
+        assert ids == {fp}                 # never a mixed fingerprint
+        # drain: highest-index slot retires gracefully in the
+        # background; the remaining replica keeps serving throughout
+        assert sup.scale_to(1, reason="autoscale:idle") == 1
+        assert sup.replica_count() == 1
+        _wait(lambda: len(sup.endpoints()) == 1, 30, "drained")
+        out = _http_predict(sup.endpoints()[0], X[:2].tolist())
+        assert out["model_id"] == fp
+        # scaling to the current size is a no-op (no event)
+        assert sup.scale_to(1) == 1
+        scales = _events(rec, "scale")
+        assert [(e["direction"], e["from_replicas"], e["to_replicas"],
+                 e["reason"]) for e in scales] == \
+            [("grow", 1, 2, "autoscale:fast_burn"),
+             ("drain", 2, 1, "autoscale:idle")]
+    finally:
+        sup.stop()
+        rec.close()
+
+
+def test_fleet_metrics_merge_includes_router_series(models):
+    from lightgbm_tpu.serve import Router, RouterConfig
+    b1 = models["b1"]
+    cfg = FleetConfig(replicas=1, probe_interval_s=0.05,
+                      probe_timeout_s=2.0, fail_threshold=3,
+                      backoff_base_s=0.05, backoff_max_s=0.2,
+                      circuit_failures=10, seed=1)
+    rec = RunRecorder(None, run_info={"task": "fleet"})
+    sup = FleetSupervisor(_inproc_factory(b1), cfg, rec)
+    router = None
+    try:
+        sup.start(wait_healthy_s=30)
+        rcfg = RouterConfig(port=0, probe_interval_s=0.05,
+                            probe_timeout_s=2.0)
+        router = Router(rcfg, recorder=rec).start()
+        router.add_model("default", supervisor=sup)
+        sup.set_router(router)
+        text = sup.metrics_text()
+        # the router's own series join the fleet aggregate as one more
+        # labeled scrape: one pane of glass for the whole serve tier
+        assert 'replica="router"' in text
+        router_lines = [ln for ln in text.splitlines()
+                        if ln.startswith("ltpu_router_") and
+                        'replica="router"' in ln]
+        assert router_lines
+        # replica scrapes and supervisor gauges still ride along
+        assert "ltpu_fleet_replicas 1" in text
+        assert 'replica="0"' in text
+    finally:
+        if router is not None:
+            router.stop()
+        sup.stop()
+        rec.close()

@@ -170,6 +170,38 @@ def test_rolling_histogram_is_recency_windowed(monkeypatch):
     assert len(h._cur._counts) == 5
 
 
+def test_rolling_histogram_epoch_flip_boundaries(monkeypatch):
+    """The percentile during an epoch swap never returns a diluted
+    lifetime view: exactly at the flip the previous window is still
+    merged, one flip later it is gone entirely, and a long silence
+    resets both epochs (the SLO latency objective samples this path
+    every scrape)."""
+    import lightgbm_tpu.obs.metrics as m
+    clock = [0.0]
+    monkeypatch.setattr(m.time, "monotonic", lambda: clock[0])
+    h = m.RollingHistogram(buckets=(1, 10, 100, 1000), window_s=10.0)
+    for _ in range(1000):
+        h.observe(5.0)                      # window 1: healthy lifetime
+    # exactly AT the boundary the read path itself rotates: the healthy
+    # epoch moves to prev but stays visible (no data cliff mid-swap)
+    clock[0] = 10.0
+    assert h.percentile(0.99) <= 10.0
+    assert h.count == 1000
+    for _ in range(50):
+        h.observe(500.0)                    # window 2: a regression
+    assert h.count == 1050                  # merged view: prev + cur
+    # next flip: window-1 samples vanish ENTIRELY — a diluted lifetime
+    # merge would keep 1000 healthy samples drowning the p99
+    clock[0] = 20.0
+    assert h.percentile(0.99) > 100.0
+    assert h.count == 50
+    # a gap of >= two windows with no traffic resets BOTH epochs: the
+    # percentile reports silence, not stale history
+    clock[0] = 40.0
+    assert h.percentile(0.99) == 0.0
+    assert h.count == 0
+
+
 def test_online_scanner_state_is_bounded():
     scanner = obs_rules.OnlineScanner()
     for i in range(obs_rules.OnlineScanner.MAX_SEGMENTS + 50):

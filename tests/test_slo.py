@@ -1,0 +1,433 @@
+"""SLO engine (obs/slo.py): burn-rate math pinned against synthetic
+streams with analytically expected rates and exhaustion times, window
+accounting, status transitions, budget persistence across restarts,
+and scrape-failure degradation.
+
+All tests drive injected ``clock``/``wall`` callables — no sleeping,
+no background threads.
+"""
+import json
+import math
+
+import pytest
+
+import lightgbm_tpu.obs.metrics as obs_metrics
+import lightgbm_tpu.utils.telemetry as tele
+from lightgbm_tpu.obs.slo import (
+    SloEngine,
+    SloObjective,
+    WindowCounter,
+    burn_rate,
+    exhaustion_eta_s,
+    router_queue_fraction,
+)
+from lightgbm_tpu.serve.config import SloConfig
+from lightgbm_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    faults.reset()
+    yield
+    faults.clear()
+    faults.reset()
+
+
+# ----------------------------------------------------------------------
+# pure math
+# ----------------------------------------------------------------------
+def test_burn_rate_analytic_pins():
+    # 0.1% errors against a 99.9% target burns exactly 1x budget
+    assert burn_rate(1, 1000, 0.999) == pytest.approx(1.0)
+    # 1% errors against 99.9%: ten budgets per window
+    assert burn_rate(10, 1000, 0.999) == pytest.approx(10.0)
+    # Google-SRE page threshold example: 14.4 burns 30d budget in ~2d
+    assert burn_rate(144, 10000, 0.999) == pytest.approx(14.4)
+    # empty window is not an outage
+    assert burn_rate(0, 0, 0.999) == 0.0
+    with pytest.raises(ValueError):
+        burn_rate(1, 10, 1.0)
+
+
+def test_exhaustion_eta_analytic_pins():
+    day = 86400.0
+    # full budget at burn 1.0 lasts exactly one budget window
+    assert exhaustion_eta_s(1.0, 1.0, day) == pytest.approx(day)
+    # half a budget burning 2x: one quarter window left
+    assert exhaustion_eta_s(0.5, 2.0, day) == pytest.approx(day / 4)
+    assert exhaustion_eta_s(1.0, 0.0, day) == math.inf
+    assert exhaustion_eta_s(0.0, 5.0, day) == 0.0
+
+
+def test_window_counter_prunes_and_windows():
+    w = WindowCounter(max_window_s=60.0)
+    w.add(0.0, 10, 1)
+    w.add(30.0, 10, 2)
+    w.add(59.0, 10, 3)
+    assert w.totals(59.0, 60.0) == (30.0, 6.0)
+    # trailing 30s sees only the newer two samples
+    assert w.totals(59.0, 30.0) == (20.0, 5.0)
+    # a sample exactly one window old has aged out (half-open window)
+    assert w.totals(90.0, 60.0) == (10.0, 3.0)
+
+
+# ----------------------------------------------------------------------
+# engine harness
+# ----------------------------------------------------------------------
+def _cfg(**kw):
+    base = dict(enable=True, interval_s=10.0, window_fast_s=60.0,
+                window_mid_s=300.0, window_slow_s=1800.0,
+                fast_burn=5.0, slow_burn=2.0, budget_window_s=86400.0)
+    base.update(kw)
+    return SloConfig(**base)
+
+
+class _Stream:
+    """Cumulative good/bad counters a test scripts per tick."""
+
+    def __init__(self):
+        self.good = 0.0
+        self.bad = 0.0
+
+    def __call__(self):
+        return self.good, self.bad
+
+
+def _engine(target=0.99, cfg=None, recorder=None, name="synthetic"):
+    clock = {"t": 0.0}
+    wall = {"t": 1_000_000.0}
+    src = _Stream()
+    eng = SloEngine([SloObjective(name, target, src)],
+                    config=cfg or _cfg(),
+                    recorder=recorder,
+                    registry=obs_metrics.MetricsRegistry(),
+                    clock=lambda: clock["t"],
+                    wall=lambda: wall["t"])
+    return eng, src, clock, wall
+
+
+def test_engine_burn_rates_match_analytic_stream():
+    eng, src, clock, _ = _engine(target=0.99)  # budget = 1%
+    res = eng.tick()[0]
+    assert res["status"] == "ok"               # baseline: no deltas
+    assert res["burn_fast"] == 0.0
+    # 30 ticks (300 s) at a steady 1% error rate: burn 1.0 everywhere
+    for _ in range(30):
+        clock["t"] += 10.0
+        src.good += 99
+        src.bad += 1
+        res = eng.tick()[0]
+    assert res["burn_fast"] == pytest.approx(1.0)
+    assert res["burn_mid"] == pytest.approx(1.0)
+    assert res["burn_slow"] == pytest.approx(1.0)
+    assert res["status"] == "ok"               # 1.0 < slow_burn=2
+    # the error rate jumps to 10%: once the fast and mid windows hold
+    # only new-rate samples the burn is exactly 10.0
+    for _ in range(30):                        # 300 s of 10% errors
+        clock["t"] += 10.0
+        src.good += 90
+        src.bad += 10
+        res = eng.tick()[0]
+    assert res["burn_fast"] == pytest.approx(10.0)
+    assert res["burn_mid"] == pytest.approx(10.0)
+    # the slow window still mixes both regimes: 300s@1% + 300s@10%
+    # -> (30*1 + 30*10) bad over 6000 requests / 1% budget = 5.5
+    assert res["burn_slow"] == pytest.approx(5.5)
+    # the period consumed 330/6000 / 1% = 5.5 budgets: exhaustion
+    # outranks paging in the status ladder
+    assert res["budget_remaining"] == 0.0
+    assert res["status"] == "budget_exhausted"
+
+
+def test_fast_burn_status_needs_both_windows_hot():
+    # page-grade status: burn above threshold on BOTH fast and mid,
+    # with enough budget left that exhaustion does not outrank it
+    cfg = _cfg(fast_burn=1.2, slow_burn=3.0)
+    eng, src, clock, _ = _engine(target=0.9, cfg=cfg)
+    eng.tick()
+    for _ in range(20):                        # healthy history
+        clock["t"] += 10.0
+        src.good += 100
+        eng.tick()
+    for _ in range(30):                        # 300 s at 15% errors
+        clock["t"] += 10.0
+        src.good += 85
+        src.bad += 15
+        res = eng.tick()[0]
+    assert res["burn_fast"] == pytest.approx(1.5)
+    assert res["burn_mid"] == pytest.approx(1.5)
+    # period: 450 bad / 5000 total / 10% budget = 0.9 consumed
+    assert res["budget_remaining"] == pytest.approx(0.1)
+    assert res["status"] == "fast_burn"
+
+
+def test_fast_burn_requires_both_windows():
+    # a one-tick blip exceeds the fast window's threshold but not the
+    # mid window's: no page (the whole point of multi-window eval)
+    cfg = _cfg(fast_burn=1.0, slow_burn=3.0)
+    eng, src, clock, _ = _engine(target=0.9, cfg=cfg)
+    eng.tick()
+    for _ in range(29):                        # long healthy history
+        clock["t"] += 10.0
+        src.good += 100
+        res = eng.tick()[0]
+    clock["t"] += 10.0                         # one bad tick
+    src.bad += 100
+    res = eng.tick()[0]
+    # fast window: 100 bad over 600 -> burn 1.67; mid: 100/3000 -> 0.33
+    assert res["burn_fast"] == pytest.approx(100 / 600 / 0.1)
+    assert res["burn_mid"] == pytest.approx(100 / 3000 / 0.1)
+    assert res["status"] == "ok"
+
+
+def test_slow_burn_tickets_without_paging():
+    # 3% steady errors against a 10% budget: burn 0.3 — above a 0.25
+    # ticket threshold, below the 0.5 page threshold
+    cfg = _cfg(fast_burn=0.5, slow_burn=0.25)
+    eng, src, clock, _ = _engine(target=0.9, cfg=cfg)
+    eng.tick()
+    for _ in range(30):
+        clock["t"] += 10.0
+        src.good += 97
+        src.bad += 3
+        res = eng.tick()[0]
+    assert res["burn_slow"] == pytest.approx(0.3)
+    assert res["burn_fast"] == pytest.approx(0.3)
+    assert res["status"] == "slow_burn"
+
+
+def test_budget_accounting_and_exhaustion_eta():
+    # 90% target => 10% budget; run the period to exhaustion
+    eng, src, clock, _ = _engine(target=0.9)
+    eng.tick()
+    clock["t"] += 10.0
+    src.good += 95
+    src.bad += 5
+    res = eng.tick()[0]
+    # period: 5 bad / 100 total / 10% budget = half the budget gone
+    assert res["budget_remaining"] == pytest.approx(0.5)
+    # burn = (5/100)/0.1 = 0.5; ETA = remaining * window / burn
+    assert res["burn_fast"] == pytest.approx(0.5)
+    assert res["exhaustion_eta_s"] == pytest.approx(
+        0.5 * 86400.0 / 0.5, rel=1e-3)
+    clock["t"] += 10.0
+    src.good += 90
+    src.bad += 10
+    res = eng.tick()[0]
+    # period now 15 bad / 200 total: 0.75 budgets consumed
+    assert res["budget_remaining"] == pytest.approx(0.25)
+    clock["t"] += 10.0
+    src.bad += 100
+    res = eng.tick()[0]                        # 115/300 >> 10% budget
+    assert res["budget_remaining"] == 0.0
+    assert res["status"] == "budget_exhausted"
+    assert res["exhaustion_eta_s"] == 0.0
+
+
+def test_budget_period_reopens_after_window():
+    cfg = _cfg(budget_window_s=3600.0)
+    eng, src, clock, wall = _engine(target=0.9, cfg=cfg)
+    eng.tick()
+    clock["t"] += 10.0
+    src.bad += 1000
+    res = eng.tick()[0]
+    assert res["status"] == "budget_exhausted"
+    # one budget window later the books reopen (window burns also aged
+    # out once the monotonic clock moves past window_slow)
+    wall["t"] += 3600.0
+    clock["t"] += 3600.0
+    src.good += 100
+    res = eng.tick()[0]
+    assert res["budget_remaining"] == pytest.approx(1.0)
+    assert res["status"] == "ok"
+
+
+def test_counter_reset_clamps_to_zero():
+    eng, src, clock, _ = _engine(target=0.99)
+    eng.tick()
+    clock["t"] += 10.0
+    src.good += 100
+    eng.tick()
+    # the source restarts: cumulative counters fall — the delta must
+    # clamp to 0, never go negative
+    src.good = 5.0
+    src.bad = 0.0
+    clock["t"] += 10.0
+    res = eng.tick()[0]
+    assert res["window_bad"] == 0.0
+    assert res["burn_fast"] == 0.0
+    assert res["budget_remaining"] == pytest.approx(1.0)
+
+
+def test_state_persists_across_restart(tmp_path):
+    path = str(tmp_path / "slo_state.json")
+    cfg = _cfg(state_file=path)
+    eng, src, clock, wall = _engine(target=0.9, cfg=cfg)
+    eng.tick()
+    clock["t"] += 10.0
+    src.good += 95
+    src.bad += 5
+    res = eng.tick()[0]
+    assert res["budget_remaining"] == pytest.approx(0.5)
+    state = json.loads(open(path).read())
+    assert state["objectives"]["synthetic"]["bad"] == 5.0
+
+    # a "restarted replica": fresh engine, same state file — the ctor
+    # adopts the unexpired period from disk
+    eng2, src2, clock2, wall2 = _engine(target=0.9, cfg=cfg)
+    wall2["t"] = wall["t"] + 60.0              # shortly after the crash
+    assert eng2._period["synthetic"] == (95.0, 5.0)
+    eng2.tick()                                # baseline
+    clock2["t"] += 10.0
+    src2.good += 100
+    res2 = eng2.tick()[0]
+    # the 5 burned bad rows survived the restart: the period is
+    # 5 bad / 200 total = 2.5% of traffic, 25% of the 10% budget...
+    assert res2["period_bad"] == 5.0
+    assert res2["budget_remaining"] == pytest.approx(
+        1.0 - (5.0 / 200.0) / 0.1)
+    # ...a crash-loop cannot launder its burned budget
+
+
+def test_expired_state_not_adopted(tmp_path):
+    path = str(tmp_path / "slo_state.json")
+    cfg = _cfg(state_file=path, budget_window_s=3600.0)
+    eng, src, clock, wall = _engine(target=0.9, cfg=cfg)
+    eng.tick()
+    clock["t"] += 10.0
+    src.bad += 50
+    eng.tick()
+    # the replica comes back two budget windows later: the recorded
+    # period has expired and must NOT be adopted
+    src2 = _Stream()
+    eng2 = SloEngine([SloObjective("synthetic", 0.9, src2)],
+                     config=cfg,
+                     registry=obs_metrics.MetricsRegistry(),
+                     clock=lambda: 0.0,
+                     wall=lambda: wall["t"] + 7200.0)
+    assert eng2._period["synthetic"] == (0.0, 0.0)
+    res = eng2.tick()[0]
+    assert res["period_bad"] == 0.0            # expired period discarded
+    assert res["budget_remaining"] == 1.0
+
+
+def test_scrape_error_degrades_to_last_known():
+    eng, src, clock, _ = _engine(target=0.99)
+    eng.tick()
+    clock["t"] += 10.0
+    src.good += 100
+    res = eng.tick()[0]
+    assert res["status"] == "ok"
+
+    def boom():
+        raise RuntimeError("source down")
+
+    eng.objectives[0].source = boom
+    clock["t"] += 10.0
+    res = eng.tick()[0]
+    assert res["status"] == "scrape_error"
+    assert "source down" in res["error"]
+    # the degraded result carries the last-known burns, not zeros
+    assert res["objective"] == "synthetic"
+    assert eng.scrape_errors == 1
+    # recovery: the source comes back, status recovers
+    eng.objectives[0].source = src
+    clock["t"] += 10.0
+    src.good += 100
+    assert eng.tick()[0]["status"] == "ok"
+
+
+def test_slo_scrape_fault_point_degrades_one_tick():
+    eng, src, clock, _ = _engine(target=0.99)
+    eng.tick()
+    faults.configure("slo.scrape:error@1")
+    faults.reset("slo.scrape")                 # baseline burned ordinal 1
+    clock["t"] += 10.0
+    src.good += 100
+    res = eng.tick()[0]
+    assert res["status"] == "scrape_error"
+    assert faults.hits("slo.scrape") == 1
+    clock["t"] += 10.0
+    src.good += 100
+    assert eng.tick()[0]["status"] == "ok"
+
+
+def test_records_validate_and_gauges_set():
+    rec = tele.RunRecorder()
+    clock = {"t": 0.0}
+    reg = obs_metrics.MetricsRegistry()
+    src = _Stream()
+    eng = SloEngine([SloObjective("availability", 0.99, src)],
+                    config=_cfg(), recorder=rec, registry=reg,
+                    clock=lambda: clock["t"])
+    eng.tick()
+    clock["t"] += 10.0
+    src.good += 90
+    src.bad += 10
+    eng.tick()
+    slo_recs = [r for r in rec.records if r["type"] == "slo"]
+    assert len(slo_recs) == 2
+    for r in slo_recs:
+        assert tele.validate_record(r) == []
+    assert slo_recs[-1]["burn_fast"] == pytest.approx(10.0)
+    text = reg.render()
+    assert 'ltpu_slo_burn_rate{objective="availability",window="fast"}' \
+        in text
+    assert 'ltpu_slo_budget_remaining{objective="availability"}' in text
+    assert eng._g_burn.labels(
+        objective="availability", window="fast"
+    ).value == pytest.approx(10.0)
+    s = rec.summary()
+    assert s["slo_evals"] == 2
+
+
+def test_worst_rollup_across_objectives():
+    clock = {"t": 0.0}
+    hot, cold = _Stream(), _Stream()
+    eng = SloEngine([SloObjective("hot", 0.99, hot),
+                     SloObjective("cold", 0.99, cold)],
+                    config=_cfg(),
+                    registry=obs_metrics.MetricsRegistry(),
+                    clock=lambda: clock["t"])
+    eng.tick()
+    clock["t"] += 10.0
+    hot.bad += 50
+    hot.good += 50
+    cold.good += 100
+    eng.tick()
+    w = eng.worst()
+    assert w["worst_burn_objective"] == "hot"
+    assert w["worst_burn_fast"] == pytest.approx(50.0)
+    assert w["min_budget_objective"] == "hot"
+
+
+# ----------------------------------------------------------------------
+# router-shaped sources
+# ----------------------------------------------------------------------
+class _FakeRoute:
+    def __init__(self, inflight, max_inflight):
+        self.inflight = inflight
+        self.max_inflight = max_inflight
+
+
+class _FakeRouter:
+    def __init__(self, routes):
+        import threading
+        self._lock = threading.Lock()
+        self._routes = routes
+        self._counts = {}
+        self._metrics = None
+
+    def models(self):
+        return list(self._routes)
+
+
+def test_router_queue_fraction_caps_and_ignores_uncapped():
+    r = _FakeRouter({"a": _FakeRoute(4, 8), "b": _FakeRoute(2, 0)})
+    # only capped routes contribute capacity; uncapped inflight still
+    # counts toward demand
+    assert router_queue_fraction(r) == pytest.approx(6 / 8)
+    r2 = _FakeRouter({"a": _FakeRoute(100, 8)})
+    assert router_queue_fraction(r2) == 1.0    # clamped
+    assert router_queue_fraction(_FakeRouter({})) == 0.0

@@ -101,6 +101,7 @@ def test_streamed_bit_identical_to_inmemory(data, oracle, tmp_path):
     assert len(cache_mod.chunk_grid(N_ROWS, CHUNK)) == 7
 
 
+@pytest.mark.slow
 def test_sealed_cache_reuse_trains_identically(data, oracle, tmp_path):
     X, y = data
     m_oracle, _ = oracle
@@ -140,6 +141,7 @@ def test_sampling_parity_matrix(data, tmp_path, extra, fused):
     assert m == m_oracle
 
 
+@pytest.mark.slow
 def test_sharded_data_parallel_parity(data, tmp_path):
     """Streamed vs in-memory at the SAME mesh width (the streamed
     path's device program is identical; only the host source of the
@@ -329,6 +331,7 @@ def test_upload_matches_monolithic_pad():
 # ----------------------------------------------------------------------
 # checkpoint resume contract
 # ----------------------------------------------------------------------
+@pytest.mark.slow
 def test_checkpoint_records_cache_identity_and_resume_hits(
         data, tmp_path):
     X, y = data

@@ -144,6 +144,7 @@ def test_parity_sharded_mesh(data):
         assert txt == solo, f"sharded member {i} not byte-equal"
 
 
+@pytest.mark.slow
 def test_prng_fold_independence(data):
     """Member i's sampling/quantization streams are functions of ITS
     seeds and the global counters only — training it alone (B=1) or

@@ -24,6 +24,17 @@ hammer it.  The run exits non-zero unless:
 - a traced request forms ONE joinable client -> router -> replica
   trace across OS processes (``tools/trace_view.py``
   ``--lint-route-continuity``).
+
+``--autoscale`` runs the closed-loop scenario instead (``obs/slo.py``
++ ``serve/autoscaler.py``): a 1-replica fleet under the SLO engine and
+the autoscaler, driven through load surge -> grow, brownout at max
+capacity -> admission retune BEFORE the error budget exhausts ->
+restore on burn clear, idle -> drain to min replicas, and a WEDGED
+controller (``autoscale.decide:hang``) that must leave the fleet
+serving at its current size.  Every scale action must reconcile
+against a fleet ``scale`` telemetry record, every acted-on decision
+must join an ``autoscale_decide`` span, and the zero-dropped /
+zero-mixed-fingerprint gates of the base scenario apply throughout.
 """
 import argparse
 import json
@@ -74,7 +85,12 @@ def main(argv=None):
     ap.add_argument("--telemetry", default="router_telemetry.jsonl")
     ap.add_argument("--threads", type=int, default=4)
     ap.add_argument("--out", help="summary JSON path")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="run the SLO + closed-loop autoscaler "
+                         "scenario instead of the base router chaos")
     args = ap.parse_args(argv)
+    if args.autoscale:
+        return autoscale_scenario(args)
 
     import numpy as np
 
@@ -351,6 +367,356 @@ def main(argv=None):
     checks["zero_mixed_fingerprint"] = counts["mixed_fingerprint"] == 0
     res = {
         "mode": "router_chaos",
+        "counts": counts,
+        "checks": checks,
+        "errors": errors[:10],
+        "passed": all(checks.values()),
+    }
+    print(json.dumps(res), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(res, f, indent=1, sort_keys=True)
+    return 0 if res["passed"] else 1
+
+
+def autoscale_scenario(args):
+    """The closed-loop e2e: see the module docstring.  Fast SLO
+    windows (seconds, not minutes) keep the control physics identical
+    while the whole loop fits a CI job."""
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import spans as _spans
+    from lightgbm_tpu.obs.slo import (SloEngine, SloObjective,
+                                      router_objectives)
+    from lightgbm_tpu.serve import (Autoscaler, AutoscaleConfig,
+                                    FleetConfig, FleetSupervisor,
+                                    ProcessReplica, Router,
+                                    RouterConfig, SloConfig,
+                                    model_fingerprint)
+    from lightgbm_tpu.serve.router import route_http
+    from lightgbm_tpu.utils import faults
+    from lightgbm_tpu.utils.telemetry import RunRecorder
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    work = os.path.abspath(args.workdir)
+    shutil.rmtree(work, ignore_errors=True)
+    os.makedirs(work)
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(2000, 8)
+    y = (X[:, 0] + 0.4 * rng.randn(2000) > 0).astype(float)
+    d = lgb.Dataset(X, label=y, params={"objective": "binary",
+                                        "verbose": -1})
+    print("autoscale chaos: training model", flush=True)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbose": -1, "metric": "None", "seed": 1},
+                    d, num_boost_round=4)
+    model_file = os.path.join(work, "model.txt")
+    bst.save_model(model_file)
+    text = lgb.Booster(model_str=bst.model_to_string(
+        num_iteration=-1)).model_to_string(num_iteration=-1)
+    fp = model_fingerprint(text)
+    preds = lgb.Booster(model_str=text).predict(X)
+    oracle = {fp: preds}
+
+    recorder = RunRecorder(args.telemetry or None,
+                           run_info={"task": "autoscale_chaos"},
+                           keep_records=True)
+    fcfg = FleetConfig(replicas=1, probe_interval_s=0.2,
+                       probe_timeout_s=5.0, fail_threshold=3,
+                       backoff_base_s=0.2, backoff_max_s=2.0,
+                       circuit_failures=10)
+
+    def factory(i):
+        return ProcessReplica(
+            model_file, work, slot=i,
+            params={"serve_drain_grace_s": "5",
+                    "serve_batch_wait_ms": "1",
+                    "serve_timeout_ms": "30000",
+                    "telemetry_file": os.path.join(
+                        work, f"replica_{i}_telemetry.jsonl")},
+            env={"PYTHONPATH": repo + os.pathsep +
+                 os.environ.get("PYTHONPATH", "")})
+
+    checks = {}
+    counts = {"ok": 0, "backpressure": 0, "dropped": 0,
+              "mixed_fingerprint": 0, "shed_structured": 0,
+              "shed_unstructured": 0}
+    lock = threading.Lock()
+    stop = threading.Event()
+    pause = threading.Event()
+    errors = []
+
+    sup = FleetSupervisor(factory, fcfg, recorder)
+    print("autoscale chaos: starting 1 process replica", flush=True)
+    sup.start(wait_healthy_s=180)
+    checks["fleet_started"] = len(sup.endpoints()) == 1
+
+    rcfg = RouterConfig(port=0, probe_interval_s=0.15,
+                        probe_timeout_s=5.0, timeout_ms=30000.0,
+                        max_retries=4, hedge_ms=75.0,
+                        breaker_failures=4, breaker_cooldown_s=1.0)
+    router = Router(rcfg, recorder=recorder)
+    router.add_model("default", supervisor=sup)
+    sup.set_router(router)
+    httpd, _ = route_http(router, port=0, background=True)
+    url = "http://127.0.0.1:%d" % httpd.server_address[1]
+    print(f"autoscale chaos: router at {url}", flush=True)
+
+    # harness-driven surge objective: generous targets (0.5 budgets)
+    # keep the budget arithmetic deterministic — a surging tick is 40%
+    # bad, so the burn is exactly 0.8x (above the 0.5 grow threshold)
+    # while period consumption can never reach 1.0 (it asymptotes to
+    # 0.8 even under a permanent surge), making "retune BEFORE the
+    # budget dies" provable rather than a race
+    surge = threading.Event()
+    synth = {"good": 0.0, "bad": 0.0}
+
+    def synth_source():
+        if surge.is_set():
+            synth["good"] += 300.0
+            synth["bad"] += 200.0
+        else:
+            synth["good"] += 100.0
+        return synth["good"], synth["bad"]
+
+    slo_state = os.path.join(work, "slo_state.json")
+    scfg = SloConfig(interval_s=0.25, window_fast_s=2.0,
+                     window_mid_s=4.0, window_slow_s=10.0,
+                     fast_burn=0.5, slow_burn=0.4,
+                     budget_window_s=3600.0, state_file=slo_state,
+                     availability_target=0.5, latency_p99_ms=10000.0,
+                     latency_target=0.5, queue_saturation=0.95,
+                     queue_target=0.5, shed_target=0.5)
+    objectives = router_objectives(router, scfg) + \
+        [SloObjective("chaos_surge", 0.5, synth_source)]
+    engine = SloEngine(objectives, config=scfg,
+                       recorder=recorder).start()
+    acfg = AutoscaleConfig(interval_s=0.3, min_replicas=1,
+                           max_replicas=2, grow_burn=0.5,
+                           grow_queue=0.95, drain_idle_s=1.5,
+                           drain_util=0.3, cooldown_s=1.0,
+                           drain_cooldown_s=1.0,
+                           shed_rows_per_s=256.0, budget_floor=0.05)
+    scaler = Autoscaler(supervisor=sup, router=router, slo=engine,
+                        config=acfg, recorder=recorder).start()
+
+    def check_response(st, out, hdrs, lo, n):
+        if st == 200:
+            mid = out.get("model_id")
+            exp = oracle.get(mid)
+            got = np.asarray(out.get("predictions", ()))
+            if exp is None or got.shape != (n,) or \
+                    not np.allclose(got, exp[lo:lo + n],
+                                    rtol=1e-9, atol=1e-9):
+                with lock:
+                    counts["mixed_fingerprint"] += 1
+                    errors.append(f"model_id {mid} does not match its "
+                                  f"predictions (rows {lo}:{lo + n})")
+            else:
+                with lock:
+                    counts["ok"] += 1
+            return
+        if st == 429:
+            with lock:
+                counts["backpressure"] += 1
+                if out.get("code") == "backpressure" and \
+                        out.get("retry_after_ms") is not None and \
+                        hdrs.get("Retry-After"):
+                    counts["shed_structured"] += 1
+                else:
+                    counts["shed_unstructured"] += 1
+                    errors.append(f"unstructured 429: {out} {hdrs}")
+            time.sleep(max(float(out.get("retry_after_ms", 20.0)),
+                           5.0) / 1e3)
+            return
+        with lock:
+            counts["dropped"] += 1
+            errors.append(f"HTTP {st} reached the client: "
+                          f"{str(out.get('error', ''))[:120]}")
+
+    def client(tid):
+        r = np.random.RandomState(1000 + tid)
+        while not stop.is_set():
+            if pause.is_set():
+                time.sleep(0.05)
+                continue
+            lo = int(r.randint(0, len(X) - 64))
+            n = int(r.randint(1, 48))
+            st, out, hdrs = _post(url, "/predict",
+                                  {"rows": X[lo:lo + n].tolist()},
+                                  timeout=60)
+            check_response(st, out, hdrs, lo, n)
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(args.threads)]
+    for t in threads:
+        t.start()
+
+    def ok_total():
+        with lock:
+            return counts["ok"]
+
+    def action_records(action=None, mode="active"):
+        return [r for r in recorder.records
+                if r.get("type") == "autoscale" and
+                (mode is None or r.get("mode") == mode) and
+                (action is None or r.get("action") == action)]
+
+    try:
+        # phase 0: steady traffic, controller quiescent
+        checks["warm_traffic"] = bool(
+            _wait_until(lambda: ok_total() >= 50, 120,
+                        "50 ok responses through the router"))
+
+        # phase 1: load surge -> the controller must GROW 1 -> 2
+        print("autoscale chaos: phase 1 — surge -> grow", flush=True)
+        surge.set()
+        checks["surge_grew"] = bool(_wait_until(
+            lambda: sup.replica_count() == 2 and
+            action_records("grow"), 60, "grow to 2 replicas"))
+        checks["grew_routable"] = bool(_wait_until(
+            lambda: len(sup.endpoints()) == 2, 120,
+            "grown replica routable"))
+        surge.clear()
+        pause.set()
+
+        # recovery within the fast burn window (+ engine slack): every
+        # objective back to ok once the surge stops, and any early
+        # retune (burn lingering in the fast window while already at
+        # max capacity is a LEGITIMATE retune) restored again
+        def all_ok():
+            snap = engine.snapshot()
+            return snap and all(r.get("status") == "ok"
+                                for r in snap.values())
+        route = router.model_route("default")
+        checks["burn_recovered"] = bool(_wait_until(
+            lambda: all_ok() and not scaler.shed_active() and
+            route.bucket.rate == rcfg.rows_per_s,
+            scfg.window_mid_s + 8.0, "burn rates clearing"))
+        pause.clear()
+
+        # phase 2: brownout at max capacity -> admission retune BEFORE
+        # the budget exhausts (shed cheap traffic, never fall over).
+        # If the recovery idle already drained a replica, the
+        # controller re-grows to max first — same policy, same end
+        # state.
+        print("autoscale chaos: phase 2 — brownout -> retune",
+              flush=True)
+        n_retunes = len(action_records("retune_shed"))
+        surge.set()
+        checks["retune_fired"] = bool(_wait_until(
+            lambda: len(action_records("retune_shed")) > n_retunes,
+            60, "admission retune at max capacity"))
+        retunes = action_records("retune_shed")
+        if len(retunes) > n_retunes:
+            # the first retune of THIS brownout (an early phase-1
+            # retune, if any, was already restored)
+            ev = retunes[n_retunes].get("evidence") or {}
+            checks["retune_before_exhaustion"] = \
+                float(ev.get("budget_remaining", 0.0)) > 0.0
+            checks["retune_at_capacity"] = \
+                int(ev.get("replicas", 0)) == acfg.max_replicas
+        checks["bucket_shed_rate"] = bool(_wait_until(
+            lambda: route.bucket.rate == acfg.shed_rows_per_s, 10,
+            "token bucket at the shed rate"))
+        n_restores = len(action_records("retune_restore"))
+        surge.clear()
+        pause.set()                        # idle: let the burn clear
+
+        # phase 3: burn cleared -> original admission budgets restored
+        print("autoscale chaos: phase 3 — restore on burn clear",
+              flush=True)
+        checks["restore_fired"] = bool(_wait_until(
+            lambda: len(action_records("retune_restore")) > n_restores,
+            60, "admission restore"))
+        checks["bucket_restored"] = bool(_wait_until(
+            lambda: route.bucket.rate == rcfg.rows_per_s, 10,
+            "token bucket back to its original rate"))
+
+        # phase 4: sustained idle -> drain back to min replicas
+        print("autoscale chaos: phase 4 — idle -> drain", flush=True)
+        checks["drained_to_min"] = bool(_wait_until(
+            lambda: sup.replica_count() == acfg.min_replicas and
+            action_records("drain"), 60, "drain to min replicas"))
+
+        # phase 5: WEDGE the controller; the fleet must keep serving
+        # at its current size even under a fresh surge
+        print("autoscale chaos: phase 5 — wedged controller",
+              flush=True)
+        faults.configure("autoscale.decide:hang@*")
+        time.sleep(2 * acfg.interval_s)    # let the hang engage
+        n_before = len(action_records(mode=None))
+        pause.clear()
+        surge.set()
+        base = ok_total()
+        checks["wedged_fleet_serving"] = bool(
+            _wait_until(lambda: ok_total() >= base + 30, 60,
+                        "traffic through the wedged controller"))
+        time.sleep(1.0)
+        checks["wedged_no_actions"] = \
+            len(action_records(mode=None)) == n_before and \
+            sup.replica_count() == acfg.min_replicas
+        surge.clear()
+        faults.configure("")
+
+        # phase 6: one traced request for the continuity lint
+        with _spans.span("client_request", recorder=recorder,
+                         root=True):
+            st, out, _ = _post(url, "/predict",
+                               {"rows": X[:3].tolist()},
+                               headers=_spans.http_headers())
+        checks["traced_request_ok"] = st == 200
+        time.sleep(1.0)                    # let replica JSONL flush
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        scaler.stop()
+        engine.stop()
+        httpd.shutdown()
+        httpd.server_close()
+        router.stop()
+        sup.stop()
+        recorder.close()
+
+    # every ACTED scale decision reconciles against a fleet scale
+    # record with the same from/to — the telemetry is the audit log
+    scale_decisions = [(r.get("from_replicas"), r.get("to_replicas"))
+                       for r in recorder.records
+                       if r.get("type") == "autoscale" and
+                       r.get("mode") == "active" and
+                       r.get("action") in ("grow", "drain")]
+    fleet_scales = [(r.get("from_replicas"), r.get("to_replicas"))
+                    for r in recorder.records
+                    if r.get("type") == "fleet" and
+                    r.get("event") == "scale" and
+                    str(r.get("reason", "")).startswith("autoscale:")]
+    checks["actions_reconciled"] = bool(scale_decisions) and \
+        scale_decisions == fleet_scales
+    checks["slo_evaluated"] = any(r.get("type") == "slo"
+                                  for r in recorder.records)
+    checks["slo_state_persisted"] = os.path.isfile(slo_state)
+
+    from trace_view import lint_route_continuity, load_records
+    files = [args.telemetry] + [
+        os.path.join(work, f"replica_{i}_telemetry.jsonl")
+        for i in range(2)
+        if os.path.exists(os.path.join(work,
+                                       f"replica_{i}_telemetry.jsonl"))]
+    lint_errs = lint_route_continuity(load_records(files),
+                                      require_processes=2)
+    checks["route_trace_continuity"] = not lint_errs
+    for e in lint_errs:
+        errors.append(f"trace lint: {e}")
+
+    checks["zero_dropped"] = counts["dropped"] == 0
+    checks["zero_mixed_fingerprint"] = counts["mixed_fingerprint"] == 0
+    checks["sheds_all_structured"] = counts["shed_unstructured"] == 0
+    res = {
+        "mode": "autoscale_chaos",
         "counts": counts,
         "checks": checks,
         "errors": errors[:10],

@@ -110,14 +110,18 @@ from lightgbm_tpu.utils.telemetry import (  # noqa: E402 - jax-free
 
 
 def drive(url, n_requests, n_threads, rows_max, n_features, seed=0,
-          swap_model_file=None, priority_mix=False):
+          swap_model_file=None, priority_mix=False, surge_threads=0):
     """Issue ``n_requests`` mixed-size requests from ``n_threads``
     clients; fire one hot-swap halfway through when
-    ``swap_model_file`` is given.  Returns the summary dict."""
+    ``swap_model_file`` is given.  ``surge_threads`` adds that many
+    extra clients for the SECOND half of the run (a step load surge —
+    the driver for watching an SLO burn / autoscaler react) and the
+    summary reports per-half latency.  Returns the summary dict."""
     import numpy as np
     rng = np.random.RandomState(seed)
     lock = threading.Lock()
     lat, counts, errors = [], {}, []
+    halves = ([], [])
     issued = [0]
     swap_at = n_requests // 2
     swap_result = {}
@@ -156,6 +160,7 @@ def drive(url, n_requests, n_threads, rows_max, n_features, seed=0,
                                   f"{len(out.get('predictions', ()))}")
                 with lock:
                     lat.append(ms)
+                    halves[1 if i > swap_at else 0].append(ms)
             elif st == 429:
                 bump("rejected")
                 time.sleep(max(float(out.get("retry_after_ms", 10)),
@@ -172,6 +177,24 @@ def drive(url, n_requests, n_threads, rows_max, n_features, seed=0,
                for i in range(n_threads)]
     for t in threads:
         t.start()
+    if surge_threads:
+        # the step surge: extra clients pile on once half the
+        # requests have been issued
+        def surge_watch():
+            while True:
+                with lock:
+                    if issued[0] >= swap_at:
+                        break
+                time.sleep(0.01)
+            extra = [threading.Thread(target=client,
+                                      args=(n_threads + j,))
+                     for j in range(surge_threads)]
+            for t in extra:
+                t.start()
+            threads.extend(extra)
+        w = threading.Thread(target=surge_watch)
+        w.start()
+        w.join()
     for t in threads:
         t.join()
     wall_s = time.monotonic() - t_start
@@ -186,6 +209,17 @@ def drive(url, n_requests, n_threads, rows_max, n_features, seed=0,
         "p99_ms": round(_percentile(lat, 0.99), 2),
         "errors": errors[:10],
     }
+    if surge_threads:
+        for h in halves:
+            h.sort()
+        out["surge"] = {
+            "threads_before": n_threads,
+            "threads_after": n_threads + surge_threads,
+            "p50_ms_before": round(_percentile(halves[0], 0.50), 2),
+            "p99_ms_before": round(_percentile(halves[0], 0.99), 2),
+            "p50_ms_after": round(_percentile(halves[1], 0.50), 2),
+            "p99_ms_after": round(_percentile(halves[1], 0.99), 2),
+        }
     if swap_result:
         out["swap"] = swap_result
     return out
@@ -725,6 +759,11 @@ def main(argv=None):
                     help="feature count for --url mode payloads")
     ap.add_argument("--swap-model", help="model file to hot-swap in "
                                          "mid-run (--url mode)")
+    ap.add_argument("--surge-threads", type=int, default=0,
+                    help="--url mode: add this many extra clients for "
+                         "the second half of the run (a step load "
+                         "surge for driving the SLO engine / "
+                         "autoscaler)")
     ap.add_argument("--telemetry", default="",
                     help="selftest: server telemetry JSONL path")
     ap.add_argument("--out", help="also write the summary JSON here")
@@ -739,7 +778,8 @@ def main(argv=None):
     elif args.url:
         res = drive(args.url.rstrip("/"), args.requests, args.threads,
                     args.rows_max, args.features,
-                    swap_model_file=args.swap_model)
+                    swap_model_file=args.swap_model,
+                    surge_threads=args.surge_threads)
         res["mode"] = "url"
         rc = 0 if not res["errors"] and res["counts"].get("ok") else 1
         res["passed"] = rc == 0

@@ -221,7 +221,12 @@ def lint_route_continuity(records: List[Dict[str, Any]],
     containing a client-side root span, a ``router`` request record,
     and a replica-side ``serve`` record.  Optionally require the
     joined trace to span >= N OS processes (the router chaos e2e runs
-    the replicas as subprocesses)."""
+    the replicas as subprocesses).
+
+    Autoscaler decisions (``serve/autoscaler.py``) are part of the
+    same timelines: every acted-on ``autoscale`` record must join a
+    trace containing its ``autoscale_decide`` root span — a scaling
+    action nobody can trace back to its evidence fails the lint."""
     errs: List[str] = []
     by_trace = traces(records)
     routed = [r for r in records if r.get("type") == "router" and
@@ -263,6 +268,21 @@ def lint_route_continuity(records: List[Dict[str, Any]],
         errs.append("no routed request forms a client -> router -> "
                     "replica trace:")
         errs.extend(reasons[:10])
+    acted = [r for r in records if r.get("type") == "autoscale" and
+             r.get("action") not in (None, "none") and
+             r.get("mode") != "degraded"]
+    for rec in acted:
+        tid = rec.get("trace_id")
+        if not tid:
+            errs.append(f"autoscale {rec.get('action')} "
+                        f"({rec.get('rule', '?')}) carries no trace "
+                        f"tag — the decision span is missing")
+            continue
+        ent = by_trace.get(tid, {"spans": [], "events": []})
+        names = {s.get("name") for s in ent["spans"]}
+        if "autoscale_decide" not in names:
+            errs.append(f"autoscale {rec.get('action')} trace {tid} "
+                        f"has no autoscale_decide span")
     return errs
 
 

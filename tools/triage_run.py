@@ -539,6 +539,41 @@ def triage(records, baseline=None):
                 f"{s.get('serve_rejected', 0):.0f} rejected, "
                 f"occupancy {s.get('serve_mean_occupancy', 0):.2f}, "
                 f"{s.get('serve_swaps', 0):.0f} swaps")
+        if s.get("slo_evals"):
+            # newest result per objective = the engine's final verdict
+            last = {}
+            for r in records:
+                if r.get("type") == "slo" and r.get("objective"):
+                    last[str(r["objective"])] = r
+            line = (f"slo         : {s['slo_evals']:.0f} evals over "
+                    f"{len(last)} objective(s)")
+            if last:
+                worst = max(last.values(),
+                            key=lambda r: r.get("burn_fast", 0.0))
+                lowest = min(last.values(),
+                             key=lambda r: r.get("budget_remaining",
+                                                 1.0))
+                line += (f", worst burn "
+                         f"{float(worst.get('burn_fast', 0.0)):.1f}x "
+                         f"({worst.get('objective')}), budget left "
+                         f"{float(lowest.get('budget_remaining', 1.0)):.0%} "
+                         f"({lowest.get('objective')})")
+            bad = [f"{k.split('slo_', 1)[1]} {v:.0f}"
+                   for k, v in sorted(s.items())
+                   if k.startswith("slo_") and k not in
+                   ("slo_evals",) and v]
+            if bad:
+                line += ", states: " + ", ".join(bad)
+            lines.append(line)
+        if s.get("autoscale_actions") or s.get("autoscale_degraded"):
+            parts = [f"{k.split('autoscale_', 1)[1]} {v:.0f}"
+                     for k, v in sorted(s.items())
+                     if k.startswith("autoscale_") and
+                     k != "autoscale_actions" and v]
+            lines.append(
+                f"autoscale   : {s.get('autoscale_actions', 0):.0f} "
+                f"action(s)" + (f" ({', '.join(parts)})" if parts
+                                else ""))
     anomalies = scan_anomalies(records)
     lines.append("anomalies   : " + ("none" if not anomalies else ""))
     for sev, msg in anomalies:
