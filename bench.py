@@ -1548,13 +1548,160 @@ def weakscale_curve(shards=(1, 2, 4, 8), rows_per_shard=2048,
     return out
 
 
+def weakscale_grid_2d(shapes=((1, 8), (2, 4), (4, 2), (8, 1)),
+                      rows_per_shard=2048, n_features=8,
+                      num_leaves=15, max_bin=63, fused_iters=8,
+                      iters=8, reps=2, telemetry_file=None):
+    """The SECOND weak-scaling axis: the 2-D ``data2d`` mesh grid at a
+    FIXED total device count, sweeping how the devices factor into
+    (data x feature) = RxF.  Fixed rows per ROW shard (total rows grow
+    with R), so every cell moves the same per-device row block; what
+    varies is the collective schedule — the "data"-axis histogram
+    reduction shrinks as O(1/F) (each device reduces only its feature
+    tile) while the "feature"-axis merge stays O(F) and its routing
+    term shrinks as 1/R.  Shared by ``bench.py --weakscale-only`` and
+    the CI mesh-smoke microbench (one generator, one schema).
+
+    Per-cell series mirror :func:`weakscale_curve` (wall, per-shard
+    CPU, measured device calls — flat at 2/K on every shape) plus the
+    per-AXIS collective estimate the superstep telemetry carries
+    (``collective_bytes_axis``), which is the acceptance series: the
+    "data" entry must fall as 1/F across the grid row."""
+    import time as _time
+
+    import numpy as np
+    import jax
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.ops.grow import collective_bytes_per_pass
+    from lightgbm_tpu.utils import telemetry as _telemetry
+
+    rec = None
+    if telemetry_file:
+        rec = _telemetry.RunRecorder(
+            str(telemetry_file),
+            run_info={"backend": jax.default_backend(),
+                      "bench": "weakscale2d"})
+    avail = len(jax.devices())
+    skipped = [list(s) for s in shapes if s[0] * s[1] > avail]
+    live = [tuple(s) for s in shapes if s[0] * s[1] <= avail]
+    boosters = {}
+    for shape in live:
+        R, F = shape
+        rng = np.random.RandomState(0)
+        N = rows_per_shard * R
+        X = rng.random_sample((N, n_features)).astype(np.float32)
+        y = (X[:, 0] + 0.5 * (X[:, 1] > 0.5) +
+             0.1 * rng.randn(N) > 0.7).astype(np.float32)
+        params = {"objective": "binary", "num_leaves": num_leaves,
+                  "max_bin": max_bin, "verbose": -1, "metric": "None",
+                  "fused_iters": fused_iters,
+                  "num_iterations": 1_000_000,
+                  "tree_learner": "data2d",
+                  "mesh_shape": f"{R}x{F}",
+                  "num_machines": R * F}
+        d = lgb.Dataset(X, label=y, params=params)
+        d.construct()
+        bst = lgb.Booster(params=params, train_set=d)
+        if rec is not None:
+            bst._gbdt.attach_telemetry(rec)
+        for _ in range(1 + 2 * fused_iters):   # bias + 2 warm blocks
+            bst.update()
+        boosters[shape] = bst
+    if rec is not None:
+        for bst in boosters.values():
+            bst._gbdt._tele_counters_last = \
+                _telemetry.counters_snapshot()
+    wall_min = {s: float("inf") for s in live}
+    cpu_min = {s: float("inf") for s in live}
+    calls = {s: 0.0 for s in live}
+    for _ in range(reps):                      # interleaved reps
+        for shape in live:
+            bst = boosters[shape]
+            c0 = _telemetry.counters_snapshot()
+            t0, p0 = _time.time(), _time.process_time()
+            for _ in range(iters):
+                bst.update()
+            wall_min[shape] = min(wall_min[shape],
+                                  (_time.time() - t0) / iters)
+            cpu_min[shape] = min(cpu_min[shape],
+                                 (_time.process_time() - p0) / iters)
+            c1 = _telemetry.counters_snapshot()
+            calls[shape] += (c1.get("superstep_dispatches", 0) -
+                             c0.get("superstep_dispatches", 0) +
+                             c1.get("superstep_fetches", 0) -
+                             c0.get("superstep_fetches", 0))
+    grid = []
+    passes = max(num_leaves, 1)
+    for shape in live:
+        R, F = shape
+        g = boosters[shape]._gbdt
+        est = collective_bytes_per_pass(g._dist.params, g._F_pad,
+                                        g._n_pad)
+        ax_b = {a: int(v["bytes"] * passes)
+                for a, v in est.get("per_axis", {}).items()}
+        ax_o = {a: int(v["ops"] * passes)
+                for a, v in est.get("per_axis", {}).items()}
+        # the leaf-assignment gather rides the data axis
+        ax_b["data"] = ax_b.get("data", 0) + \
+            (g._n_pad // g._dist.row_shards) * 4
+        ax_o["data"] = ax_o.get("data", 0) + 1
+        grid.append({
+            "shape": [int(R), int(F)],
+            "shards": int(R * F),
+            "rows_per_shard": int(rows_per_shard),
+            "collective_bytes_axis": ax_b,
+            "collective_ops_axis": ax_o,
+            "iter_s": round(wall_min[shape], 4),
+            "cpu_s_per_shard_iter": round(cpu_min[shape] / (R * F), 4),
+            "device_calls_per_iter": round(
+                calls[shape] / (reps * iters), 3),
+        })
+    if rec is not None:
+        rec.close(log=False)
+    cores = os.cpu_count() or 1
+    total = live[0][0] * live[0][1] if live else 0
+    out = {
+        "metric": "weak_scaling_2d_mesh_grid",
+        "learner": "data2d+fused_scan",
+        "devices": int(total),
+        "fused_iters": int(fused_iters),
+        "cores": int(cores),
+        "source": "python bench.py --weakscale-only",
+        "grid": grid,
+        "note": (
+            "fixed devices, sweeping the (data x feature) factoring; "
+            "the acceptance series is collective_bytes_axis['data'] "
+            "falling as 1/F down the grid (each device reduces only "
+            "its feature tile).  Wall iter_s on a virtual CPU mesh "
+            f"timeshares {total} shards over {cores} core(s) — only "
+            "the per-axis bytes and the flat device_calls_per_iter "
+            "are dryrun-meaningful"),
+    }
+    if len(grid) > 1:
+        # the 1/F acceptance pin, precomputed for the render/CI side:
+        # data-axis bytes at the widest feature axis over the F=1
+        # (pure-data-parallel schedule through the 2-D path) cell
+        by_f = {c["shape"][1]: c["collective_bytes_axis"].get(
+            "data", 0) for c in grid}
+        f_lo, f_hi = min(by_f), max(by_f)
+        if by_f[f_lo] > 0:
+            out["data_axis_bytes_ratio"] = round(
+                by_f[f_hi] / by_f[f_lo], 4)
+            out["data_axis_ideal_ratio"] = round(f_lo / f_hi, 4)
+    if skipped:
+        out["skipped_shapes"] = skipped
+    return out
+
+
 def weakscale_only():
     """Fast path (``python bench.py --weakscale-only``): regenerate
     WEAKSCALE.json from the sharded fused super-step on a
     host-platform-device-count mesh (or real devices when present),
     plus a telemetry JSONL carrying the per-block collective counters
-    for ``tools/triage_run.py``.  ``tools/render_benchmarks.py``
-    renders the curve + ideal line into docs/Benchmarks.md."""
+    for ``tools/triage_run.py``.  The 1-D curve is followed by the 2-D
+    ``data2d`` (data x feature) grid at the full device count
+    (``grid2d`` key).  ``tools/render_benchmarks.py`` renders the
+    curve + ideal line + the 2-D table into docs/Benchmarks.md."""
     max_shards = int(os.environ.get("BENCH_WEAKSCALE_SHARDS", "8"))
     if ensure_backend(variant="weakscale",
                       force_host_devices=max_shards) is None:
@@ -1576,6 +1723,16 @@ def weakscale_only():
         rows_per_shard=int(os.environ.get("BENCH_WEAKSCALE_ROWS",
                                           "2048")),
         iters=int(os.environ.get("BENCH_WEAKSCALE_ITERS", "16")),
+        reps=int(os.environ.get("BENCH_WEAKSCALE_REPS", "3")),
+        telemetry_file=tele or None)
+    grid_n = min(max_shards, 8)
+    shapes = tuple((r, grid_n // r)
+                   for r in (1, 2, 4, 8) if grid_n % r == 0)
+    out["grid2d"] = weakscale_grid_2d(
+        shapes=shapes,
+        rows_per_shard=int(os.environ.get("BENCH_WEAKSCALE_ROWS",
+                                          "2048")),
+        iters=int(os.environ.get("BENCH_WEAKSCALE_ITERS_2D", "8")),
         reps=int(os.environ.get("BENCH_WEAKSCALE_REPS", "3")),
         telemetry_file=tele or None)
     print(json.dumps(out), flush=True)

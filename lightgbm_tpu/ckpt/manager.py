@@ -395,22 +395,32 @@ class CheckpointManager:
             ck_kind = str(ck_mesh.get("learner", cur["learner"]))
             ck_shards = int(ck_mesh.get("num_shards",
                                         cur["num_shards"]) or 1)
-            if (ck_kind, ck_shards) != (cur["learner"],
-                                        cur["num_shards"]):
-                # cross-mesh-width (or cross-learner) resume: the
-                # checkpointed state is host-side and mesh-agnostic —
-                # the freshly constructed booster already placed its
-                # tensors under ITS shardings, so restoring here IS
-                # the re-shard.  Continuation is bit-exact at the new
-                # width (docs/Distributed.md parity contract).
+            ck_shape = [int(s) for s in
+                        ck_mesh.get("mesh_shape",
+                                    cur["mesh_shape"]) or [1]]
+            cur_shape = [int(s) for s in cur["mesh_shape"] or [1]]
+            if (ck_kind, ck_shards, ck_shape) != (cur["learner"],
+                                                  cur["num_shards"],
+                                                  cur_shape):
+                # cross-mesh resume — a different width, a different
+                # 2-D shape (a 4x2 data2d checkpoint into a 2x4
+                # booster has EQUAL shard counts), or a different
+                # learner: the checkpointed state is host-side and
+                # mesh-agnostic — the freshly constructed booster
+                # already placed its tensors under ITS shardings, so
+                # restoring here IS the re-shard.  Continuation is
+                # bit-exact at the new topology (docs/Distributed.md
+                # parity contract).
                 Log.warning(
                     "checkpoint was taken under tree_learner=%s on a "
-                    "%d-shard mesh; this booster runs tree_learner=%s "
-                    "over %d shard(s) — re-sharding the restored "
-                    "training state (bit-exact continuation at the "
-                    "new width; see docs/Distributed.md)",
-                    ck_kind, ck_shards, cur["learner"],
-                    cur["num_shards"])
+                    "%d-shard mesh %s; this booster runs "
+                    "tree_learner=%s over %d shard(s) %s — "
+                    "re-sharding the restored training state "
+                    "(bit-exact continuation on the new topology; "
+                    "see docs/Distributed.md)",
+                    ck_kind, ck_shards, "x".join(map(str, ck_shape)),
+                    cur["learner"], cur["num_shards"],
+                    "x".join(map(str, cur_shape)))
                 _telemetry.counters.incr("recovery_reshards")
                 rec = self.recorder or _telemetry.get_recorder() or \
                     getattr(g, "_telemetry", None)
@@ -420,6 +430,8 @@ class CheckpointManager:
                              to_shards=int(cur["num_shards"]),
                              from_learner=ck_kind,
                              to_learner=cur["learner"],
+                             from_shape=ck_shape,
+                             to_shape=cur_shape,
                              iter=int(meta.get("iter", -1)))
         ck_stream = meta.get("stream")
         if ck_stream:

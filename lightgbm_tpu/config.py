@@ -66,12 +66,19 @@ PARAMS: List[Param] = [
        "max number of leaves in one tree", check=">1"),
     _p("tree_learner", "serial", str,
        ("tree", "tree_type", "tree_learner_type"),
-       "serial, feature, data, voting.  Parallel learners run SPMD "
-       "over a 1-D device mesh (all devices, capped by num_machines; "
-       "or an explicit mesh= keyword) with the strategy collectives "
-       "in-program, and with fused_iters>1 the sharded build rides "
-       "inside the fused lax.scan super-step — see "
+       "serial, feature, data, voting, data2d.  Parallel learners run "
+       "SPMD over a 1-D device mesh (all devices, capped by "
+       "num_machines; or an explicit mesh= keyword) with the strategy "
+       "collectives in-program, and with fused_iters>1 the sharded "
+       "build rides inside the fused lax.scan super-step; data2d "
+       "shards rows x feature tiles over a 2-D (data, feature) mesh "
+       "(mesh_shape) with per-axis collectives — see "
        "docs/Distributed.md"),
+    _p("mesh_shape", "", str, (),
+       "tree_learner=data2d: the 2-D device mesh as 'RxF' (rows x "
+       "feature tiles, e.g. '4x2' or '4,2'); '' = factor the device "
+       "count automatically (largest feature-axis divisor <= sqrt(D))",
+       group="network"),
     _p("num_threads", 0, int, ("num_thread", "nthread", "nthreads", "n_jobs"),
        "number of host threads (0 = default)"),
     _p("device_type", "tpu", str, ("device",), "tpu, cpu (XLA backend)",
@@ -404,12 +411,13 @@ PARAMS: List[Param] = [
     # ---- elastic (shard-loss recovery for sharded training) ----
     _p("elastic_training", False, bool, ("elastic",),
        "supervise mesh-sharded fused training (tree_learner="
-       "data/feature/voting with fused_iters>1) for shard loss: each "
-       "fused-block dispatch runs under a collective-stall watchdog "
-       "and a per-block heartbeat; a failed or hung shard triggers "
-       "exact rewind to the served boundary, a re-mesh over the "
-       "surviving devices, and bit-exact continuation — see "
-       "docs/Distributed.md", group="elastic"),
+       "data/feature/voting/data2d with fused_iters>1) for shard "
+       "loss: each fused-block dispatch runs under a collective-stall "
+       "watchdog and a per-block heartbeat; a failed or hung shard "
+       "triggers exact rewind to the served boundary, a re-mesh over "
+       "the surviving devices (a 2-D mesh drops the full row or "
+       "column that loses fewer devices), and bit-exact continuation "
+       "— see docs/Distributed.md", group="elastic"),
     _p("elastic_stall_timeout_s", 120.0, float, (),
        "collective-stall watchdog: a fused-block dispatch silent this "
        "long (no heartbeat) is abandoned as a hung collective and "

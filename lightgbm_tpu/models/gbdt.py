@@ -255,8 +255,14 @@ class GBDT:
         # the mesh sharding
         learner = config.tree_learner
         num_shards = 1
+        mesh_shape2d = None
         if learner not in ("serial", ""):
             from ..parallel import resolve_num_shards
+            from ..utils.env import maybe_init_distributed
+            # multi-host entry (env-gated, no-op single-host): join the
+            # distributed runtime BEFORE counting devices so the mesh
+            # factors over the global device set
+            maybe_init_distributed()
             num_shards = resolve_num_shards(config, mesh)
             if num_shards <= 1:
                 Log.warning("tree_learner=%s requested but only one device "
@@ -264,12 +270,30 @@ class GBDT:
                             learner)
                 learner = "serial"
         dist_active = learner not in ("serial", "") and num_shards > 1
+        if dist_active and learner == "data2d":
+            from ..parallel.learners import (factor_mesh_shape,
+                                             parse_mesh_shape)
+            if mesh is not None:
+                mesh_shape2d = tuple(int(s) for s in mesh.devices.shape)
+            elif getattr(config, "mesh_shape", ""):
+                mesh_shape2d = parse_mesh_shape(config.mesh_shape)
+                # an explicit shape wins over the device count: the
+                # builder raises when the host cannot satisfy it
+                num_shards = mesh_shape2d[0] * mesh_shape2d[1]
+            else:
+                mesh_shape2d = factor_mesh_shape(num_shards)
+        self._mesh_shape2d = mesh_shape2d
 
         from ..parallel.learners import pad_features_for, pad_rows_for
         row_block = rpb if use_pallas else 1
         kind = learner if dist_active else "serial"
-        self._n_pad = pad_rows_for(kind, num_shards, n, row_block)
-        self._F_pad = pad_features_for(kind, num_shards, F)
+        # per-AXIS shard counts: the 2-D learner pads rows to its row
+        # axis and features to its feature axis; 1-D learners key both
+        # off the flat width (the pad helpers ignore the irrelevant one)
+        row_shards = mesh_shape2d[0] if mesh_shape2d else num_shards
+        feat_shards = mesh_shape2d[1] if mesh_shape2d else num_shards
+        self._n_pad = pad_rows_for(kind, row_shards, n, row_block)
+        self._F_pad = pad_features_for(kind, feat_shards, F)
 
         monotone, penalty = self._constraint_tuples(config, train_set, F)
         forced = self._forced_splits(config, train_set, dist_active)
@@ -348,7 +372,12 @@ class GBDT:
         # 9-33): data psums whole-wave histograms, feature merges
         # children bests by a batched all-gather arg-max, voting
         # psums only the elected features' histograms (grow.py)
-        wave_on = bool(config.wave_splits and use_pool and not forced)
+        # data2d runs the non-wave loop: its per-axis collective
+        # schedule (row-axis hist psum, feature-axis merge) is defined
+        # on the per-leaf passes, and the wave path's whole-tensor
+        # psum would forfeit the O(1/F_axis) histogram-byte cut
+        wave_on = bool(config.wave_splits and use_pool and not forced
+                       and learner != "data2d")
         # two-column quantized passes (W=64): legal only when the count
         # channel is provably redundant (GrowParams.two_col contract).
         # With missing values the default-direction "any missing data
@@ -459,7 +488,8 @@ class GBDT:
             # scale via pmax; noise hashed from global row index)
             quantize=(config.num_grad_quant_bins
                       if (config.use_quantized_grad and
-                          (not dist_active or wave_on))
+                          (not dist_active or wave_on or
+                           learner == "data2d"))
                       else 0),
             spec_tolerance=float(config.speculative_tolerance),
             # wave growth (wave_splits): top-W splits applied per loop
@@ -485,9 +515,15 @@ class GBDT:
         if dist_active:
             from ..parallel import DistributedBuilder
             self._dist = DistributedBuilder(
-                learner, self.grow_params, num_shards, mesh)
-            Log.info("tree_learner=%s over a %d-way device mesh",
-                     learner, num_shards)
+                learner, self.grow_params, num_shards, mesh,
+                mesh_shape=mesh_shape2d)
+            if learner == "data2d":
+                Log.info("tree_learner=data2d over a %dx%d "
+                         "(data x feature) device mesh",
+                         self._dist.row_shards, self._dist.feat_shards)
+            else:
+                Log.info("tree_learner=%s over a %d-way device mesh",
+                         learner, num_shards)
         self._stream_upload = None
         stream_info = getattr(train_set, "stream", None)
         if stream_info is not None:
@@ -597,6 +633,7 @@ class GBDT:
             split_kernel=split_kernel, split_gate=split_gate)
         self._collective_per_pass = 0
         self._collective_ops_per_pass = 0
+        self._collective_per_axis = {}
         if dist_active and self._dist is not None:
             from ..ops.grow import collective_bytes_per_pass
             # the builder's params carry the real DistConfig (the
@@ -605,6 +642,7 @@ class GBDT:
                                             self._F_pad, self._n_pad)
             self._collective_per_pass = est["total"]
             self._collective_ops_per_pass = est["ops"]
+            self._collective_per_axis = est.get("per_axis", {})
         self._telemetry = None
         self._tele_counters_last: Dict[str, float] = {}
         if getattr(config, "telemetry_file", ""):
@@ -723,6 +761,9 @@ class GBDT:
         if not wave_on:
             if not config.wave_splits:
                 gates["wave"] = "wave_splits=false"
+            elif learner == "data2d":
+                gates["wave"] = ("data2d runs the non-wave per-axis "
+                                 "collective schedule")
             elif not use_pool:
                 gates["wave"] = ("histogram pool over budget "
                                  "(histogram_pool_size)")
@@ -789,6 +830,10 @@ class GBDT:
                            if self._bundles is not None else 0),
             "learner": learner if dist_active else "serial",
             "num_shards": int(num_shards) if dist_active else 1,
+            "mesh_shape": ([int(s) for s in
+                            self._dist.mesh.devices.shape]
+                           if dist_active and self._dist is not None
+                           else [1]),
         }
 
     # ------------------------------------------------------------------
@@ -1099,10 +1144,13 @@ class GBDT:
         drop = ("leaf_idx", "leaf_values", "leaf_values_final",
                 "leaf_stats")
         rows_sharded = dist is not None and dist.kind in ("data",
-                                                          "voting")
+                                                          "voting",
+                                                          "data2d")
         if rows_sharded:
+            # data2d shards rows over the ROW axis only (R of the R*F
+            # devices); the 1-D learners' row axis is the whole mesh
             ax = dist.params.dist.axis
-            n_loc = n_pad // dist.num_shards
+            n_loc = n_pad // dist.row_shards
 
         def superstep(score, bag0, lr, quant_key, xt, base_mask,
                       num_bins, missing_type, is_cat, iters, fmasks,
@@ -1247,7 +1295,8 @@ class GBDT:
         superstep = self._superstep_core()
         dist = self._dist
         rows_sharded = dist is not None and dist.kind in ("data",
-                                                          "voting")
+                                                          "voting",
+                                                          "data2d")
         if dist is not None:
             from jax.sharding import PartitionSpec as P
             from ..parallel.learners import shard_map_compat
@@ -1260,6 +1309,15 @@ class GBDT:
                 in_specs = (R, R, R, R, P(ax_name, None), R,
                             P(ax_name), P(ax_name), P(ax_name), R,
                             P(None, ax_name), R)
+            elif dist.kind == "data2d":
+                # 2-D: rows down the data axis (base_mask local),
+                # feature tiles + descriptors + the stacked feature
+                # masks across the feature axis; the score carry and
+                # gradients stay replicated
+                fax = dist.feat_axis
+                in_specs = (R, R, R, R, P(fax, ax_name), P(ax_name),
+                            P(fax), P(fax), P(fax), R,
+                            P(None, fax), R)
             else:   # data | voting: rows sharded, features whole
                 in_specs = (R, R, R, R, P(None, ax_name), P(ax_name),
                             R, R, R, R, R, R)
@@ -1585,15 +1643,28 @@ class GBDT:
             hp = hist_passes if hist_passes is not None \
                 else K * max(self.config.num_leaves, 1)
             extra_b = extra_o = 0
-            if self._dist.kind in ("data", "voting"):
+            if self._dist.kind in ("data", "voting", "data2d"):
                 # per-SHARD send payload of the tiled leaf-assignment
                 # all-gather — n_loc*4 bytes, O(1) in mesh size at
                 # fixed rows/shard (collective_bytes_per_pass is a
                 # per-shard estimate; mixing in the gathered GLOBAL
                 # width would make the telemetry read as if wire cost
                 # grew with the mesh)
-                n_loc = self._n_pad // self._dist.num_shards
+                n_loc = self._n_pad // self._dist.row_shards
                 extra_b, extra_o = K * n_loc * 4, K
+            # per-AXIS attribution (obs/rules.py keys its weak-scaling
+            # anomaly on these): 1-D learners put everything on their
+            # single axis; data2d splits histogram traffic (row axis)
+            # from merge+routing (feature axis).  The leaf-assignment
+            # gather rides the row axis.
+            per_ax_b, per_ax_o = {}, {}
+            for axn, v in self._collective_per_axis.items():
+                per_ax_b[axn] = int(v["bytes"] * hp)
+                per_ax_o[axn] = int(v["ops"] * hp)
+            if extra_b and per_ax_b:
+                axn = self._dist.axis
+                per_ax_b[axn] = per_ax_b.get(axn, 0) + extra_b
+                per_ax_o[axn] = per_ax_o.get(axn, 0) + extra_o
             self._tele_superstep.update({
                 "learner": self._dist.kind,
                 "num_shards": int(self._dist.num_shards),
@@ -1603,6 +1674,8 @@ class GBDT:
                     self._collective_per_pass * hp + extra_b),
                 "collective_ops": int(
                     self._collective_ops_per_pass * hp + extra_o),
+                "collective_bytes_axis": per_ax_b,
+                "collective_ops_axis": per_ax_o,
             })
         return self._serve_fused()
 
@@ -1798,10 +1871,13 @@ class GBDT:
                                self._dist.mesh.devices.shape]}
 
     def remesh(self, num_shards: Optional[int] = None, mesh=None,
-               raw=None, snapshot: Optional[Dict] = None) -> int:
+               raw=None, snapshot: Optional[Dict] = None,
+               mesh_shape=None) -> int:
         """Re-mesh entry point: rebuild the device mesh (narrower
-        after shard loss, or any explicit 1-D mesh) and continue
-        BIT-exactly from the last served boundary.
+        after shard loss, any explicit 1-D mesh, or — via
+        ``mesh_shape=(R, F)`` — a 2-D data x feature mesh for the
+        data2d learner) and continue BIT-exactly from the last served
+        boundary.
 
         Lands on the served boundary first (dispatch-fence restore +
         the PR 3 exact rewind), captures the PR 5 bit-exact training
@@ -1831,17 +1907,24 @@ class GBDT:
         valid_sets = self.valid_sets
         cfg = self.config
         if mesh is None:
+            if mesh_shape is not None:
+                r, f = (int(s) for s in mesh_shape)
+                num_shards = r * f
+                if num_shards > 1:
+                    from ..parallel.learners import make_mesh_2d
+                    mesh = make_mesh_2d((r, f))
             if num_shards is None:
-                raise ValueError("remesh needs num_shards or an "
-                                 "explicit mesh")
-            from ..parallel.learners import AXIS_NAME, make_mesh_for
-            if int(num_shards) > 1:
-                mesh = make_mesh_for(int(num_shards))
-            else:
-                # 1-device mesh: resolve_num_shards reads 1 and the
-                # construction falls back to the serial learner
-                mesh = jax.sharding.Mesh(
-                    np.asarray(jax.devices()[:1]), (AXIS_NAME,))
+                raise ValueError("remesh needs num_shards, mesh_shape "
+                                 "or an explicit mesh")
+            if mesh is None:
+                from ..parallel.learners import AXIS_NAME, make_mesh_for
+                if int(num_shards) > 1:
+                    mesh = make_mesh_for(int(num_shards))
+                else:
+                    # 1-device mesh: resolve_num_shards reads 1 and the
+                    # construction falls back to the serial learner
+                    mesh = jax.sharding.Mesh(
+                        np.asarray(jax.devices()[:1]), (AXIS_NAME,))
         # the SAME recorder must survive the re-construction: blank
         # the file param so __init__ cannot open a second handle on
         # the same JSONL
@@ -2141,7 +2224,8 @@ class GBDT:
             # collective bytes is the dispatch-overhead signature the
             # single-program refactor exists to kill)
             for key in ("learner", "num_shards", "mesh_shape",
-                        "collective_bytes", "collective_ops"):
+                        "collective_bytes", "collective_ops",
+                        "collective_bytes_axis", "collective_ops_axis"):
                 if key in ss:
                     fields[key] = ss[key]
             rec.emit("superstep", **fields)
@@ -2193,6 +2277,12 @@ class GBDT:
             if self._dist is not None:
                 fields["learner"] = self._dist.kind
                 fields["num_shards"] = int(self._dist.num_shards)
+                fields["mesh_shape"] = [
+                    int(s) for s in self._dist.mesh.devices.shape]
+                if self._collective_per_axis:
+                    fields["collective_bytes_axis"] = {
+                        k: int(v["bytes"] * hp)
+                        for k, v in self._collective_per_axis.items()}
         rec.emit("iteration", **fields)
         return stop
 

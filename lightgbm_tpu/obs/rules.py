@@ -52,7 +52,9 @@ def superstep_warmups(records) -> Iterator[Tuple[Dict[str, Any], bool]]:
     auto-sized tail block is a shorter scan) AND per mesh identity (a
     sharded run's scan is a different program per learner x shard
     count — the weak-scale grid runs several in one file), so the
-    FIRST superstep of each (k, learner, shards) is per-shape warmup.
+    FIRST superstep of each (k, learner, shards, mesh-shape) is
+    per-shape warmup — a data2d 4x2 and 2x4 cell share a shard count
+    but compile distinct scans.
     Sharded runs get TWO warmup blocks: block 1 consumes the
     single-device score the unfused bias iteration left behind,
     block 2 runs on the mesh-replicated carry — same trace, two XLA
@@ -111,7 +113,12 @@ class _WarmupTracker:
         if rtype != "superstep":
             return None
         shards = int(r.get("num_shards", 1))
-        key = (int(r.get("k", 1)), r.get("learner", ""), shards)
+        # the mesh SHAPE is part of the program identity: a 4x2 and a
+        # 2x4 data2d cell share (k, learner, 8) but compile distinct
+        # scans, so each earns its own warmup allowance (the 2-D
+        # weak-scale grid runs several shapes in one file)
+        shape = tuple(int(s) for s in (r.get("mesh_shape") or ()))
+        key = (int(r.get("k", 1)), r.get("learner", ""), shards, shape)
         n = self.seen.get(key, 0)
         self.seen[key] = n + 1
         warm = (n < (2 if shards > 1 else 1) or self.ckpt_pending or
@@ -167,6 +174,12 @@ class OnlineScanner:
         self._slo_worst: Dict[str, Dict[str, Any]] = {}
         self._as_actions = 0
         self._as_degraded = 0
+        # 2-D weak-scaling per-axis watch: feature-axis collective
+        # bytes keyed by (k, F) across data-axis sizes R — on the
+        # data2d schedule the tile merge is O(F) and routing shrinks
+        # as 1/R, so feature-axis bytes must NOT grow with R
+        self._ws_feat: Dict[Tuple[int, int], Dict[int, float]] = {}
+        self._ws_bad: Optional[Tuple[int, float, int, float, int]] = None
         self._segs: "deque[Dict[str, Any]]" = \
             deque(maxlen=self.MAX_SEGMENTS)
         self._cur_seg: Optional[Dict[str, Any]] = None
@@ -232,6 +245,33 @@ class OnlineScanner:
                             f"{self._overlap_total} fused blocks show "
                             f"~zero fetch overlap at "
                             f"pipeline_depth > 0"))
+            ax_b = rec.get("collective_bytes_axis") or {}
+            shape2 = rec.get("mesh_shape") or []
+            if len(shape2) == 2 and "feature" in ax_b:
+                rr, ff = int(shape2[0]), int(shape2[1])
+                per_it = float(ax_b["feature"]) / \
+                    max(int(rec.get("k", 1)), 1)
+                grid = self._ws_feat.setdefault(
+                    (int(rec.get("k", 1)), ff), {})
+                grid[rr] = per_it
+                if "weakscale_axis" not in self._fired:
+                    for r0 in sorted(grid):
+                        b0, b1 = grid[r0], grid[max(grid)]
+                        if r0 < max(grid) and b1 > 1.10 * b0 + 1024:
+                            self._fired.add("weakscale_axis")
+                            self._ws_bad = (r0, b0, max(grid), b1, ff)
+                            out.append((
+                                "MED", "weakscale_axis",
+                                f"feature-axis collective bytes GROW "
+                                f"with the data-axis size: "
+                                f"{b1:.0f} B/iter at mesh "
+                                f"{max(grid)}x{ff} vs {b0:.0f} B/iter "
+                                f"at {r0}x{ff} — the 2-D schedule "
+                                f"keeps the tile merge O(F) and "
+                                f"shrinks routing as 1/R, so "
+                                f"feature-axis traffic must not "
+                                f"scale with R"))
+                            break
             if self._cur_seg is not None and "split_kernel" in rec:
                 self._cur_seg["ss_last"] = (rec.get("split_kernel"),
                                             rec.get("split_fallback"))
@@ -495,6 +535,16 @@ class OnlineScanner:
                                f"enabled — the window prep cost is "
                                f"fully serialized again (mirrors the "
                                f"pipelining-disabled rule)"))
+        if self._ws_bad is not None:
+            r0, b0, r1, b1, ff = self._ws_bad
+            out.append(("MED", f"2-D weak-scaling per-axis anomaly: "
+                               f"feature-axis collective bytes grew "
+                               f"from {b0:.0f} B/iter ({r0}x{ff}) to "
+                               f"{b1:.0f} B/iter ({r1}x{ff}) as the "
+                               f"data axis widened — the tile merge is "
+                               f"O(F) and routing shrinks as 1/R, so "
+                               f"this traffic should be flat or "
+                               f"falling in R"))
         if self._ss_late:
             out.append(("HIGH", f"superstep retrace storm: "
                                 f"{self._ss_late:.0f} "

@@ -32,6 +32,15 @@ parallel learners re-expressed as XLA collectives:
   the global top-2k by votes are elected, and ONLY those features'
   histograms are ``psum``-ed — mirroring the PV-Tree
   ``VotingParallelTreeLearner`` (``voting_parallel_tree_learner.cpp``).
+- ``data2d``: rows AND feature tiles sharded on a 2-D
+  ``Mesh(("data", "feature"))`` — each device holds an R-th of the rows
+  x an F-th of the features.  The collective schedule factors per axis:
+  histograms ``psum`` over the ROW axis only (each device then holds
+  complete histograms for its own feature tile, so per-pass bytes drop
+  from O(F·B) to O(F·B/F_axis)), per-tile best splits merge by an
+  all-gather arg-max over the FEATURE axis, and row routing broadcasts
+  one owner bit per local row over the feature axis — the data x
+  feature composition the 1-D learners force a choice between.
 """
 from __future__ import annotations
 
@@ -64,14 +73,25 @@ class DistConfig:
     """Static distribution strategy for the growth loop.
 
     ``kind``: serial | data | feature | voting (``tree_learner`` values,
-    ``tree_learner.cpp:9-33``).  ``num_shards`` is the mesh-axis size;
-    ``axis`` the mesh axis name the collectives run over.  ``top_k`` is
-    the per-shard ballot size for voting-parallel (``config.h:349``).
+    ``tree_learner.cpp:9-33``) | data2d (2-D row x feature-tile mesh).
+    ``num_shards`` is the ROW-axis size; ``axis`` the mesh axis name the
+    row-scoped collectives run over.  ``top_k`` is the per-shard ballot
+    size for voting-parallel (``config.h:349``).
+
+    ``data2d`` factors the collective schedule per axis: histograms are
+    ``psum``-ed over the ``axis`` (row) axis only — each device then
+    holds the COMPLETE histograms of its own feature tile — the
+    per-tile best splits ballot-gather over ``feat_axis``
+    (``feat_shards`` tiles), and routing broadcasts one owner bit per
+    local row over ``feat_axis``.  1-D kinds leave
+    ``feat_shards == 1``.
     """
     kind: str = "serial"
     axis: str = "shard"
     num_shards: int = 1
     top_k: int = 20
+    feat_axis: str = "feature"
+    feat_shards: int = 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -187,22 +207,30 @@ def collective_bytes_per_pass(params: GrowParams, num_features: int,
       all-gather plus one (N,) f32 owner-bit routing psum per wave.
     - ``voting`` — ballot all-gather plus the elected-only (2k, B, 3)
       psum per scanned child.
+    - ``data2d`` — the (F/Fx, B, 3) feature-TILE histogram psum over
+      the row axis only (the O(F·B) -> O(F·B/Fx) drop this learner
+      exists for), one best-record all-gather over the feature axis,
+      one (N/R,) owner-bit routing psum over the feature axis.
 
-    Keys: hist / merge / route / total (bytes) and ``ops`` (the number
+    Keys: hist / merge / route / total (bytes), ``ops`` (the number
     of collective operations the pass issues — the count a weak-scaling
-    reader checks stays O(1) in shard count).  Coarse-to-fine and
-    two-column passes stream fewer bins; this reports the full-
-    resolution upper bound (telemetry consumers care about order of
-    magnitude and trend, not exact wire bytes).
+    reader checks stays O(1) in shard count) and ``per_axis`` — the
+    same bytes/ops attributed to the mesh axis they cross (one entry
+    for 1-D kinds; ``data`` + ``feature`` entries for data2d).
+    Coarse-to-fine and two-column passes stream fewer bins; this
+    reports the full-resolution upper bound (telemetry consumers care
+    about order of magnitude and trend, not exact wire bytes).
     """
     p = params
     kind = p.dist.kind
     D = max(p.dist.num_shards, 1)
+    Fx = max(p.dist.feat_shards, 1)
     F = max(num_features, 1)
     B = p.split.max_bin
     W = p.speculate if (p.wave and p.speculate > 1) else 1
-    out = {"hist": 0, "merge": 0, "route": 0, "total": 0, "ops": 0}
-    if kind in ("serial", "") or D <= 1:
+    out = {"hist": 0, "merge": 0, "route": 0, "total": 0, "ops": 0,
+           "per_axis": {}}
+    if kind in ("serial", "") or D * Fx <= 1:
         return out
     # one _MERGE_KEYS record: gain f32 + feature/threshold i32 +
     # default_left/is_cat bool + (B,) bool left_mask + (3,) f32 stats
@@ -226,7 +254,23 @@ def collective_bytes_per_pass(params: GrowParams, num_features: int,
         out["merge"] = n_children * n_vote * 4 * D
         out["hist"] = n_children * n_elect * B * 3 * 4
         out["ops"] = 2                          # ballot gather + psum
+    elif kind == "data2d":
+        # per-device feature tile: the row-axis psum moves F/Fx of the
+        # full histogram — the 1/F_axis collective-byte scaling
+        out["hist"] = (F // Fx) * B * 3 * 4
+        out["merge"] = rec_bytes * Fx
+        out["route"] = (num_rows // D) * 4
+        out["ops"] = 3            # row psum + tile merge + routing psum
     out["total"] = out["hist"] + out["merge"] + out["route"]
+    if kind == "data2d":
+        out["per_axis"] = {
+            p.dist.axis: {"bytes": out["hist"], "ops": 1},
+            p.dist.feat_axis: {"bytes": out["merge"] + out["route"],
+                               "ops": 2},
+        }
+    else:
+        out["per_axis"] = {p.dist.axis: {"bytes": out["total"],
+                                         "ops": out["ops"]}}
     return out
 
 
@@ -320,10 +364,21 @@ def build_tree_impl(xt: jax.Array, grad: jax.Array, hess: jax.Array,
     kind = dist.kind
     ax = dist.axis
     D = dist.num_shards
+    fax = dist.feat_axis
+    Fx = dist.feat_shards
+    # row-parallel kinds: rows sharded over ``ax``, so per-row state
+    # (stats, quantization scales, noise streams, leaf renewal) needs a
+    # reduction over that axis.  data2d's feature axis replicates rows,
+    # so the SAME row-axis collectives serve it unchanged.
+    row_par = kind in ("data", "voting", "data2d")
 
-    assert p.quantize == 0 or kind in ("serial", "data") or p.wave, \
-        "quantized histograms: serial/data learners, or any parallel " \
-        "learner under wave growth"
+    assert p.quantize == 0 or kind in ("serial", "data", "data2d") \
+        or p.wave, \
+        "quantized histograms: serial/data/data2d learners, or any " \
+        "parallel learner under wave growth"
+    assert not (p.wave and kind == "data2d"), \
+        "data2d runs the non-wave growth loop (wave composes with the " \
+        "1-D learners only)"
     assert not p.two_col or (p.quantize > 0 and p.wave and
                              not p.bundled and p.split.counts_proxy), \
         "two_col requires quantized wave growth with counts_proxy"
@@ -358,9 +413,11 @@ def build_tree_impl(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         h_w = hess * sample_mask
         sg = jnp.maximum(jnp.max(jnp.abs(g_w)), jnp.float32(1e-30))
         sh = jnp.maximum(jnp.max(jnp.abs(h_w)), jnp.float32(1e-30))
-        if kind in ("data", "voting"):
+        if row_par:
             # shard-consistent scale: quantization must agree across
             # shards or the psum-ed integer histograms mix units
+            # (data2d: rows replicate over the feature axis, so the
+            # row-axis pmax already yields the global max everywhere)
             sg = jax.lax.pmax(sg, ax)
             sh = jax.lax.pmax(sh, ax)
         sg, sh = sg / q, sh / q
@@ -369,7 +426,7 @@ def build_tree_impl(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         # the same row gets the same noise under any row sharding, so
         # an 8-shard data-parallel tree is bit-identical to the serial
         # one (integer sums are exact in f32 up to 2^24)
-        if kind in ("data", "voting"):
+        if row_par:
             idx0 = jax.lax.axis_index(ax).astype(jnp.uint32) * \
                 jnp.uint32(N)
         else:
@@ -417,6 +474,15 @@ def build_tree_impl(xt: jax.Array, grad: jax.Array, hess: jax.Array,
         # features are sharded in memory; descriptor arrays arrive local
         F_hist = F
         f_offset = jax.lax.axis_index(ax) * F
+        blk = lambda a: jax.lax.dynamic_slice_in_dim(a, f_offset, F)
+        nb_l, mt_l, cat_l, fmask_l = (num_bins, missing_type, is_cat,
+                                      feature_mask)
+    elif kind == "data2d":
+        # feature tiles are sharded in memory over the FEATURE axis
+        # (descriptors arrive local, like the feature learner); the
+        # tile offset indexes that axis, not the row axis
+        F_hist = F
+        f_offset = jax.lax.axis_index(fax) * F
         blk = lambda a: jax.lax.dynamic_slice_in_dim(a, f_offset, F)
         nb_l, mt_l, cat_l, fmask_l = (num_bins, missing_type, is_cat,
                                       feature_mask)
@@ -474,6 +540,12 @@ def build_tree_impl(xt: jax.Array, grad: jax.Array, hess: jax.Array,
                 # one XLA reduce-scatter over the feature dimension
                 h = jax.lax.psum_scatter(h, ax, scatter_dimension=0,
                                          tiled=True)
+        elif kind == "data2d":
+            # axis-scoped: the row-axis psum alone completes THIS
+            # feature tile's histograms (replicated down the mesh
+            # column) — F/Fx of the bytes a 1-D data psum would move;
+            # the feature axis never carries histogram traffic
+            h = jax.lax.psum(h, ax)
         if hist_scale is not None:
             h = h * hist_scale  # dequantize: ints -> gradient units
         if p.two_col:
@@ -672,7 +744,7 @@ def build_tree_impl(xt: jax.Array, grad: jax.Array, hess: jax.Array,
                                        missing_type=mt_l)
 
     def global_stats(local):
-        if kind in ("data", "voting"):
+        if row_par:
             return jax.lax.psum(local, ax)
         return local
 
@@ -697,7 +769,15 @@ def build_tree_impl(xt: jax.Array, grad: jax.Array, hess: jax.Array,
                                     monotone=mono_l, penalty=pen_l,
                                     min_output=mn, max_output=mx)
             b["feature"] = b["feature"] + f_offset
-            if kind in ("data", "feature") and not wave_dist:
+            if kind == "data2d":
+                # ballot-gather over the FEATURE axis only: devices
+                # down a mesh column scanned identical tile histograms
+                # and hold identical per-tile winners, so the row axis
+                # needs no merge; gather order along the feature axis
+                # is tile-major == global feature-major, preserving the
+                # serial tie-break
+                b = _merge_best(b, fax)
+            elif kind in ("data", "feature") and not wave_dist:
                 # wave_dist scans replicated histograms — every shard
                 # already holds the identical global winner
                 b = _merge_best(b, ax)
@@ -771,14 +851,20 @@ def build_tree_impl(xt: jax.Array, grad: jax.Array, hess: jax.Array,
                                                keepdims=False)
             bundle_mask = jnp.take(left_mask_row, fb)
             return mask_lookup(bundle_mask, col)
-        if kind == "feature":
+        if kind in ("feature", "data2d"):
+            # only the winning tile's owner holds the column; it
+            # broadcasts one bit per (local) row over the axis the
+            # features shard on — (N,) for feature-parallel, (N/R,)
+            # for data2d (rows already sharded over the row axis)
             local_f = feat - f_offset
             owner = (local_f >= 0) & (local_f < F)
             col = jax.lax.dynamic_index_in_dim(
                 xt, jnp.clip(local_f, 0, F - 1), axis=0, keepdims=False)
             cand = mask_lookup(left_mask_row, col)
+            route_ax = fax if kind == "data2d" else ax
             return jax.lax.psum(
-                jnp.where(owner, cand.astype(jnp.float32), 0.0), ax) > 0.5
+                jnp.where(owner, cand.astype(jnp.float32), 0.0),
+                route_ax) > 0.5
         col = jax.lax.dynamic_index_in_dim(xt, feat, axis=0, keepdims=False)
         return mask_lookup(left_mask_row, col)
 
@@ -1681,7 +1767,7 @@ def build_tree_impl(xt: jax.Array, grad: jax.Array, hess: jax.Array,
             ex = histogram(state["leaf_idx"][None, :], ex_vals,
                            max_bin=L, impl=p.hist_impl,
                            rows_per_block=p.rows_per_block)
-        if kind in ("data", "voting"):
+        if row_par:
             ex = jax.lax.psum(ex, ax)
         extra["leaf_stats_exact"] = ex[0, :L]
         leaf_values_final = jnp.where(

@@ -15,8 +15,12 @@ and which reductions run where — as documented on
 :class:`~lightgbm_tpu.ops.grow.DistConfig`.
 """
 from .elastic import ElasticError, ElasticSupervisor
-from .learners import (AXIS_NAME, DistributedBuilder, make_mesh_for,
+from .learners import (AXIS_NAME, DATA_AXIS, FEAT_AXIS,
+                       DistributedBuilder, factor_mesh_shape,
+                       make_mesh_2d, make_mesh_for, parse_mesh_shape,
                        resolve_num_shards)
 
-__all__ = ["AXIS_NAME", "DistributedBuilder", "ElasticError",
-           "ElasticSupervisor", "make_mesh_for", "resolve_num_shards"]
+__all__ = ["AXIS_NAME", "DATA_AXIS", "FEAT_AXIS", "DistributedBuilder",
+           "ElasticError", "ElasticSupervisor", "factor_mesh_shape",
+           "make_mesh_2d", "make_mesh_for", "parse_mesh_shape",
+           "resolve_num_shards"]

@@ -55,7 +55,13 @@ Surviving-set policy: when the failure names a device (real runtimes
 usually do; the classifier keeps the message) the mesh is rebuilt
 without it; otherwise the HIGHEST-index device is dropped — a
 deterministic stand-in that keeps the chaos harness and the parity
-oracles reproducible.  Repeated failures degrade further, bounded by
+oracles reproducible.  A 2-D (data x feature) mesh cannot drop a
+single device — shardings need a full rectangle — so recovery drops
+the whole mesh ROW or COLUMN that loses fewer devices
+(:func:`degrade_mesh_shape`: a lost device on a 4x2 mesh re-meshes to
+3x2, sacrificing one healthy peer; on a 2x4 to 2x3) and rebuilds the
+2-D shardings over the surviving rectangle.  Repeated failures degrade
+further, bounded by
 ``elastic_max_remesh`` and ``elastic_min_shards``; past either bound
 the supervisor raises :class:`ElasticError` (fail loudly: the PR 5
 checkpoint story owns process-level restart, including resuming an
@@ -79,7 +85,20 @@ from ..utils import telemetry as _telemetry
 from ..utils.log import Log
 
 __all__ = ["ElasticError", "ElasticAbandoned", "ElasticSupervisor",
-           "classify_shard_failure"]
+           "classify_shard_failure", "degrade_mesh_shape"]
+
+
+def degrade_mesh_shape(r: int, f: int) -> tuple:
+    """The 2-D re-mesh policy: on shard loss, drop the full mesh row
+    or column that loses FEWER devices (a rectangle is the smallest
+    unit a 2-D sharding can shrink by).  Dropping a data-axis row
+    loses ``f`` devices; a feature-axis column loses ``r``.  Ties
+    prefer the row drop (rows usually dominate the device count, so
+    the feature axis — and its O(1/F_axis) histogram-byte cut — is
+    preserved longest)."""
+    if r > 1 and (f <= r or f == 1):
+        return (r - 1, f)
+    return (r, f - 1)
 
 # message signatures of a shard/collective failure, matched against
 # real XLA/PJRT device-loss errors and the injected stand-in.  Kept
@@ -197,8 +216,13 @@ class ElasticSupervisor:
 
     def _mesh_key(self):
         g = self.booster._gbdt
-        return (g._dist.kind if g._dist is not None else "serial",
-                int(g._dist.num_shards) if g._dist is not None else 1)
+        if g._dist is None:
+            return ("serial", 1, (1,))
+        # the mesh SHAPE is part of the identity: a 4x2 and a 2x4
+        # data2d mesh compile different programs, so each earns its
+        # own first-block compile grace
+        return (g._dist.kind, int(g._dist.num_shards),
+                tuple(int(s) for s in g._dist.mesh.devices.shape))
 
     # ------------------------------------------------------------------
     def update(self, fobj=None) -> bool:
@@ -311,7 +335,17 @@ class ElasticSupervisor:
         g._fused_rewind()
         g._flush_pending()
         snapshot = g.training_snapshot()
-        survivors = width - 1
+        # 2-D meshes degrade by whole rows/columns so the survivors
+        # still tile a rectangle; 1-D meshes shed one shard at a time
+        shape = None
+        if g._dist is not None and g._dist.kind == "data2d":
+            shape = (int(g._dist.row_shards), int(g._dist.feat_shards))
+        from_shape = list(shape) if shape is not None else None
+        if shape is not None:
+            shape = degrade_mesh_shape(*shape)
+            survivors = shape[0] * shape[1]
+        else:
+            survivors = width - 1
         while True:
             if survivors < self.min_shards:
                 self._emit("escalate", reason="min_shards",
@@ -321,23 +355,34 @@ class ElasticSupervisor:
                     f"elastic_min_shards={self.min_shards} — restart "
                     f"from checkpoint ({cause}: {str(detail)[:200]})")
             t0 = time.perf_counter()
+            use_2d = shape is not None and survivors > 1
             try:
                 mode = _faults.fire("elastic.remesh")
                 if mode == "error":
                     raise RuntimeError("injected fault "
                                        "(elastic.remesh:error)")
-                new_width = g.remesh(num_shards=survivors,
-                                     snapshot=snapshot)
+                if use_2d:
+                    new_width = g.remesh(mesh_shape=shape,
+                                         snapshot=snapshot)
+                else:
+                    new_width = g.remesh(num_shards=survivors,
+                                         snapshot=snapshot)
             except (Exception, _faults.InjectedFault) as exc:
                 self._emit("remesh_failed", to_shards=survivors,
+                           to_shape=list(shape) if use_2d else None,
                            error=str(exc)[:300])
                 Log.warning("elastic: re-mesh to %d shard(s) failed "
                             "(%s); degrading further", survivors, exc)
-                survivors -= 1
+                if use_2d:
+                    shape = degrade_mesh_shape(*shape)
+                    survivors = shape[0] * shape[1]
+                else:
+                    survivors -= 1
                 continue
             self._emit("remesh", from_shards=width,
                        to_shards=int(new_width), iter=boundary,
-                       cause=cause,
+                       cause=cause, from_shape=from_shape,
+                       to_shape=list(shape) if use_2d else None,
                        duration_ms=round(
                            (time.perf_counter() - t0) * 1e3, 3))
             Log.warning("elastic: re-meshed %d -> %d shard(s) at "

@@ -2,7 +2,10 @@
 device growth loop.
 
 Maps ``tree_learner={data,feature,voting}`` (``tree_learner.cpp:9-33``)
-onto a 1-D named mesh.  The growth loop itself
+onto a 1-D named mesh, and ``tree_learner=data2d`` onto a 2-D
+``Mesh((R, F), ("data", "feature"))`` — rows sharded down one axis,
+feature tiles across the other, with the collective schedule factored
+per axis (see :mod:`lightgbm_tpu.ops.grow`).  The growth loop itself
 (:func:`lightgbm_tpu.ops.grow.build_tree`) contains the per-strategy
 collectives; this module owns mesh construction, sharding specs, and
 the feature-axis padding the block-cyclic layouts need.
@@ -19,6 +22,8 @@ from ..ops.grow import DistConfig, GrowParams, build_tree
 from ..utils.log import Log
 
 AXIS_NAME = "shard"
+DATA_AXIS = "data"
+FEAT_AXIS = "feature"
 
 
 def shard_map_compat(fn, mesh, in_specs, out_specs):
@@ -82,16 +87,74 @@ def make_mesh_for(num_shards: int):
                              (AXIS_NAME,))
 
 
+def parse_mesh_shape(spec) -> tuple:
+    """``'4x2'`` / ``'4,2'`` / ``(4, 2)`` -> ``(4, 2)`` — the
+    ``mesh_shape`` config value as a (rows, feature-tiles) pair."""
+    if isinstance(spec, (tuple, list)):
+        toks = [str(s) for s in spec]
+    else:
+        import re
+        toks = [t for t in re.split(r"[x*,()\s]+", str(spec).strip())
+                if t]
+    if len(toks) != 2:
+        raise ValueError(
+            f"mesh_shape must name exactly two axes as 'RxF', got "
+            f"{spec!r}")
+    r, f = int(toks[0]), int(toks[1])
+    if r < 1 or f < 1:
+        raise ValueError(f"mesh_shape axes must be positive, got "
+                         f"({r}, {f})")
+    return (r, f)
+
+
+def factor_mesh_shape(n: int) -> tuple:
+    """Default (R, F) factorization of ``n`` devices when the user set
+    ``tree_learner=data2d`` without ``mesh_shape``: the largest
+    feature-axis divisor <= sqrt(n), rows get the rest (8 -> 4x2).
+    Rows usually outnumber features by orders of magnitude, so the row
+    axis gets the larger factor; the feature axis still earns its
+    O(1/F_axis) histogram-byte cut."""
+    fx = 1
+    for d in range(1, int(np.sqrt(n)) + 1):
+        if n % d == 0:
+            fx = d
+    return (n // fx, fx)
+
+
+def make_mesh_2d(mesh_shape) -> "jax.sharding.Mesh":
+    """A 2-D ``(rows, features)`` mesh over the first R*F local
+    devices, axes named ``("data", "feature")``.  Raises when fewer
+    devices are visible — same no-silent-narrowing contract as
+    :func:`make_mesh_for`."""
+    import jax
+    r, f = (int(s) for s in mesh_shape)
+    if r < 1 or f < 1:
+        raise ValueError(f"mesh_shape must be positive, got ({r}, {f})")
+    need = r * f
+    devices = jax.devices()
+    if len(devices) < need:
+        raise ValueError(
+            f"requested a {r}x{f} mesh ({need} devices) but only "
+            f"{len(devices)} device(s) are visible — pass a shape the "
+            f"host can satisfy (resume re-shards checkpointed state to "
+            f"any shape automatically; see docs/Distributed.md)")
+    return jax.sharding.Mesh(
+        np.asarray(devices[:need]).reshape(r, f), (DATA_AXIS, FEAT_AXIS))
+
+
 def pad_rows_for(kind: str, num_shards: int, n: int, base: int = 1) -> int:
     """Rows must split evenly over the mesh (and per-shard row count
-    must honor the histogram kernel's block size)."""
+    must honor the histogram kernel's block size).  ``num_shards`` is
+    the ROW-axis size — the 2-D learner passes R, not R*F."""
     step = base if kind in ("feature", "serial", "") \
         else base * num_shards
     return (n + step - 1) // step * step
 
 
 def pad_features_for(kind: str, num_shards: int, f: int) -> int:
-    """Features must split evenly for the feature-block layouts."""
+    """Features must split evenly for the feature-block layouts.
+    ``num_shards`` is the FEATURE-axis size — the 2-D learner passes
+    F, not R*F."""
     if kind in ("voting", "serial", ""):
         return f
     d = num_shards
@@ -108,39 +171,79 @@ class DistributedBuilder:
     """
 
     def __init__(self, kind: str, params: GrowParams, num_shards: int,
-                 mesh=None):
+                 mesh=None, mesh_shape=None):
         import jax
         from jax.sharding import PartitionSpec as P
 
-        if kind not in ("data", "feature", "voting"):
+        if kind not in ("data", "feature", "voting", "data2d"):
             raise ValueError(f"unknown parallel tree_learner {kind!r}")
         self.kind = kind
         self.num_shards = num_shards
-        self.mesh = mesh if mesh is not None else make_mesh_for(num_shards)
-        if len(self.mesh.axis_names) != 1:
-            raise ValueError(
-                f"tree learners shard over a 1-D mesh; got axes "
-                f"{self.mesh.axis_names}")
-        axis = self.mesh.axis_names[0]
-        self.params = dataclasses.replace(
-            params, dist=DistConfig(kind=kind, axis=axis,
-                                    num_shards=num_shards,
-                                    top_k=params.dist.top_k))
-
-        S = P(axis)
         R = P()
-        if kind == "feature":
-            xt_spec, row_spec, feat_spec = P(axis, None), R, S
-            leaf_idx_spec = R
-        else:  # data | voting: rows sharded, features whole
-            xt_spec, row_spec, feat_spec = P(None, axis), S, R
-            leaf_idx_spec = S
+        if kind == "data2d":
+            if mesh is not None:
+                if len(mesh.devices.shape) != 2:
+                    raise ValueError(
+                        f"tree_learner=data2d shards over a 2-D "
+                        f"(data, feature) mesh; got axes "
+                        f"{mesh.axis_names}")
+                shape = tuple(int(s) for s in mesh.devices.shape)
+            else:
+                shape = tuple(int(s) for s in (
+                    mesh_shape if mesh_shape
+                    else factor_mesh_shape(num_shards)))
+                mesh = make_mesh_2d(shape)
+            if shape[0] * shape[1] != num_shards:
+                raise ValueError(
+                    f"mesh_shape {shape[0]}x{shape[1]} does not factor "
+                    f"the {num_shards} shards")
+            self.mesh = mesh
+            axis, feat_axis = self.mesh.axis_names
+            self.row_shards, self.feat_shards = shape
+            self.params = dataclasses.replace(
+                params, dist=DistConfig(kind=kind, axis=axis,
+                                        num_shards=self.row_shards,
+                                        top_k=params.dist.top_k,
+                                        feat_axis=feat_axis,
+                                        feat_shards=self.feat_shards))
+            # xt is (F, N): feature tiles down axis 0, row blocks down
+            # axis 1 — each device holds an R-th of rows x an F-th of
+            # features; descriptors shard with the tiles, per-row state
+            # with the row blocks
+            xt_spec = P(feat_axis, axis)
+            row_spec, feat_spec = P(axis), P(feat_axis)
+            leaf_idx_spec = P(axis)
+        else:
+            self.mesh = mesh if mesh is not None \
+                else make_mesh_for(num_shards)
+            if len(self.mesh.axis_names) != 1:
+                raise ValueError(
+                    f"tree learner {kind!r} shards over a 1-D mesh; "
+                    f"got axes {self.mesh.axis_names}")
+            axis = self.mesh.axis_names[0]
+            feat_axis = None
+            self.row_shards = num_shards if kind in ("data", "voting") \
+                else 1
+            self.feat_shards = num_shards if kind == "feature" else 1
+            self.params = dataclasses.replace(
+                params, dist=DistConfig(kind=kind, axis=axis,
+                                        num_shards=num_shards,
+                                        top_k=params.dist.top_k))
+
+            S = P(axis)
+            if kind == "feature":
+                xt_spec, row_spec, feat_spec = P(axis, None), R, S
+                leaf_idx_spec = R
+            else:  # data | voting: rows sharded, features whole
+                xt_spec, row_spec, feat_spec = P(None, axis), S, R
+                leaf_idx_spec = S
         # the sharding contract, exposed for (a) mesh-resident placement
         # of the training tensors (device_put once, no per-call
         # resharding) and (b) the fused sharded super-step
         # (models/gbdt.py wraps its K-iteration scan in shard_map with
         # these same specs)
         self.axis = axis
+        self.feat_axis = feat_axis
         self.xt_spec, self.row_spec, self.feat_spec = (xt_spec, row_spec,
                                                        feat_spec)
 
@@ -192,10 +295,12 @@ class DistributedBuilder:
                 "rep": NamedSharding(m, P())}
 
     def pad_rows(self, n: int, base: int = 1) -> int:
-        return pad_rows_for(self.kind, self.num_shards, n, base)
+        return pad_rows_for(self.kind, max(self.row_shards, 1), n, base)
 
     def pad_features(self, f: int) -> int:
-        return pad_features_for(self.kind, self.num_shards, f)
+        shards = self.feat_shards if self.kind == "data2d" \
+            else self.num_shards
+        return pad_features_for(self.kind, shards, f)
 
     def __call__(self, xt, grad, hess, sample_mask, feature_mask,
                  num_bins, missing_type, is_cat, params=None,

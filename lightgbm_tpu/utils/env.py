@@ -20,6 +20,45 @@ def force_host_platform_devices(n: int) -> None:
     ).strip()
 
 
+def maybe_init_distributed() -> bool:
+    """Env-gated multi-host entry: join the JAX distributed runtime
+    when the ``LTPU_COORDINATOR`` env triple is set, no-op otherwise.
+
+    A multi-host launcher exports::
+
+        LTPU_COORDINATOR=host0:12355   # coordinator (process 0)
+        LTPU_NUM_PROCESSES=4
+        LTPU_PROCESS_ID=<rank>         # or LTPU_MACHINE_RANK
+
+    and every process calls this (the driver does, before building any
+    mesh) — afterwards ``jax.devices()`` spans all hosts, so the 1-D
+    learners' meshes and the data2d 2-D mesh factor over the GLOBAL
+    device set.  Single-host runs (no ``LTPU_COORDINATOR``) return
+    False without importing jax.  Idempotent: a runtime already joined
+    with the same topology is a no-op; a different topology raises
+    (``parallel.distributed.init_distributed``).  Malformed env values
+    raise — a silent single-host fallback would train at the wrong
+    scale (docs/Distributed.md).
+    """
+    coordinator = os.environ.get("LTPU_COORDINATOR", "")
+    if not coordinator:
+        return False
+    n = int(os.environ.get("LTPU_NUM_PROCESSES", "1"))
+    if n <= 1:
+        return False
+    rank = os.environ.get("LTPU_PROCESS_ID",
+                          os.environ.get("LTPU_MACHINE_RANK"))
+    if rank is None:
+        raise RuntimeError(
+            "LTPU_COORDINATOR is set but neither LTPU_PROCESS_ID nor "
+            "LTPU_MACHINE_RANK names this process's rank")
+    from ..parallel.distributed import init_distributed
+    timeout = os.environ.get("LTPU_INIT_TIMEOUT_S")
+    init_distributed(coordinator, n, int(rank),
+                     timeout_s=int(timeout) if timeout else None)
+    return True
+
+
 def pallas_interpret_forced() -> bool:
     """True when the ``LTPU_PALLAS_INTERPRET`` env lane is armed: every
     Pallas kernel runs under ``pl.pallas_call(..., interpret=True)``

@@ -10,9 +10,16 @@ TRAINED at different widths differ in float low bits), while
 feature-parallel reduces no float histograms and is byte-identical to
 serial at EVERY width, prefix included.
 
+The 2-D lane (ISSUE 18): ``tree_learner=data2d`` degrades by whole
+mesh rows/columns (``degrade_mesh_shape`` — whichever loses fewer
+devices, ties preferring the row so the feature axis survives), with
+row-drop AND column-drop recovery each byte-equal to the clean
+shape-remesh oracle and the full (R, F) topology on checkpoint
+manifests.
+
 Fast lane: one representative per property on the forced 8-device CPU
 mesh (feature-parallel cross-width resume, the healthy-path
-supervisor, remesh-to-serial fallback).  The full cross-width resume
+supervisor, remesh-to-serial fallback, the 2-D shape entrypoint).  The full cross-width resume
 matrix ({data, feature, voting} x fused_iters {1, 4} x resume width
 {4, 1}) and the heaviest ~20 s bit-exact recovery pins (same-width
 roundtrip, supervisor error recovery with/without an outstanding
@@ -458,3 +465,119 @@ def test_cross_width_resume_matrix(data601, tmp_path, learner, fused,
         scratch = lgb.train(p, d, verbose_eval=False,
                             mesh=_mesh(max(width, 1)))
         assert resumed.model_to_string() == scratch.model_to_string()
+
+
+# ----------------------------------------------------------------------
+# 2-D (data x feature) elastic re-mesh
+# ----------------------------------------------------------------------
+def _booster_2d(X, y, shape, fused=4, rounds=ROUNDS, **kw):
+    p = _params("data2d", fused, rounds, mesh_shape=shape, **kw)
+    d = lgb.Dataset(X, label=y, params=p)
+    d.construct()
+    return lgb.Booster(params=p, train_set=d)
+
+
+def _oracle_remesh_2d(X, y, boundary, from_shape, to_shape, fused=4,
+                      rounds=ROUNDS, **kw):
+    """Clean 2-D continuation oracle: uninterrupted on ``from_shape``
+    to the boundary, explicit shape re-mesh, uninterrupted to the
+    end."""
+    b = _booster_2d(X, y, from_shape, fused, rounds, **kw)
+    _train_to(b, boundary)
+    b._gbdt.remesh(mesh_shape=[int(s) for s in to_shape.split("x")])
+    _train_to(b, rounds)
+    return b.model_to_string()
+
+
+def test_degrade_mesh_shape_policy():
+    """The 2-D surviving-set policy: drop the whole mesh row or
+    column that loses fewer devices; ties prefer the row drop (the
+    feature axis — and with it the collective-byte cut — survives)."""
+    from lightgbm_tpu.parallel.elastic import degrade_mesh_shape
+    assert degrade_mesh_shape(4, 2) == (3, 2)   # row costs 2, col 4
+    assert degrade_mesh_shape(2, 4) == (2, 3)   # col costs 2, row 4
+    assert degrade_mesh_shape(2, 2) == (1, 2)   # tie: row drop
+    assert degrade_mesh_shape(4, 1) == (3, 1)   # degenerate column
+    assert degrade_mesh_shape(1, 4) == (1, 3)   # degenerate row
+
+
+@pytest.mark.slow
+def test_remesh_2d_shape_entrypoint(data601):
+    """``GBDT.remesh(mesh_shape=...)`` rebuilds the 2-D builder at the
+    new shape mid-run and training continues on it."""
+    X, y = data601
+    b = _booster_2d(X, y, "4x2", rounds=6)
+    _train_to(b, 3)
+    assert b._gbdt.remesh(mesh_shape=(2, 2)) == 4
+    g = b._gbdt
+    assert (g._dist.row_shards, g._dist.feat_shards) == (2, 2)
+    _train_to(b, 6)
+    assert g.iter == 6
+
+
+@pytest.mark.slow
+def test_supervisor_2d_row_drop_bit_exact(data601, tmp_path):
+    """A shard dying on the 4x2 mesh drops the whole mesh ROW (4x2 ->
+    3x2: the row costs 2 devices, the column 4) and the recovered
+    model is BYTE-identical to a clean shape-remesh continuation at
+    the served boundary, with the (R, F) shapes on the recovery
+    records."""
+    X, y = data601
+    tele = str(tmp_path / "tele.jsonl")
+    faults.configure("mesh.collective:error@2")
+    p = _params("data2d", elastic_training=True, mesh_shape="4x2",
+                telemetry_file=tele)
+    d = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.train(p, d, verbose_eval=False)
+    bst._gbdt._telemetry.close(log=False)
+    faults.clear()
+    g = bst._gbdt
+    assert (g._dist.row_shards, g._dist.feat_shards) == (3, 2)
+    assert g.iter == ROUNDS
+
+    recov = [json.loads(l) for l in open(tele)
+             if '"type": "recovery"' in l]
+    assert [r["event"] for r in recov] == ["detect", "remesh"], recov
+    assert recov[1]["from_shape"] == [4, 2]
+    assert recov[1]["to_shape"] == [3, 2]
+    assert recov[1]["from_shards"] == 8 and recov[1]["to_shards"] == 6
+    boundary = recov[1]["iter"]
+    assert bst.model_to_string() == \
+        _oracle_remesh_2d(X, y, boundary, "4x2", "3x2")
+
+
+@pytest.mark.slow
+def test_supervisor_2d_column_drop_bit_exact(data601):
+    """On the 2x4 mesh the COLUMN is cheaper (2 devices vs the row's
+    4): recovery drops 2x4 -> 2x3, byte-equal to the clean-remesh
+    oracle."""
+    X, y = data601
+    faults.configure("mesh.collective:error@2")
+    p = _params("data2d", elastic_training=True, mesh_shape="2x4")
+    d = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.train(p, d, verbose_eval=False)
+    faults.clear()
+    g = bst._gbdt
+    assert (g._dist.row_shards, g._dist.feat_shards) == (2, 3)
+    assert g.iter == ROUNDS
+    assert bst.model_to_string() == \
+        _oracle_remesh_2d(X, y, 5, "2x4", "2x3")
+
+
+@pytest.mark.slow
+def test_manifest_records_2d_mesh_topology(data601, tmp_path):
+    """data2d checkpoints record the FULL (R, F) tuple + learner kind
+    — a 4x2 and a 2x4 snapshot are distinguishable even though their
+    flat shard counts match."""
+    X, y = data601
+    ck = str(tmp_path / "ck")
+    p = _params("data2d", mesh_shape="4x2", checkpoint_dir=ck,
+                snapshot_freq=3, keep_last_n=8)
+    d = lgb.Dataset(X, label=y, params=p)
+    lgb.train(p, d, verbose_eval=False)
+    snap = os.path.join(ck, "ckpt_00000003")
+    assert os.path.isdir(snap)
+    for blob in ("manifest.json", "extra.json"):
+        mesh = json.load(open(os.path.join(snap, blob)))["mesh"]
+        assert mesh == {"learner": "data2d", "num_shards": 8,
+                        "mesh_shape": [4, 2]}

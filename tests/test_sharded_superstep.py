@@ -12,6 +12,13 @@ sharded learner.  The row count (601) is deliberately NOT divisible by
 the mesh width so the padded-row stitching of the stacked leaf table
 is exercised (the replay-slice regression).
 
+The 2-D lane (ISSUE 18): ``tree_learner=data2d`` shards the binned
+matrix on BOTH axes of a (data x feature) mesh — fused == unfused
+BIT-exact on {2x4, 4x2} x the same sampling matrix, the same
+non-dividing row count, mid-block checkpoint/resume under the 2-D
+mesh, and the superstep telemetry carrying the full (R, F) shape plus
+per-axis collective accounting.
+
 Fast lane: one representative per property.  The full matrix is @slow.
 """
 import json
@@ -188,6 +195,176 @@ def test_superstep_telemetry_and_device_call_budget(data601, tmp_path):
            if '"type": "run_end"' in l]
     assert end and end[-1]["summary"]["collective_bytes"] > 0
     assert end[-1]["summary"]["collective_ops"] > 0
+
+
+@pytest.mark.slow
+def test_data2d_goss_fused_equals_unfused(data601):
+    """2-D fast-lane representative: the row-axis histogram psum, the
+    feature-axis best-split gather and the feature-axis routing psum
+    all ride inside the scan on the 4x2 (data x feature) mesh, and
+    the fused model is BIT-identical to the unfused 2-D path."""
+    X, y = data601
+    b1 = _train(X, y, "data2d", 1, SAMPLING["goss"])
+    b4 = _train(X, y, "data2d", 4, SAMPLING["goss"])
+    _assert_fused_sharded(b4, "data2d")
+    g = b4._gbdt
+    assert (g._dist.row_shards, g._dist.feat_shards) == (4, 2)
+    assert b4.model_to_string() == b1.model_to_string()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("shape", ["2x4", "4x2"])
+@pytest.mark.parametrize("sampling", sorted(SAMPLING))
+def test_data2d_fused_matrix(data601, shape, sampling):
+    """The 2-D acceptance matrix: {2x4, 4x2} x {none, bagging, GOSS,
+    MVS} x fused_iters {1, 4} — fused == unfused bit-exactly on the
+    same 2-D mesh, with the 601-row count dividing neither axis."""
+    X, y = data601
+    extra = dict(SAMPLING[sampling], mesh_shape=shape)
+    b1 = _train(X, y, "data2d", 1, extra)
+    b4 = _train(X, y, "data2d", 4, extra)
+    _assert_fused_sharded(b4, "data2d")
+    r, f = (int(s) for s in shape.split("x"))
+    g = b4._gbdt
+    assert (g._dist.row_shards, g._dist.feat_shards) == (r, f)
+    assert b4.model_to_string() == b1.model_to_string()
+
+
+@pytest.mark.slow
+def test_data2d_fused_matches_serial_structure(data601):
+    """Quantized-tier serial-structure pin through the 2-D mesh: the
+    row-axis psum sums small integers — exact in f32 in any reduction
+    order — and the feature-axis merge reproduces the serial
+    feature-major tie-break, so the data2d model's STRUCTURE equals
+    the serial learner's exactly."""
+    X, y = data601
+    fast = {"use_quantized_grad": True, "min_data_in_leaf": 1,
+            "max_bin": 63}
+    serial = _train(X, y, "serial", 4, fast)
+    b2d = _train(X, y, "data2d", 4, fast)
+    assert b2d._gbdt._dist is not None and b2d._gbdt._fused_ok()
+    for ts, td in zip(serial._gbdt.models, b2d._gbdt.models):
+        n = ts.num_leaves - 1
+        assert td.num_leaves == ts.num_leaves
+        np.testing.assert_array_equal(td.split_feature[:n],
+                                      ts.split_feature[:n])
+        np.testing.assert_array_equal(td.threshold_bin[:n],
+                                      ts.threshold_bin[:n])
+    np.testing.assert_allclose(b2d.predict(X), serial.predict(X),
+                               rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_midblock_checkpoint_resume_data2d(data601, tmp_path):
+    """Mid-fused-block snapshot/resume under the 2-D mesh: the
+    served-boundary replay must stitch the doubly-padded (row x
+    feature) state back to the real row count bit-exactly."""
+    X, y = data601
+    extra = dict(SAMPLING["bagging"], num_iterations=10)
+    oracle = _train(X, y, "data2d", 4, extra, rounds=10)
+    ck = str(tmp_path / "ck")
+    _train(X, y, "data2d", 4, dict(extra, checkpoint_dir=ck,
+                                   snapshot_freq=3, keep_last_n=8),
+           rounds=10)
+    snap = os.path.join(ck, "ckpt_00000003")
+    assert os.path.isdir(snap)
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "metric": "None", "tree_learner": "data2d",
+              "fused_iters": 4, "num_iterations": 10}
+    params.update(SAMPLING["bagging"])
+    d = lgb.Dataset(X, label=y, params=params)
+    resumed = lgb.train(params, d, verbose_eval=False,
+                        resume_from=snap)
+    assert resumed.model_to_string() == oracle.model_to_string()
+
+
+@pytest.mark.slow
+def test_data2d_cross_shape_resume(data601, tmp_path):
+    """A checkpoint taken on the 4x2 mesh restored into a 2x4 booster
+    (EQUAL shard counts — only the shape differs) re-shards and
+    continues; the manifest's full (R, F) topology is what makes the
+    mismatch detectable at all."""
+    X, y = data601
+    ck = str(tmp_path / "ck")
+    _train(X, y, "data2d", 4, {"mesh_shape": "4x2",
+                               "checkpoint_dir": ck,
+                               "snapshot_freq": 4, "keep_last_n": 8},
+           rounds=8)
+    snap = os.path.join(ck, "ckpt_00000004")
+    assert os.path.isdir(snap)
+    # the 2x4 oracle: same data, same params, trained clean
+    oracle = _train(X, y, "data2d", 4, {"mesh_shape": "2x4"},
+                    rounds=8)
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "metric": "None", "tree_learner": "data2d",
+              "mesh_shape": "2x4", "fused_iters": 4,
+              "num_iterations": 8}
+    d = lgb.Dataset(X, label=y, params=params)
+    resumed = lgb.train(params, d, verbose_eval=False,
+                        resume_from=snap)
+    g = resumed._gbdt
+    assert (g._dist.row_shards, g._dist.feat_shards) == (2, 4)
+    # the resumed trees from the boundary on were grown on the 2x4
+    # mesh: prediction parity with the clean 2x4 oracle within float
+    # psum-reordering noise (the first 4 trees are byte-identical
+    # carried state)
+    np.testing.assert_allclose(resumed.predict(X), oracle.predict(X),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_data2d_telemetry_mesh_shape_and_budget(data601, tmp_path):
+    """The data2d superstep record carries the full 2-D mesh shape
+    plus PER-AXIS collective accounting (the 2-D weak-scaling triage
+    keys on it), and the device-call budget stays 2 per K-block."""
+    from lightgbm_tpu.utils import telemetry as _telemetry
+    from lightgbm_tpu.utils.telemetry import lint_file
+
+    X, y = data601
+    tele = str(tmp_path / "tele.jsonl")
+    c0 = _telemetry.counters_snapshot()
+    bst = _train(X, y, "data2d", 4, {"telemetry_file": tele},
+                 rounds=9)
+    c1 = _telemetry.counters_snapshot()
+    bst._gbdt._telemetry.close(log=False)
+
+    assert c1["superstep_dispatches"] - c0.get(
+        "superstep_dispatches", 0) == 2
+    assert c1["superstep_fetches"] - c0.get(
+        "superstep_fetches", 0) == 2
+
+    n, errs = lint_file(tele)
+    assert errs == [] and n > 0
+    ss = [json.loads(l) for l in open(tele)
+          if '"type": "superstep"' in l]
+    assert len(ss) == 2
+    for r in ss:
+        assert r["learner"] == "data2d"
+        assert r["num_shards"] == 8
+        assert r["mesh_shape"] == [4, 2]
+        axb = r["collective_bytes_axis"]
+        axo = r["collective_ops_axis"]
+        assert set(axb) == {"data", "feature"} == set(axo)
+        assert axb["data"] > 0 and axb["feature"] > 0
+        assert axo["data"] > 0 and axo["feature"] > 0
+        assert r["collective_bytes"] > 0
+
+
+def test_data2d_mesh_resident_state(data601):
+    """The binned matrix is sharded on BOTH axes at construction —
+    each device holds an R-th of rows x an F-th of feature tiles —
+    while per-row state shards on the data axis only."""
+    X, y = data601
+    bst = _train(X, y, "data2d", 4, rounds=4)
+    g = bst._gbdt
+    shd = g._dist.shardings()
+    assert g._xt.sharding == shd["xt"]
+    assert not g._xt.sharding.is_fully_replicated
+    assert g._base_mask.sharding == shd["row"]
+    assert g._score.sharding.is_fully_replicated
+    # per-device block really is (F/Fx, N/R)
+    F_pad, n_pad = g._F_pad, g._n_pad
+    shard_shapes = {tuple(s.data.shape) for s in g._xt.addressable_shards}
+    assert shard_shapes == {(F_pad // 2, n_pad // 4)}
 
 
 def test_mesh_resident_state_sharded(data601):

@@ -32,6 +32,12 @@ path: the single-program property `docs/Distributed.md` documents
 iteration, the WEAKSCALE.json degradation), and the elastic
 heartbeat/watchdog detection riding it at zero extra device calls.
 
+A 2-D SHARDED cell runs ``tree_learner=data2d`` over a (data x
+feature) mesh (R x 2 of the same virtual devices) through the same
+fused scan and HARD-asserts the identical 2-calls-per-K-block budget:
+the per-axis collective factoring changes what moves on the wire, not
+how often the host touches the device.
+
     JAX_PLATFORMS=cpu python tools/prof_superstep.py            # write
     JAX_PLATFORMS=cpu python tools/prof_superstep.py --stdout
 """
@@ -49,7 +55,8 @@ OUT = os.path.join(ROOT, "BENCH_superstep_cpu.json")
 
 
 def measure(variants=(1, 4, 8), n_rows=5_000, n_feat=28, reps=6,
-            block=8, learner="serial", num_shards=0, elastic=False):
+            block=8, learner="serial", num_shards=0, elastic=False,
+            mesh_shape=None):
     """Interleaved A/B: one booster per ``fused_iters`` variant, then
     round-robin 8-iteration blocks across them — the same-process
     interleaving discipline docs/Benchmarks.md's protocol notes
@@ -65,7 +72,7 @@ def measure(variants=(1, 4, 8), n_rows=5_000, n_feat=28, reps=6,
     X = rng.randn(n_rows, n_feat).astype(np.float32)
     y = (X[:, 0] + 0.4 * rng.randn(n_rows) > 0).astype(np.float32)
     mesh = None
-    if learner != "serial" and num_shards > 1:
+    if learner not in ("serial", "data2d") and num_shards > 1:
         import jax
         mesh = jax.sharding.Mesh(
             np.asarray(jax.devices()[:num_shards]), ("shard",))
@@ -77,11 +84,16 @@ def measure(variants=(1, 4, 8), n_rows=5_000, n_feat=28, reps=6,
                   "num_iterations": 10_000,  # no tail block in-window
                   "tree_learner": learner,
                   "fused_iters": k}
+        if learner == "data2d":
+            # the 2-D learner builds its own (data x feature) mesh
+            # from the shape spec — no 1-D mesh handed in
+            params["num_machines"] = num_shards
+            params["mesh_shape"] = "x".join(str(s) for s in mesh_shape)
         d = lgb.Dataset(X, label=y, params=params)
         d.construct()
         bst = lgb.Booster(params=params, train_set=d, mesh=mesh)
         step = bst.update
-        if elastic and mesh is not None:
+        if elastic and (mesh is not None or learner == "data2d"):
             # the sharded cell runs under the elastic supervisor
             # (parallel/elastic.py): the healthy-path budget pin below
             # covers the SUPERVISED path — detection must cost zero
@@ -449,6 +461,34 @@ def main(argv=None):
         sharded_budget["matches_serial_fused"] = (
             sharded_budget["observed_fused_device_calls"] ==
             sharded_budget["expected_fused_device_calls"])
+    # 2-D SHARDED cell: tree_learner=data2d over a (data x feature)
+    # mesh rides the SAME fused scan — the per-axis collective
+    # factoring (histogram psum over "data" only, tile merge + routing
+    # over "feature") must not change how many times the host touches
+    # the device, so its budget is HARD-asserted at 2 per K-block
+    sharded2d_cells, sharded2d_budget = [], None
+    if D >= 4:
+        r2, f2 = D // 2, 2
+        sharded2d_cells, sharded2d_budget = measure(
+            variants=(8,), n_rows=2_048 * r2, n_feat=10,
+            reps=args.reps, learner="data2d", num_shards=D,
+            mesh_shape=(r2, f2), elastic=True)
+        for c in sharded2d_cells:
+            c["shape"] = (f"{2048 * r2} x 10, data2d over a "
+                          f"{r2}x{f2} (data x feature) mesh, "
+                          f"elastic-supervised")
+        sharded2d_budget["num_shards"] = D
+        sharded2d_budget["mesh_shape"] = [r2, f2]
+        sharded2d_budget["supervised_elastic"] = True
+        sharded2d_budget["matches_serial_fused"] = (
+            sharded2d_budget["observed_fused_device_calls"] ==
+            sharded2d_budget["expected_fused_device_calls"])
+        assert sharded2d_budget["matches_serial_fused"], (
+            f"2-D mesh device-call budget broken: "
+            f"{sharded2d_budget['observed_fused_device_calls']} calls "
+            f"observed, "
+            f"{sharded2d_budget['expected_fused_device_calls']} "
+            f"expected (2 per K-block on the {r2}x{f2} mesh)")
     # ASYNC BLOCK PIPELINING cell (superstep_pipeline_depth): the
     # per-block fetch overlapped behind the next block's dispatch,
     # with the 2-calls-per-K-block budget hard-asserted at every depth
@@ -475,6 +515,9 @@ def main(argv=None):
     if sharded_cells:
         out["sharded_cells"] = sharded_cells
         out["sharded_device_call_budget"] = sharded_budget
+    if sharded2d_cells:
+        out["sharded2d_cells"] = sharded2d_cells
+        out["sharded2d_device_call_budget"] = sharded2d_budget
     text = json.dumps(out, indent=2)
     if args.stdout:
         print(text)

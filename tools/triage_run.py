@@ -401,15 +401,21 @@ def triage(records, baseline=None):
                      f"{fused_iters} iterations")
         sharded = [r for r in supersteps if "num_shards" in r]
         if sharded:
-            meshes = sorted({(r.get("learner", "?"),
-                              int(r["num_shards"])) for r in sharded})
+            # a 2-D (data2d) mesh prints its full RxF shape — the
+            # shard count alone cannot tell a 4x2 from a 2x4 cell
+            def _mesh_label(r):
+                shape = r.get("mesh_shape") or []
+                if len(shape) == 2:
+                    return (f"{r.get('learner', '?')}x"
+                            f"{'x'.join(str(int(s)) for s in shape)}")
+                return f"{r.get('learner', '?')}x{int(r['num_shards'])}"
+            meshes = sorted({_mesh_label(r) for r in sharded})
             cb = sum(float(r.get("collective_bytes", 0.0))
                      for r in sharded)
             co = sum(float(r.get("collective_ops", 0.0))
                      for r in sharded)
             lines.append(
-                f"  sharded   : "
-                f"{', '.join(f'{l}x{d}' for l, d in meshes)} — "
+                f"  sharded   : {', '.join(meshes)} — "
                 f"{cb / 1e6:.1f} MB / {co:.0f} collective ops inside "
                 f"the fused scans (per-shard estimate)")
     meds = phase_medians(records)
