@@ -2437,6 +2437,162 @@ def ingest_only():
     return 0
 
 
+def paged_only():
+    """Fast path (``python bench.py --paged-only``): measure the
+    device-block pager's cost envelope on the CPU backend and write
+    BENCH_paged_cpu.json — resident-vs-paged train wall at two page
+    geometries (explicit ``paged_page_rows`` and ``hbm_budget_mb``
+    auto), the prefetch overlap fraction, and the device-call budget
+    re-pin from ``tools/prof_superstep.measure_paged`` (page serves
+    are pure_callbacks inside the compiled scan, so the fused
+    super-step stays at 2 host->device calls per K-block at any page
+    count).  Acceptance pins: the paged model is BYTE-IDENTICAL to
+    the resident one, pages actually flowed, and the budget held.
+
+    Honest caveat (recorded in the artifact): on this 2-core CPU
+    container host RAM backs both the "device" buffers and the page
+    store, so page prep is a near-free memcpy — the paged slowdown
+    prices the pure_callback serve machinery, not real HBM<->host
+    bandwidth, and the overlap numbers are milliseconds of trivially
+    cheap prep, not the transfer walls the prefetch thread exists to
+    hide.  The TPU-side point of the pager (training sets larger
+    than HBM) is the ROADMAP real-hardware item."""
+    import datetime
+
+    if ensure_backend(variant="paged") is None:
+        return 0
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils import telemetry as _telemetry
+    _telemetry.install_jax_hooks()
+
+    n_rows = int(os.environ.get("BENCH_PAGED_ROWS", "60000"))
+    n_features = 28
+    rounds = int(os.environ.get("BENCH_PAGED_ROUNDS", "10"))
+    rng = np.random.RandomState(0)
+    X = rng.randn(n_rows, n_features).astype(np.float32)
+    w = rng.randn(n_features).astype(np.float32)
+    y = (1.0 / (1.0 + np.exp(-(X @ w) * 0.5)) >
+         rng.random_sample(n_rows)).astype(np.float32)
+    base = {"objective": "binary", "num_leaves": 31, "verbose": -1,
+            "metric": "None", "num_iterations": rounds,
+            "fused_iters": 4}
+
+    def run_cell(label, extra):
+        p = dict(base, **extra)
+        d = lgb.Dataset(X, label=y, params=p)
+        d.construct()
+        binned_mb = np.asarray(d._constructed.binned).nbytes / 1e6
+        t0 = time.time()
+        bst = lgb.train(p, d, verbose_eval=False)
+        wall = time.time() - t0
+        g = bst._gbdt
+        cell = {"label": label, "rounds": rounds,
+                "wall_s": round(wall, 3),
+                "binned_mb": round(binned_mb, 2)}
+        pager = getattr(g, "_pager", None)
+        if pager is not None:
+            s = pager.stats()
+            busy = s["overlap_s"] + s["wait_s"]
+            cell.update({
+                "page_rows": int(s["page_rows"]),
+                "n_pages": int(s["n_pages"]),
+                "pages_served": int(s["pages"]),
+                "paged_mb": round(s["bytes"] / 1e6, 2),
+                "prefetch_hits": int(s["prefetch_hits"]),
+                "stalls": int(s["stalls"]),
+                "overlap_s": round(s["overlap_s"], 4),
+                "wait_s": round(s["wait_s"], 4),
+                # fraction of page-prep wall absorbed by the prefetch
+                # thread instead of stalling the serve callback
+                "overlap_fraction": round(
+                    s["overlap_s"] / max(busy, 1e-9), 3),
+            })
+        rec = getattr(g, "_telemetry", None)
+        if rec is not None:
+            rec.close(log=False)
+        model = bst.model_to_string()
+        print(json.dumps({"paged_cell": label,
+                          **{k: v for k, v in cell.items()
+                             if k != "label"}}), flush=True)
+        return cell, model
+
+    cells = []
+    resident_cell, resident_model = run_cell("resident", {})
+    cells.append(resident_cell)
+    page_rows = int(os.environ.get("BENCH_PAGED_PAGE_ROWS",
+                                   str(max(n_rows // 8, 1))))
+    paged_cell, paged_model = run_cell(
+        f"paged page_rows={page_rows}",
+        {"paged_training": "on", "paged_page_rows": page_rows})
+    cells.append(paged_cell)
+    # auto lane: a budget sized to ~1/4 of the binned matrix must
+    # trigger paging on its own and land the same model bytes
+    budget_mb = max(resident_cell["binned_mb"] / 4.0, 0.001)
+    auto_cell, auto_model = run_cell(
+        f"paged auto hbm_budget_mb={budget_mb:.2f}",
+        {"paged_training": "auto", "hbm_budget_mb": budget_mb})
+    cells.append(auto_cell)
+    for c in cells[1:]:
+        c["wall_over_resident"] = round(
+            c["wall_s"] / max(resident_cell["wall_s"], 1e-9), 3)
+
+    # device-call budget re-pin (hard-asserts inside): 2 calls per
+    # K-block at every page count — recorded in THIS artifact per the
+    # ISSUE acceptance, same numbers prof_superstep.py pins
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    from prof_superstep import measure_paged
+    budget = measure_paged(reps=3)
+    print(json.dumps({"paged_budget": {
+        "budget_ok_at_all_page_counts":
+            budget["budget_ok_at_all_page_counts"],
+        "page_counts": [c["n_pages"] for c in budget["cells"]],
+    }}), flush=True)
+
+    pins = {
+        "byte_identical_paged_vs_resident":
+            paged_model == resident_model,
+        "byte_identical_auto_vs_resident":
+            auto_model == resident_model,
+        "auto_lane_paged": auto_cell.get("n_pages", 0) >= 3,
+        "pages_served_nonzero":
+            paged_cell.get("pages_served", 0) > 0,
+        "device_call_budget_2_per_block":
+            budget["budget_ok_at_all_page_counts"],
+    }
+    out = {
+        "metric": "paged_training_cpu",
+        "unit": "s",
+        "backend": "cpu",
+        "date": datetime.date.today().isoformat(),
+        "source": "JAX_PLATFORMS=cpu python bench.py --paged-only",
+        "env": "2-core CPU container",
+        "forest": (f"31-leaf binary forest, {n_rows} x {n_features} "
+                   f"train matrix, {rounds} iterations, fused_iters=4"),
+        "note": "CPU numbers price the pure_callback serve machinery "
+                "only — host RAM backs both sides on this 2-core "
+                "container, so page prep is a near-free memcpy and "
+                "the overlap columns are milliseconds of trivially "
+                "cheap prep, not the HBM<->host transfer walls the "
+                "prefetch thread exists to hide; the HBM-ceiling win "
+                "is the ROADMAP real-hardware item",
+        "config": {"rows": n_rows, "features": n_features,
+                   "rounds": rounds, "page_rows": page_rows,
+                   "auto_hbm_budget_mb": round(budget_mb, 3)},
+        "cells": cells,
+        "device_call_budget": budget,
+        "pins": pins,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_paged_cpu.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    print(json.dumps({"wrote": os.path.basename(path),
+                      "pins": pins}), flush=True)
+    return 0 if all(pins.values()) else 1
+
+
 _SWEEP_SOLO_DRIVER = """\
 import json, sys
 import numpy as np
@@ -2585,6 +2741,8 @@ if __name__ == "__main__":
         sys.exit(continual_only())
     if "--ingest-only" in sys.argv:
         sys.exit(ingest_only())
+    if "--paged-only" in sys.argv:
+        sys.exit(paged_only())
     if "--weakscale-only" in sys.argv:
         sys.exit(weakscale_only())
     if "--sweep-only" in sys.argv:

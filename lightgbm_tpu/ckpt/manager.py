@@ -192,6 +192,14 @@ class CheckpointManager:
             if hasattr(g, "stream_identity") else None
         if stream_id is not None:
             meta["stream"] = stream_id
+        # device-block pager geometry (io/pager.py): provenance that
+        # this snapshot came from an out-of-core run — paged training
+        # is byte-identical to resident, so resume may use ANY page
+        # geometry (or none); the record is for triage, not a check
+        pager_id = g.pager_identity() \
+            if hasattr(g, "pager_identity") else None
+        if pager_id is not None:
+            meta["pager"] = pager_id
         # trace carrier (obs/spans.py): a watcher in ANOTHER process
         # re-enters this context, so the saving run's trace continues
         # through validate -> canary -> publish -> first served request
@@ -231,6 +239,8 @@ class CheckpointManager:
                     "mesh": meta["mesh"], "blobs": blobs}
         if "stream" in meta:
             manifest["stream"] = meta["stream"]
+        if "pager" in meta:
+            manifest["pager"] = meta["pager"]
         _fsync_write(os.path.join(staging, _MANIFEST),
                      json.dumps(manifest, sort_keys=True,
                                 indent=1).encode("utf-8"))
@@ -475,6 +485,24 @@ class CheckpointManager:
                              "cache_key", ""))[:16],
                          rebinned=int(getattr(info, "rebinned", 0)
                                       if info is not None else 0))
+        ck_pager = meta.get("pager")
+        if ck_pager:
+            # paged runs are byte-identical to resident, so any
+            # geometry (or none at all) is a valid resume — log the
+            # transition for triage only
+            cur_pg = g.pager_identity() \
+                if hasattr(g, "pager_identity") else None
+            if cur_pg != ck_pager:
+                Log.info(
+                    "checkpoint was written by an out-of-core run "
+                    "(page_rows=%s, n_pages=%s); resuming %s — "
+                    "results are byte-identical either way",
+                    ck_pager.get("page_rows", "?"),
+                    ck_pager.get("n_pages", "?"),
+                    "resident" if cur_pg is None else
+                    "with page_rows=%s, n_pages=%s" % (
+                        cur_pg.get("page_rows", "?"),
+                        cur_pg.get("n_pages", "?")))
         raw = None
         if booster.train_set is not None:
             raw = booster.train_set.raw_mat

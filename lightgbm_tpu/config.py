@@ -291,6 +291,33 @@ PARAMS: List[Param] = [
        "EFB transform) while window i's async device copy runs.  "
        "~zero measured overlap with streaming enabled is a MED "
        "anomaly (obs/rules.py)", group="io"),
+    # ---- device-block pager: out-of-core ON DEVICE (io/pager.py,
+    # docs/Streaming.md "Out-of-core on device") ----
+    _p("paged_training", "auto", str, ("paged",),
+       "device-block paged training (docs/Streaming.md): the (F, N) "
+       "binned matrix never materializes in device memory — each "
+       "shard's row range splits into fixed-size row pages served "
+       "from the binned cache, and the per-iteration histogram pass "
+       "becomes a page loop whose page p+1 prefetch rides under page "
+       "p's compute.  'auto' pages only when the per-device matrix "
+       "exceeds hbm_budget_mb; 'on' forces paging (ValueError if the "
+       "config is paged-ineligible: requires the baseline "
+       "hist_impl=segsum / split_kernel=xla lane, no wave growth or "
+       "speculation); 'off' always trains resident.  Paged models "
+       "are byte-identical to resident ones (tests/test_pager.py)",
+       group="io", check="auto, on, off"),
+    _p("hbm_budget_mb", 0.0, float, ("device_budget_mb",),
+       "per-device memory budget for the PAGED binned matrix (the "
+       "page double-buffer): with paged_training=auto, paging "
+       "activates when a device's resident matrix block would exceed "
+       "this many MB, and the page size is chosen so two page slots "
+       "fit inside it.  0 disables the auto trigger", group="io",
+       check=">=0"),
+    _p("paged_page_rows", 0, int, (),
+       "explicit rows per page of the device-block pager (overrides "
+       "the hbm_budget_mb-derived page size; mainly for tests and "
+       "benchmarks pinning a page count).  0 derives the size from "
+       "the budget", group="io", check=">=0"),
     _p("save_binary", False, bool, ("is_save_binary", "is_save_binary_file"),
        "save dataset to binary file", group="io"),
     _p("header", False, bool, ("has_header",), "input data has header",

@@ -443,6 +443,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
         import sys as _sys
         _obs_stack.__exit__(*_sys.exc_info())
         guard.restore()
+        gb = booster._gbdt
+        if getattr(gb, "_pager", None) is not None:
+            rec = getattr(gb, "_telemetry", None)
+            if rec is not None:
+                # cumulative rollup: everything the run paged
+                rec.emit("pager", event="done", **gb._pager.stats())
     if booster.best_iteration <= 0:
         for item in (booster.eval_set() if booster._gbdt.metrics else []):
             booster.best_score.setdefault(item[0], {})[item[1]] = item[2]

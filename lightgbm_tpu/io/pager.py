@@ -1,0 +1,567 @@
+"""Device-block pager: out-of-core ON-DEVICE training.
+
+PR 15 (io/stream.py) moved the dataset bound from host RAM to disk,
+but every shard still holds its full binned row range in HBM — dataset
+scale is capped by ``chips x HBM``.  This module breaks that ceiling:
+with ``hbm_budget_mb`` / ``paged_training=on`` the (F, N) binned
+matrix never materializes on device.  Each shard's row range splits
+into fixed-size row pages served from the content-keyed cache (an
+mmap for streamed datasets, the in-memory binned array otherwise),
+and the per-iteration histogram pass becomes a page loop INSIDE the
+already-compiled training program:
+
+- :class:`PagedXt` is a trace-time stand-in passed where the device
+  ``xt`` operand used to go.  ``ops/grow.py``'s two ``xt`` consumers
+  (the histogram pass and the split-time column fetch) dispatch on it,
+  so ``build_tree_impl`` stays the single source of truth — the paged
+  lane is the SAME program with the matrix reads swapped for page
+  reads, which is what makes byte-parity a construction property
+  rather than a test-only one.
+- Page reads are ``jax.pure_callback``s (the fetch is pure and
+  deterministic): page ``p``'s bins arrive while the accumulated
+  histogram of pages ``< p`` is still in flight, and the host
+  prefetch thread preps page ``p+1`` under page ``p``'s device
+  compute — the PR 11/15 double-buffer overlap pointed at the
+  histogram pass.  Callbacks are not dispatches: the fused
+  K-iteration super-step keeps its 2-device-call budget at ANY page
+  count (pinned in tools/prof_superstep.py).
+- Histograms accumulate across pages with
+  :func:`..ops.histogram.histogram_segsum_into` — bit-identical to
+  the monolithic segment-sum because the per-bucket fold order (rows
+  ascending) is preserved by the page carry.
+- Under a device mesh each shard pages ONLY its local
+  ``(F_loc, n_loc)`` block: callbacks carry ``axis_index`` of the
+  row/feature axes, so the local fold is bit-equal to the resident
+  shard's and the strategy collectives above it are untouched.
+
+Residency contract (v1, documented in docs/Streaming.md): the paged
+object is the O(F·N) binned matrix — the HBM-dominant term at ~F
+bytes/row.  Per-row f32 training state (score carry, bagging masks,
+leaf ids; ~13-20 bytes/row) stays resident: the GOSS/MVS mask draws
+need global gradient statistics computed exactly as the resident path
+computes them, so paging that state would break the byte-parity
+contract this subsystem is built on.  Served pages write back to a
+bounded spill file (``pager.writeback`` / ``pager.evict`` fault
+points) so re-reads hit prepped bytes, not the source transform.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import tempfile
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..utils import faults as _faults
+from ..utils import telemetry as _telemetry
+from ..utils.log import Log
+
+__all__ = ["PagePlan", "plan_pages", "PageStore", "PagedXt"]
+
+
+@dataclass(frozen=True)
+class PagePlan:
+    """Static page geometry for ONE shard's local block."""
+    page_rows: int          # rows per page (last page may overhang)
+    n_pages: int            # pages per local row block
+    f_loc: int              # local feature rows of the paged matrix
+    n_loc: int              # local padded row count
+
+    @property
+    def page_bytes(self) -> int:
+        return self.f_loc * self.page_rows
+
+    def identity(self) -> Dict[str, int]:
+        """Checkpoint-manifest record (resume observability)."""
+        return {"page_rows": int(self.page_rows),
+                "n_pages": int(self.n_pages),
+                "f_loc": int(self.f_loc), "n_loc": int(self.n_loc)}
+
+
+def plan_pages(n_loc: int, f_loc: int, itemsize: int = 1,
+               hbm_budget_mb: float = 0.0, page_rows: int = 0,
+               min_pages: int = 2) -> PagePlan:
+    """Pick the page geometry for a local (f_loc, n_loc) block.
+
+    ``hbm_budget_mb`` bounds the PAGED matrix's device residency:
+    two page slots (the double buffer) plus the accumulating
+    histogram must fit, so ``page_rows <= budget / (2 * f_loc *
+    itemsize)``.  An explicit ``page_rows`` wins over the budget.
+    The row grid is kept on multiples of 8 where possible (sublane
+    granularity); the last page may overhang ``n_loc`` — overhang
+    rows are routed to a trash bucket in the accumulation step, so
+    they can never touch a real histogram cell.
+    """
+    n_loc, f_loc = int(n_loc), int(f_loc)
+    if page_rows > 0:
+        r = min(int(page_rows), n_loc)
+    elif hbm_budget_mb > 0:
+        budget = int(hbm_budget_mb * (1 << 20))
+        r = max(budget // max(2 * f_loc * itemsize, 1), 1)
+    else:
+        r = n_loc
+    r = min(max(r, 1), n_loc)
+    if r >= 8:
+        r -= r % 8
+    pages = -(-n_loc // r)
+    if pages < min_pages:
+        pages = min(min_pages, n_loc)
+        r = -(-n_loc // pages)
+        if r >= 8:
+            r += (-r) % 8
+        pages = -(-n_loc // r)
+    return PagePlan(page_rows=r, n_pages=pages, f_loc=f_loc,
+                    n_loc=n_loc)
+
+
+class PageStore:
+    """Host side of the pager: page prep, prefetch, spill, fencing.
+
+    ``binned`` is the ROW-MAJOR (n_rows, F) source — the streamed
+    cache mmap or the in-memory binned array.  A page
+    ``(fid, sid, pg)`` is the transposed, zero-padded
+    ``(f_loc, page_rows)`` block of device layout rows
+    ``[sid*n_loc + pg*R, +R)`` and feature rows
+    ``[fid*f_loc, +f_loc)``; ``transform`` (EFB bundling) is
+    row-independent and applied per page, exactly as the streamed
+    upload applies it per window.
+
+    A daemon prefetch thread preps the successor of every served page
+    (``overlap_s``: prep seconds hidden under device compute; a serve
+    that has to prep inline is a ``stall``).  Served pages persist in
+    a small LRU whose evictions write to an anonymous spill file —
+    re-reads hit prepped bytes (``spill_hits``) instead of re-running
+    the source read + transform.  ``abort`` participates in the
+    elastic fence (io/stream.py ``abort_active_fetchers``): prepped
+    and in-flight pages are dropped so a re-mesh can never consume a
+    page of the old geometry.
+    """
+
+    def __init__(self, binned, n_rows: int, n_pad: int, out_cols: int,
+                 plan: PagePlan, row_shards: int = 1,
+                 feat_shards: int = 1, transform=None, dtype=None,
+                 prefetch: bool = True,
+                 max_resident: Optional[int] = None,
+                 spill: bool = True, spill_dir: Optional[str] = None):
+        self.binned = binned
+        self.n_rows = int(n_rows)
+        self.n_pad = int(n_pad)
+        self.out_cols = int(out_cols)
+        self.plan = plan
+        self.row_shards = int(row_shards)
+        self.feat_shards = int(feat_shards)
+        self.transform = transform
+        self.dtype = np.dtype(dtype or binned.dtype)
+        self.prefetch = bool(prefetch)
+        # the device-side contract is two slots (active + prefetch) per
+        # (feature, row) shard stream; the host cache mirrors that so
+        # N streams hitting one store don't thrash each other out
+        if max_resident is None:
+            max_resident = 2 * self.row_shards * self.feat_shards + 2
+        self.max_resident = max(int(max_resident), 2)
+        self._lock = threading.Lock()
+        # the spill file is shared by the serve path and the prefetch
+        # worker; seek+read/write pairs must be atomic or a concurrent
+        # spill tears an unspill into the wrong slot's bytes
+        self._io_lock = threading.Lock()
+        self._abort = threading.Event()
+        self._resident: Dict[Any, np.ndarray] = {}   # insertion = LRU
+        self._inflight: Dict[Any, threading.Event] = {}
+        self._spill_file = None
+        self._spilled: Dict[Any, int] = {}
+        self._spill_slots = 0
+        if spill:
+            try:
+                self._spill_file = tempfile.TemporaryFile(
+                    dir=spill_dir if spill_dir and
+                    os.path.isdir(spill_dir) else None,
+                    prefix="ltpu_pager_")
+            except OSError:          # spill is an optimization only
+                self._spill_file = None
+        self._stats = {"pages": 0, "bytes": 0, "stalls": 0,
+                       "prefetch_hits": 0, "spill_hits": 0,
+                       "spills": 0, "evictions": 0, "columns": 0,
+                       "errors": 0, "prep_s": 0.0, "wait_s": 0.0}
+        # first serve-path failure: a pure_callback CANNOT raise
+        # usefully (the runtime logs it and the program continues on a
+        # garbage buffer), so serves return zeros, the error sticks
+        # here, and raise_if_poisoned() fails the iteration boundary
+        self._error: Optional[BaseException] = None
+        self._q: "queue.Queue" = queue.Queue()
+        self._worker = None
+        if self.prefetch:
+            self._worker = threading.Thread(
+                target=self._prefetch_loop, daemon=True,
+                name="ltpu-pager-prefetch")
+            self._worker.start()
+        from .stream import _ACTIVE_FETCHERS, _FETCHER_LOCK
+        with _FETCHER_LOCK:
+            _ACTIVE_FETCHERS.add(self)
+
+    # -- fencing -------------------------------------------------------
+    def abort(self) -> bool:
+        """Elastic fence: drop every prepped/in-flight page.  Unlike a
+        one-shot upload, the store stays SERVABLE — the re-meshed
+        program re-fetches from the source, so no stale-geometry page
+        can survive the fence.  True if anything was dropped."""
+        with self._lock:
+            live = bool(self._resident) or bool(self._inflight)
+            self._resident.clear()
+        with self._io_lock:
+            self._spilled.clear()
+            self._abort.set()
+        # unblock waiters parked on an in-flight prep
+        for ev in list(self._inflight.values()):
+            ev.set()
+        with self._lock:
+            self._inflight.clear()
+            self._abort.clear()
+            # the fence discards whatever block consumed the zero
+            # page, so the poison is resolved with it
+            self._error = None
+        return live
+
+    def close(self) -> None:
+        if self._worker is not None:
+            self._q.put(None)
+        if self._spill_file is not None:
+            try:
+                self._spill_file.close()
+            except OSError:
+                pass
+            self._spill_file = None
+
+    # -- page prep -----------------------------------------------------
+    def _prep(self, fid: int, sid: int, pg: int) -> np.ndarray:
+        mode = _faults.fire("pager.fetch")
+        if mode == "error":
+            raise OSError(f"injected fault (pager.fetch:error) at "
+                          f"page ({fid},{sid},{pg})")
+        if mode == "crash":
+            from ..utils.faults import InjectedFault
+            raise InjectedFault("pager.fetch:crash")
+        if mode.startswith("sleep_"):
+            time.sleep(float(mode[len("sleep_"):]) / 1e3)
+        p = self.plan
+        f_lo = fid * p.f_loc
+        r0 = sid * p.n_loc + pg * p.page_rows
+        out = np.zeros((p.f_loc, p.page_rows), dtype=self.dtype)
+        data_rows = max(0, min(r0 + p.page_rows, self.n_rows) - r0)
+        if data_rows > 0:
+            blk = np.asarray(self.binned[r0:r0 + data_rows])
+            if self.transform is not None:
+                blk = self.transform(blk)
+            blk_t = blk.T                       # (cols, data_rows)
+            cols = min(max(blk_t.shape[0] - f_lo, 0), p.f_loc)
+            if cols > 0:
+                out[:cols, :data_rows] = blk_t[f_lo:f_lo + cols]
+        return out
+
+    def _spill(self, key, page: np.ndarray) -> None:
+        if self._spill_file is None:
+            return
+        mode = _faults.fire("pager.writeback")
+        if mode == "error":
+            # a failed write-back only costs a later re-prep
+            Log.warning("pager: injected writeback fault; page %s "
+                        "dropped without spill", key)
+            return
+        if mode == "crash":
+            from ..utils.faults import InjectedFault
+            raise InjectedFault("pager.writeback:crash")
+        with self._io_lock:
+            slot = self._spilled.get(key)
+            if slot is None:
+                slot = self._spill_slots
+                self._spill_slots += 1
+            try:
+                self._spill_file.seek(slot * page.nbytes)
+                self._spill_file.write(page.tobytes())
+            except OSError:
+                return
+            self._spilled[key] = slot
+        self._stats["spills"] += 1
+
+    def _unspill(self, key) -> Optional[np.ndarray]:
+        if self._spill_file is None:
+            return None
+        p = self.plan
+        nbytes = p.f_loc * p.page_rows * self.dtype.itemsize
+        with self._io_lock:
+            slot = self._spilled.get(key)
+            if slot is None:
+                return None
+            try:
+                self._spill_file.seek(slot * nbytes)
+                raw = self._spill_file.read(nbytes)
+            except OSError:
+                return None
+        if len(raw) != nbytes:
+            return None
+        self._stats["spill_hits"] += 1
+        return np.frombuffer(raw, dtype=self.dtype).reshape(
+            p.f_loc, p.page_rows)
+
+    def _insert(self, key, page: np.ndarray) -> None:
+        evicted = []
+        with self._lock:
+            self._resident[key] = page
+            while len(self._resident) > self.max_resident:
+                old_key = next(iter(self._resident))
+                evicted.append((old_key, self._resident.pop(old_key)))
+        for old_key, old in evicted:
+            self._spill(old_key, old)
+            if _faults.fire("pager.evict") == "crash":
+                from ..utils.faults import InjectedFault
+                raise InjectedFault("pager.evict:crash")
+            self._stats["evictions"] += 1
+
+    def _obtain(self, key) -> np.ndarray:
+        """Resident -> spill -> source, preparing inline on a miss."""
+        with self._lock:
+            page = self._resident.get(key)
+            ev = self._inflight.get(key)
+        if page is not None:
+            return page
+        if ev is not None:
+            t0 = time.perf_counter()
+            ev.wait()
+            self._stats["wait_s"] += time.perf_counter() - t0
+            with self._lock:
+                page = self._resident.get(key)
+            if page is not None:
+                return page
+        page = self._unspill(key)
+        if page is None:
+            t0 = time.perf_counter()
+            page = self._prep(*key)
+            dt = time.perf_counter() - t0
+            self._stats["stalls"] += 1
+            self._stats["wait_s"] += dt
+        self._insert(key, page)
+        return page
+
+    # -- prefetch ------------------------------------------------------
+    def _prefetch_loop(self) -> None:
+        while True:
+            key = self._q.get()
+            if key is None:
+                return
+            with self._lock:
+                if key in self._resident or key in self._inflight:
+                    continue
+                ev = threading.Event()
+                self._inflight[key] = ev
+            try:
+                page = self._unspill(key)
+                if page is None:
+                    t0 = time.perf_counter()
+                    page = self._prep(*key)
+                    self._stats["prep_s"] += time.perf_counter() - t0
+                self._insert(key, page)
+            except BaseException as exc:  # noqa: BLE001 - surfaced on serve
+                Log.warning("pager prefetch of %s failed: %s", key, exc)
+            finally:
+                with self._lock:
+                    self._inflight.pop(key, None)
+                ev.set()
+
+    def _schedule(self, fid: int, sid: int, pg: int) -> None:
+        if self._worker is not None:
+            self._q.put((fid, sid, pg))
+
+    # -- the device-facing callbacks ----------------------------------
+    def _poison(self, exc: BaseException) -> None:
+        with self._lock:
+            if self._error is None:
+                self._error = exc
+        self._stats["errors"] += 1
+        Log.warning("pager: page serve failed (training state is "
+                    "poisoned until the next iteration boundary): %s",
+                    exc)
+
+    def raise_if_poisoned(self) -> None:
+        """Fail LOUDLY at a host boundary: a serve-path error already
+        fed zeros to the device program, so the in-flight block's
+        state is garbage — training must stop here, not publish it.
+        Sticky until :meth:`abort` rebuilds the fence."""
+        err = self._error
+        if err is not None:
+            raise RuntimeError(
+                f"pager: a page serve failed mid-block and the device "
+                f"program consumed a zero page — training state is "
+                f"poisoned: {err}") from err
+
+    def page_cb(self, fid, sid, pg) -> np.ndarray:
+        """pure_callback target: serve page ``pg`` of shard
+        ``(fid, sid)`` and prefetch its successor.  Serve errors
+        return a ZERO page and poison the store — the callback runtime
+        cannot propagate them (InjectedFault crash simulation still
+        raises through for the direct-call tests)."""
+        fid, sid, pg = int(fid), int(sid), int(pg)
+        key = (fid, sid, pg)
+        try:
+            page = self._obtain(key)
+        except _faults.InjectedFault:
+            raise
+        except Exception as exc:  # noqa: BLE001 - surfaced at boundary
+            self._poison(exc)
+            return np.zeros((self.plan.f_loc, self.plan.page_rows),
+                            dtype=self.dtype)
+        self._stats["pages"] += 1
+        self._stats["bytes"] += page.nbytes
+        nxt = (pg + 1) % self.plan.n_pages
+        if nxt != pg:
+            self._schedule(fid, sid, nxt)
+        return page
+
+    def column_cb(self, fid, sid, feat) -> np.ndarray:
+        """pure_callback target: one LOCAL feature row (n_loc,) for
+        split-time routing — assembled from the shard's pages so a
+        routing read never faults the whole matrix in."""
+        fid, sid = int(fid), int(sid)
+        p = self.plan
+        feat = min(max(int(feat), 0), p.f_loc - 1)   # XLA clamp rule
+        out = np.zeros(p.n_loc, dtype=self.dtype)
+        try:
+            for pg in range(p.n_pages):
+                page = self._obtain((fid, sid, pg))
+                lo = pg * p.page_rows
+                hi = min(lo + p.page_rows, p.n_loc)
+                out[lo:hi] = page[feat, :hi - lo]
+        except _faults.InjectedFault:
+            raise
+        except Exception as exc:  # noqa: BLE001 - surfaced at boundary
+            self._poison(exc)
+            return np.zeros(p.n_loc, dtype=self.dtype)
+        self._stats["columns"] += 1
+        return out
+
+    # -- telemetry -----------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        s = dict(self._stats)
+        s["page_rows"] = int(self.plan.page_rows)
+        s["n_pages"] = int(self.plan.n_pages)
+        s["overlap_s"] = round(s.pop("prep_s"), 6)
+        s["wait_s"] = round(s["wait_s"], 6)
+        return s
+
+    def stats_delta(self, last: Dict[str, Any]) -> Dict[str, Any]:
+        cur = self.stats()
+        out = {}
+        for k, v in cur.items():
+            if isinstance(v, (int, float)) and k in last and \
+                    k not in ("page_rows", "n_pages"):
+                out[k] = round(v - last[k], 6) \
+                    if isinstance(v, float) else v - last[k]
+            else:
+                out[k] = v
+        return out
+
+    def view(self, dist_kind: str = "serial", axis=None,
+             feat_axis=None) -> "PagedXt":
+        return PagedXt(self, dist_kind, axis, feat_axis)
+
+
+class PagedXt:
+    """Trace-time stand-in for the device ``xt`` operand.
+
+    Carries the shard-LOCAL static shape and the mesh axis names; its
+    two methods trace host callbacks into the surrounding program.
+    ``ops/grow.py`` dispatches on this type at its two ``xt``
+    consumers, so the paged lane shares every other op with the
+    resident one.
+    """
+
+    ndim = 2
+
+    def __init__(self, store: PageStore, dist_kind: str, axis,
+                 feat_axis):
+        self.store = store
+        self.dist_kind = dist_kind
+        self.axis = axis
+        self.feat_axis = feat_axis
+        self.dtype = store.dtype
+
+    @property
+    def shape(self):
+        return (self.store.plan.f_loc, self.store.plan.n_loc)
+
+    # row-shard / feature-shard ids of the CALLING program instance:
+    # traced axis indices under shard_map, constants in a serial jit
+    def _sid(self):
+        import jax
+        import jax.numpy as jnp
+        if self.dist_kind in ("data", "voting"):
+            return jax.lax.axis_index(self.axis)
+        if self.dist_kind == "data2d":
+            return jax.lax.axis_index(self.axis)
+        return jnp.int32(0)
+
+    def _fid(self):
+        import jax
+        import jax.numpy as jnp
+        if self.dist_kind == "feature":
+            return jax.lax.axis_index(self.axis)
+        if self.dist_kind == "data2d":
+            return jax.lax.axis_index(self.feat_axis)
+        return jnp.int32(0)
+
+    def _fetch_page(self, pg):
+        import jax
+        import jax.numpy as jnp
+        p = self.store.plan
+        return jax.pure_callback(
+            self.store.page_cb,
+            jax.ShapeDtypeStruct((p.f_loc, p.page_rows),
+                                 jnp.dtype(self.dtype)),
+            self._fid(), self._sid(), pg)
+
+    def hist(self, vals: "Any", max_bin: int):
+        """The paged histogram pass: fold the shard's pages into one
+        carried (f_loc, max_bin, 3) histogram — bit-identical to
+        ``histogram_segsum`` over the resident local block (see
+        ``histogram_segsum_into``).  Page ``pg``'s callback result is
+        consumed by iteration ``pg`` of a ``fori_loop``, so the
+        runtime overlaps page ``pg+1``'s host prep + transfer with
+        page ``pg``'s scatter-add; overhang rows of the last page
+        scatter into a trash bucket that is sliced off on exit."""
+        import jax
+        import jax.numpy as jnp
+        p = self.store.plan
+        R, Pg, n = p.page_rows, p.n_pages, p.n_loc
+        pad = R * Pg - n
+        vals_p = jnp.pad(vals, ((0, pad), (0, 0))) if pad else vals
+        trash = jnp.int32(max_bin)                   # one extra bucket
+        rows = jnp.arange(R, dtype=jnp.int32)
+
+        def body(pg, h):
+            from ..ops.histogram import histogram_segsum_into
+            page = self._fetch_page(pg).astype(jnp.int32)
+            valid = (pg * R + rows) < n
+            bins = jnp.where(valid[None, :], page, trash)
+            v = jax.lax.dynamic_slice_in_dim(vals_p, pg * R, R, axis=0)
+            return histogram_segsum_into(h, bins, v, max_bin + 1)
+
+        h0 = jnp.zeros((p.f_loc, max_bin + 1, 3), vals.dtype)
+        out = jax.lax.fori_loop(0, Pg, body, h0)
+        _telemetry.counters.incr("pager_hist_passes")
+        return out[:, :max_bin]
+
+    def column(self, feat):
+        """Split-time column fetch: the (n_loc,) local bins of ONE
+        feature row, assembled host-side from prepped pages.  Matches
+        ``jax.lax.dynamic_index_in_dim``'s clamp-out-of-range
+        semantics (the masked non-owner reads of the 2-D mesh rely on
+        the clamped value being well-defined, not meaningful)."""
+        import jax
+        import jax.numpy as jnp
+        p = self.store.plan
+        return jax.pure_callback(
+            self.store.column_cb,
+            jax.ShapeDtypeStruct((p.n_loc,), jnp.dtype(self.dtype)),
+            self._fid(), self._sid(), jnp.asarray(feat, jnp.int32))

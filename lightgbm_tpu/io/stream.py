@@ -774,6 +774,13 @@ def prune_cache_root(cache_root: str, keep_keys: Sequence[str] = (),
 _ACTIVE_FETCHERS: "weakref.WeakSet[BlockFetcher]" = weakref.WeakSet()
 _FETCHER_LOCK = threading.Lock()
 
+# test hook (tests/test_stream.py): when set, upload() records the
+# accumulator's buffer pointer after every window write — pinning that
+# donation keeps the slot count CONSTANT (no per-window allocation
+# growth).  Reading the pointer synchronizes, so it's never on by
+# default.
+_TRACK_SLOT_PTRS = False
+
 
 def abort_active_fetchers() -> int:
     """The elastic abort fence, extended to in-flight host->device
@@ -871,24 +878,55 @@ class BlockFetcher:
                 time.sleep(sleep_s)
 
     # -- the upload ----------------------------------------------------
-    def upload(self, dtype=None):
+    def upload(self, dtype=None, sharding=None, donate=None):
+        """Stream the matrix to device in budgeted windows.
+
+        ``sharding`` (a NamedSharding) places the accumulating buffer
+        — and every window write — directly in the tree learner's
+        layout (1-D ``P(None, "shard")`` rows, or the data2d
+        ``P("feature", "data")`` tiles).  Without it the full
+        ``(out_cols, n_pad)`` matrix materializes on ONE device and
+        gets re-sharded afterwards, which is exactly the residency
+        spike the windowed upload exists to avoid."""
         import jax
         import jax.numpy as jnp
 
         dtype = dtype or self.binned.dtype
         starts = list(range(0, self.n_pad, self.window_rows))
         t_all0 = time.perf_counter()
-        donate = jax.default_backend() not in ("cpu",)
+        # donation lets XLA write every window into the SAME
+        # accumulator allocation (two live slots total: the buffer +
+        # the in-flight window) instead of growing one allocation per
+        # window; default off on CPU where the copy is cheap, and
+        # overridable so the slot-reuse contract is testable there
+        if donate is None:
+            donate = jax.default_backend() not in ("cpu",)
 
         def _write(buf, win, s):
             return jax.lax.dynamic_update_slice(buf, win, (0, s))
 
-        write = jax.jit(_write, donate_argnums=(0,) if donate else ())
-        buf = jnp.zeros((self.out_cols, self.n_pad), dtype=dtype)
+        write = jax.jit(
+            _write, donate_argnums=(0,) if donate else (),
+            **({"out_shardings": sharding}
+               if sharding is not None else {}))
+        if sharding is not None:
+            buf = jnp.zeros((self.out_cols, self.n_pad), dtype=dtype,
+                            device=sharding)
+        else:
+            buf = jnp.zeros((self.out_cols, self.n_pad), dtype=dtype)
 
         prep_s = [0.0]
         wait_s = 0.0
         bytes_moved = 0
+        slot_ptrs: list = []
+
+        def _pin(b):
+            # blocks until the write lands — test-hook only
+            try:
+                slot_ptrs.append(b.unsafe_buffer_pointer())
+            except Exception:  # noqa: BLE001 — sharded array
+                slot_ptrs.append(
+                    b.addressable_shards[0].data.unsafe_buffer_pointer())
 
         if self.prefetch and len(starts) > 1:
             q: "queue.Queue" = queue.Queue(maxsize=1)
@@ -926,6 +964,8 @@ class BlockFetcher:
                     dev = jax.device_put(win)
                     buf = write(buf, dev, jnp.int32(s))
                     bytes_moved += win.nbytes
+                    if _TRACK_SLOT_PTRS:
+                        _pin(buf)
                 th.join(timeout=5.0)
             finally:
                 # an early consumer exit (abort fence, prep error)
@@ -954,6 +994,8 @@ class BlockFetcher:
                 dev = jax.device_put(win)
                 buf = write(buf, dev, jnp.int32(s))
                 bytes_moved += win.nbytes
+                if _TRACK_SLOT_PTRS:
+                    _pin(buf)
         if self._abort.is_set():
             raise StreamAborted("host->device stream fenced off")
         overlap = max(prep_s[0] - wait_s, 0.0) if self.prefetch else 0.0
@@ -966,6 +1008,8 @@ class BlockFetcher:
             "prep_s": round(prep_s[0], 6),
             "duration_ms": round(
                 (time.perf_counter() - t_all0) * 1e3, 3)}
+        if slot_ptrs:
+            self._stats["slot_unique_ptrs"] = len(set(slot_ptrs))
         _telemetry.counters.incr("ingest_prefetch_windows",
                                  len(starts))
         return buf

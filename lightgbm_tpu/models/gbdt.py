@@ -509,6 +509,86 @@ class GBDT:
                            use_pool and not forced)
                        else 0))
 
+        # ---- device-block pager (io/pager.py, docs/Streaming.md
+        # "Out-of-core on device"): decide whether the binned matrix
+        # trains RESIDENT or PAGED.  Auto triggers when ONE device's
+        # matrix block would exceed hbm_budget_mb; "on" forces paging
+        # and fails loudly on a paged-ineligible config instead of
+        # silently training resident over budget ----
+        old_pager = getattr(self, "_pager", None)
+        if old_pager is not None:       # remesh re-runs __init__
+            old_pager.abort()
+            old_pager.close()
+        self._pager = None
+        self._pager_view = None
+        self._pager_last = None
+        paged_req = str(getattr(config, "paged_training", "auto")
+                        or "auto").lower()
+        hbm_budget = float(getattr(config, "hbm_budget_mb", 0.0) or 0.0)
+        pg_out_cols = self._bundles.num_groups \
+            if self._bundles is not None else self._F_pad
+        pg_kind = learner if dist_active else "serial"
+        pg_row_shards = (mesh_shape2d[0] if pg_kind == "data2d" else
+                         num_shards if pg_kind in ("data", "voting")
+                         else 1)
+        pg_feat_shards = (mesh_shape2d[1] if pg_kind == "data2d" else
+                          num_shards if pg_kind == "feature" else 1)
+        if self._bundles is not None:
+            pg_dtype = self._bundles.bundle_matrix(
+                np.asarray(train_set.binned[:1])).dtype
+        else:
+            pg_dtype = train_set.binned.dtype
+        pg_f_loc = pg_out_cols // max(pg_feat_shards, 1)
+        pg_n_loc = self._n_pad // max(pg_row_shards, 1)
+        per_dev_bytes = pg_f_loc * pg_n_loc * np.dtype(pg_dtype).itemsize
+        want_paged = paged_req == "on" or (
+            paged_req == "auto" and hbm_budget > 0 and
+            per_dev_bytes > hbm_budget * (1 << 20))
+        if want_paged:
+            gp = self.grow_params
+            if gp.hist_impl != "segsum":
+                pg_gate = ("hist_impl=pallas — the on-chip histogram "
+                           "tiers read the resident matrix")
+            elif gp.wave or gp.speculate > 1:
+                pg_gate = ("wave/speculative growth batches "
+                           "multi-leaf passes over the resident matrix")
+            elif split_kernel == "pallas":
+                pg_gate = "split_kernel=pallas reads resident tiles"
+            else:
+                pg_gate = None
+            if pg_gate is not None:
+                if paged_req == "on":
+                    raise ValueError(
+                        f"paged_training=on, but this config is "
+                        f"paged-ineligible: {pg_gate}.  Paged "
+                        f"training runs the baseline segsum+xla lane "
+                        f"(docs/Streaming.md)")
+                Log.warning("paged_training=auto: %s; training "
+                            "resident", pg_gate)
+                want_paged = False
+        if want_paged:
+            from ..io.pager import PageStore, plan_pages
+            pg_plan = plan_pages(
+                pg_n_loc, pg_f_loc, np.dtype(pg_dtype).itemsize,
+                hbm_budget_mb=hbm_budget,
+                page_rows=int(getattr(config, "paged_page_rows", 0)
+                              or 0))
+            self._pager = PageStore(
+                train_set.binned, n_rows=n, n_pad=self._n_pad,
+                out_cols=pg_out_cols, plan=pg_plan,
+                row_shards=pg_row_shards, feat_shards=pg_feat_shards,
+                transform=(self._bundles.bundle_matrix
+                           if self._bundles is not None else None),
+                dtype=pg_dtype,
+                prefetch=bool(getattr(config, "stream_prefetch",
+                                      True)))
+            Log.info("paged training: %d pages x %d rows per device "
+                     "block (%.1f MB resident vs %.1f MB paged "
+                     "double-buffer)", pg_plan.n_pages,
+                     pg_plan.page_rows, per_dev_bytes / 1e6,
+                     2 * pg_plan.page_bytes *
+                     np.dtype(pg_dtype).itemsize / 1e6)
+
         # parallel tree learner over the device mesh
         # (tree_learner={data,feature,voting}, tree_learner.cpp:9-33)
         self._dist = None
@@ -516,7 +596,9 @@ class GBDT:
             from ..parallel import DistributedBuilder
             self._dist = DistributedBuilder(
                 learner, self.grow_params, num_shards, mesh,
-                mesh_shape=mesh_shape2d)
+                mesh_shape=mesh_shape2d, pager=self._pager)
+            if self._pager is not None:
+                self._pager_view = self._dist.pager_view
             if learner == "data2d":
                 Log.info("tree_learner=data2d over a %dx%d "
                          "(data x feature) device mesh",
@@ -526,7 +608,18 @@ class GBDT:
                          learner, num_shards)
         self._stream_upload = None
         stream_info = getattr(train_set, "stream", None)
-        if stream_info is not None:
+        if self._pager is not None:
+            # paged lane: the binned matrix NEVER materializes on
+            # device.  Dispatch signatures keep a replicated dummy
+            # operand in the xt slot (shapes/specs stay uniform) and
+            # the traced programs read pages through the PagedXt
+            # view — the streamed cache mmap and the in-memory binned
+            # array are served by the same PageStore, so no upload
+            # window or host-side transpose happens at all
+            self._xt = jnp.zeros((1, 8), dtype=pg_dtype)
+            if self._pager_view is None:
+                self._pager_view = self._pager.view("serial")
+        elif stream_info is not None:
             # streamed dataset (io/stream.py): the binned matrix is a
             # read-only mmap over the crash-safe cache — upload it in
             # budgeted double-buffered windows instead of
@@ -549,7 +642,12 @@ class GBDT:
                 backoff_base_s=float(getattr(config,
                                              "stream_backoff_base_s",
                                              0.1)))
-            self._xt = fetcher.upload()
+            # windows land directly in the learner's layout (data2d:
+            # the P("feature", "data") tiles) — no single-device
+            # staging copy, no re-shard afterwards
+            self._xt = fetcher.upload(
+                sharding=(self._dist.shardings()["xt"]
+                          if self._dist is not None else None))
             self._stream_upload = fetcher.stats()
         else:
             if self._bundles is not None:
@@ -582,13 +680,32 @@ class GBDT:
             # host-placed global arrays on every call (the per-shard
             # dispatch overhead behind the WEAKSCALE degradation)
             shd = self._dist.shardings()
-            self._xt = jax.device_put(self._xt, shd["xt"])
+            if self._pager is None and stream_info is None:
+                # streamed uploads were already placed window-by-window
+                self._xt = jax.device_put(self._xt, shd["xt"])
             self._base_mask = jax.device_put(self._base_mask, shd["row"])
             self._num_bins = jax.device_put(self._num_bins, shd["feat"])
             self._missing_type = jax.device_put(self._missing_type,
                                                 shd["feat"])
             self._is_cat = jax.device_put(self._is_cat, shd["feat"])
         self._build_tree = build_tree if self._dist is None else self._dist
+        if self._pager is not None and self._dist is None:
+            # serial paged per-tree dispatch: the jitted builder closes
+            # over the PagedXt view (a trace-time object, not a pytree
+            # leaf) and ignores the dummy xt operand — same signature
+            # as build_tree, so the dispatch sites stay untouched
+            import functools as _ft
+            from ..ops.grow import build_tree_impl as _bt_impl
+            view = self._pager_view
+
+            def _paged_build(xt, grad, hess, mask, fmask, nb, mt, cat,
+                             params, bundle_maps=None, quant_key=None):
+                return _bt_impl(view, grad, hess, mask, fmask, nb, mt,
+                                cat, params, bundle_maps=bundle_maps,
+                                quant_key=quant_key)
+
+            self._build_tree = _ft.partial(
+                jax.jit, static_argnames=("params",))(_paged_build)
 
         # scores: (num_tree_per_iteration, N) device
         k = self.num_tree_per_iteration
@@ -1152,9 +1269,18 @@ class GBDT:
             ax = dist.params.dist.axis
             n_loc = n_pad // dist.row_shards
 
+        pager_view = getattr(self, "_pager_view", None)
+
         def superstep(score, bag0, lr, quant_key, xt, base_mask,
                       num_bins, missing_type, is_cat, iters, fmasks,
                       tree_ids, *extras):
+            if pager_view is not None:
+                # paged lane: the xt operand is a replicated dummy —
+                # the scan reads the matrix through page callbacks
+                # (trace-time swap; the scan body is otherwise
+                # IDENTICAL to the resident one, which is what makes
+                # paged-vs-resident byte-parity structural)
+                xt = pager_view
             if batched:
                 wvec, bag_key = extras
                 saved_key = self._bag_key
@@ -1329,6 +1455,12 @@ class GBDT:
             # stitches the global (K, n_pad) table with no collective
             # (the host-side rewind replay is its only reader)
             li_spec = P(None, ax_name) if rows_sharded else R
+            if self._pager is not None:
+                # paged: the xt slot carries a replicated dummy; each
+                # program instance pages its OWN (f_loc, n_loc) block
+                # via axis-indexed callbacks instead of receiving a
+                # sharded operand
+                in_specs = in_specs[:4] + (R,) + in_specs[5:]
             superstep = shard_map_compat(superstep, dist.mesh,
                                          in_specs=in_specs,
                                          out_specs=(R, R, R, R, R,
@@ -1858,6 +1990,22 @@ class GBDT:
                 "cache_dir": info.cache_dir,
                 "chunk_rows": int(info.chunk_rows)}
 
+    def pager_identity(self) -> Optional[Dict]:
+        """The device-block pager geometry this booster trains under,
+        or None (fully resident).  Checkpoint manifests record it so a
+        resume knows the run was out-of-core; paged results are
+        byte-identical to resident, so a geometry CHANGE on resume
+        (different budget, different mesh) is legal — the record is
+        provenance, not a constraint (docs/Streaming.md)."""
+        if self._pager is None:
+            return None
+        ident = dict(self._pager.plan.identity())
+        ident["mode"] = str(getattr(self.config, "paged_training",
+                                    "auto")).lower()
+        ident["hbm_budget_mb"] = float(
+            getattr(self.config, "hbm_budget_mb", 0.0))
+        return ident
+
     def mesh_identity(self) -> Dict:
         """The live mesh topology — recorded in checkpoint manifests
         (``ckpt/manager.py``) so resume can validate it against the
@@ -2168,6 +2316,8 @@ class GBDT:
         rec = getattr(self, "_telemetry", None)
         if rec is None:
             stop = self._train_one_iter_impl(grad, hess)
+            if self._pager is not None:
+                self._pager.raise_if_poisoned()
             # clear the superstep markers: a recorder attached later
             # must not mis-emit a stale block
             self.__dict__.pop("_tele_superstep", None)
@@ -2179,6 +2329,8 @@ class GBDT:
         ph0 = profiling.snapshot()
         t0 = _time.perf_counter()
         stop = self._train_one_iter_impl(grad, hess)
+        if self._pager is not None:
+            self._pager.raise_if_poisoned()
         dur_ms = (_time.perf_counter() - t0) * 1e3
         ss = self.__dict__.pop("_tele_superstep", None)
         if ss is not None:
@@ -2229,6 +2381,7 @@ class GBDT:
                 if key in ss:
                     fields[key] = ss[key]
             rec.emit("superstep", **fields)
+            self._emit_pager_flush(rec, it)
             return stop
         if self.__dict__.pop("_tele_serving", False):
             # serving a tree from an already-recorded super-step block
@@ -2284,7 +2437,20 @@ class GBDT:
                         k: int(v["bytes"] * hp)
                         for k, v in self._collective_per_axis.items()}
         rec.emit("iteration", **fields)
+        self._emit_pager_flush(rec, it)
         return stop
+
+    def _emit_pager_flush(self, rec, it: int) -> None:
+        """One pager record per telemetry-visible training step: the
+        DELTA of the PageStore's cumulative stats since the last
+        flush (pages/bytes/overlap_s/stalls — the series the
+        pager_no_overlap rule reads)."""
+        if self._pager is None or rec is None:
+            return
+        delta = self._pager.stats_delta(self._pager_last or {})
+        self._pager_last = self._pager.stats()
+        if delta.get("pages", 0) or delta.get("columns", 0):
+            rec.emit("pager", event="flush", iter=int(it), **delta)
 
     def _train_one_iter_impl(self, grad: Optional[np.ndarray] = None,
                              hess: Optional[np.ndarray] = None) -> bool:

@@ -168,6 +168,13 @@ class OnlineScanner:
         self._ing_overlap_s = 0.0
         self._ing_quarantines = 0
         self._ing_resume_miss: Optional[Dict[str, Any]] = None
+        # device-block pager rollups (io/pager.py): like the streamed
+        # ingest rule, prefetch overlap is judged once enough pages
+        # have been served — paging with no measured overlap means the
+        # page loop is fully serialized behind host fetches
+        self._pg_flushes = 0
+        self._pg_pages = 0
+        self._pg_overlap_s = 0.0
         # SLO rollups (obs/slo.py): worst observed state per objective
         # plus the autoscaler's response, so the triage summary can say
         # "the budget burned AND the controller did/didn't react"
@@ -459,6 +466,24 @@ class OnlineScanner:
                         f"serializing behind the device copies "
                         f"(stream_host_budget_mb too small? prefetch "
                         f"thread starved?)"))
+        elif rtype == "pager":
+            if r.get("event") == "flush":
+                self._pg_flushes += 1
+                self._pg_pages += int(r.get("pages", 0))
+                self._pg_overlap_s += float(r.get("overlap_s", 0.0))
+                if ("pager_no_overlap" not in self._fired and
+                        self._pg_pages >= 16 and
+                        self._pg_overlap_s < 1e-5):
+                    self._fired.add("pager_no_overlap")
+                    out.append((
+                        "MED", "pager_no_overlap",
+                        f"device-block pager served {self._pg_pages} "
+                        f"pages with prefetch overlap ~0 — page prep "
+                        f"is serializing behind the histogram passes "
+                        f"(prefetch thread disabled or starved, or "
+                        f"hbm_budget_mb so small every page misses) — "
+                        f"paging is costing full fetch latency per "
+                        f"page"))
         elif rtype == "checkpoint" and r.get("event") == "fallback":
             out.append((
                 "HIGH", "ckpt_fallback",
@@ -535,6 +560,13 @@ class OnlineScanner:
                                f"enabled — the window prep cost is "
                                f"fully serialized again (mirrors the "
                                f"pipelining-disabled rule)"))
+        if self._pg_flushes and self._pg_pages >= 16 and \
+                self._pg_overlap_s < 1e-5:
+            out.append(("MED", f"device-block pager overlap ~0 across "
+                               f"{self._pg_pages} served pages — the "
+                               f"out-of-core page loop ran with fetch "
+                               f"latency fully exposed (no prefetch "
+                               f"overlap was ever measured)"))
         if self._ws_bad is not None:
             r0, b0, r1, b1, ff = self._ws_bad
             out.append(("MED", f"2-D weak-scaling per-axis anomaly: "

@@ -59,6 +59,7 @@ from .histogram import (histogram_pallas, histogram_pallas_multi,
                         histogram_segsum_multi_win,
                         histogram_segsum_multi_win_lanes,
                         routed_chunk_ok)
+from ..io.pager import PagedXt
 from .split import (NEG_INF, SplitParams, choose_window,
                     eval_forced_split, find_best_split,
                     find_best_split_c2f, find_best_split_pallas,
@@ -275,6 +276,10 @@ def collective_bytes_per_pass(params: GrowParams, num_features: int,
 
 
 def _hist(xt, vals, p: GrowParams):
+    if isinstance(xt, PagedXt):
+        # paged lane: the SAME accumulation as histogram_segsum, as a
+        # page loop (bit-identical fold — see PagedXt.hist)
+        return xt.hist(vals, p.split.max_bin)
     if p.hist_impl == "pallas":
         return histogram_pallas(xt, vals, p.split.max_bin, p.rows_per_block,
                                 exact=p.quantize > 0)
@@ -576,6 +581,18 @@ def build_tree_impl(xt: jax.Array, grad: jax.Array, hess: jax.Array,
     # The driver (models/gbdt.py) gates eligibility and records why a
     # config fell back; the asserts here are the backstop for direct
     # build_tree users.
+    paged = isinstance(xt, PagedXt)
+    if paged:
+        # driver-gated (models/gbdt.py _paged_eligibility); backstop
+        # for direct build_tree users.  The paged lane IS the baseline
+        # segsum+xla lane with the matrix reads swapped for page
+        # callbacks — the accelerated tiers read xt in access patterns
+        # a page stream cannot serve.
+        assert p.hist_impl == "segsum" and not p.wave \
+            and p.speculate <= 1 and p.split_kernel == "xla", \
+            "paged training requires the baseline lane: " \
+            "hist_impl=segsum, no wave growth, speculate<=1, " \
+            "split_kernel=xla (driver-gated)"
     use_split_pallas = p.split_kernel == "pallas"
     if use_split_pallas:
         assert kind == "serial" and not sp.any_cat and not p.bundled \
@@ -847,8 +864,9 @@ def build_tree_impl(xt: jax.Array, grad: jax.Array, hess: jax.Array,
                                              keepdims=False)
             fb = jax.lax.dynamic_index_in_dim(bm_from, feat, axis=0,
                                               keepdims=False)  # (B,)
-            col = jax.lax.dynamic_index_in_dim(xt, g, axis=0,
-                                               keepdims=False)
+            col = xt.column(g) if paged else \
+                jax.lax.dynamic_index_in_dim(xt, g, axis=0,
+                                             keepdims=False)
             bundle_mask = jnp.take(left_mask_row, fb)
             return mask_lookup(bundle_mask, col)
         if kind in ("feature", "data2d"):
@@ -858,14 +876,17 @@ def build_tree_impl(xt: jax.Array, grad: jax.Array, hess: jax.Array,
             # for data2d (rows already sharded over the row axis)
             local_f = feat - f_offset
             owner = (local_f >= 0) & (local_f < F)
-            col = jax.lax.dynamic_index_in_dim(
-                xt, jnp.clip(local_f, 0, F - 1), axis=0, keepdims=False)
+            clamped = jnp.clip(local_f, 0, F - 1)
+            col = xt.column(clamped) if paged else \
+                jax.lax.dynamic_index_in_dim(xt, clamped, axis=0,
+                                             keepdims=False)
             cand = mask_lookup(left_mask_row, col)
             route_ax = fax if kind == "data2d" else ax
             return jax.lax.psum(
                 jnp.where(owner, cand.astype(jnp.float32), 0.0),
                 route_ax) > 0.5
-        col = jax.lax.dynamic_index_in_dim(xt, feat, axis=0, keepdims=False)
+        col = xt.column(feat) if paged else \
+            jax.lax.dynamic_index_in_dim(xt, feat, axis=0, keepdims=False)
         return mask_lookup(left_mask_row, col)
 
     # ---- init: root ------------------------------------------------

@@ -44,7 +44,8 @@ from ..utils.env import pallas_interpret
 from .split import (_PART_LANES, finish_split_partials,
                     split_epilogue_rows, split_scan_descriptors)
 
-__all__ = ["histogram", "histogram_segsum", "histogram_pallas",
+__all__ = ["histogram", "histogram_segsum", "histogram_segsum_into",
+           "histogram_pallas",
            "histogram_segsum_multi", "histogram_pallas_multi",
            "histogram_segsum_multi_win", "histogram_pallas_multi_win",
            "multi_width"]
@@ -69,6 +70,29 @@ def histogram_segsum(bins_t: jax.Array, vals: jax.Array, max_bin: int
     flat = jax.ops.segment_sum(
         jnp.broadcast_to(vals[None, :, :], (f, n, 3)).reshape(-1, 3),
         ids.reshape(-1), num_segments=f * max_bin)
+    return flat.reshape(f, max_bin, 3)
+
+
+def histogram_segsum_into(h: jax.Array, bins_t: jax.Array,
+                          vals: jax.Array, max_bin: int) -> jax.Array:
+    """Accumulate one ROW PAGE into a carried (F, B, 3) histogram.
+
+    The out-of-core pager (io/pager.py) folds a shard's row range one
+    fixed-size page at a time; this op is its accumulation step.  It
+    is BIT-identical to one :func:`histogram_segsum` over the
+    concatenated pages: a scatter-add visits each (feature, bin)
+    bucket's rows in ascending row order — the same per-bucket fold
+    order ``jax.ops.segment_sum`` uses — so carrying ``h`` across
+    contiguous pages in page order reproduces the monolithic sum
+    add-for-add.  (Summing independent per-page partial histograms
+    does NOT have this property: it reassociates the per-bucket fold
+    and drifts in the last ulp.)
+    """
+    f, n = bins_t.shape
+    ids = bins_t.astype(jnp.int32) + \
+        jnp.arange(f, dtype=jnp.int32)[:, None] * max_bin
+    upd = jnp.broadcast_to(vals[None, :, :], (f, n, 3)).reshape(-1, 3)
+    flat = h.reshape(f * max_bin, 3).at[ids.reshape(-1)].add(upd)
     return flat.reshape(f, max_bin, 3)
 
 

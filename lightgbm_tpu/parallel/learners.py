@@ -171,7 +171,7 @@ class DistributedBuilder:
     """
 
     def __init__(self, kind: str, params: GrowParams, num_shards: int,
-                 mesh=None, mesh_shape=None):
+                 mesh=None, mesh_shape=None, pager=None):
         import jax
         from jax.sharding import PartitionSpec as P
 
@@ -269,7 +269,26 @@ class DistributedBuilder:
             out_specs["leaf_stats_exact"] = R
         out_specs["leaf_idx"] = leaf_idx_spec
 
+        # device-block pager (io/pager.py): the per-tree dispatch
+        # substitutes the PagedXt view for the sharded xt operand —
+        # the slot keeps a replicated dummy so the call signature
+        # stays build_tree's, and each program instance pages its own
+        # (f_loc, n_loc) block through axis-indexed callbacks
+        self.pager_view = pager.view(kind, axis, feat_axis) \
+            if pager is not None else None
+        view = self.pager_view
+        if view is not None:
+            xt_spec = R
+
         def fn(xt, grad, hess, mask, fmask, nb, mt, cat, qk):
+            if view is not None:
+                # trace-time operand swap; build_tree_impl runs
+                # un-jitted here because the whole shard_map is
+                # already under jit and PagedXt is not a pytree leaf
+                from ..ops.grow import build_tree_impl
+                return build_tree_impl(view, grad, hess, mask, fmask,
+                                       nb, mt, cat, self.params,
+                                       quant_key=qk)
             return build_tree(xt, grad, hess, mask, fmask, nb, mt, cat,
                               self.params, quant_key=qk)
         sharded = shard_map_compat(

@@ -109,6 +109,24 @@ point                  modes its call site interprets
                        upload that never finishes); ``sleep_<ms>`` —
                        added latency (widens the overlap window the
                        telemetry measures)
+``pager.fetch``        fired once per page prep of the device-block
+                       pager (``io/pager.py``, serve path and
+                       prefetch thread alike): ``error`` — the prep
+                       raises ``OSError`` (surfaces through the
+                       training callback — paged training fails
+                       loudly, never silently drops a page);
+                       ``crash`` — die mid-page-stream (the SIGKILL
+                       shape: resume from the last checkpoint must be
+                       byte-identical); ``sleep_<ms>`` — added prep
+                       latency (widens/starves the prefetch overlap
+                       the pager telemetry measures)
+``pager.writeback``    fired once per page spill write (LRU eviction
+                       to the pager's spill file): ``error`` — the
+                       write-back is dropped (the page re-preps from
+                       source later; costs time, never bytes);
+                       ``crash`` — die mid-write-back
+``pager.evict``        fired once per resident-page eviction:
+                       ``crash`` — die at the eviction boundary
 ``slo.scrape``         fired once per SLO engine tick
                        (``obs/slo.py``): ``error`` — every objective
                        source scrape raises; the tick degrades to
@@ -173,6 +191,7 @@ KNOWN_POINTS = frozenset({
     "mesh.heartbeat", "elastic.remesh", "router.backend",
     "router.admit", "stream.chunk_read", "stream.cache_write",
     "stream.prefetch", "slo.scrape", "autoscale.decide",
+    "pager.fetch", "pager.writeback", "pager.evict",
 })
 
 

@@ -76,7 +76,7 @@ SCHEMA_VERSION = 1
 RECORD_TYPES = ("run_start", "iteration", "superstep", "eval", "predict",
                 "serve", "checkpoint", "fleet", "continual", "recovery",
                 "router", "ingest", "span", "capture", "sweep", "slo",
-                "autoscale", "run_end")
+                "autoscale", "pager", "run_end")
 
 # per-type required fields on top of the common envelope; values are
 # (field, type-or-types) pairs the lint enforces
@@ -198,6 +198,16 @@ _TYPE_FIELDS: Dict[str, Tuple[Tuple[str, Any], ...]] = {
     # recorded cache identity with the live dataset's: cache_hit=false
     # means a re-bin the manifest should have prevented — MED).
     "ingest": (("event", str),),
+    # one record per device-block pager flush (io/pager.py via
+    # models/gbdt.py): ``event`` is flush (per-iteration/per-block
+    # DELTA stats: pages served, bytes paged, overlap_s of prep
+    # hidden on the prefetch thread, wait_s the device program
+    # blocked in callbacks, stalls = serve-path inline preps, spills/
+    # evictions/spill_hits of the host spill cache, page_rows/
+    # n_pages geometry) | done (cumulative rollup at train end).
+    # obs/rules.py flags paging active with ~zero prefetch overlap
+    # as MED (pager_no_overlap).
+    "pager": (("event", str),),
     # one record per closed trace span (obs/spans.py): ``trace_id``
     # joins spans (and trace-tagged records of every other type)
     # emitted by ANY process into one timeline — the continual
@@ -703,6 +713,19 @@ class RunRecorder:
             elif event == "resume" and not rec.get("cache_hit", True):
                 self._agg["ingest_resume_misses"] = \
                     self._agg.get("ingest_resume_misses", 0) + 1
+        elif t == "pager":
+            if rec.get("event") == "flush":
+                for field, key in (("pages", "pager_pages"),
+                                   ("bytes", "pager_bytes"),
+                                   ("stalls", "pager_stalls")):
+                    self._agg[key] = self._agg.get(key, 0) + \
+                        int(rec.get(field, 0))
+                self._agg["pager_overlap_s"] = round(
+                    self._agg.get("pager_overlap_s", 0.0) +
+                    float(rec.get("overlap_s", 0.0)), 6)
+                self._agg["pager_wait_s"] = round(
+                    self._agg.get("pager_wait_s", 0.0) +
+                    float(rec.get("wait_s", 0.0)), 6)
         elif t == "recovery":
             key = {
                 "detect": "recovery_detects",

@@ -1,0 +1,415 @@
+"""Device-block pager (io/pager.py): out-of-core on-device training.
+
+Pins the subsystem contract from docs/Streaming.md "Out-of-core on
+device":
+
+- BYTE-PARITY: paged training produces byte-identical model strings to
+  resident training across sampling {none, bagging, goss, mvs} x
+  fused_iters {1, 4} x tree_learner {serial, data, data2d}, with the
+  page geometry forcing >= 3 pages per shard on the CPU lane.
+- plan_pages geometry: explicit page_rows wins, budget-derived rows
+  honour the double-buffer bound, min_pages fallback, 8-row grid.
+- PageStore host semantics: page contents match the source block,
+  spill round-trips are byte-exact, abort() drops state but stays
+  servable (elastic fence), pager.fetch faults surface loudly.
+- Eligibility: paged_training=on + a paged-ineligible config raises;
+  auto only pages when one device's block exceeds hbm_budget_mb.
+- Telemetry: paged runs emit per-iteration ``pager`` flush deltas, a
+  cumulative done record, run_end aggregation, and the
+  ``pager_no_overlap`` MED rule fires on overlap ~0.
+- Checkpoint provenance: pager_identity() lands in the manifest.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io.pager import PagePlan, PageStore, PagedXt, plan_pages
+from lightgbm_tpu.utils import faults
+from lightgbm_tpu.utils import telemetry
+
+N_ROWS, N_FEAT = 601, 12
+
+BASE = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+        "metric": "None", "num_iterations": 6, "enable_bundle": False}
+
+# page_rows=24 on the 8-shard data learner gives n_loc=76 -> 4 pages
+# per shard; serial n_loc=608 -> 26 pages (both >= the 3-page floor
+# the acceptance matrix asks for)
+PAGED = {"paged_training": "on", "paged_page_rows": 24}
+
+SAMPLING = {"none": {},
+            "bagging": {"bagging_fraction": 0.7, "bagging_freq": 1},
+            "goss": {"boosting": "goss"},
+            "mvs": {"boosting": "mvs"}}
+
+LEARNERS = {"serial": {},
+            "data": {"tree_learner": "data"},
+            "data2d": {"tree_learner": "data2d", "mesh_shape": "4x2"}}
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(7)
+    X = rng.randn(N_ROWS, N_FEAT)
+    w = rng.randn(N_FEAT)
+    y = (1.0 / (1.0 + np.exp(-(X @ w) * 0.5))
+         > rng.random_sample(N_ROWS)).astype(np.float32)
+    return X, y
+
+
+_MODEL_CACHE = {}
+
+
+def _model(data, extra):
+    """Train and cache by param set — the resident references are
+    shared across parity cells."""
+    key = tuple(sorted((k, str(v)) for k, v in extra.items()))
+    if key not in _MODEL_CACHE:
+        X, y = data
+        p = dict(BASE, **extra)
+        d = lgb.Dataset(X, label=y, params=dict(p))
+        _MODEL_CACHE[key] = lgb.train(dict(p), d).model_to_string()
+    return _MODEL_CACHE[key]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.configure("")
+    faults.reset()
+
+
+# ---------------------------------------------------------------- plan
+
+
+def test_plan_pages_explicit_rows_win():
+    p = plan_pages(608, 16, 1, hbm_budget_mb=100.0, page_rows=24)
+    assert p.page_rows == 24 and p.n_pages == -(-608 // 24)
+    assert p.f_loc == 16 and p.n_loc == 608
+
+
+def test_plan_pages_budget_bound():
+    # budget bounds BOTH double-buffer slots: rows <= B / (2*f*item)
+    p = plan_pages(608, 16, 1, hbm_budget_mb=0.001)
+    budget = int(0.001 * (1 << 20))
+    assert 2 * p.f_loc * p.page_rows <= budget + 8 * 2 * p.f_loc
+    assert p.n_pages >= 3
+    assert p.page_rows % 8 == 0
+
+
+def test_plan_pages_min_pages_fallback():
+    # no budget, no explicit rows -> still split (min 2 pages)
+    p = plan_pages(608, 16, 1)
+    assert p.n_pages >= 2
+    assert p.page_rows * p.n_pages >= 608
+
+
+def test_plan_pages_tiny_block():
+    p = plan_pages(5, 4, 1, page_rows=2)
+    assert p.page_rows * p.n_pages >= 5
+
+
+def test_plan_identity_keys():
+    ident = plan_pages(608, 16, 1, page_rows=24).identity()
+    assert set(ident) == {"page_rows", "n_pages", "f_loc", "n_loc"}
+    assert all(isinstance(v, int) for v in ident.values())
+
+
+# ----------------------------------------------------------- PageStore
+
+
+def _store(binned, page_rows=24, **kw):
+    n, f = binned.shape
+    n_pad = -(-n // 8) * 8
+    plan = plan_pages(n_pad, f, binned.dtype.itemsize,
+                      page_rows=page_rows)
+    kw.setdefault("prefetch", False)
+    return PageStore(binned, n_rows=n, n_pad=n_pad, out_cols=f,
+                     plan=plan, **kw), plan, n_pad
+
+
+def test_pagestore_page_contents():
+    rng = np.random.RandomState(0)
+    binned = rng.randint(0, 32, size=(601, 12)).astype(np.uint8)
+    st, plan, n_pad = _store(binned)
+    try:
+        R = plan.page_rows
+        for pg in (0, 1, plan.n_pages - 1):
+            page = st.page_cb(0, 0, pg)
+            assert page.shape == (plan.f_loc, R)
+            r0 = pg * R
+            rows = min(max(601 - r0, 0), R)
+            expect = np.zeros((plan.f_loc, R), np.uint8)
+            if rows:
+                expect[:, :rows] = binned[r0:r0 + rows].T
+            np.testing.assert_array_equal(page, expect)
+    finally:
+        st.close()
+
+
+def test_pagestore_spill_roundtrip():
+    rng = np.random.RandomState(1)
+    binned = rng.randint(0, 256, size=(601, 12)).astype(np.uint8)
+    st, plan, _ = _store(binned, page_rows=16, max_resident=2)
+    try:
+        first = [np.array(st.page_cb(0, 0, pg))
+                 for pg in range(plan.n_pages)]
+        again = [np.array(st.page_cb(0, 0, pg))
+                 for pg in range(plan.n_pages)]
+        for a, b in zip(first, again):
+            np.testing.assert_array_equal(a, b)
+        s = st.stats()
+        assert s["spills"] > 0 and s["spill_hits"] > 0
+    finally:
+        st.close()
+
+
+def test_pagestore_abort_stays_servable():
+    rng = np.random.RandomState(2)
+    binned = rng.randint(0, 32, size=(601, 12)).astype(np.uint8)
+    st, plan, _ = _store(binned)
+    try:
+        ref = np.array(st.page_cb(0, 0, 0))
+        assert st.abort()            # fence: drop resident + spilled
+        # unlike the one-shot BlockFetcher, the store re-serves from
+        # source — a re-mesh rebuilds views but the host side survives
+        np.testing.assert_array_equal(st.page_cb(0, 0, 0), ref)
+    finally:
+        st.close()
+
+
+def test_pagestore_fetch_fault_poisons_then_fence_clears():
+    """A serve error cannot raise through pure_callback, so the store
+    feeds a ZERO page, records the error, and raise_if_poisoned fails
+    the next iteration boundary; the abort fence resolves the poison
+    with the block that consumed it."""
+    rng = np.random.RandomState(3)
+    binned = rng.randint(0, 32, size=(601, 12)).astype(np.uint8)
+    st, plan, _ = _store(binned)
+    try:
+        faults.configure("pager.fetch:error@*")
+        page = st.page_cb(0, 0, 0)
+        assert not page.any()                     # deterministic zeros
+        with pytest.raises(RuntimeError, match="poisoned") as ei:
+            st.raise_if_poisoned()
+        assert isinstance(ei.value.__cause__, OSError)
+        assert st.stats()["errors"] == 1
+        faults.configure("")
+        faults.reset()
+        with pytest.raises(RuntimeError):
+            st.raise_if_poisoned()                # sticky until fenced
+        st.abort()
+        st.raise_if_poisoned()                    # resolved
+        assert st.page_cb(0, 0, 0).shape == (plan.f_loc,
+                                             plan.page_rows)
+    finally:
+        st.close()
+
+
+def test_paged_training_fails_loudly_on_fetch_errors(data):
+    X, y = data
+    p = dict(BASE, paged_training="on", paged_page_rows=24)
+    d = lgb.Dataset(X, label=y, params=dict(p))
+    faults.configure("pager.fetch:error@*")
+    with pytest.raises(RuntimeError, match="pager"):
+        lgb.train(dict(p), d)
+
+
+def test_pagestore_column_matches_pages():
+    rng = np.random.RandomState(4)
+    binned = rng.randint(0, 32, size=(601, 12)).astype(np.uint8)
+    st, plan, n_pad = _store(binned)
+    try:
+        col = np.array(st.column_cb(0, 0, 3))
+        expect = np.zeros(n_pad, np.uint8)
+        expect[:601] = binned[:, 3]
+        np.testing.assert_array_equal(col, expect)
+    finally:
+        st.close()
+
+
+# ------------------------------------------------------- parity matrix
+
+
+def test_paged_parity_fast(data):
+    """The quick-gate parity cells (CI mesh-smoke fast lane): serial
+    and the 8-shard data learner, fused super-steps on."""
+    for learner in ("serial", "data"):
+        extra = dict(LEARNERS[learner], fused_iters=4)
+        resident = _model(data, extra)
+        paged = _model(data, dict(extra, **PAGED))
+        assert paged == resident, f"paged parity broke: {learner}"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("sampling", sorted(SAMPLING))
+@pytest.mark.parametrize("learner", sorted(LEARNERS))
+@pytest.mark.parametrize("fused", [1, 4])
+def test_paged_parity_matrix(data, sampling, learner, fused):
+    """The acceptance matrix: byte-identical models, every cell."""
+    extra = dict(SAMPLING[sampling], **LEARNERS[learner],
+                 fused_iters=fused)
+    resident = _model(data, extra)
+    paged = _model(data, dict(extra, **PAGED))
+    assert paged == resident, \
+        f"paged parity broke: {sampling}/{learner}/fused={fused}"
+
+
+def test_paged_parity_efb(data):
+    """EFB bundling is a per-page transform — parity must survive it."""
+    extra = {"enable_bundle": True, "fused_iters": 4}
+    resident = _model(data, extra)
+    paged = _model(data, dict(extra, paged_training="on",
+                              paged_page_rows=80))
+    assert paged == resident
+
+
+@pytest.mark.slow
+def test_paged_parity_streamed(data, tmp_path):
+    """Streamed ingest + paging: the PageStore reads the published
+    cache mmap directly — no resident device matrix ever exists."""
+    X, y = data
+    extra = {"stream_ingest": True, "stream_cache_dir": str(tmp_path),
+             "stream_chunk_rows": 97, "fused_iters": 4}
+    resident = _model(data, {"fused_iters": 4})
+    paged = _model(data, dict(extra, paged_training="on",
+                              paged_page_rows=160))
+    assert paged == resident
+
+
+# ------------------------------------------------- eligibility & auto
+
+
+def test_paged_on_ineligible_raises(data):
+    X, y = data
+    p = dict(BASE, paged_training="on", wave_splits=True)
+    d = lgb.Dataset(X, label=y, params=dict(p))
+    with pytest.raises(ValueError, match="paged-ineligible"):
+        lgb.train(dict(p), d)
+
+
+def test_paged_auto_triggers_on_budget(data):
+    X, y = data
+    p = dict(BASE, paged_training="auto", hbm_budget_mb=0.001)
+    d = lgb.Dataset(X, label=y, params=dict(p))
+    bst = lgb.train(dict(p), d)
+    gb = bst._gbdt
+    assert gb._pager is not None
+    assert gb._pager.plan.n_pages >= 3
+    ident = gb.pager_identity()
+    assert ident["mode"] == "auto"
+    assert ident["n_pages"] == gb._pager.plan.n_pages
+
+
+def test_paged_auto_stays_resident_when_fits(data):
+    X, y = data
+    p = dict(BASE, paged_training="auto", hbm_budget_mb=64.0)
+    d = lgb.Dataset(X, label=y, params=dict(p))
+    bst = lgb.train(dict(p), d)
+    assert bst._gbdt._pager is None
+    assert bst._gbdt.pager_identity() is None
+
+
+def test_paged_off_never_pages(data):
+    X, y = data
+    p = dict(BASE, paged_training="off", hbm_budget_mb=0.001)
+    d = lgb.Dataset(X, label=y, params=dict(p))
+    bst = lgb.train(dict(p), d)
+    assert bst._gbdt._pager is None
+
+
+# ----------------------------------------------------------- telemetry
+
+
+def test_pager_telemetry_records(data, tmp_path):
+    path = str(tmp_path / "paged.jsonl")
+    X, y = data
+    p = dict(BASE, paged_training="on", paged_page_rows=24,
+             tree_learner="data", fused_iters=3,
+             telemetry_file=path)
+    d = lgb.Dataset(X, label=y, params=dict(p))
+    lgb.train(dict(p), d)
+    for rec in list(telemetry._OPEN_RECORDERS):
+        rec.close(log=False)
+    n, errs = telemetry.lint_file(path)
+    assert errs == []
+    recs = telemetry.read_records(path)
+    flush = [r for r in recs
+             if r["type"] == "pager" and r["event"] == "flush"]
+    done = [r for r in recs
+            if r["type"] == "pager" and r["event"] == "done"]
+    assert flush, "paged run emitted no per-iteration flush deltas"
+    assert sum(r["pages"] for r in flush) > 0
+    assert len(done) == 1
+    assert done[0]["pages"] >= sum(r["pages"] for r in flush)
+    assert done[0]["n_pages"] >= 3
+    end = [r for r in recs if r["type"] == "run_end"]
+    assert end and end[0]["summary"]["pager_pages"] == \
+        sum(r["pages"] for r in flush)
+
+
+def test_pager_run_end_aggregation(tmp_path):
+    path = str(tmp_path / "agg.jsonl")
+    rec = telemetry.RunRecorder(path)
+    rec.emit("run_start", config={})
+    for it in range(2):
+        rec.emit("pager", event="flush", iter=it, pages=10, bytes=100,
+                 stalls=1, overlap_s=0.5, wait_s=0.25)
+    rec.close(log=False)
+    end = [r for r in telemetry.read_records(path)
+           if r["type"] == "run_end"][0]["summary"]
+    assert end["pager_pages"] == 20 and end["pager_bytes"] == 200
+    assert end["pager_stalls"] == 2
+    assert abs(end["pager_overlap_s"] - 1.0) < 1e-9
+    assert abs(end["pager_wait_s"] - 0.5) < 1e-9
+
+
+def test_pager_no_overlap_rule_fires():
+    from lightgbm_tpu.obs.rules import OnlineScanner
+    sc = OnlineScanner()
+    sc.feed({"type": "run_start", "backend": "cpu"})
+    out = []
+    for it in range(4):
+        out += sc.feed({"type": "pager", "event": "flush", "iter": it,
+                        "pages": 8, "overlap_s": 0.0})
+    names = [a[1] for a in out]
+    assert "pager_no_overlap" in names
+    sev = [a[0] for a in out if a[1] == "pager_no_overlap"]
+    assert sev == ["MED"]          # fires once
+    assert any("pager" in msg for _, msg in sc.summary_anomalies())
+
+
+def test_pager_no_overlap_rule_quiet_with_overlap():
+    from lightgbm_tpu.obs.rules import OnlineScanner
+    sc = OnlineScanner()
+    sc.feed({"type": "run_start", "backend": "cpu"})
+    out = []
+    for it in range(4):
+        out += sc.feed({"type": "pager", "event": "flush", "iter": it,
+                        "pages": 8, "overlap_s": 0.01})
+    assert "pager_no_overlap" not in [a[1] for a in out]
+    assert not any("pager" in m for _, m in sc.summary_anomalies())
+
+
+# ------------------------------------------------ checkpoint manifest
+
+
+@pytest.mark.slow
+def test_pager_identity_in_manifest(data, tmp_path):
+    from lightgbm_tpu.ckpt.manager import CheckpointManager
+    X, y = data
+    p = dict(BASE, paged_training="on", paged_page_rows=24,
+             tree_learner="data", fused_iters=3)
+    d = lgb.Dataset(X, label=y, params=dict(p))
+    bst = lgb.train(dict(p), d)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    path = mgr.save(bst, reason="test")
+    manifest = json.loads(open(
+        os.path.join(path, "manifest.json")).read())
+    pg = manifest.get("pager")
+    assert pg is not None
+    assert pg["page_rows"] == 24 and pg["n_pages"] >= 3
+    assert pg["mode"] == "on"

@@ -153,6 +153,28 @@ def test_sharded_data_parallel_parity(data, tmp_path):
     assert m == m_oracle
 
 
+def test_sharded_data2d_streamed_parity(data, tmp_path):
+    """Streamed ingest x the 2-D data x feature mesh: upload windows
+    must land in the data2d ``P("feature", "data")`` tiles (NOT the
+    1-D row layout), and the model stays byte-identical to the
+    resident 2-D run."""
+    X, y = data
+    cfg = {"tree_learner": "data2d", "mesh_shape": "4x2"}
+    m_oracle, _ = train_model(X, y, dict(BASE, **cfg))
+    p = stream_params(tmp_path, cfg)
+    d = lgb.Dataset(X, label=y, params=dict(p))
+    bst = lgb.train(dict(p), d, verbose_eval=False)
+    assert bst.model_to_string() == m_oracle
+    g = bst._gbdt
+    # the binned matrix sits in the learner's own 2-D tiles, placed
+    # window-by-window during upload (no post-hoc re-shard)
+    assert g._stream_upload is not None
+    want = g._dist.shardings()["xt"]
+    assert g._xt.sharding == want
+    spec = tuple(g._xt.sharding.spec)
+    assert None not in spec and len(spec) == 2
+
+
 # ----------------------------------------------------------------------
 # crash safety
 # ----------------------------------------------------------------------
@@ -313,6 +335,54 @@ def test_abort_fence_cancels_inflight_upload():
             f.upload()
     finally:
         t.cancel()
+
+
+@pytest.mark.slow
+def test_abort_fence_cancels_upload_during_2d_remesh(data, tmp_path):
+    """The fence reaches a streamed re-upload riding INSIDE a 2-D
+    re-mesh: remesh re-runs construction, construction re-streams the
+    cache, the fence lands mid-window and StreamAborted surfaces out
+    of remesh; a fault-free retry with the pre-captured snapshot then
+    lands the new (R, F) shape and training state survives."""
+    X, y = data
+    p = stream_params(tmp_path, {"tree_learner": "data2d",
+                                 "mesh_shape": "4x2",
+                                 "num_iterations": 4})
+    d = lgb.Dataset(X, label=y, params=dict(p))
+    bst = lgb.train(dict(p), d, verbose_eval=False)
+    g = bst._gbdt
+    snap = g.training_snapshot()
+    faults.configure("stream.prefetch:sleep_150@*")
+    t = threading.Timer(0.2, abort_active_fetchers)
+    t.start()
+    try:
+        with pytest.raises(StreamAborted):
+            g.remesh(mesh_shape=(2, 4), snapshot=snap)
+    finally:
+        t.cancel()
+    faults.configure("")
+    faults.reset()
+    assert g.remesh(mesh_shape=(2, 4), snapshot=snap) == 8
+    assert (g._dist.row_shards, g._dist.feat_shards) == (2, 4)
+
+
+def test_upload_donation_reuses_slots(monkeypatch):
+    """Donated window writes reuse a CONSTANT number of device
+    allocations (the accumulator slots) — per-window allocation growth
+    would defeat the budget the windowed upload enforces."""
+    rng = np.random.RandomState(5)
+    binned = rng.randint(0, 9, size=(N_ROWS, N_FEAT)).astype(np.uint8)
+    f = BlockFetcher(binned, n_rows=N_ROWS, n_pad=640, out_cols=16,
+                     window_rows=64)
+    monkeypatch.setattr(stream_mod, "_TRACK_SLOT_PTRS", True)
+    got = np.asarray(f.upload(donate=True))
+    want = np.pad(binned.T, ((0, 16 - N_FEAT), (0, 640 - N_ROWS)))
+    np.testing.assert_array_equal(got, want)
+    s = f.stats()
+    assert s["windows"] == 10
+    # ping-pong bound: at most the two paging slots, never one
+    # allocation per window
+    assert 1 <= s["slot_unique_ptrs"] <= 2
 
 
 def test_upload_matches_monolithic_pad():

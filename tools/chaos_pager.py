@@ -1,0 +1,344 @@
+"""Device-block pager chaos e2e: the acceptance harness for
+out-of-core ON-DEVICE training (``io/pager.py``, ``docs/Streaming.md``
+"Out-of-core on device").
+
+Phases (exit nonzero on any failed check):
+
+1. **SIGKILL mid-page-stream** — a subprocess trains PAGED with
+   periodic checkpoints and a sleep fault stretching the page stream;
+   it is SIGKILLed after its first checkpoint lands, mid-iteration.
+   The checkpoint manifest must record the page geometry, and the
+   ``resume_from=auto`` restart must finish to a model byte-identical
+   to the fully-resident in-memory oracle (paged -> paged resume).
+2. **Write-back faults absorbed** — ``pager.writeback:error@*`` drops
+   every spill: training completes byte-identical anyway (a failed
+   write-back only costs a later re-prep, never a wrong page).
+3. **Fetch faults fail loudly, the store survives** —
+   ``pager.fetch:error@*`` surfaces out of training as an error (no
+   silent wrong histograms); with the faults cleared the SAME process
+   trains byte-identical again.
+4. **Cross-geometry resume** — a checkpoint written by a PAGED run
+   resumes RESIDENT (and vice versa) to byte-identical finals: page
+   geometry is provenance, not a constraint.
+
+Every telemetry JSONL is schema-linted; paged runs must emit ``pager``
+flush records and the shared anomaly scanner (``obs/rules.py``) must
+stay quiet on ingest/checkpoint codes.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/chaos_pager.py \
+        --workdir chaos_pager_work --out chaos_pager.json
+"""
+import argparse
+import glob
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+CHECKS = []
+
+SMALL = dict(rows=601, feats=12, rounds=8)
+KILL = dict(rows=601, feats=12, rounds=16)
+
+
+def check(name, ok, detail=""):
+    CHECKS.append({"name": name, "ok": bool(ok), "detail": str(detail)})
+    print(f"[{'OK' if ok else 'FAIL'}] {name}"
+          + (f" — {detail}" if detail and not ok else ""), flush=True)
+    return bool(ok)
+
+
+def make_data(shape, seed=11):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(shape["rows"], shape["feats"])
+    w = rng.randn(shape["feats"])
+    y = (1.0 / (1.0 + np.exp(-(X @ w) * 0.5)) >
+         rng.random_sample(shape["rows"])).astype(np.float32)
+    return X, y
+
+
+def base_params(shape, **extra):
+    p = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+         "metric": "None", "num_iterations": shape["rounds"],
+         "fused_iters": 4, "enable_bundle": False}
+    p.update(extra)
+    return p
+
+
+def paged(shape, **extra):
+    return base_params(shape, paged_training="on",
+                       paged_page_rows=24, **extra)
+
+
+def train_text(params, X, y, resume_from=None):
+    import lightgbm_tpu as lgb
+    d = lgb.Dataset(X, label=y, params=dict(params))
+    bst = lgb.train(dict(params), d, verbose_eval=False,
+                    resume_from=resume_from)
+    return bst.model_to_string(), bst
+
+
+def read_events(path):
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        pass
+    except OSError:
+        pass
+    return out
+
+
+def lint(path, name):
+    from lightgbm_tpu.utils import telemetry as tele
+    n, errs = tele.lint_file(path)
+    check(f"{name}: telemetry schema-clean ({n} records)",
+          n > 0 and not errs, "; ".join(errs[:3]))
+
+
+def wait_for(pred, timeout, what):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    print(f"TIMEOUT waiting for {what}", flush=True)
+    return False
+
+
+def spawn_child(workdir, stem, shape, telemetry, faults="",
+                resume=False):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep +
+               os.environ.get("PYTHONPATH", ""))
+    if faults:
+        env["LTPU_FAULTS"] = faults
+    else:
+        env.pop("LTPU_FAULTS", None)
+    cmd = [sys.executable, os.path.abspath(__file__), "--child",
+           "train", "--workdir", workdir, "--stem", stem,
+           "--shape", json.dumps(shape), "--telemetry", telemetry]
+    if resume:
+        cmd.append("--resume")
+    return subprocess.Popen(cmd, env=env)
+
+
+# ----------------------------------------------------------------------
+# child mode (a subprocess so SIGKILL is a real SIGKILL)
+# ----------------------------------------------------------------------
+def child_main(args):
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils import telemetry as tele
+    shape = json.loads(args.shape)
+    rec = tele.RunRecorder(args.telemetry)
+    tele.set_recorder(rec)
+    X = np.load(args.stem + ".X.npy")
+    y = np.load(args.stem + ".y.npy")
+    p = paged(shape, checkpoint_dir=os.path.join(args.workdir, "ck"),
+              snapshot_freq=2)
+    d = lgb.Dataset(X, label=y, params=dict(p))
+    bst = lgb.train(dict(p), d, verbose_eval=False,
+                    resume_from="auto" if args.resume else None)
+    with open(os.path.join(args.workdir, "final_model.txt"), "w") as f:
+        f.write(bst.model_to_string())
+    with open(os.path.join(args.workdir, "pager_info.json"), "w") as f:
+        g = bst._gbdt
+        json.dump({"identity": g.pager_identity(),
+                   "stats": g._pager.stats()}, f)
+    rec.close(log=False)
+    print("CHILD_TRAIN_DONE", flush=True)
+    return 0
+
+
+# ----------------------------------------------------------------------
+# phases
+# ----------------------------------------------------------------------
+def phase_sigkill_mid_page_stream(workdir, oracle16):
+    wd = os.path.join(workdir, "p1")
+    os.makedirs(wd)
+    stem = os.path.join(wd, "raw")
+    X, y = make_data(KILL)
+    np.save(stem + ".X.npy", X)
+    np.save(stem + ".y.npy", y)
+    ck = os.path.join(wd, "ck")
+    # stretch the page stream once training is underway (preps after
+    # the 30th fire a 20 ms sleep) so the kill lands mid-iteration,
+    # with pages in flight
+    child = spawn_child(wd, stem, KILL,
+                        os.path.join(wd, "tele_run1.jsonl"),
+                        faults="pager.fetch:sleep_20@30+")
+    ok = wait_for(lambda: bool(glob.glob(os.path.join(
+        ck, "ckpt_*", "manifest.json"))), 240, "first checkpoint")
+    time.sleep(0.4)                 # well inside a later page stream
+    child.send_signal(signal.SIGKILL)
+    child.wait()
+    check("p1: child SIGKILLed mid-page-stream after its first "
+          "checkpoint", ok)
+    manifests = sorted(glob.glob(os.path.join(
+        ck, "ckpt_*", "manifest.json")))
+    try:
+        with open(manifests[-1]) as f:
+            man = json.load(f)
+    except (OSError, IndexError) as exc:
+        check("p1: checkpoint manifest readable", False, str(exc))
+        return
+    pg = man.get("pager") or {}
+    check("p1: manifest records the page geometry",
+          pg.get("page_rows") == 24 and pg.get("n_pages", 0) >= 3
+          and pg.get("mode") == "on", str(pg))
+    # restart: resume_from=auto, fault-free
+    t2 = os.path.join(wd, "tele_run2.jsonl")
+    child = spawn_child(wd, stem, KILL, t2, resume=True)
+    rc = child.wait(timeout=600)
+    check("p1: resumed child finished (rc=0)", rc == 0, f"rc={rc}")
+    try:
+        with open(os.path.join(wd, "final_model.txt")) as f:
+            final = f.read()
+        with open(os.path.join(wd, "pager_info.json")) as f:
+            pinfo = json.load(f)
+    except OSError as exc:
+        check("p1: child artifacts written", False, str(exc))
+        return
+    check("p1: resumed PAGED model byte-identical to the resident "
+          "in-memory oracle", final == oracle16)
+    check("p1: resumed run trained out-of-core "
+          f"({pinfo['stats'].get('pages', 0)} pages served)",
+          pinfo["stats"].get("pages", 0) > 0 and
+          pinfo["identity"]["n_pages"] >= 3)
+    records = read_events(t2)
+    flush = [r for r in records if r.get("type") == "pager"
+             and r.get("event") == "flush"]
+    check("p1: resumed run emitted pager flush telemetry",
+          bool(flush) and sum(r.get("pages", 0) for r in flush) > 0)
+    lint(t2, "p1")
+    from lightgbm_tpu.obs import rules
+    scanner = rules.OnlineScanner()
+    fired = [a for r in records for a in scanner.feed(r)]
+    bad = [c for _, c, _ in fired
+           if c in ("ingest_cache_miss", "ingest_quarantine",
+                    "ckpt_fallback")]
+    check("p1: no cache/checkpoint anomalies on the clean restart",
+          not bad, str(bad))
+
+
+def phase_writeback_absorbed(workdir, X, y, oracle8):
+    from lightgbm_tpu.utils import faults
+    faults.configure("pager.writeback:error@*")
+    try:
+        final, bst = train_text(paged(SMALL), X, y)
+    finally:
+        faults.configure("")
+        faults.reset()
+    check("p2: training absorbed dropped write-backs byte-identically",
+          final == oracle8)
+    s = bst._gbdt._pager.stats()
+    check("p2: every spill was dropped (write-back error path taken)",
+          s["spills"] == 0 and s["spill_hits"] == 0,
+          f"spills={s['spills']} spill_hits={s['spill_hits']}")
+
+
+def phase_fetch_fails_loudly(workdir, X, y, oracle8):
+    from lightgbm_tpu.utils import faults
+    faults.configure("pager.fetch:error@*")
+    err = None
+    try:
+        train_text(paged(SMALL), X, y)
+    except BaseException as exc:  # noqa: BLE001 — jax wraps the OSError
+        err = exc
+    finally:
+        faults.configure("")
+        faults.reset()
+    check("p3: poisoned page fetches fail training LOUDLY",
+          err is not None and "pager.fetch" in str(err),
+          repr(err)[:160])
+    final, _ = train_text(paged(SMALL), X, y)
+    check("p3: same process trains byte-identical after the faults "
+          "clear", final == oracle8)
+
+
+def phase_cross_geometry_resume(workdir, X, y, oracle8):
+    wd = os.path.join(workdir, "p4")
+    os.makedirs(wd)
+    # paged run writes the checkpoint...
+    ck_a = os.path.join(wd, "ck_paged")
+    train_text(paged(dict(SMALL, rounds=4), checkpoint_dir=ck_a,
+                     snapshot_freq=4), X, y)
+    man = json.load(open(sorted(glob.glob(os.path.join(
+        ck_a, "ckpt_*", "manifest.json")))[-1]))
+    check("p4: paged checkpoint manifest carries pager geometry",
+          (man.get("pager") or {}).get("page_rows") == 24)
+    # ...and a RESIDENT run finishes from it
+    final, _ = train_text(base_params(SMALL, checkpoint_dir=ck_a),
+                          X, y, resume_from="auto")
+    check("p4: paged checkpoint -> resident resume byte-identical",
+          final == oracle8)
+    # resident run writes the checkpoint, a PAGED run finishes it
+    ck_b = os.path.join(wd, "ck_res")
+    train_text(base_params(dict(SMALL, rounds=4), checkpoint_dir=ck_b,
+                           snapshot_freq=4), X, y)
+    man = json.load(open(sorted(glob.glob(os.path.join(
+        ck_b, "ckpt_*", "manifest.json")))[-1]))
+    check("p4: resident manifest records NO pager geometry",
+          "pager" not in man)
+    final, _ = train_text(paged(SMALL, checkpoint_dir=ck_b), X, y,
+                          resume_from="auto")
+    check("p4: resident checkpoint -> paged resume byte-identical",
+          final == oracle8)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workdir", default="chaos_pager_work")
+    ap.add_argument("--out", default="")
+    ap.add_argument("--child", default="")
+    ap.add_argument("--stem", default="")
+    ap.add_argument("--shape", default="{}")
+    ap.add_argument("--telemetry", default="")
+    ap.add_argument("--resume", action="store_true")
+    args = ap.parse_args()
+    if args.child:
+        return child_main(args)
+
+    workdir = os.path.abspath(args.workdir)
+    if os.path.isdir(workdir):
+        shutil.rmtree(workdir)
+    os.makedirs(workdir)
+
+    X, y = make_data(SMALL)
+    oracle8, _ = train_text(base_params(SMALL), X, y)
+    X16, y16 = make_data(KILL)
+    oracle16, _ = train_text(base_params(KILL), X16, y16)
+
+    phase_sigkill_mid_page_stream(workdir, oracle16)
+    phase_writeback_absorbed(workdir, X, y, oracle8)
+    phase_fetch_fails_loudly(workdir, X, y, oracle8)
+    phase_cross_geometry_resume(workdir, X, y, oracle8)
+
+    n_ok = sum(1 for c in CHECKS if c["ok"])
+    result = {"checks": CHECKS, "passed": n_ok, "total": len(CHECKS)}
+    print(f"\nchaos_pager: {n_ok}/{len(CHECKS)} checks passed",
+          flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+    return 0 if n_ok == len(CHECKS) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

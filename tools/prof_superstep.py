@@ -38,6 +38,13 @@ fused scan and HARD-asserts the identical 2-calls-per-K-block budget:
 the per-axis collective factoring changes what moves on the wire, not
 how often the host touches the device.
 
+A PAGED cell (``paged_training=on``, the device-block pager of
+``io/pager.py``) re-pins the same budget with the binned matrix
+served page by page from host memory: page serves ride
+``jax.pure_callback`` INSIDE the compiled scan, so the host-side
+device-call budget stays 2 per K-block at ANY page count —
+hard-asserted per page-rows variant.
+
     JAX_PLATFORMS=cpu python tools/prof_superstep.py            # write
     JAX_PLATFORMS=cpu python tools/prof_superstep.py --stdout
 """
@@ -56,7 +63,7 @@ OUT = os.path.join(ROOT, "BENCH_superstep_cpu.json")
 
 def measure(variants=(1, 4, 8), n_rows=5_000, n_feat=28, reps=6,
             block=8, learner="serial", num_shards=0, elastic=False,
-            mesh_shape=None):
+            mesh_shape=None, extra_params=None):
     """Interleaved A/B: one booster per ``fused_iters`` variant, then
     round-robin 8-iteration blocks across them — the same-process
     interleaving discipline docs/Benchmarks.md's protocol notes
@@ -84,6 +91,8 @@ def measure(variants=(1, 4, 8), n_rows=5_000, n_feat=28, reps=6,
                   "num_iterations": 10_000,  # no tail block in-window
                   "tree_learner": learner,
                   "fused_iters": k}
+        if extra_params:
+            params.update(extra_params)
         if learner == "data2d":
             # the 2-D learner builds its own (data x feature) mesh
             # from the shape spec — no 1-D mesh handed in
@@ -229,6 +238,111 @@ def measure_pipelined(depths=(0, 1, 2), K=8, n_rows=2_000, n_feat=10,
                  f"interleaved min-of-{reps} {block}-update windows",
         "device_call_budget_per_block": 2,
         "budget_ok_at_all_depths": True,
+        "cells": cells,
+    }
+
+
+def measure_paged(page_rows_variants=(256, 64), K=8, n_rows=2_000,
+                  n_feat=10, reps=6, block=8):
+    """Out-of-core cell: the device-block pager serves the binned
+    feature matrix page by page from host memory, yet the fused
+    super-step's HOST-SIDE device-call budget must not move — page
+    serves ride ``jax.pure_callback`` INSIDE the one compiled scan,
+    so they are never dispatches.  One resident booster plus one
+    paged booster per page-count variant, interleaved 8-update
+    windows; HARD-asserts 2 calls per K-block at EVERY page count
+    (the pin re-pinned here per ISSUE 19: paging changes where the
+    bytes live, not how often the host touches the device)."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils import telemetry
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(n_rows, n_feat).astype(np.float32)
+    y = (X[:, 0] + 0.4 * rng.randn(n_rows) > 0).astype(np.float32)
+    variants = [None] + list(page_rows_variants)  # None == resident
+    boosters, n_pages = {}, {}
+    for pr in variants:
+        params = {"objective": "binary", "num_leaves": 7,
+                  "max_bin": 63, "verbose": -1, "metric": "None",
+                  "num_iterations": 10_000, "fused_iters": K}
+        if pr is not None:
+            params["paged_training"] = "on"
+            params["paged_page_rows"] = pr
+        d = lgb.Dataset(X, label=y, params=params)
+        d.construct()
+        bst = lgb.Booster(params=params, train_set=d)
+        pager = bst._gbdt._pager
+        if pr is None:
+            assert pager is None, "resident baseline built a pager"
+            n_pages[pr] = 0
+        else:
+            assert pager is not None, (
+                f"paged_training=on at page_rows={pr} did not build "
+                f"a pager (eligibility gate regressed?)")
+            n_pages[pr] = int(pager.plan.n_pages)
+            assert n_pages[pr] >= 3, (
+                f"page_rows={pr} yields only {n_pages[pr]} pages — "
+                f"shape too small to exercise the paged lane")
+        for _ in range(1 + K):
+            bst.update()
+        boosters[pr] = bst
+    mins = {pr: [] for pr in variants}
+    calls = {pr: [0, 0] for pr in variants}
+    for _ in range(reps):
+        for pr in variants:
+            bst = boosters[pr]
+            c0 = telemetry.counters_snapshot()
+            t0 = time.time()
+            for _ in range(block):
+                bst.update()
+            mins[pr].append((time.time() - t0) / block)
+            c1 = telemetry.counters_snapshot()
+            calls[pr][0] += int(c1.get("superstep_dispatches", 0) -
+                                c0.get("superstep_dispatches", 0))
+            calls[pr][1] += int(c1.get("superstep_fetches", 0) -
+                                c0.get("superstep_fetches", 0))
+    cells = []
+    blocks = reps * block // K
+    for pr in variants:
+        disp, fet = calls[pr]
+        # the ISSUE-19 pin: page serves are pure_callbacks inside the
+        # compiled scan, NOT dispatches — the budget stays 2 per
+        # K-block whether the matrix is resident or split 32 ways
+        assert disp == blocks and fet == blocks, (
+            f"paged device-call budget broken at page_rows={pr} "
+            f"({n_pages[pr]} pages): {disp} dispatches / {fet} "
+            f"fetches over {blocks} blocks (expected "
+            f"{blocks}/{blocks})")
+        stats = {}
+        if pr is not None:
+            stats = boosters[pr]._gbdt._pager.stats()
+            assert stats.get("pages", 0) > 0, (
+                f"page_rows={pr}: pager built but zero pages served")
+        cells.append({
+            "page_rows": pr, "n_pages": n_pages[pr],
+            "fused_iters": K,
+            "iter_s": round(min(mins[pr]), 6),
+            "iter_s_mean": round(sum(mins[pr]) / reps, 6),
+            "dispatches_per_block": round(disp / blocks, 3),
+            "fetches_per_block": round(fet / blocks, 3),
+            "pages_served": int(stats.get("pages", 0)),
+            "prefetch_overlap_s": round(
+                float(stats.get("overlap_s", 0.0)), 4),
+        })
+    base = cells[0]
+    for c in cells:
+        c["slowdown_vs_resident"] = round(
+            c["iter_s"] / max(base["iter_s"], 1e-9), 2)
+    return {
+        "shape": f"{n_rows} x {n_feat} binary, 7 leaves, K={K}, "
+                 f"interleaved min-of-{reps} {block}-update windows",
+        "device_call_budget_per_block": 2,
+        "budget_ok_at_all_page_counts": True,
+        "note": "CPU slowdown is the honest host-callback cost on a "
+                "2-core container (host RAM serves both sides); the "
+                "TPU-side win — training sets larger than HBM — is "
+                "the ROADMAP real-hardware item",
         "cells": cells,
     }
 
@@ -493,6 +607,10 @@ def main(argv=None):
     # per-block fetch overlapped behind the next block's dispatch,
     # with the 2-calls-per-K-block budget hard-asserted at every depth
     pipelined = measure_pipelined(reps=args.reps)
+    # PAGED cell (device-block pager): page serves are pure_callbacks
+    # inside the one compiled scan, so the budget is hard-asserted at
+    # 2 per K-block at every page count (re-pinned per page geometry)
+    paged = measure_paged(reps=args.reps)
     # BEST-SPLIT cell (split_kernel): fused histogram→split vs the
     # two-dispatch pair + the 2-calls-per-K-block pin per engine
     split_cell = measure_split(reps=args.reps)
@@ -510,6 +628,7 @@ def main(argv=None):
         "cells": cells,
         "dispatch_bound_cells": tiny,
         "pipelined": pipelined,
+        "paged": paged,
         "split": split_cell,
     }
     if sharded_cells:

@@ -507,6 +507,15 @@ def triage(records, baseline=None):
                 f"backoffs, prefetch overlap "
                 f"{s.get('ingest_prefetch_overlap_s', 0.0):.3f}s over "
                 f"{s.get('ingest_prefetch_windows', 0):.0f} windows")
+        if s.get("pager_pages"):
+            lines.append(
+                f"pager       : "
+                f"{s.get('pager_pages', 0):.0f} pages served "
+                f"({s.get('pager_bytes', 0) / 1e6:.2f} MB), "
+                f"{s.get('pager_stalls', 0):.0f} serve stalls, "
+                f"prefetch overlap "
+                f"{s.get('pager_overlap_s', 0.0):.3f}s, inline wait "
+                f"{s.get('pager_wait_s', 0.0):.3f}s")
         if s.get("continual_batches") or s.get("continual_quarantines"):
             mean_ms = (s.get("continual_batch_ms", 0.0) /
                        max(s.get("continual_batches", 0), 1))
