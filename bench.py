@@ -226,22 +226,31 @@ def bench_predict(booster, X, reps=3):
 
 def bench_serve(booster, n_features, swap_booster=None,
                 n_requests=400, threads=8, rows_max=900,
-                max_batch_rows=1024, batch_wait_ms=1.0, seed=0):
+                max_batch_rows=1024, batch_wait_ms=1.0, seed=0,
+                kind="predict", fastpath_max_rows=None):
     """Online-serving microbench: in-process Server, concurrent
     clients issuing mixed row-count requests through the
     micro-batching scheduler (one mid-run hot-swap when
-    ``swap_booster`` is given).  Reports latency percentiles,
-    throughput, batch occupancy and the steady-state compile count —
-    the serving analog of ``bench_predict``."""
+    ``swap_booster`` is given).  ``kind="explain"`` drives the
+    explanation lane (per-row SHAP contributions) instead;
+    ``fastpath_max_rows`` overrides the single-row fast-path gate
+    (0 disables — the knob the fastpath-vs-bucketed cells flip).
+    Reports latency percentiles, throughput, batch occupancy and the
+    steady-state compile count — the serving analog of
+    ``bench_predict``."""
     import threading as _threading
 
     import numpy as np
     from lightgbm_tpu.serve import ServeConfig, Server
     from lightgbm_tpu.utils.telemetry import counters_snapshot
 
+    cfg_kw = {}
+    if fastpath_max_rows is not None:
+        cfg_kw["fastpath_max_rows"] = fastpath_max_rows
     cfg = ServeConfig(max_batch_rows=max_batch_rows,
                       batch_wait_ms=batch_wait_ms, timeout_ms=60000,
-                      queue_rows=max(rows_max * threads * 4, 16384))
+                      queue_rows=max(rows_max * threads * 4, 16384),
+                      **cfg_kw)
     srv = Server(booster, config=cfg).start()
     lat, lock = [], _threading.Lock()
     errors, rows_done = [], [0]
@@ -263,7 +272,10 @@ def bench_serve(booster, n_features, swap_booster=None,
             X = r.randn(n, n_features)
             t0 = time.time()
             try:
-                srv.predict(X)
+                if kind == "explain":
+                    srv.explain(X)
+                else:
+                    srv.predict(X)
             except Exception as exc:   # noqa: BLE001 - recorded
                 errors.append(str(exc)[:120])
                 continue
@@ -273,6 +285,8 @@ def bench_serve(booster, n_features, swap_booster=None,
 
     try:
         srv.predict(np.zeros((1, n_features)))   # settle first touch
+        if kind == "explain":
+            srv.explain(np.zeros((1, n_features)))
         base = counters_snapshot()
         t_start = time.time()
         clients = [_threading.Thread(target=client, args=(i,))
@@ -297,6 +311,9 @@ def bench_serve(booster, n_features, swap_booster=None,
     bpad = now.get("serve_padded_rows", 0) - \
         base.get("serve_padded_rows", 0)
     return {
+        "kind": kind,
+        "fastpath_batches": int(now.get("serve_fastpath_batches", 0) -
+                                base.get("serve_fastpath_batches", 0)),
         "requests": len(lat),
         "threads": threads,
         "rows_total": rows_done[0],
@@ -961,6 +978,86 @@ def serve_only():
     with open(path, "w") as f:
         json.dump(out, f, indent=1, sort_keys=True)
     print(json.dumps({"wrote": os.path.basename(path)}), flush=True)
+    return 0
+
+
+def explain_only():
+    """Fast path (``python bench.py --explain-only``): train a small
+    booster on the CPU backend and record the serve-time explanation
+    matrix as BENCH_explain_cpu.json — explanation-lane latency/
+    throughput (device TreeSHAP through the micro-batcher) plus the
+    single-row fastpath-vs-bucketed predict cells, all with the
+    steady-state compile count pinned at 0 (publish-time warmup
+    pre-compiles every bucket).  Rendered into docs/Benchmarks.md by
+    ``tools/render_benchmarks.py``."""
+    import datetime
+
+    if ensure_backend(variant="explain") is None:
+        return 0
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils import telemetry as _telemetry
+    _telemetry.install_jax_hooks()
+
+    n_features = 28
+    rng = np.random.RandomState(0)
+    X = rng.randn(20000, n_features).astype(np.float32)
+    w = rng.randn(n_features).astype(np.float32)
+    y = (1.0 / (1.0 + np.exp(-(X @ w) * 0.5)) >
+         rng.random_sample(20000)).astype(np.float32)
+    d = lgb.Dataset(X, label=y, params={"objective": "binary",
+                                        "verbose": -1})
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbose": -1, "metric": "None", "seed": 1},
+                    d, num_boost_round=20)
+    forest = (f"{bst.num_trees()}-tree 31-leaf binary forest over "
+              f"{n_features} features, float64 device TreeSHAP")
+    n_req = int(os.environ.get("BENCH_EXPLAIN_REQUESTS", "200"))
+    cells = []
+    # -- explanation lane: mixed row counts through the explain lane
+    for label, threads, wait_ms, rows_max in (
+            ("explain sequential", 1, 0.0, 400),
+            ("explain concurrent x8", 8, 1.0, 400)):
+        res = bench_serve(bst, n_features, n_requests=n_req,
+                          threads=threads, rows_max=rows_max,
+                          batch_wait_ms=wait_ms, kind="explain")
+        res["label"] = label
+        cells.append(res)
+        print(json.dumps({"explain_cell": label, **res}), flush=True)
+    # -- single-row predict: occupancy-routed fast path vs the same
+    # requests forced through the full bucketed path (fastpath gate
+    # off) — the p50 delta IS the fast path's reason to exist
+    for label, fp_rows in (("single-row fastpath", 8),
+                           ("single-row bucketed", 0)):
+        res = bench_serve(bst, n_features, n_requests=n_req,
+                          threads=1, rows_max=1, batch_wait_ms=0.0,
+                          kind="predict", fastpath_max_rows=fp_rows)
+        res["label"] = label
+        cells.append(res)
+        print(json.dumps({"explain_cell": label, **res}), flush=True)
+    by_label = {c["label"]: c for c in cells}
+    fast = by_label["single-row fastpath"]
+    slow = by_label["single-row bucketed"]
+    speedup = round(slow["p50_ms"] / max(fast["p50_ms"], 1e-9), 2)
+    out = {
+        "metric": "explain_latency_throughput_cpu",
+        "unit": "ms",
+        "backend": "cpu",
+        "date": datetime.date.today().isoformat(),
+        "source": "JAX_PLATFORMS=cpu python bench.py --explain-only",
+        "env": "2-core CPU container",
+        "forest": forest,
+        "config": {"max_batch_rows": 1024, "requests": n_req,
+                   "timeout_ms": 60000},
+        "fastpath_p50_speedup": speedup,
+        "cells": cells,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_explain_cpu.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    print(json.dumps({"wrote": os.path.basename(path),
+                      "fastpath_p50_speedup": speedup}), flush=True)
     return 0
 
 
@@ -2729,6 +2826,8 @@ def sweep_only():
 if __name__ == "__main__":
     if "--serve-only" in sys.argv:
         sys.exit(serve_only())
+    if "--explain-only" in sys.argv:
+        sys.exit(explain_only())
     if "--router-only" in sys.argv:
         sys.exit(router_only())
     if "--autoscale-only" in sys.argv:

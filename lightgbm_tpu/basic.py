@@ -640,9 +640,7 @@ class Booster:
         if pred_leaf:
             return self._gbdt.predict_leaf_index(mat, ni, **eng)
         if pred_contrib:
-            from .ops.shap import predict_contrib
-            return predict_contrib(self._gbdt.models, mat, ni,
-                                   self._gbdt.num_tree_per_iteration)
+            return self._gbdt.predict_contrib(mat, ni, **eng)
         es = {}
         if kwargs.get("pred_early_stop"):
             es = {"early_stop": True,

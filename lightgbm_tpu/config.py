@@ -653,6 +653,20 @@ PARAMS: List[Param] = [
        "published, BEFORE it becomes the admission target — the "
        "zero-steady-state-compile contract; disable only for "
        "debugging", group="serve"),
+    _p("serve_fastpath_max_rows", 8, int, (),
+       "single-row fast path: a predict batch with at most this many "
+       "rows AND a shallow queue (serve_fastpath_max_queue) skips the "
+       "512-row minimum bucket and dispatches on a tiny power-of-two "
+       "bucket compiled per fingerprint at publish — the occupancy-"
+       "routed p50 lane.  Outputs are bit-identical to the bucketed "
+       "engine (pinned by tests/test_shap_engine.py); 0 disables",
+       group="serve", check=">=0"),
+    _p("serve_fastpath_max_queue", 2, int, (),
+       "fast-path occupancy gate: the tiny-bucket lane is taken only "
+       "when at most this many requests remain queued behind the "
+       "batch — under load the batcher keeps coalescing into the big "
+       "warmed buckets instead of serializing many small dispatches",
+       group="serve", check=">=0"),
     _p("serve_max_body_bytes", 33554432, int, ("serve_max_body",),
        "HTTP front body-size bound: requests with a larger "
        "Content-Length are rejected with a structured 413 before the "
@@ -754,6 +768,13 @@ PARAMS: List[Param] = [
        "per-model in-flight request cap at the router (0 = "
        "unlimited); beyond it low-priority requests shed with 429",
        group="route", check=">=0"),
+    _p("route_explain_cost", 4.0, float, (),
+       "admission weight of one explain row: POST /v1/<model>/explain "
+       "charges the SAME per-model token bucket as predict, "
+       "multiplied by this factor (TreeSHAP does O(depth^2) work per "
+       "leaf where predict does O(depth)), so explain bursts shed "
+       "before they starve the predict lane", group="route",
+       check=">=1"),
     _p("route_backends", "", str, (),
        "static backend table for task=route: comma-separated entries "
        "'http://host:port' (default tenant) or "

@@ -176,6 +176,7 @@ class GBDT:
         device-handoff path re-extracts lazily."""
         self._model_version = getattr(self, "_model_version", 0) + 1
         self._flat_cache = None
+        self._shap_cache = None
         self._tree_flats = []
 
     def __init__(self, config: Config, train_set: TpuDataset,
@@ -194,6 +195,7 @@ class GBDT:
         self._models: List[Tree] = []
         self._model_version = 0
         self._flat_cache = None     # (key, FlatForest) — ops/predict.py
+        self._shap_cache = None     # (key, ShapForest) — ops/shap.py
         self._tree_flats = []       # per-tree handoff rows (TreeFlat)
         self._pending = None        # in-flight tree (pipelined boosting)
         self._stop_flag = False
@@ -3025,6 +3027,59 @@ class GBDT:
             return self.objective.convert_output(raw)
         return raw
 
+    def _shap_forest(self):
+        """Flattened SHAP path-descriptor tables (ops/shap.py), cached
+        until the model mutates — same invalidation rules as
+        :meth:`_flat_forest`."""
+        from ..ops.shap import flatten_forest_shap
+        models = self.models            # flushes any pending tree
+        key = (self._model_version, len(models))
+        cache = getattr(self, "_shap_cache", None)
+        if cache is None or cache[0] != key:
+            cache = (key, flatten_forest_shap(
+                models, self.num_tree_per_iteration))
+            self._shap_cache = cache
+        return cache[1]
+
+    def predict_contrib(self, X: np.ndarray, num_iteration: int = -1,
+                        predict_engine=None,
+                        predict_chunk_rows=None) -> np.ndarray:
+        """Per-row SHAP contributions (``PredictContrib`` layout:
+        (rows, nf+1), multiclass flattened to (rows, k*(nf+1)) with
+        per-class bias columns).  Served by the flattened explanation
+        engine (``ops/shap.py``); the per-tree host recursion stays
+        the oracle path behind the same ``predict_engine`` /
+        ``LTPU_PREDICT_ENGINE`` gates as :meth:`predict_raw`."""
+        import time as _time
+        t0 = _time.perf_counter()
+        X = np.ascontiguousarray(np.asarray(X, np.float64))
+        k = self.num_tree_per_iteration
+        n_trees = len(self.models)
+        if num_iteration is not None and num_iteration > 0:
+            n_trees = min(n_trees, num_iteration * k)
+        rows, nf = X.shape
+        used_engine = n_trees > 0 and rows > 0 and \
+            self._use_predict_engine(predict_engine)
+        if used_engine:
+            from ..ops.shap import get_shap_engine
+            sf = self._shap_forest()
+            raw = get_shap_engine().predict_contrib(
+                sf, X, n_trees,
+                chunk_rows=predict_chunk_rows or
+                getattr(self.config, "predict_chunk_rows", 0))
+            F = sf.num_features
+            out = np.zeros((rows, k, nf + 1), dtype=np.float64)
+            c = min(F, nf)
+            out[:, :, :c] = np.moveaxis(raw[:, :c, :], 2, 0)
+            out[:, :, -1] = raw[:, F, :].T
+            out = out[:, 0, :] if k == 1 else \
+                out.reshape(rows, k * (nf + 1))
+        else:
+            from ..ops.shap import predict_contrib as _host_contrib
+            out = _host_contrib(self.models, X, num_iteration, k)
+        self._record_predict("contrib", rows, n_trees, used_engine, t0)
+        return out
+
     def predict_leaf_index(self, X: np.ndarray, num_iteration: int = -1,
                            predict_engine=None,
                            predict_chunk_rows=None) -> np.ndarray:
@@ -3062,8 +3117,12 @@ class GBDT:
                   "duration_ms": round((_time.perf_counter() - t0) * 1e3,
                                        3)}
         try:
-            from ..ops.predict import get_engine
-            fields["cache"] = get_engine().cache_info()
+            if kind == "contrib":
+                from ..ops.shap import get_shap_engine
+                fields["cache"] = get_shap_engine().cache_info()
+            else:
+                from ..ops.predict import get_engine
+                fields["cache"] = get_engine().cache_info()
         except Exception:
             pass
         rec.emit("predict", **fields)

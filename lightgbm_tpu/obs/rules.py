@@ -160,6 +160,12 @@ class OnlineScanner:
         self._rt_requests = 0
         self._rt_hedges = 0
         self._rt_shed = 0
+        # explanation-lane rollups (serve/server.py): a warmed explain
+        # lane re-runs cached programs; compiles past the allowance
+        # mean the publish warm-up missed a bucket or the shap cache
+        # is thrashing
+        self._ex_requests = 0
+        self._ex_compiles = 0.0
         # streamed-ingest rollups (io/stream.py): prefetch overlap is
         # judged once enough windows have streamed, mirroring the
         # pipelining-disabled rule
@@ -373,6 +379,26 @@ class OnlineScanner:
                             f"admission budgets are turning real "
                             f"traffic away; raise route_rows_per_s "
                             f"or add replicas"))
+        elif rtype == "explain":
+            # steady-state explain contract: publish pre-warms the
+            # whole ShapEngine bucket ladder, so a served explain
+            # request carrying a compile delta means a bucket was
+            # missed or evicted.  Same warmup allowance as the
+            # training retrace rule; one-shot, totals in the summary.
+            self._ex_requests += 1
+            c = float(r.get("xla_compiles", 0.0) or 0.0)
+            if c and self._ex_requests > WARMUP_ITERS:
+                self._ex_compiles += c
+                if "explain_compile" not in self._fired:
+                    self._fired.add("explain_compile")
+                    out.append((
+                        "MED", "explain_compile",
+                        f"steady-state explain compiled: {c:.0f} XLA "
+                        f"compile(s) on served explain request "
+                        f"#{self._ex_requests} — the publish warm-up "
+                        f"must cover every explain bucket "
+                        f"(serve/registry.py warmup; shap cache "
+                        f"eviction?)"))
         elif rtype == "slo":
             status = r.get("status", "")
             obj = str(r.get("objective", "?"))
@@ -516,6 +542,16 @@ class OnlineScanner:
                                     f"turning real traffic away; "
                                     f"raise route_rows_per_s or add "
                                     f"replicas"))
+        if self._ex_compiles:
+            out.append(("MED", f"explanation lane compiled at steady "
+                               f"state: {self._ex_compiles:.0f} XLA "
+                               f"compile(s) across "
+                               f"{self._ex_requests} served explain "
+                               f"request(s) — the zero-steady-state-"
+                               f"compile contract extends to "
+                               f"/explain; check the publish warm-up "
+                               f"bucket set and the shap engine's "
+                               f"LRU capacity"))
         for obj in sorted(self._slo_worst):
             r = self._slo_worst[obj]
             status = r.get("status", "")

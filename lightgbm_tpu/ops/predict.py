@@ -675,6 +675,20 @@ class PredictEngine:
         mx = self._max_chunk(flat, chunk_rows)
         return sum(b for _, _, b in self._buckets(n, mx))
 
+    @staticmethod
+    def fast_bucket_set(max_rows: int) -> List[int]:
+        """The single-row fast path's tiny power-of-two ladder:
+        1, 2, 4, ... up to ``max_rows`` rounded up.  The serve layer
+        warms this set per published fingerprint alongside
+        :meth:`bucket_set` so a low-occupancy request never compiles."""
+        cap = 1 << max(int(max_rows) - 1, 0).bit_length()
+        out = []
+        b = 1
+        while b <= cap:
+            out.append(b)
+            b <<= 1
+        return out
+
     def _tree_chunk(self, flat: FlatForest, early_stop: bool,
                     freq: int, n_trees: int) -> int:
         k = flat.k
@@ -696,7 +710,7 @@ class PredictEngine:
     # -- execution -------------------------------------------------------
     def _run(self, flat: FlatForest, X: np.ndarray, n_trees: int,
              want_leaf: bool, es: bool, freq: int, margin: float,
-             chunk_rows: Optional[int] = None):
+             chunk_rows: Optional[int] = None, buckets=None):
         import contextlib
         import jax
         import jax.numpy as jnp
@@ -710,6 +724,8 @@ class PredictEngine:
                 f"references feature {flat.requires_features - 1}")
         Tc = self._tree_chunk(flat, es, freq, n_trees)
         max_chunk = self._max_chunk(flat, chunk_rows)
+        if buckets is None:
+            buckets = self._buckets(n, max_chunk)
         outs = []
         # the engine is a host-memory-bound kernel: pin it to the CPU
         # backend even when the session's default device is a TPU
@@ -723,7 +739,7 @@ class PredictEngine:
         with dev_ctx, jax.experimental.enable_x64():
             tabs = flat.device_tables(n_trees, Tc)
             xmat_fn = _xmat_compiled()
-            for start, rows, B in self._buckets(n, max_chunk):
+            for start, rows, B in buckets:
                 key = self._key(flat, B, n_trees, Tc, es)
                 raw_k, leaf_k = self._compiled(key)
                 blk = X[start:start + rows, :flat.num_features]
@@ -762,6 +778,22 @@ class PredictEngine:
         return self._run(flat, X, n_trees, False, bool(early_stop),
                          int(early_stop_freq), float(early_stop_margin),
                          chunk_rows)
+
+    def predict_raw_fast(self, flat: FlatForest, X: np.ndarray,
+                         n_trees: Optional[int] = None) -> np.ndarray:
+        """The serve tier's single-row fast path: pad to the tiny
+        power-of-two bucket (no ``_MIN_BUCKET`` clamp) instead of a
+        full serving bucket.  Same kernels, same compile-cache key
+        space — every per-row operation in the kernel is independent
+        of the padding width, so outputs are bit-identical to the
+        bucketed path (pinned by tests/test_shap_engine.py)."""
+        n_trees = flat.n_trees if n_trees is None else n_trees
+        n = X.shape[0]
+        if n_trees <= 0 or n == 0:
+            return np.zeros((flat.k, n))
+        B = 1 << max(n - 1, 0).bit_length()
+        return self._run(flat, X, n_trees, False, False, 10, 10.0,
+                         buckets=[(0, n, B)])
 
     def predict_leaf_index(self, flat: FlatForest, X: np.ndarray,
                            n_trees: Optional[int] = None,

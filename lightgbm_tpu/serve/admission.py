@@ -13,9 +13,10 @@ so a stale deadline never wastes a device dispatch.
 The queue is also the coalescing point: :meth:`AdmissionQueue.drain_batch`
 blocks until work is available, gives concurrent submitters
 ``batch_wait`` to pile on, then hands the dispatcher a FIFO run of
-same-version requests totalling at most ``max_batch_rows`` rows
-(version grouping is what lets a hot-swap proceed while old-version
-requests are still in flight).
+same-version same-kind requests totalling at most ``max_batch_rows``
+rows (version grouping is what lets a hot-swap proceed while
+old-version requests are still in flight; kind grouping keeps the
+predict and explain lanes in separate device batches).
 """
 from __future__ import annotations
 
@@ -57,21 +58,26 @@ class UnknownModel(ServeError):
 
 
 class Request:
-    """One predict request; completion is an event the submitting
-    thread (or HTTP handler) waits on.  ``version`` is pinned at
-    ADMISSION — a later hot-swap never changes which model this
-    request is scored by."""
+    """One predict or explain request; completion is an event the
+    submitting thread (or HTTP handler) waits on.  ``version`` is
+    pinned at ADMISSION — a later hot-swap never changes which model
+    this request is scored by.  ``kind`` ("predict" | "explain")
+    selects the dispatch lane: the coalescer groups by (version,
+    kind) identity, so predict and explain rows never share a device
+    batch."""
 
     __slots__ = ("rid", "X", "raw", "priority", "deadline", "t_admit",
-                 "version", "status", "result", "error",
+                 "version", "kind", "status", "result", "error",
                  "retry_after_ms", "timings", "trace", "_done",
                  "_finish_lock")
 
     def __init__(self, rid: int, X: np.ndarray, raw: bool,
-                 priority: int, deadline: Optional[float], version):
+                 priority: int, deadline: Optional[float], version,
+                 kind: str = "predict"):
         self.rid = rid
         self.X = X
         self.raw = bool(raw)
+        self.kind = str(kind)
         self.priority = int(priority)
         self.deadline = deadline        # absolute time.monotonic(), or None
         self.t_admit = time.monotonic()
@@ -229,7 +235,8 @@ class AdmissionQueue:
             # (counted from the OLDEST pending admission) to pile on
             t_dead = head.t_admit + wait_s
             while (not stop.is_set()
-                   and self._front_rows(head.version) < max_batch_rows):
+                   and self._front_rows(head.version,
+                                        head.kind) < max_batch_rows):
                 left = t_dead - time.monotonic()
                 if left <= 0:
                     break
@@ -244,6 +251,7 @@ class AdmissionQueue:
                     timed.append(r)
                     continue
                 if out and (r.version is not out[0].version or
+                            r.kind != out[0].kind or
                             rows + r.rows > max_batch_rows):
                     break
                 self._dq.popleft()
@@ -257,12 +265,12 @@ class AdmissionQueue:
             t.finish("timeout", error="deadline expired in queue")
         return out, timed
 
-    def _front_rows(self, version) -> int:
-        """Rows in the batchable FIFO prefix (same version, capped
-        scan — the queue bound keeps this short)."""
+    def _front_rows(self, version, kind: str = "predict") -> int:
+        """Rows in the batchable FIFO prefix (same version AND kind,
+        capped scan — the queue bound keeps this short)."""
         rows = 0
         for i, r in enumerate(self._dq):
-            if r.version is not version or i >= 512:
+            if r.version is not version or r.kind != kind or i >= 512:
                 break
             rows += r.rows
         return rows

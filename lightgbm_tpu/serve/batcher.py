@@ -23,18 +23,21 @@ from .config import ServeConfig
 
 
 class Batch:
-    """One assembled dispatch unit."""
+    """One assembled dispatch unit (one version, one kind)."""
 
     __slots__ = ("requests", "X", "rows", "bucket_rows", "version",
-                 "assemble_ms")
+                 "kind", "fastpath", "assemble_ms")
 
     def __init__(self, requests: List[Request], X: np.ndarray,
-                 bucket_rows: int, assemble_ms: float):
+                 bucket_rows: int, assemble_ms: float,
+                 fastpath: bool = False):
         self.requests = requests
         self.X = X
         self.rows = int(X.shape[0])
         self.bucket_rows = int(bucket_rows)   # engine-padded total
         self.version = requests[0].version
+        self.kind = requests[0].kind
+        self.fastpath = bool(fastpath)
         self.assemble_ms = assemble_ms
 
     @property
@@ -67,9 +70,31 @@ class MicroBatcher:
             X = reqs[0].X
         else:
             X = np.concatenate([r.X for r in reqs], axis=0)
-        bucket = reqs[0].version.padded_rows(
-            X.shape[0], self.config.max_batch_rows)
+        ver = reqs[0].version
+        fastpath = False
+        if reqs[0].kind == "explain":
+            # explanation lane: the ShapEngine has its own bucket
+            # ladder (128-row floor, bytes-capped chunk)
+            bucket = ver.padded_explain_rows(
+                X.shape[0], self.config.max_batch_rows)
+        else:
+            # occupancy-routed single-row fast path: at low load a
+            # tiny predict batch skips the 512-row minimum bucket and
+            # runs the per-fingerprint scalar-sized program warmed at
+            # publish — bit-identical outputs, much less padded work.
+            # The queue-depth gate keeps the lane off under pressure,
+            # where coalescing into big buckets wins throughput.
+            fp_rows = self.config.fastpath_max_rows
+            if (0 < X.shape[0] <= fp_rows and
+                    self.queue.depth()[0] <=
+                    self.config.fastpath_max_queue):
+                fastpath = True
+                bucket = 1 << max(int(X.shape[0]) - 1, 0).bit_length()
+            else:
+                bucket = ver.padded_rows(
+                    X.shape[0], self.config.max_batch_rows)
         assemble_ms = round((time.monotonic() - t0) * 1e3, 3)
         for r in reqs:
             r.timings["assemble_ms"] = assemble_ms
-        return Batch(reqs, X, bucket, assemble_ms), timed
+        return Batch(reqs, X, bucket, assemble_ms,
+                     fastpath=fastpath), timed

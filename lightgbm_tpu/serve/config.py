@@ -33,6 +33,11 @@ class ServeConfig:
     # pre-compile every bucket kernel at publish time, before the
     # version becomes visible (the zero-steady-state-compile contract)
     warmup: bool = True
+    # single-row fast path: predict batches at most this many rows
+    # with a shallow queue dispatch on tiny per-fingerprint buckets
+    # (bit-identical outputs, lower p50); 0 disables the lane
+    fastpath_max_rows: int = 8
+    fastpath_max_queue: int = 2
     # engine compile-cache LRU capacity (must cover the layouts x
     # buckets being served; the serve path bypasses GBDT, so the
     # Server applies this itself at construction)
@@ -77,6 +82,8 @@ class ServeConfig:
             timeout_ms=float(cfg.serve_timeout_ms),
             workers=int(cfg.serve_workers),
             warmup=bool(cfg.serve_warmup),
+            fastpath_max_rows=int(cfg.serve_fastpath_max_rows),
+            fastpath_max_queue=int(cfg.serve_fastpath_max_queue),
             predict_cache_slots=int(cfg.predict_cache_slots),
             telemetry_file=str(cfg.telemetry_file or ""),
             max_body_bytes=int(cfg.serve_max_body_bytes),
@@ -101,6 +108,9 @@ class ServeConfig:
             raise ValueError("serve wait/timeout must be >= 0")
         if self.max_body_bytes <= 0:
             raise ValueError("serve_max_body_bytes must be > 0")
+        if self.fastpath_max_rows < 0 or self.fastpath_max_queue < 0:
+            raise ValueError("serve_fastpath_max_rows/max_queue must "
+                             "be >= 0")
         if self.drain_grace_s < 0:
             raise ValueError("serve_drain_grace_s must be >= 0")
         if self.metrics_latency_buckets and (
@@ -150,6 +160,9 @@ class RouterConfig:
     rows_per_s: float = 0.0
     burst_rows: int = 8192
     max_inflight: int = 256
+    # admission weight of one explain row against the shared token
+    # bucket (TreeSHAP costs O(depth^2) per leaf vs predict's O(depth))
+    explain_cost: float = 4.0
     max_body_bytes: int = 33554432
     metrics: bool = True
     seed: int = 0
@@ -184,6 +197,7 @@ class RouterConfig:
             rows_per_s=float(cfg.route_rows_per_s),
             burst_rows=int(cfg.route_burst_rows),
             max_inflight=int(cfg.route_max_inflight),
+            explain_cost=float(cfg.route_explain_cost),
             max_body_bytes=int(cfg.serve_max_body_bytes),
             metrics=bool(cfg.serve_metrics),
             seed=int(cfg.seed) if cfg.seed is not None else 0,
@@ -209,6 +223,8 @@ class RouterConfig:
         if self.rows_per_s < 0 or self.burst_rows < 1 or \
                 self.max_inflight < 0:
             raise ValueError("route admission budget out of range")
+        if self.explain_cost < 1:
+            raise ValueError("route_explain_cost must be >= 1")
 
 
 @dataclasses.dataclass

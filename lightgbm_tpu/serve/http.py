@@ -13,10 +13,18 @@ to coalesce).  Routes:
   body is ``{"error": msg, "code": slug}`` — malformed JSON, wrong
   dtypes and oversized bodies map to 400/413, never to a 500
   traceback.
+- ``POST /explain``  same body shape as ``/predict`` (``raw`` is
+  ignored — contributions are raw-score space by definition) ->
+  ``{"contributions": [[...], ...], ...}``: per-row SHAP values in
+  the ``Booster.predict(pred_contrib=True)`` layout, served by the
+  device explanation engine (``ops/shap.py``) through its own
+  micro-batch lane (predict and explain never mix in one device
+  batch).
 - ``POST /swap``     ``{"model_file": path}`` or ``{"model_str": s}``
   -> ``{"version": v, "model_id": id}`` (blocks through flatten +
   pre-warm; in-flight requests finish on their admitted version).
-- ``POST /v1/<model>/predict`` / ``POST /v1/<model>/swap``
+- ``POST /v1/<model>/predict`` / ``/v1/<model>/explain`` /
+  ``POST /v1/<model>/swap``
   multi-model tenancy: the named tenant's registry (created on first
   swap) — one replica serves many boosters, tenants never mixing in a
   device batch (requests pin their version at admission).  An
@@ -267,6 +275,8 @@ def _json_handler_for(server: Server):
                 model, verb = split_model_route(self.path)
                 if verb == "/predict":
                     self._predict(model)
+                elif verb == "/explain":
+                    self._predict(model, kind="explain")
                 elif verb == "/swap":
                     self._swap(model)
                 elif self.path == "/faults":
@@ -275,7 +285,10 @@ def _json_handler_for(server: Server):
                     self._send(404, {"error": f"no route {self.path}",
                                      "code": "no_route"})
 
-        def _predict(self, model=None):
+        def _predict(self, model=None, kind="predict"):
+            # one handler, two lanes: /explain shares the whole
+            # admission/backpressure/error surface and differs only in
+            # the submit kind and the response key.
             # fault-injection point ``http.request``: "error" answers
             # a structured 500; "drop" closes the connection with no
             # response (a client-visible transport failure)
@@ -312,8 +325,9 @@ def _json_handler_for(server: Server):
                                   f"{exc}")
             try:
                 req = server.submit(X, priority=priority,
-                                    timeout_ms=timeout_ms, raw=raw,
-                                    model=model)
+                                    timeout_ms=timeout_ms,
+                                    raw=raw or kind == "explain",
+                                    model=model, kind=kind)
                 out = req.value()
             except UnknownModel as exc:
                 # tenancy 404: the name is not in this replica's
@@ -342,8 +356,10 @@ def _json_handler_for(server: Server):
             except ServeError as exc:      # dispatch failed: server fault
                 self._send(500, {"error": str(exc), "code": "dispatch"})
                 return
+            key = "contributions" if kind == "explain" \
+                else "predictions"
             self._send(200, {
-                "predictions": np.asarray(out).tolist(),
+                key: np.asarray(out).tolist(),
                 "version": req.version.version,
                 "model_id": req.version.model_id,
                 "total_ms": round(req.timings.get("total_ms", 0.0), 3)})

@@ -47,16 +47,21 @@ def model_fingerprint(model_text: str) -> str:
 class ModelVersion:
     """One immutable published model: booster + flattened tables."""
 
-    def __init__(self, version: int, booster, chunk_rows: int):
+    def __init__(self, version: int, booster, chunk_rows: int,
+                 fastpath_rows: int = 0):
         self.version = int(version)
         self.booster = booster
         self.chunk_rows = int(chunk_rows)
+        self.fastpath_rows = int(fastpath_rows)
         # the flattened tables ARE the version snapshot: flatten_forest
         # builds fresh arrays, so later mutations of the booster
         # (continue-training, refit, DART renorm) never reach scoring
         # through this version — requests admitted under it really do
         # complete against the model as published
         self.flat = booster._gbdt._flat_forest()
+        # the explanation lane's SoA tables (ops/shap.py), pinned for
+        # the same post-publish-mutation immunity as ``flat``
+        self.shap = booster._gbdt._shap_forest()
         self._objective = booster._gbdt.objective
         self.average_output = bool(getattr(booster._gbdt,
                                            "average_output", False))
@@ -88,6 +93,34 @@ class ModelVersion:
             out = out / max(self.n_trees // self.k, 1)
         return out[0] if self.k == 1 else out.T
 
+    def predict_raw_fast_batch(self, X: np.ndarray) -> np.ndarray:
+        """The single-row fast path: same pinned tables, same kernels,
+        dispatched on the tiny power-of-two bucket matching this batch
+        instead of the 512-row serving floor — bit-identical outputs
+        (pinned by ``tests/test_shap_engine.py``), a fraction of the
+        padded device work at occupancy ~1."""
+        from ..ops.predict import get_engine
+        X = np.ascontiguousarray(np.asarray(X, np.float64))
+        out = get_engine().predict_raw_fast(self.flat, X, self.n_trees)
+        if self.average_output and self.n_trees:
+            out = out / max(self.n_trees // self.k, 1)
+        return out[0] if self.k == 1 else out.T
+
+    def explain_batch(self, X: np.ndarray) -> np.ndarray:
+        """Per-row SHAP contributions for an assembled explain batch,
+        straight from the pinned :class:`~..ops.shap.ShapForest`
+        tables — ``Booster.predict(pred_contrib=True)`` layout
+        ((rows, nf+1); multiclass (rows, k*(nf+1))), rows first so the
+        dispatcher slices per request like predict."""
+        from ..ops.shap import get_shap_engine
+        X = np.ascontiguousarray(np.asarray(X, np.float64))
+        raw = get_shap_engine().predict_contrib(
+            self.shap, X, self.n_trees, chunk_rows=self.chunk_rows)
+        rows = X.shape[0]
+        out = np.moveaxis(raw, 2, 0)       # (rows, k, nf+1)
+        return out[:, 0, :] if self.k == 1 else \
+            np.ascontiguousarray(out.reshape(rows, -1))
+
     def convert(self, raw: np.ndarray) -> np.ndarray:
         """Raw -> output space (sigmoid/softmax/exp per objective)."""
         obj = self._objective
@@ -99,15 +132,33 @@ class ModelVersion:
         return get_engine().padded_rows(self.flat, n,
                                         chunk_rows or self.chunk_rows)
 
+    def padded_explain_rows(self, n: int,
+                            chunk_rows: Optional[int] = None) -> int:
+        from ..ops.shap import get_shap_engine
+        return get_shap_engine().padded_rows(
+            self.shap, n, chunk_rows or self.chunk_rows)
+
     # -- warmup ----------------------------------------------------------
     def warmup(self) -> Dict[str, Any]:
-        """Compile every kernel the serve bucket set can hit for this
-        layout; returns ``{buckets, xla_compiles, warmup_s}`` so the
-        caller can record what the swap cost off the request path."""
-        from ..ops.predict import get_engine
+        """Compile every kernel the serve bucket sets can hit for this
+        layout — the predict ladder, the explain ladder AND the
+        fast-path tiny buckets — before the version becomes the
+        admission target.  Returns ``{buckets, explain_buckets,
+        fastpath_buckets, xla_compiles, warmup_s}`` so the caller can
+        record what the swap cost off the request path.  Because
+        fleet reconciliation republishes through this same method, a
+        restarted replica rejoins with its explain and fast-path
+        kernels already compiled — it never compiles on its first
+        explain request."""
+        from ..ops.predict import PredictEngine, get_engine
+        from ..ops.shap import get_shap_engine
         from ..utils.telemetry import install_jax_hooks
         engine = get_engine()
         buckets = engine.bucket_set(self.flat, self.chunk_rows)
+        explain_buckets = get_shap_engine().bucket_set(
+            self.shap, self.chunk_rows)
+        fast_buckets = PredictEngine.fast_bucket_set(
+            self.fastpath_rows) if self.fastpath_rows > 0 else []
         # the compile counter only counts once the jax.monitoring
         # hooks exist; a recorder-less Server never installed them,
         # which made every warmup report 0 compiles (idempotent)
@@ -116,9 +167,16 @@ class ModelVersion:
         t0 = time.monotonic()
         for b in buckets:
             self.predict_raw_batch(np.zeros((b, self.num_features)))
+        for b in fast_buckets:
+            self.predict_raw_fast_batch(
+                np.zeros((b, self.num_features)))
+        for b in explain_buckets:
+            self.explain_batch(np.zeros((b, self.num_features)))
         now = counters_snapshot()
         info = {
             "buckets": list(buckets),
+            "explain_buckets": list(explain_buckets),
+            "fastpath_buckets": list(fast_buckets),
             "xla_compiles": now.get("xla_compiles", 0.0) -
             base.get("xla_compiles", 0.0),
             "warmup_s": round(time.monotonic() - t0, 3),
@@ -138,9 +196,11 @@ class ModelRegistry:
     """Holds the active :class:`ModelVersion`; swaps are serialized
     and atomic (one pointer assignment under the lock)."""
 
-    def __init__(self, chunk_rows: int = 1024, warm: bool = True):
+    def __init__(self, chunk_rows: int = 1024, warm: bool = True,
+                 fastpath_rows: int = 0):
         self.chunk_rows = int(chunk_rows)
         self.warm = bool(warm)
+        self.fastpath_rows = int(fastpath_rows)
         self._lock = threading.Lock()          # guards _active/_history
         self._publish_lock = threading.Lock()  # serializes publishes
         self._active: Optional[ModelVersion] = None
@@ -159,7 +219,8 @@ class ModelRegistry:
                 booster = Booster(model_file=model_file,
                                   model_str=model_str)
             ver = ModelVersion(self._next_version, booster,
-                               self.chunk_rows)
+                               self.chunk_rows,
+                               fastpath_rows=self.fastpath_rows)
             if self.warm:
                 info = ver.warmup()
                 Log.info("serve: warmed model v%d (%d trees) — "

@@ -37,6 +37,11 @@ backend failures invisible and opens multi-model tenancy:
   (``POST /v1/<model>/predict``) over the replicas' per-model
   registries (``serve/server.py``), so one fleet serves many boosters
   — the seam the continual daemon's publish tier left open.
+- **explanation forwarding** — ``POST /v1/<model>/explain`` (and the
+  bare ``/explain`` alias) rides the SAME retry/hedge/breaker/
+  admission machinery; explain rows charge the shared token bucket
+  weighted by ``route_explain_cost`` (TreeSHAP is O(depth^2) per
+  leaf), so an explain burst sheds before it starves predict.
 
 Fault-injection points ``router.backend`` (``sleep_<ms>`` brownout /
 ``error`` per forwarded attempt) and ``router.admit`` (``shed``) drive
@@ -616,13 +621,16 @@ class Router:
     def route_request(self, model: str, raw_body: bytes, rows: int,
                       priority: int = 0,
                       timeout_ms: Optional[float] = None,
-                      carrier: Optional[Tuple[str, str]] = None
-                      ) -> _Result:
-        """Route one predict request: admission budget -> balanced
-        forwarding with retries + hedging inside the timeout budget.
-        Returns the client-facing :class:`_Result` (the backend's body
-        passes through byte-identical on success; router metadata
-        rides response headers)."""
+                      carrier: Optional[Tuple[str, str]] = None,
+                      verb: str = "/predict") -> _Result:
+        """Route one predict or explain request: admission budget ->
+        balanced forwarding with retries + hedging inside the timeout
+        budget.  ``verb`` ("/predict" | "/explain") selects the
+        backend route; explain rows charge the token bucket weighted
+        by ``route_explain_cost``.  Returns the client-facing
+        :class:`_Result` (the backend's body passes through
+        byte-identical on success; router metadata rides response
+        headers)."""
         t0 = time.monotonic()
         with self._lock:
             self._rid += 1
@@ -632,7 +640,7 @@ class Router:
             return self._finish(rid, model, rows, t0, _json_result(
                 404, "unknown_model",
                 {"error": f"no model {model!r} in the routing table",
-                 "code": "unknown_model"}))
+                 "code": "unknown_model"}), verb)
         # -- admission budget (before any backend sees the request).
         # The in-flight cap is checked AND claimed in one critical
         # section (concurrent admissions cannot overshoot it), and it
@@ -650,7 +658,11 @@ class Router:
                     admitted_inflight = True
         try:
             if retry_ms <= 0:
-                retry_ms = route.bucket.try_take(rows, priority)
+                # explain rows cost more device work than predict
+                # rows; weight them so the shared budget stays honest
+                cost = rows if verb != "/explain" else \
+                    int(-(-rows * self.config.explain_cost // 1))
+                retry_ms = route.bucket.try_take(cost, priority)
             if _faults.fire("router.admit") == "shed":
                 retry_ms = max(retry_ms, 1.0)
             if retry_ms > 0:
@@ -662,7 +674,7 @@ class Router:
                     {"error": f"admission budget exhausted for model "
                               f"{model!r}", "code": "backpressure",
                      "retry_after_ms": round(retry_ms, 1)},
-                    headers={"Retry-After": str(retry_s)}))
+                    headers={"Retry-After": str(retry_s)}), verb)
             budget_ms = self.config.timeout_ms
             if timeout_ms is not None and timeout_ms > 0:
                 budget_ms = min(budget_ms, float(timeout_ms))
@@ -676,15 +688,15 @@ class Router:
                     route.inflight += 1
                 admitted_inflight = True
             res = self._attempt_loop(route, raw_body, rid, deadline,
-                                     fwd_headers)
+                                     fwd_headers, verb)
         finally:
             if admitted_inflight:
                 with self._lock:
                     route.inflight -= 1
-        return self._finish(rid, model, rows, t0, res)
+        return self._finish(rid, model, rows, t0, res, verb)
 
     def _finish(self, rid: int, model: str, rows: int, t0: float,
-                res: _Result) -> _Result:
+                res: _Result, verb: str = "/predict") -> _Result:
         total_ms = round((time.monotonic() - t0) * 1e3, 3)
         with self._lock:
             self._counts[res.status] = \
@@ -712,6 +724,8 @@ class Router:
             "total_ms": total_ms, "attempts": res.attempts,
             "retries": res.retries, "rid": rid,
         }
+        if verb != "/predict":
+            fields["verb"] = verb
         if res.hedged:
             fields["hedged"] = True
             fields["hedge_won"] = bool(res.hedge_won)
@@ -726,7 +740,8 @@ class Router:
 
     def _attempt_loop(self, route: _ModelRoute, raw_body: bytes,
                       rid: int, deadline: float,
-                      fwd_headers: Dict[str, str]) -> _Result:
+                      fwd_headers: Dict[str, str],
+                      verb: str = "/predict") -> _Result:
         cond = threading.Condition()
         state: Dict[str, Any] = {"winner": None, "failures": [],
                                  "live": 0}
@@ -749,7 +764,7 @@ class Router:
             threading.Thread(
                 target=self._run_attempt,
                 args=(att, route, raw_body, deadline, fwd_headers,
-                      cond, state),
+                      cond, state, verb),
                 name="ltpu-route-attempt", daemon=True).start()
             return att
 
@@ -922,7 +937,8 @@ class Router:
 
     def _run_attempt(self, att: _Attempt, route: _ModelRoute,
                      raw_body: bytes, deadline: float,
-                     fwd_headers: Dict[str, str], cond, state) -> None:
+                     fwd_headers: Dict[str, str], cond, state,
+                     verb: str = "/predict") -> None:
         status = None
         body = b""
         retry_after = ""
@@ -952,8 +968,7 @@ class Router:
                 att.backend.host, att.backend.port, timeout=timeout)
             att.conn = conn
             rep = route.replica_model
-            path = "/predict" if rep == "default" \
-                else f"/v1/{rep}/predict"
+            path = verb if rep == "default" else f"/v1/{rep}{verb}"
             conn.request("POST", path, raw_body, headers=fwd_headers)
             resp = conn.getresponse()
             body = resp.read()
@@ -1138,7 +1153,7 @@ def _router_handler_for(router: Router):
 
         def _post(self):
             model, verb = split_model_route(self.path)
-            if verb != "/predict":
+            if verb not in ("/predict", "/explain"):
                 self._send_json(404, {"error": f"no route {self.path}",
                                       "code": "no_route"})
                 return
@@ -1185,7 +1200,7 @@ def _router_handler_for(router: Router):
             with _spans.use(carrier):
                 res = router.route_request(
                     model or "default", raw, rows, priority=priority,
-                    timeout_ms=timeout_ms, carrier=carrier)
+                    timeout_ms=timeout_ms, carrier=carrier, verb=verb)
             self._send(res.code, res.body, res.headers)
 
     return RouteHandler

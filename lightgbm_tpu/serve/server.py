@@ -59,7 +59,8 @@ class Server:
         self._registries: Dict[str, ModelRegistry] = {
             DEFAULT_MODEL: ModelRegistry(
                 chunk_rows=self.config.max_batch_rows,
-                warm=self.config.warmup)}
+                warm=self.config.warmup,
+                fastpath_rows=self.config.fastpath_max_rows)}
         self._registries_lock = threading.Lock()
         self._stop = threading.Event()
         self.draining = False
@@ -92,7 +93,13 @@ class Server:
         # must be applied here — GBDT._engine() never runs
         if self.config.predict_cache_slots > 0:
             from ..ops.predict import get_engine
+            from ..ops.shap import get_shap_engine
             get_engine().set_cache_size(self.config.predict_cache_slots)
+            # the explanation engine shares the LRU-capacity contract:
+            # its serve-visible layouts x buckets must stay resident
+            # or steady-state explains would recompile
+            get_shap_engine().set_cache_size(
+                self.config.predict_cache_slots)
         if booster is not None:
             self.registry.publish(booster)
 
@@ -121,13 +128,37 @@ class Server:
                 buckets=_obs_metrics.OCCUPANCY_BUCKETS),
             "swaps": reg.counter(
                 "ltpu_serve_swaps_total", "model hot-swaps"),
+            # the explanation lane gets its own request/row/latency
+            # series: explain latency is a different distribution
+            # (O(depth^2) per leaf) and blending it into the predict
+            # histogram would poison the rollback watchdog's p99
+            "ex_requests": reg.counter(
+                "ltpu_serve_explain_requests_total",
+                "explain requests by terminal status", ("status",)),
+            "ex_rows": reg.counter(
+                "ltpu_serve_explain_rows_total",
+                "rows admitted into terminal explain requests",
+                ("status",)),
+            "ex_latency": reg.histogram(
+                "ltpu_serve_explain_latency_ms",
+                "total explain request latency (ok requests)",
+                buckets=lat_buckets),
+            "fp_batches": reg.counter(
+                "ltpu_serve_fastpath_batches_total",
+                "predict batches dispatched on the single-row "
+                "fast path"),
+            "fp_rows": reg.counter(
+                "ltpu_serve_fastpath_rows_total",
+                "rows dispatched on the single-row fast path"),
         }
         # request-path fast lane: labeled children resolved once, not
         # per request (the registry lookup costs real microseconds at
         # serve rates)
         m["lat_child"] = m["latency"].labels()
+        m["ex_lat_child"] = m["ex_latency"].labels()
         m["occ_child"] = m["occupancy"].labels()
         m["req_children"] = {}
+        m["ex_req_children"] = {}
         # gauges capture self: remember the closures so stop() can
         # release them (a dead server must not stay pinned in the
         # process-global registry through its scrape callbacks)
@@ -149,12 +180,16 @@ class Server:
             reg.gauge_callback(name, fn, help_)
         return m
 
-    def _metric_children(self, status: str):
-        ch = self._metrics["req_children"].get(status)
+    def _metric_children(self, status: str, kind: str = "predict"):
+        key = "ex_req_children" if kind == "explain" \
+            else "req_children"
+        ch = self._metrics[key].get(status)
         if ch is None:                     # benign race: idempotent
-            ch = (self._metrics["requests"].labels(status=status),
-                  self._metrics["rows"].labels(status=status))
-            self._metrics["req_children"][status] = ch
+            base = ("ex_requests", "ex_rows") if kind == "explain" \
+                else ("requests", "rows")
+            ch = (self._metrics[base[0]].labels(status=status),
+                  self._metrics[base[1]].labels(status=status))
+            self._metrics[key][status] = ch
         return ch
 
     def _make_recorder(self, telemetry):
@@ -251,7 +286,8 @@ class Server:
                         f"{sorted(self._registries)})")
                 reg = ModelRegistry(
                     chunk_rows=self.config.max_batch_rows,
-                    warm=self.config.warmup)
+                    warm=self.config.warmup,
+                    fastpath_rows=self.config.fastpath_max_rows)
                 self._registries[name] = reg
         return reg
 
@@ -321,12 +357,17 @@ class Server:
     def submit(self, data, priority: int = 0,
                timeout_ms: Optional[float] = None,
                raw: bool = False,
-               model: Optional[str] = None) -> Request:
-        """Admit one predict request against the named tenant (default
-        when ``model`` is None); returns the request future
-        (``.value()`` blocks for the result or raises).  Raises
+               model: Optional[str] = None,
+               kind: str = "predict") -> Request:
+        """Admit one request against the named tenant (default when
+        ``model`` is None); returns the request future (``.value()``
+        blocks for the result or raises).  ``kind="explain"`` admits
+        into the explanation lane (per-row SHAP contributions; the
+        batcher never mixes lanes in one device batch).  Raises
         :class:`QueueSaturated` immediately on backpressure and
         :class:`UnknownModel` for an unpublished tenant name."""
+        if kind not in ("predict", "explain"):
+            raise ValueError(f"unknown request kind {kind!r}")
         if not self._threads:
             raise ServerClosed("server not started (call start())")
         ver = self.registry_for(model).require()
@@ -354,7 +395,7 @@ class Server:
         with self._rid_lock:
             self._rid += 1
             rid = self._rid
-        req = Request(rid, X, raw, priority, deadline, ver)
+        req = Request(rid, X, raw, priority, deadline, ver, kind=kind)
         # the serve record is emitted on a dispatcher thread; carry
         # the submitter's trace context (HTTP header / caller span)
         # on the request so the record still joins its trace
@@ -379,6 +420,22 @@ class Server:
         ``raw_score=True``)."""
         req = self.submit(data, priority=priority,
                           timeout_ms=timeout_ms, raw=raw, model=model)
+        return self._await(req)
+
+    def explain(self, data, priority: int = 0,
+                timeout_ms: Optional[float] = None,
+                model: Optional[str] = None) -> np.ndarray:
+        """Blocking per-row SHAP contributions through the explanation
+        lane.  Output matches ``Booster.predict(pred_contrib=True)``:
+        (rows, nf+1) with the bias in the last column, multiclass
+        flattened to (rows, k*(nf+1)).  Contributions are raw-score
+        space by definition (per row, sum + bias == predict_raw)."""
+        req = self.submit(data, priority=priority,
+                          timeout_ms=timeout_ms, raw=True, model=model,
+                          kind="explain")
+        return self._await(req)
+
+    def _await(self, req: Request) -> np.ndarray:
         # grace beyond the deadline: the dispatcher times the request
         # out itself; this guard only catches a wedged worker
         grace = None
@@ -406,19 +463,40 @@ class Server:
             self._dispatch(batch)
 
     def _dispatch(self, batch: Batch) -> None:
+        from ..utils.telemetry import counters_snapshot
         t0 = time.monotonic()
+        explain = batch.kind == "explain"
+        compiles = 0.0
         try:
-            # fault-injection point ``serve.dispatch`` (utils/faults.py):
-            # "error" exercises the real failure path below; "sleep_<ms>"
-            # degrades latency so the rollback controller's p99 trigger
-            # is testable without a genuinely slow model
-            mode = _faults.fire("serve.dispatch")
+            # fault-injection points (utils/faults.py):
+            # ``serve.dispatch`` covers every batch, ``serve.explain``
+            # only the explanation lane — "error" exercises the real
+            # failure path below; "sleep_<ms>" degrades latency so the
+            # rollback controller's p99 trigger is testable without a
+            # genuinely slow model
+            mode = _faults.fire("serve.explain") if explain \
+                else _faults.fire("serve.dispatch")
+            if explain and not mode:
+                mode = _faults.fire("serve.dispatch")
             if mode.startswith("sleep_"):
                 time.sleep(max(float(mode.split("_", 1)[1]), 0.0) / 1e3)
             elif mode == "error":
                 raise RuntimeError(
-                    "injected fault (serve.dispatch:error)")
-            raw = batch.version.predict_raw_batch(batch.X)
+                    f"injected fault "
+                    f"(serve.{'explain' if explain else 'dispatch'}"
+                    f":error)")
+            if explain:
+                # steady-state explains must re-run cached programs;
+                # the compile delta rides the explain record so
+                # obs/rules.py can flag a warmed lane that compiles
+                base = counters_snapshot().get("xla_compiles", 0.0)
+                raw = batch.version.explain_batch(batch.X)
+                compiles = counters_snapshot().get(
+                    "xla_compiles", 0.0) - base
+            elif batch.fastpath:
+                raw = batch.version.predict_raw_fast_batch(batch.X)
+            else:
+                raw = batch.version.predict_raw_batch(batch.X)
         except Exception as exc:  # batch fails as a unit, loudly
             Log.warning("serve: batch dispatch failed: %s", exc)
             for r in batch.requests:
@@ -435,18 +513,34 @@ class Server:
         for r in batch.requests:
             sl = raw[pos:pos + r.rows]
             pos += r.rows
-            out = sl if r.raw else batch.version.convert(sl)
+            # contributions are raw-score space by definition (their
+            # row sum reproduces predict_raw) — never converted
+            out = sl if (r.raw or explain) \
+                else batch.version.convert(sl)
             r.timings["dispatch_ms"] = dispatch_ms
             if r.finish("ok", result=out):
-                self._emit(r, batch)
+                self._emit(r, batch, compiles=compiles)
         _tele_counters.incr("serve_batches")
         _tele_counters.incr("serve_batch_rows", batch.rows)
         _tele_counters.incr("serve_padded_rows", batch.bucket_rows)
+        if explain:
+            _tele_counters.incr("serve_explain_batches")
+            _tele_counters.incr("serve_explain_rows", batch.rows)
+        elif batch.fastpath:
+            _tele_counters.incr("serve_fastpath_batches")
+            _tele_counters.incr("serve_fastpath_rows", batch.rows)
+            if self._metrics is not None:
+                self._metrics["fp_batches"].inc()
+                self._metrics["fp_rows"].inc(batch.rows)
 
     # -- telemetry / stats -----------------------------------------------
-    def _emit(self, req: Request, batch: Optional[Batch] = None) -> None:
+    def _emit(self, req: Request, batch: Optional[Batch] = None,
+              compiles: float = 0.0) -> None:
         status = req.status
+        explain = req.kind == "explain"
         _tele_counters.incr("serve_requests")
+        if explain:
+            _tele_counters.incr("serve_explain_requests")
         if status != "ok":
             _tele_counters.incr(f"serve_{status}")
         with self._counts_lock:
@@ -454,11 +548,12 @@ class Server:
         if status == "ok":
             self._lat_hist.observe(req.timings.get("total_ms", 0.0))
         if self._metrics is not None:
-            c_req, c_rows = self._metric_children(status)
+            c_req, c_rows = self._metric_children(status, req.kind)
             c_req.inc()
             c_rows.inc(req.rows)
             if status == "ok":
-                self._metrics["lat_child"].observe(
+                self._metrics["ex_lat_child" if explain
+                              else "lat_child"].observe(
                     req.timings.get("total_ms", 0.0))
                 if batch is not None:
                     self._metrics["occ_child"].observe(
@@ -496,12 +591,21 @@ class Server:
             fields["batch_rows"] = batch.rows
             fields["bucket_rows"] = batch.bucket_rows
             fields["occupancy"] = round(batch.occupancy, 4)
+            if batch.fastpath:
+                fields["fastpath"] = True
+        if explain:
+            # rides the record so obs/rules.py can flag a warmed
+            # explain lane that still compiles (explain_compile MED);
+            # 0 past warmup IS the contract, so it is always present
+            fields["xla_compiles"] = compiles
         if req.error and status not in ("ok",):
             fields["error"] = str(req.error)[:200]
-        self._recorder.emit("serve", **fields)
+        self._recorder.emit("explain" if explain else "serve",
+                            **fields)
 
     def stats(self) -> Dict[str, Any]:
         from ..ops.predict import get_engine
+        from ..ops.shap import get_shap_engine
         with self._counts_lock:
             counts = dict(self._counts)
         depth_reqs, depth_rows = self.queue.depth()
@@ -523,6 +627,7 @@ class Server:
             },
             "retry_after_ms": self.queue.retry_after_ms(),
             "engine_cache": get_engine().cache_info(),
+            "explain_cache": get_shap_engine().cache_info(),
             "versions": self.registry.history(),
         }
 

@@ -27,6 +27,9 @@ point                  modes its call site interprets
 ``serve.dispatch``     ``error`` — the batch dispatch raises (requests
                        finish with status ``error``); ``sleep_<ms>`` —
                        adds latency to every dispatch (p99 regression)
+``serve.explain``      same modes, scoped to the explanation lane
+                       only (``serve/server.py``) — predict batches
+                       keep dispatching while explain degrades
 ``http.request``       ``error`` — the front answers a structured 500;
                        ``drop`` — the connection closes with no
                        response (client-visible transport failure)
@@ -186,7 +189,8 @@ __all__ = ["InjectedFault", "FaultSpec", "KNOWN_POINTS", "configure",
 # itself stays point-agnostic — this set only powers the typo warning.
 KNOWN_POINTS = frozenset({
     "ckpt.save", "watcher.validate", "watcher.canary", "serve.dispatch",
-    "http.request", "fleet.spawn", "ingest.read", "ingest.validate",
+    "serve.explain", "http.request", "fleet.spawn", "ingest.read",
+    "ingest.validate",
     "trainer.step", "trainer.refit", "mesh.collective",
     "mesh.heartbeat", "elastic.remesh", "router.backend",
     "router.admit", "stream.chunk_read", "stream.cache_write",

@@ -74,9 +74,9 @@ def percentile(sorted_vals, q: float) -> float:
 SCHEMA_VERSION = 1
 
 RECORD_TYPES = ("run_start", "iteration", "superstep", "eval", "predict",
-                "serve", "checkpoint", "fleet", "continual", "recovery",
-                "router", "ingest", "span", "capture", "sweep", "slo",
-                "autoscale", "pager", "run_end")
+                "serve", "explain", "checkpoint", "fleet", "continual",
+                "recovery", "router", "ingest", "span", "capture", "sweep",
+                "slo", "autoscale", "pager", "run_end")
 
 # per-type required fields on top of the common envelope; values are
 # (field, type-or-types) pairs the lint enforces
@@ -116,6 +116,17 @@ _TYPE_FIELDS: Dict[str, Tuple[Tuple[str, Any], ...]] = {
     # rolls up p50/p95/p99 total latency and shed/timeout counts.
     "serve": (("status", str), ("rows", int),
               ("total_ms", (int, float))),
+    # one record per ONLINE explanation request (serve/server.py, the
+    # /explain lane): same envelope and status vocabulary as ``serve``
+    # plus ``xla_compiles`` — the compile-counter DELTA measured across
+    # the request's device SHAP dispatch.  Steady state must be 0 (the
+    # publish-time warmup pre-compiles every explain bucket); a
+    # non-zero value past warmup is the explanation engine silently
+    # recompiling per request (MED anomaly ``explain_compile``,
+    # obs/rules.py).  The run_end summary rolls up request/row counts
+    # and p50/p95/p99 explain latency separately from the predict lane.
+    "explain": (("status", str), ("rows", int),
+                ("total_ms", (int, float))),
     # one record per checkpoint event (ckpt/manager.py): ``event`` is
     # save|load|fallback; saves carry iter/reason(periodic|preempt|
     # final)/bytes, loads carry iter/bytes, fallbacks carry the
@@ -477,6 +488,8 @@ class RunRecorder:
         self._serve_lat_n = 0
         self._serve_occ_sum = 0.0
         self._serve_occ_n = 0
+        self._explain_lat: List[float] = []
+        self._explain_lat_n = 0
         # routed-request latency ring (serve/router.py), same bounded
         # most-recent-samples policy as the serve ring
         self._router_lat: List[float] = []
@@ -591,6 +604,26 @@ class RunRecorder:
             if occ is not None:
                 self._serve_occ_sum += float(occ)
                 self._serve_occ_n += 1
+        elif t == "explain":
+            status = rec.get("status")
+            self._agg["explain_requests"] = \
+                self._agg.get("explain_requests", 0) + 1
+            self._agg["explain_rows"] = \
+                self._agg.get("explain_rows", 0) + int(rec.get("rows", 0))
+            compiles = float(rec.get("xla_compiles", 0.0) or 0.0)
+            if compiles:
+                self._agg["explain_compiles"] = \
+                    self._agg.get("explain_compiles", 0.0) + compiles
+            if status != "ok":
+                self._agg[f"explain_{status}"] = \
+                    self._agg.get(f"explain_{status}", 0) + 1
+                return
+            v = float(rec.get("total_ms", 0.0))
+            if len(self._explain_lat) < 65536:
+                self._explain_lat.append(v)
+            else:
+                self._explain_lat[self._explain_lat_n % 65536] = v
+            self._explain_lat_n += 1
         elif t == "checkpoint":
             event = rec.get("event")
             if event in ("save", "load", "fallback"):
@@ -786,6 +819,14 @@ class RunRecorder:
             if self._serve_occ_n:
                 out["serve_mean_occupancy"] = round(
                     self._serve_occ_sum / self._serve_occ_n, 4)
+            if self._explain_lat:
+                lat = sorted(self._explain_lat)
+                out["explain_total_ms_p50"] = \
+                    round(percentile(lat, 0.50), 3)
+                out["explain_total_ms_p95"] = \
+                    round(percentile(lat, 0.95), 3)
+                out["explain_total_ms_p99"] = \
+                    round(percentile(lat, 0.99), 3)
             if self._router_lat:
                 lat = sorted(self._router_lat)
                 out["router_total_ms_p50"] = \

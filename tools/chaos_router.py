@@ -16,6 +16,11 @@ hammer it.  The run exits non-zero unless:
 - a replica SIGKILL mid-traffic is invisible (retry/failover);
 - an injected backend brownout (``router.backend:sleep_*`` on
   scattered attempt ordinals) is hedged around — hedge wins > 0;
+- the explanation lane flows THROUGH the brownout: a slice of every
+  phase's traffic POSTs ``/explain`` (checked against a
+  per-fingerprint contribution oracle — a stale-model explanation
+  counts as mixed), explains keep answering while the backends are
+  browned out, and at least one explain is hedged;
 - a tightened admission budget sheds with STRUCTURED 429s (JSON
   ``code=backpressure`` + ``retry_after_ms`` + ``Retry-After``
   header) and never touches a backend;
@@ -131,12 +136,14 @@ def main(argv=None):
         loaded = lgb.Booster(model_str=text)
         return (model_fingerprint(
             loaded.model_to_string(num_iteration=-1)),
-            loaded.predict(X), text)
+            loaded.predict(X),
+            loaded.predict(X, pred_contrib=True), text)
 
-    fpA1, predsA1, textA1 = fp_preds(bA1)
-    fpA2, predsA2, textA2 = fp_preds(bA2)
-    fpB, predsB, textB = fp_preds(bB)
+    fpA1, predsA1, contribA1, textA1 = fp_preds(bA1)
+    fpA2, predsA2, contribA2, textA2 = fp_preds(bA2)
+    fpB, predsB, contribB, textB = fp_preds(bB)
     oracle = {fpA1: predsA1, fpA2: predsA2, fpB: predsB}
+    contrib_oracle = {fpA1: contribA1, fpA2: contribA2, fpB: contribB}
     print(f"router chaos: fingerprints a1={fpA1} a2={fpA2} b={fpB}",
           flush=True)
 
@@ -160,9 +167,9 @@ def main(argv=None):
                  os.environ.get("PYTHONPATH", "")})
 
     checks = {}
-    counts = {"ok": 0, "ok_m2": 0, "backpressure": 0, "dropped": 0,
-              "mixed_fingerprint": 0, "shed_structured": 0,
-              "shed_unstructured": 0}
+    counts = {"ok": 0, "ok_m2": 0, "ok_explain": 0, "backpressure": 0,
+              "dropped": 0, "mixed_fingerprint": 0,
+              "shed_structured": 0, "shed_unstructured": 0}
     lock = threading.Lock()
     stop = threading.Event()
     m2_live = threading.Event()
@@ -184,24 +191,32 @@ def main(argv=None):
     url = "http://127.0.0.1:%d" % httpd.server_address[1]
     print(f"router chaos: router at {url}", flush=True)
 
-    def check_response(st, out, hdrs, lo, n, kind):
+    def check_response(st, out, hdrs, lo, n, kind, explain=False):
         """Count one client-visible response; the oracle check is the
-        zero-mixed-fingerprint acceptance gate."""
+        zero-mixed-fingerprint acceptance gate (a stale-model
+        EXPLANATION counts as mixed exactly like a stale predict)."""
         if st == 200:
             mid = out.get("model_id")
-            exp = oracle.get(mid)
-            got = np.asarray(out.get("predictions", ()))
-            if exp is None or got.shape != (n,) or \
+            if explain:
+                exp = contrib_oracle.get(mid)
+                got = np.asarray(out.get("contributions", ()))
+            else:
+                exp = oracle.get(mid)
+                got = np.asarray(out.get("predictions", ()))
+            if exp is None or got.shape != exp[lo:lo + n].shape or \
                     not np.allclose(got, exp[lo:lo + n],
                                     rtol=1e-9, atol=1e-9):
                 with lock:
                     counts["mixed_fingerprint"] += 1
                     errors.append(f"{kind}: model_id {mid} does not "
-                                  f"match its predictions "
+                                  f"match its "
+                                  f"{'contributions' if explain else 'predictions'} "
                                   f"(rows {lo}:{lo + n})")
             else:
                 with lock:
                     counts["ok_m2" if kind == "m2" else "ok"] += 1
+                    if explain:
+                        counts["ok_explain"] += 1
             return
         if st == 429:
             with lock:
@@ -227,14 +242,18 @@ def main(argv=None):
             lo = int(r.randint(0, len(X) - 64))
             n = int(r.randint(1, 48))
             body = {"rows": X[lo:lo + n].tolist()}
+            explain = r.random_sample() < 0.25
+            verb = "explain" if explain else "predict"
             if m2_live.is_set() and r.random_sample() < 0.35:
-                st, out, hdrs = _post(url, "/v1/m2/predict", body,
+                st, out, hdrs = _post(url, f"/v1/m2/{verb}", body,
                                       timeout=60)
-                check_response(st, out, hdrs, lo, n, "m2")
+                check_response(st, out, hdrs, lo, n, "m2",
+                               explain=explain)
             else:
-                st, out, hdrs = _post(url, "/predict", body,
+                st, out, hdrs = _post(url, f"/{verb}", body,
                                       timeout=60)
-                check_response(st, out, hdrs, lo, n, "default")
+                check_response(st, out, hdrs, lo, n, "default",
+                               explain=explain)
             time.sleep(0.002)
 
     threads = [threading.Thread(target=client, args=(i,), daemon=True)
@@ -291,16 +310,28 @@ def main(argv=None):
                         for k in range(n0 + 1, n0 + 121, 3))
         faults.configure(spec)
         base = ok_total()
+        base_ex = counts["ok_explain"]
+        n_router_recs = len(recorder.records)
         _wait_until(lambda: ok_total() >= base + 80, 180,
                     "traffic through the brownout")
+        checks["explain_through_brownout"] = bool(
+            _wait_until(lambda: counts["ok_explain"] >= base_ex + 10,
+                        120, "explains through the brownout"))
         faults.configure("")
         st1 = router.stats()
         checks["hedges_fired"] = \
             st1["hedges"] - st0["hedges"] > 0
         checks["hedge_wins"] = \
             st1["hedge_wins"] - st0["hedge_wins"] > 0
+        # at least one brownout-window explain rode a hedge: the tail
+        # protection covers the explanation lane, not just predicts
+        checks["hedged_explain"] = any(
+            r.get("type") == "router" and r.get("event") == "request"
+            and r.get("verb") == "/explain" and r.get("hedged")
+            for r in recorder.records[n_router_recs:])
         print(f"router chaos: hedges {st1['hedges'] - st0['hedges']}, "
-              f"wins {st1['hedge_wins'] - st0['hedge_wins']}",
+              f"wins {st1['hedge_wins'] - st0['hedge_wins']}, "
+              f"explains {counts['ok_explain'] - base_ex}",
               flush=True)
 
         # phase 4: budget exhaustion — tighten m2's token bucket; the

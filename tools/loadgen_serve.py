@@ -1,8 +1,11 @@
 """Load generator for the online serving endpoint (serve/http.py).
 
-Drives mixed row-count predict requests from concurrent clients,
-optionally fires one mid-run hot-swap, and prints a JSON summary line
-(latency percentiles, throughput, status counts).  Three modes:
+Drives mixed row-count predict requests from concurrent clients —
+optionally with a slice of the traffic routed through the explanation
+lane (``--explain-frac``: those clients POST ``/explain`` and verify
+the per-row contribution width) — optionally fires one mid-run
+hot-swap, and prints a JSON summary line (latency percentiles,
+throughput, status counts per lane).  Three modes:
 
     # drive an already-running server
     python tools/loadgen_serve.py --url http://127.0.0.1:9595
@@ -71,27 +74,42 @@ def _get_text(url, path, timeout=30):
     return r.read().decode()
 
 
-def check_metrics_scrape(url, counts, swaps_expected=None):
-    """Scrape ``GET /metrics``, parse it as Prometheus text, and diff
-    the per-status request counters against the CLIENT-side oracle
-    ``counts`` — the live-metrics half of the CI serve smoke (the
-    scrape must match what the clients actually observed bit-for-bit).
-    Returns a summary dict with any mismatches."""
-    from lightgbm_tpu.obs import metrics as obs_metrics
-    text = _get_text(url, "/metrics")
-    parsed = obs_metrics.parse_text(text)      # raises on malformed
-    by_status = {dict(ls).get("status", ""): v
-                 for (name, ls), v in parsed.items()
-                 if name == "ltpu_serve_requests_total"}
+def _status_oracle(counts):
     # client-side 5xx buckets are server-side "error" statuses
     oracle = {}
     for key, v in counts.items():
         oracle_key = "error" if key.startswith("http_") else key
         oracle[oracle_key] = oracle.get(oracle_key, 0) + v
+    return oracle
+
+
+def _diff_by_status(parsed, series, counts):
+    by_status = {dict(ls).get("status", ""): v
+                 for (name, ls), v in parsed.items()
+                 if name == series}
+    oracle = _status_oracle(counts)
     mismatches = {
         k: {"scrape": by_status.get(k, 0.0), "oracle": oracle.get(k, 0)}
         for k in set(by_status) | set(oracle)
         if by_status.get(k, 0.0) != oracle.get(k, 0)}
+    return by_status, mismatches
+
+
+def check_metrics_scrape(url, counts, swaps_expected=None,
+                         explain_counts=None):
+    """Scrape ``GET /metrics``, parse it as Prometheus text, and diff
+    the per-status request counters against the CLIENT-side oracle
+    ``counts`` — the live-metrics half of the CI serve smoke (the
+    scrape must match what the clients actually observed bit-for-bit).
+    ``explain_counts`` diffs the explanation lane the same way against
+    ``ltpu_serve_explain_requests_total`` (the lanes have DISJOINT
+    series; a predict request must never bump the explain counter).
+    Returns a summary dict with any mismatches."""
+    from lightgbm_tpu.obs import metrics as obs_metrics
+    text = _get_text(url, "/metrics")
+    parsed = obs_metrics.parse_text(text)      # raises on malformed
+    by_status, mismatches = _diff_by_status(
+        parsed, "ltpu_serve_requests_total", counts)
     out = {
         "series": len(parsed),
         "by_status": by_status,
@@ -100,6 +118,16 @@ def check_metrics_scrape(url, counts, swaps_expected=None):
         "mismatches": mismatches,
         "passed": not mismatches and len(parsed) > 10,
     }
+    if explain_counts is not None:
+        ex_status, ex_mism = _diff_by_status(
+            parsed, "ltpu_serve_explain_requests_total", explain_counts)
+        out["explain_by_status"] = ex_status
+        out["explain_mismatches"] = ex_mism
+        out["fastpath_batches"] = parsed.get(
+            ("ltpu_serve_fastpath_batches_total", ()), 0.0)
+        out["fastpath_rows"] = parsed.get(
+            ("ltpu_serve_fastpath_rows_total", ()), 0.0)
+        out["passed"] = out["passed"] and not ex_mism
     if swaps_expected is not None:
         out["passed"] = out["passed"] and out["swaps"] == swaps_expected
     return out
@@ -110,25 +138,32 @@ from lightgbm_tpu.utils.telemetry import (  # noqa: E402 - jax-free
 
 
 def drive(url, n_requests, n_threads, rows_max, n_features, seed=0,
-          swap_model_file=None, priority_mix=False, surge_threads=0):
+          swap_model_file=None, priority_mix=False, surge_threads=0,
+          explain_frac=0.0):
     """Issue ``n_requests`` mixed-size requests from ``n_threads``
     clients; fire one hot-swap halfway through when
-    ``swap_model_file`` is given.  ``surge_threads`` adds that many
-    extra clients for the SECOND half of the run (a step load surge —
-    the driver for watching an SLO burn / autoscaler react) and the
-    summary reports per-half latency.  Returns the summary dict."""
+    ``swap_model_file`` is given.  ``explain_frac`` of the traffic
+    POSTs ``/explain`` instead (the explanation lane: the response's
+    ``contributions`` must be n rows of a CONSISTENT width > the
+    feature count — features + bias).  ``surge_threads`` adds that
+    many extra clients for the SECOND half of the run (a step load
+    surge — the driver for watching an SLO burn / autoscaler react)
+    and the summary reports per-half latency.  Returns the summary
+    dict."""
     import numpy as np
     rng = np.random.RandomState(seed)
     lock = threading.Lock()
     lat, counts, errors = [], {}, []
+    ex_lat, ex_counts = [], {}
     halves = ([], [])
     issued = [0]
     swap_at = n_requests // 2
     swap_result = {}
 
-    def bump(key):
+    def bump(key, explain=False):
         with lock:
-            counts[key] = counts.get(key, 0) + 1
+            d = ex_counts if explain else counts
+            d[key] = d.get(key, 0) + 1
 
     def client(tid):
         r = np.random.RandomState(1000 + tid)
@@ -146,29 +181,42 @@ def drive(url, n_requests, n_threads, rows_max, n_features, seed=0,
                     status=st, version=out.get("version"),
                     swap_ms=round((time.monotonic() - t0) * 1e3, 1))
                 continue
+            explain = r.random_sample() < explain_frac
             n = int(r.randint(1, rows_max + 1))
             body = {"rows": r.randn(n, n_features).tolist()}
             if priority_mix:
                 body["priority"] = int(r.randint(0, 3))
             t0 = time.monotonic()
-            st, out = _post(url, "/predict", body)
+            st, out = _post(url, "/explain" if explain else "/predict",
+                            body)
             ms = (time.monotonic() - t0) * 1e3
             if st == 200:
-                bump("ok")
-                if len(out.get("predictions", ())) != n:
+                bump("ok", explain)
+                if explain:
+                    contrib = out.get("contributions", ())
+                    widths = {len(row) for row in contrib}
+                    if len(contrib) != n or len(widths) != 1 or \
+                            min(widths) <= n_features:
+                        errors.append(
+                            f"bad contributions: {n} rows -> "
+                            f"{len(contrib)} x {sorted(widths)}")
+                    with lock:
+                        ex_lat.append(ms)
+                elif len(out.get("predictions", ())) != n:
                     errors.append(f"short response: {n} rows -> "
                                   f"{len(out.get('predictions', ()))}")
-                with lock:
-                    lat.append(ms)
-                    halves[1 if i > swap_at else 0].append(ms)
+                if not explain:
+                    with lock:
+                        lat.append(ms)
+                        halves[1 if i > swap_at else 0].append(ms)
             elif st == 429:
-                bump("rejected")
+                bump("rejected", explain)
                 time.sleep(max(float(out.get("retry_after_ms", 10)),
                                1.0) / 1e3)
             elif st in (503, 504):
-                bump("shed" if st == 503 else "timeout")
+                bump("shed" if st == 503 else "timeout", explain)
             else:
-                bump(f"http_{st}")
+                bump(f"http_{st}", explain)
                 errors.append(f"HTTP {st}: "
                               f"{str(out.get('error', ''))[:120]}")
 
@@ -200,7 +248,8 @@ def drive(url, n_requests, n_threads, rows_max, n_features, seed=0,
     wall_s = time.monotonic() - t_start
     lat.sort()
     out = {
-        "requests": sum(v for k, v in counts.items()),
+        "requests": sum(v for k, v in counts.items()) +
+        sum(v for k, v in ex_counts.items()),
         "counts": counts,
         "wall_s": round(wall_s, 3),
         "req_per_s": round(counts.get("ok", 0) / max(wall_s, 1e-9), 1),
@@ -209,6 +258,11 @@ def drive(url, n_requests, n_threads, rows_max, n_features, seed=0,
         "p99_ms": round(_percentile(lat, 0.99), 2),
         "errors": errors[:10],
     }
+    if explain_frac > 0:
+        ex_lat.sort()
+        out["explain_counts"] = ex_counts
+        out["explain_p50_ms"] = round(_percentile(ex_lat, 0.50), 2)
+        out["explain_p99_ms"] = round(_percentile(ex_lat, 0.99), 2)
     if surge_threads:
         for h in halves:
             h.sort()
@@ -254,13 +308,32 @@ def selftest(args):
     httpd, _ = serve_http(server, port=0, background=True)
     url = "http://127.0.0.1:%d" % httpd.server_address[1]
     try:
+        from lightgbm_tpu.utils.telemetry import counters_snapshot
+        # settle both lanes once, then pin the compile counter: the
+        # publish-time warmup pre-compiled every predict/explain/
+        # fast-path bucket, so the WHOLE driven run (including the
+        # same-layout mid-run swap) must not compile
+        _post(url, "/predict", {"rows": X[:3].tolist()})
+        _post(url, "/explain", {"rows": X[:3].tolist()})
+        base = counters_snapshot()
         res = drive(url, args.requests, args.threads, args.rows_max,
-                    n_features=8, swap_model_file=swap_file)
+                    n_features=8, swap_model_file=swap_file,
+                    explain_frac=args.explain_frac)
+        now = counters_snapshot()
+        res["steady_xla_compiles"] = \
+            now.get("xla_compiles", 0) - base.get("xla_compiles", 0)
+        # fold the two settle requests into the client-side oracle so
+        # the scrape diff stays bit-for-bit
+        res["counts"]["ok"] = res["counts"].get("ok", 0) + 1
+        ex = res.setdefault("explain_counts", {})
+        ex["ok"] = ex.get("ok", 0) + 1
         res["stats"] = _get(url, "/stats")
         # metrics-scrape smoke: /metrics must parse as Prometheus
         # text and its request counters must equal the client oracle
-        res["metrics"] = check_metrics_scrape(url, res["counts"],
-                                              swaps_expected=1)
+        # (per lane — predict and explain series are disjoint)
+        res["metrics"] = check_metrics_scrape(
+            url, res["counts"], swaps_expected=1,
+            explain_counts=res.get("explain_counts"))
     finally:
         httpd.shutdown()
         server.stop()
@@ -274,7 +347,10 @@ def selftest(args):
           and res.get("swap", {}).get("status") == 200
           and res["counts"].get("shed", 0) == 0
           and res["counts"].get("timeout", 0) == 0
+          and res["steady_xla_compiles"] == 0
           and res["metrics"]["passed"])
+    if args.explain_frac > 0:
+        ok = ok and res["explain_counts"].get("ok", 0) > 0
     res["passed"] = ok
     return res, 0 if ok else 1
 
@@ -309,6 +385,8 @@ def router_selftest(args):
 
     b1, b2 = train(4, 1), train(6, 2)
     exp1, exp2 = b1.predict(X), b2.predict(X)
+    contrib1 = b1.predict(X, pred_contrib=True)
+    contrib2 = b2.predict(X, pred_contrib=True)
     recorder = RunRecorder(args.telemetry or None,
                            run_info={"task": "router"},
                            keep_records=True)
@@ -331,12 +409,15 @@ def router_selftest(args):
     counts = {}
     errors = []
     swapped = threading.Event()
+    explain_on = threading.Event()
+    compile_base = {}
 
     def bump(key):
         with lock:
             counts[key] = counts.get(key, 0) + 1
 
     def client(tid):
+        from lightgbm_tpu.utils.telemetry import counters_snapshot
         r = np.random.RandomState(1000 + tid)
         per_client = args.requests // max(args.threads, 1)
         for i in range(per_client):
@@ -344,18 +425,26 @@ def router_selftest(args):
             n = int(r.randint(1, min(args.rows_max, 64) + 1))
             body = {"rows": X[lo:lo + n].tolist()}
             use_m2 = swapped.is_set() and r.random_sample() < 0.4
-            path = "/v1/m2/predict" if use_m2 else "/predict"
+            explain = explain_on.is_set() and r.random_sample() < 0.3
+            verb = "explain" if explain else "predict"
+            path = f"/v1/m2/{verb}" if use_m2 else f"/{verb}"
             st, out = _post(url, path, body)
             if st == 200:
-                exp = exp2 if use_m2 else exp1
-                got = np.asarray(out.get("predictions", ()))
-                if got.shape == (n,) and np.allclose(
-                        got, exp[lo:lo + n], rtol=1e-9, atol=1e-9):
-                    bump("ok")
+                if explain:
+                    exp = contrib2 if use_m2 else contrib1
+                    got = np.asarray(out.get("contributions", ()))
+                    key_ok, key_bad = "ok_explain", "mixed"
                 else:
-                    bump("mixed")
+                    exp = exp2 if use_m2 else exp1
+                    got = np.asarray(out.get("predictions", ()))
+                    key_ok, key_bad = "ok", "mixed"
+                if got.shape == exp[lo:lo + n].shape and np.allclose(
+                        got, exp[lo:lo + n], rtol=1e-9, atol=1e-9):
+                    bump(key_ok)
+                else:
+                    bump(key_bad)
                     errors.append(f"{path}: response does not match "
-                                  f"the model's predictions")
+                                  f"the model's {verb} oracle")
             elif st == 429:
                 bump("shed")
                 time.sleep(max(float(out.get("retry_after_ms", 10)),
@@ -373,6 +462,13 @@ def router_selftest(args):
                         len(sup.endpoints()) < 2:
                     time.sleep(0.05)
                 swapped.set()
+                # settle the explanation lane once per tenant, then
+                # pin the compile counter: every explain routed after
+                # this point must hit publish-warmed programs
+                _post(url, "/explain", {"rows": X[:2].tolist()})
+                _post(url, "/v1/m2/explain", {"rows": X[:2].tolist()})
+                compile_base.update(counters_snapshot())
+                explain_on.set()
 
     try:
         threads = [threading.Thread(target=client, args=(i,))
@@ -384,8 +480,14 @@ def router_selftest(args):
             t.join()
         wall = time.monotonic() - t0
         stats = router.stats()
+        from lightgbm_tpu.utils.telemetry import counters_snapshot
+        now = counters_snapshot()
+        steady_compiles = now.get("xla_compiles", 0) - \
+            compile_base.get("xla_compiles", 0) if compile_base else -1
         # metrics-scrape oracle: the router's own counters must equal
-        # the client-observed counts bit-for-bit
+        # the client-observed counts bit-for-bit (the router counts
+        # BOTH verbs in one series; the two settle explains rode it
+        # too, so they join the oracle)
         text = _get_text(url, "/metrics")
         parsed = obs_metrics.parse_text(text)
         by_status = {dict(ls).get("status", ""): v
@@ -398,6 +500,8 @@ def router_selftest(args):
         router.stop()
         sup.stop()
         recorder.close()
+    oracle_ok = counts.get("ok", 0) + counts.get("ok_explain", 0) + \
+        (2 if explain_on.is_set() else 0)
     res = {
         "mode": "router",
         "counts": counts,
@@ -407,10 +511,13 @@ def router_selftest(args):
                          ("requests", "hedges", "hedge_wins",
                           "retries", "latency_ms")},
         "metrics_ok_scrape": scrape_ok,
+        "steady_xla_compiles": steady_compiles,
         "errors": errors[:10],
     }
     ok = (not errors and counts.get("ok", 0) > 0
-          and scrape_ok == counts.get("ok", 0)
+          and counts.get("ok_explain", 0) > 0
+          and scrape_ok == oracle_ok
+          and steady_compiles == 0
           and swapped.is_set())
     res["passed"] = ok
     return res, 0 if ok else 1
@@ -759,6 +866,10 @@ def main(argv=None):
                     help="feature count for --url mode payloads")
     ap.add_argument("--swap-model", help="model file to hot-swap in "
                                          "mid-run (--url mode)")
+    ap.add_argument("--explain-frac", type=float, default=0.25,
+                    help="fraction of driven traffic routed through "
+                         "POST /explain (the explanation lane; "
+                         "--selftest and --url modes)")
     ap.add_argument("--surge-threads", type=int, default=0,
                     help="--url mode: add this many extra clients for "
                          "the second half of the run (a step load "
@@ -779,7 +890,8 @@ def main(argv=None):
         res = drive(args.url.rstrip("/"), args.requests, args.threads,
                     args.rows_max, args.features,
                     swap_model_file=args.swap_model,
-                    surge_threads=args.surge_threads)
+                    surge_threads=args.surge_threads,
+                    explain_frac=args.explain_frac)
         res["mode"] = "url"
         rc = 0 if not res["errors"] and res["counts"].get("ok") else 1
         res["passed"] = rc == 0
